@@ -1,0 +1,124 @@
+// Native host oracle for tpu_reductions — the framework's C++ runtime
+// component, mirroring the role of the reference's native CPU reference
+// reductions (Kahan-compensated sum + linear min/max scans,
+// reference cuda/C/src/reduction/reduction.cpp:206-249) and its vendored
+// MT19937 + cycle-timer header (mpi/externalfunctions.h). Written from
+// scratch: MT19937 comes from the C++ standard library, the timer from
+// std::chrono — no vendored numerics.
+//
+// Built as a plain shared library (see csrc/Makefile) and loaded from
+// Python via ctypes (tpu_reductions/ops/oracle.py). All entry points are
+// extern "C" with flat pointer+length signatures so the ctypes layer stays
+// trivial.
+
+#include <chrono>
+#include <cstdint>
+#include <limits>
+#include <random>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Kahan-compensated sums (the float/double oracle; reduction.cpp:214-227
+// uses the same compensation so the oracle stays accurate at n = 2^24).
+// ---------------------------------------------------------------------------
+
+double oracle_kahan_sum_f32(const float* data, int64_t n) {
+  double sum = 0.0, c = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    double y = static_cast<double>(data[i]) - c;
+    double t = sum + y;
+    c = (t - sum) - y;
+    sum = t;
+  }
+  return sum;
+}
+
+double oracle_kahan_sum_f64(const double* data, int64_t n) {
+  double sum = 0.0, c = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    double y = data[i] - c;
+    double t = sum + y;
+    c = (t - sum) - y;
+    sum = t;
+  }
+  return sum;
+}
+
+// int32 sum with two's-complement wraparound, matching the device's int32
+// accumulator (XLA int32 reduce wraps; so did the reference's int path,
+// reduction.cpp:748,776-777). Unsigned arithmetic avoids UB.
+int32_t oracle_sum_i32(const int32_t* data, int64_t n) {
+  uint32_t acc = 0;
+  for (int64_t i = 0; i < n; ++i) acc += static_cast<uint32_t>(data[i]);
+  return static_cast<int32_t>(acc);
+}
+
+// ---------------------------------------------------------------------------
+// Linear min/max scans (reduction.cpp:228-249 analog).
+// ---------------------------------------------------------------------------
+
+#define DEFINE_MINMAX(SUFFIX, T)                                     \
+  T oracle_min_##SUFFIX(const T* data, int64_t n) {                  \
+    T best = data[0];                                                \
+    for (int64_t i = 1; i < n; ++i)                                  \
+      if (data[i] < best) best = data[i];                            \
+    return best;                                                     \
+  }                                                                  \
+  T oracle_max_##SUFFIX(const T* data, int64_t n) {                  \
+    T best = data[0];                                                \
+    for (int64_t i = 1; i < n; ++i)                                  \
+      if (data[i] > best) best = data[i];                            \
+    return best;                                                     \
+  }
+
+DEFINE_MINMAX(i32, int32_t)
+DEFINE_MINMAX(f32, float)
+DEFINE_MINMAX(f64, double)
+#undef DEFINE_MINMAX
+
+// ---------------------------------------------------------------------------
+// MT19937 payload generation (externalfunctions.h analog, via std::mt19937).
+// Fills the same masked-byte distributions the drivers use
+// (reduction.cpp:698-705): ints in [0,255]; reals byte/RAND_MAX.
+// ---------------------------------------------------------------------------
+
+static std::mt19937 make_engine(uint32_t rank, uint32_t seed) {
+  // Rank-offset seeding discipline (reduce.c:38-41 analog).
+  std::seed_seq seq{0x1571u + rank + seed, 0x2662u, 0x3753u, 0x4844u};
+  return std::mt19937(seq);
+}
+
+void oracle_fill_i32(int32_t* out, int64_t n, uint32_t rank, uint32_t seed) {
+  std::mt19937 eng = make_engine(rank, seed);
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = static_cast<int32_t>(eng() & 0xFFu);
+}
+
+void oracle_fill_f32(float* out, int64_t n, uint32_t rank, uint32_t seed) {
+  std::mt19937 eng = make_engine(rank, seed);
+  const float inv = 1.0f / 2147483647.0f;  // 1/RAND_MAX
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = static_cast<float>(eng() & 0xFFu) * inv;
+}
+
+void oracle_fill_f64(double* out, int64_t n, uint32_t rank, uint32_t seed) {
+  std::mt19937 eng = make_engine(rank, seed);
+  const double inv = 1.0 / 2147483647.0;
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = static_cast<double>(eng() & 0xFFu) * inv;
+}
+
+// ---------------------------------------------------------------------------
+// Monotonic nanosecond clock (the rdtsc/CLOCK_RATE analog,
+// externalfunctions.h:7-43 + constants.h:4 — but a real clock, never a
+// hard-coded frequency; SURVEY.md §5 tracing note).
+// ---------------------------------------------------------------------------
+
+int64_t oracle_now_ns(void) {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // extern "C"

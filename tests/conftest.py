@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+Forces an 8-device virtual CPU platform BEFORE any test imports touch jax —
+the multi-device simulation path the reference never had (its distributed
+testing was "run on Blue Gene and eyeball rank-0 stdout", SURVEY.md §4).
+Pallas kernels run in interpreter mode on CPU (pallas_reduce picks this up
+automatically from the backend).
+
+Note: the axon TPU plugin in this image overrides the JAX_PLATFORMS env
+var, so the platform must be forced through jax.config instead.
+"""
+
+import os
+
+# harmless on the config path, but kept for plain-jaxlib environments
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+# f64 configs need x64; enabling it globally keeps tests order-independent.
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,53 @@
+"""L0 config tests: flag parity with the reference CLI (reduction.cpp:31-40)."""
+
+import pytest
+
+from tpu_reductions.config import (KERNEL_SINGLE_PASS, ReduceConfig,
+                                   parse_collective, parse_single_chip)
+
+
+def test_defaults_match_reference():
+    # n=1<<24, threads=256, kernel=6, maxBlocks=64 (reduction.cpp:665-668)
+    cfg = ReduceConfig(method="SUM")
+    assert cfg.n == 1 << 24
+    assert cfg.threads == 256
+    assert cfg.kernel == KERNEL_SINGLE_PASS
+    assert cfg.max_blocks == 64
+    assert cfg.cpu_thresh == 1
+    assert cfg.iterations == 100
+
+
+def test_dtype_aliases():
+    # reference spells dtypes int/float/double (reduction.cpp:96-109)
+    assert ReduceConfig(method="SUM", dtype="int").dtype == "int32"
+    assert ReduceConfig(method="MIN", dtype="float").dtype == "float32"
+    assert ReduceConfig(method="MAX", dtype="double").dtype == "float64"
+
+
+def test_method_required():
+    # missing --method exits, like reduction.cpp:124-128
+    with pytest.raises(SystemExit):
+        parse_single_chip([])
+
+
+def test_method_validation():
+    with pytest.raises(ValueError):
+        ReduceConfig(method="PROD")
+
+
+def test_cli_round_trip():
+    cfg, shmoo = parse_single_chip(
+        ["--method=MIN", "--type=double", "--n=4096", "--threads=128",
+         "--kernel=7", "--maxblocks=8", "--cpufinal", "--cputhresh=4"])
+    assert cfg.method == "MIN" and cfg.dtype == "float64"
+    assert cfg.n == 4096 and cfg.threads == 128
+    assert cfg.kernel == 7 and cfg.max_blocks == 8
+    assert cfg.cpu_final and cfg.cpu_thresh == 4
+    assert not shmoo
+
+
+def test_collective_cli():
+    ccfg = parse_collective(["--method=SUM", "--type=double", "--n=1024",
+                             "--devices=8", "--mode=co", "--rooted"])
+    assert ccfg.num_devices == 8 and ccfg.mode == "co" and ccfg.rooted
+    assert ccfg.retries == 5  # RETRY_COUNT analog (constants.h:5)

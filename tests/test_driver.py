@@ -1,0 +1,59 @@
+"""L4 driver tests: the full self-verifying benchmark flow on CPU."""
+
+import numpy as np
+import pytest
+
+from tpu_reductions.bench.driver import main, run_benchmark
+from tpu_reductions.config import ReduceConfig
+from tpu_reductions.utils.logging import BenchLogger
+from tpu_reductions.utils.qa import QAStatus
+
+
+def _cfg(**kw):
+    base = dict(method="SUM", dtype="int32", n=4096, iterations=3, warmup=1,
+                log_file=None, master_log=None)
+    base.update(kw)
+    return ReduceConfig(**base)
+
+
+@pytest.mark.parametrize("dtype", ["int32", "float32", "float64"])
+@pytest.mark.parametrize("method", ["SUM", "MIN", "MAX"])
+def test_run_benchmark_all_configs(method, dtype):
+    # the 9 runTest instantiations (reduction.cpp:161-200) in one driver
+    res = run_benchmark(_cfg(method=method, dtype=dtype))
+    assert res.status == QAStatus.PASSED, res.to_dict()
+    assert res.gbps > 0 and res.iterations == 3
+
+
+def test_run_benchmark_xla_backend():
+    res = run_benchmark(_cfg(backend="xla", method="MAX", dtype="float32"))
+    assert res.passed
+
+
+def test_waived_kernel():
+    # kernels 0-5 -> WAIVED (reduction_kernel.cu:278-289 emptied cases)
+    res = run_benchmark(_cfg(kernel=3))
+    assert res.status == QAStatus.WAIVED
+
+
+def test_two_pass_and_cpufinal():
+    for kw in [dict(kernel=7), dict(kernel=7, cpu_final=True),
+               dict(cpu_final=True)]:
+        res = run_benchmark(_cfg(method="MIN", dtype="float32", n=100_000,
+                                 threads=16, max_blocks=8, **kw))
+        assert res.passed, res.to_dict()
+
+
+def test_throughput_line_in_logs(tmp_path):
+    app = tmp_path / "app.txt"
+    master = tmp_path / "master.txt"
+    logger = BenchLogger(str(app), str(master))
+    run_benchmark(_cfg(), logger=logger)
+    assert "Reduction, Throughput = " in master.read_text()
+
+
+def test_cli_main_exit_codes(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    code = main(["--method=SUM", "--type=int", "--n=4096",
+                 "--iterations=2", "--logfile", str(tmp_path / "r.txt")])
+    assert code == 0

@@ -1,0 +1,84 @@
+"""Host-oracle tests: Kahan accuracy, wrapping int sum, native/numpy parity."""
+
+import math
+
+import numpy as np
+import pytest
+
+from tpu_reductions.ops import oracle
+
+
+def test_int32_sum_wraps():
+    # int32 accumulator wraps mod 2^32, matching device semantics
+    # (reduction.cpp:748,776-777 — int compare is exact-match)
+    x = np.array([2**31 - 1, 2**31 - 1, 5], dtype=np.int32)
+    got = oracle.host_reduce(x, "SUM")
+    expect = np.int64(int(x[0]) + int(x[1]) + 5).astype(np.int32)  # wraps
+    assert got == expect
+
+
+def test_kahan_beats_naive_f32():
+    # an adversarial payload where naive f32 summation visibly drifts
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 1, size=1 << 16).astype(np.float32)
+    exact = math.fsum(x.astype(np.float64).tolist())
+    got = float(oracle.host_reduce(x, "SUM"))
+    assert abs(got - exact) < 1e-6
+
+
+def test_f64_sum_matches_fsum():
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1e-7, size=1 << 14)
+    exact = math.fsum(x.tolist())
+    got = float(oracle.host_reduce(x, "SUM"))
+    assert abs(got - exact) < 1e-15
+
+
+@pytest.mark.parametrize("dtype", ["int32", "float32", "float64"])
+@pytest.mark.parametrize("method", ["MIN", "MAX"])
+def test_minmax(dtype, method):
+    rng = np.random.default_rng(2)
+    x = (rng.integers(-1000, 1000, 4097).astype(dtype) if dtype == "int32"
+         else rng.standard_normal(4097).astype(dtype))
+    got = oracle.host_reduce(x, method)
+    expect = x.min() if method == "MIN" else x.max()
+    assert got == expect and got.dtype == x.dtype
+
+
+def test_native_and_fallback_agree(monkeypatch):
+    rng = np.random.default_rng(3)
+    x32 = rng.uniform(0, 1, 10_001).astype(np.float32)
+    xi = rng.integers(0, 256, 10_001).astype(np.int32)
+    cases = [("SUM", x32), ("MIN", x32), ("MAX", x32), ("SUM", xi)]
+    res_native = [oracle.host_reduce(arr, m) for m, arr in cases]
+    # force the numpy fallback
+    monkeypatch.setattr(oracle, "_lib", None)
+    monkeypatch.setattr(oracle, "_lib_tried", True)
+    for (m, arr), val in zip(cases, res_native):
+        fb = oracle.host_reduce(arr, m)
+        assert abs(float(fb) - float(val)) < 1e-9
+
+
+def test_native_fill_matches_distribution():
+    x = oracle.native_fill(1 << 12, "int32", rank=1, seed=0)
+    if x is None:
+        pytest.skip("native oracle not built")
+    assert x.min() >= 0 and x.max() <= 255
+    y = oracle.native_fill(1 << 12, "int32", rank=1, seed=0)
+    np.testing.assert_array_equal(x, y)  # deterministic per (rank, seed)
+    z = oracle.native_fill(1 << 12, "int32", rank=2, seed=0)
+    assert not np.array_equal(x, z)
+
+
+def test_verify_tolerances():
+    # acceptance rule parity (reduction.cpp:750,763-765,776-779)
+    ok, _ = oracle.verify(100, 100, "SUM", "int32", 1 << 24)
+    bad, _ = oracle.verify(100, 101, "SUM", "int32", 1 << 24)
+    assert ok and not bad
+    n = 1 << 24
+    ok, _ = oracle.verify(1.0 + 0.5e-8 * n, 1.0, "SUM", "float32", n)
+    bad, _ = oracle.verify(1.0 + 2e-8 * n, 1.0, "SUM", "float32", n)
+    assert ok and not bad
+    ok, _ = oracle.verify(1.0 + 0.5e-12, 1.0, "SUM", "float64", n)
+    bad, _ = oracle.verify(1.0 + 2e-12, 1.0, "SUM", "float64", n)
+    assert ok and not bad

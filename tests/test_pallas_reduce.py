@@ -1,0 +1,97 @@
+"""Pallas kernel tests (interpret mode on the CPU test platform).
+
+Includes regression tests pinning the cases the reference gets WRONG
+(SURVEY.md §2.2): non-pow2 min/max (broken load guard,
+reduction_kernel.cu:157,221 + unconditional OOB first load :140,204) and
+multi-pass / host-finished min/max (the `+=` instead of min/max bug,
+reduction.cpp:426-429,456-459,516-521,546-551).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_reductions.ops.pallas_reduce import (choose_tiling, pallas_reduce,
+                                              make_staged_reduce)
+from tpu_reductions.ops import oracle
+from tpu_reductions.utils.rng import host_data
+
+
+def _expect(x, method):
+    if method == "SUM":
+        return (x.sum(dtype=np.int64).astype(np.int32)
+                if x.dtype == np.int32 else x.astype(np.float64).sum())
+    return x.min() if method == "MIN" else x.max()
+
+
+def _tol(method, dtype, n):
+    if method != "SUM" or dtype == "int32":
+        return 0.0
+    return 1e-12 if dtype == "float64" else 1e-8 * n
+
+
+@pytest.mark.parametrize("dtype", ["int32", "float32", "float64"])
+@pytest.mark.parametrize("method", ["SUM", "MIN", "MAX"])
+@pytest.mark.parametrize("kernel", [6, 7])
+def test_pallas_matches_oracle(method, dtype, kernel):
+    n = 10_000  # non-pow2, non-multiple of the tile
+    x = host_data(n, dtype, rank=0)
+    got = np.asarray(pallas_reduce(jnp.asarray(x), method, kernel=kernel,
+                                   threads=32, max_blocks=4))
+    expect = _expect(x, method)
+    assert abs(float(got) - float(expect)) <= _tol(method, dtype, n)
+
+
+@pytest.mark.parametrize("n", [1, 7, 128, 129, 1024, 4097, 8192, 100_000])
+@pytest.mark.parametrize("method", ["MIN", "MAX"])
+def test_nonpow2_minmax_regression(n, method):
+    """The reference's min/max kernels read OOB and mis-guard the second
+    load for non-pow2 n (reduction_kernel.cu:140,157,204,221). Identity
+    padding makes every size exact here — pinned across awkward sizes."""
+    rng = np.random.default_rng(n)
+    x = rng.integers(-2**30, 2**30, size=n).astype(np.int32)
+    got = np.asarray(pallas_reduce(jnp.asarray(x), method, threads=16,
+                                   max_blocks=4))
+    assert got == _expect(x, method)
+
+
+@pytest.mark.parametrize("method", ["MIN", "MAX", "SUM"])
+def test_multipass_and_hostfinal_minmax_regression(method):
+    """cpu_final / cpu_thresh paths must use the op's combine, not `+=`
+    (the reference bug at reduction.cpp:426-429,516-521)."""
+    n = 50_000
+    x = host_data(n, "float32", rank=1)
+    for kwargs in [dict(kernel=7, cpu_thresh=4),
+                   dict(kernel=7, cpu_final=True),
+                   dict(kernel=6, cpu_final=True)]:
+        got = np.asarray(pallas_reduce(jnp.asarray(x), method, threads=16,
+                                       max_blocks=8, **kwargs))
+        assert abs(float(got) - float(_expect(x, method))) <= \
+            _tol(method, "float32", n)
+
+
+def test_choose_tiling_geometry():
+    # threads -> tile rows (sublane-aligned), maxblocks clamps partials
+    tm, p, t = choose_tiling(1 << 20, threads=256, max_blocks=64)
+    assert tm % 8 == 0 and tm <= 256
+    assert p <= 64
+    assert p * t * tm * 128 >= 1 << 20
+    # tiny n: single block
+    tm, p, t = choose_tiling(100, threads=256, max_blocks=64)
+    assert p == 1 and t == 1
+
+
+def test_staged_reduce_matches():
+    n = 123_457
+    x = host_data(n, "float32", rank=0)
+    stage_fn, fn = make_staged_reduce("SUM", n, "float32", threads=64,
+                                      max_blocks=16, kernel=7)
+    staged = stage_fn(jnp.asarray(x))
+    got = np.asarray(fn(staged))
+    assert abs(float(got) - float(_expect(x, "SUM"))) <= 1e-8 * n
+
+
+def test_waived_kernel_ids():
+    with pytest.raises(ValueError):
+        pallas_reduce(jnp.arange(16, dtype=jnp.float32), "SUM", kernel=3)

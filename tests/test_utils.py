@@ -1,0 +1,96 @@
+"""L1 tests: QA protocol, timing, logging rows, RNG determinism."""
+
+import io
+import time
+
+import numpy as np
+
+from tpu_reductions.utils.logging import (BenchLogger, COLLECTIVE_HEADER,
+                                          collective_row, throughput_line)
+from tpu_reductions.utils.qa import QAStatus, qa_finish, qa_start
+from tpu_reductions.utils.rng import host_data
+from tpu_reductions.utils.timing import Stopwatch, TimerRegistry, time_fn
+
+
+def test_qa_markers():
+    # exact shrQATest marker grammar (shrQATest.h:83-112,224-229)
+    buf = io.StringIO()
+    qa_start("reduction_tpu", ["--method=SUM"], out=buf)
+    code = qa_finish("reduction_tpu", QAStatus.PASSED, out=buf)
+    lines = buf.getvalue().splitlines()
+    assert lines[0] == "&&&& RUNNING reduction_tpu --method=SUM"
+    assert lines[1] == "&&&& reduction_tpu PASSED"
+    assert code == 0
+    assert int(QAStatus.FAILED) == 1 and int(QAStatus.WAIVED) == 2
+
+
+def test_stopwatch_average():
+    sw = Stopwatch()
+    for _ in range(3):
+        sw.start()
+        time.sleep(0.001)
+        sw.stop()
+    assert sw.sessions == 3
+    assert 0.0005 < sw.average_s < 0.1
+    sw.reset()
+    assert sw.sessions == 0 and sw.total_s == 0.0
+
+
+def test_timer_registry():
+    reg = TimerRegistry()
+    reg.create("t")
+    reg["t"].start()
+    reg["t"].stop()
+    assert reg["t"].sessions == 1
+    reg.delete("t")
+
+
+def test_time_fn_counts_iterations():
+    import jax.numpy as jnp
+    result, sw = time_fn(lambda x: x + 1, jnp.zeros(8), iterations=5, warmup=2)
+    assert sw.sessions == 5
+    assert float(result[0]) == 1.0
+
+
+def test_throughput_line_format():
+    # reduction.cpp:744-745 format
+    line = throughput_line(90.8413, 0.00074, 1 << 24, workgroup=256)
+    assert line == ("Reduction, Throughput = 90.8413 GB/s, Time = 0.00074 s, "
+                    "Size = 16777216 Elements, NumDevsUsed = 1, "
+                    "Workgroup = 256")
+
+
+def test_collective_row_format():
+    # reduce.c:81,95 rank-0 schema; getAvgs.sh greps on these fields
+    assert collective_row("int32", "SUM", 64, 9.182) == "INT SUM 64 9.182"
+    assert collective_row("float64", "MAX", 1024, 90.315) == \
+        "DOUBLE MAX 1024 90.315"
+    assert COLLECTIVE_HEADER == "DATATYPE OP NODES GB/sec"
+
+
+def test_logger_fanout(tmp_path):
+    app, master = tmp_path / "app.txt", tmp_path / "master.txt"
+    console = io.StringIO()
+    lg = BenchLogger(str(app), str(master), console=console)
+    lg.log("plain")
+    lg.log_master("canonical")
+    assert "plain" in console.getvalue()
+    assert app.read_text() == "plain\ncanonical\n"
+    assert master.read_text() == "canonical\n"  # only LOGBOTH|MASTER lines
+
+
+def test_host_data_deterministic_and_masked():
+    a = host_data(1000, "int32", rank=3, seed=7)
+    b = host_data(1000, "int32", rank=3, seed=7)
+    c = host_data(1000, "int32", rank=4, seed=7)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)  # rank-offset seeding (reduce.c:38-41)
+    # masked-byte distribution (reduction.cpp:700): ints in [0, 255]
+    assert a.min() >= 0 and a.max() <= 255 and a.dtype == np.int32
+
+
+def test_host_data_real_distribution():
+    x = host_data(1000, "float64", rank=0)
+    # (byte)/RAND_MAX: tiny positive reals (reduction.cpp:702-704)
+    assert x.dtype == np.float64
+    assert (x >= 0).all() and x.max() <= 255 / (2**31 - 1)

@@ -1,0 +1,29 @@
+"""XLA-baseline reduce vs numpy, all ops x dtypes."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_reductions.ops.xla_reduce import make_xla_reduce, xla_reduce
+from tpu_reductions.utils.rng import host_data
+
+
+@pytest.mark.parametrize("dtype", ["int32", "float32", "float64"])
+@pytest.mark.parametrize("method", ["SUM", "MIN", "MAX"])
+def test_xla_vs_numpy(method, dtype):
+    x = host_data(4099, dtype, rank=0)  # deliberately non-pow2
+    got = np.asarray(xla_reduce(jnp.asarray(x), method))
+    if method == "SUM":
+        expect = x.sum(dtype=np.int64).astype(np.int32) if dtype == "int32" \
+            else x.astype(np.float64).sum()
+        tol = 0 if dtype == "int32" else 1e-6
+        assert abs(float(got) - float(expect)) <= tol
+    else:
+        expect = x.min() if method == "MIN" else x.max()
+        assert got == expect
+
+
+def test_make_xla_reduce_closure():
+    fn = make_xla_reduce("MAX")
+    x = jnp.arange(100, dtype=jnp.int32)
+    assert int(fn(x)) == 99

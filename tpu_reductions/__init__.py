@@ -1,0 +1,26 @@
+"""tpu_reductions — a TPU-native reduction-benchmark framework.
+
+Rebuilds the capability surface of szabodabo/CUDA-MPI-Reductions (see
+/root/repo/SURVEY.md) as an idiomatic JAX/XLA/Pallas framework:
+
+- Reduction ops SUM / MIN / MAX over int32 / float32 / float64
+  (reference: cuda/C/src/reduction/reduction_kernel.cu, mpi/reduce.c:21-28).
+- Single-chip hierarchical Pallas reduction kernels — the TPU analog of the
+  tree + warp-synchronous CUDA "kernel 6" (reduction_kernel.cu:74-253).
+- Cross-chip collective reductions over a `jax.sharding.Mesh` — the analog of
+  `MPI_Reduce` over the Blue Gene/L torus (mpi/reduce.c:76,90).
+- Self-verifying benchmark drivers (accelerator vs Kahan host oracle,
+  PASSED/FAILED/WAIVED protocol — reduction.cpp:206-249, shrQATest.h).
+- A sweep -> collect -> average -> plot pipeline (mpi/submit_all.sh,
+  getAvgs.sh, makePlots.gp analogs).
+
+Layer map (SURVEY.md §7):
+  L0 config/CLI      tpu_reductions.config
+  L1 runtime utils   tpu_reductions.utils.{timing,logging,qa,rng}
+  L2 ops             tpu_reductions.ops.{registry,xla_reduce,pallas_reduce,oracle}
+  L3 collectives     tpu_reductions.parallel.{mesh,collectives}
+  L4 drivers         tpu_reductions.bench.{driver,collective_driver}
+  L5 sweep/analysis  tpu_reductions.bench.{sweep,aggregate,plot}
+"""
+
+__version__ = "0.1.0"

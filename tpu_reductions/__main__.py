@@ -1,0 +1,11 @@
+"""CLI: `python -m tpu_reductions --method=SUM -type is spelled --type here`.
+
+The reduction-benchmark executable analog (reference reduction.cpp:84-204).
+"""
+
+import sys
+
+from tpu_reductions.bench.driver import main
+
+if __name__ == "__main__":
+    sys.exit(main())

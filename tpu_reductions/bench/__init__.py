@@ -1,0 +1,1 @@
+"""L4/L5: benchmark drivers, sweep, aggregation, plotting."""

@@ -1,0 +1,202 @@
+"""L4: the self-verifying single-chip reduction benchmark driver.
+
+Re-creates the reference's runTest{Sum,Min,Max} / benchmarkReduce* flow
+(reference reduction.cpp:297-384,661-1034) as one generic driver:
+
+  host data gen -> stage to device (pad/reshape outside the timed loop)
+  -> warm-up launch (reduction.cpp:729) -> N timed, synced iterations
+  (reduction.cpp:731, sync points :319,373) -> GB/s from the mean
+  iteration time (reduction.cpp:743-745) -> verify against the host
+  oracle (reduction.cpp:748-780) -> PASSED/FAILED/WAIVED.
+
+One driver covers all 9 (op, dtype) combinations instead of the
+reference's three near-duplicate runTest/benchmark function families —
+and uses the *correct* combine for MIN/MAX finishing, fixing the
+reference's `+=` bug (reduction.cpp:426-429,516-521; SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Optional
+
+import numpy as np
+
+from tpu_reductions.config import (KERNEL_SINGLE_PASS, LIVE_KERNELS,
+                                   ReduceConfig)
+from tpu_reductions.ops import oracle as oracle_mod
+from tpu_reductions.ops.registry import tolerance
+from tpu_reductions.utils.logging import BenchLogger, throughput_line
+from tpu_reductions.utils.qa import QAStatus
+from tpu_reductions.utils.rng import host_data
+from tpu_reductions.utils.timing import time_fn
+
+
+@dataclasses.dataclass
+class BenchResult:
+    """One benchmark outcome — everything the sweep/aggregate layers need."""
+
+    method: str
+    dtype: str
+    n: int
+    backend: str
+    kernel: int
+    gbps: float
+    avg_s: float
+    iterations: int
+    status: QAStatus
+    device_result: float
+    oracle_result: float
+    abs_diff: float
+    waived_reason: Optional[str] = None
+
+    @property
+    def passed(self) -> bool:
+        return self.status == QAStatus.PASSED
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["status"] = self.status.name
+        return d
+
+
+def _resolve_backend(cfg: ReduceConfig) -> str:
+    if cfg.backend != "auto":
+        return cfg.backend
+    # auto: Pallas is the flagship kernel path; XLA remains the comparator.
+    return "pallas"
+
+
+def _make_device_fn(cfg: ReduceConfig, backend: str):
+    """Build (stage_fn, reduce_fn) for the chosen backend — the
+    kernel-dispatch analog (reduction_kernel.cu:263-346)."""
+    import jax
+    import jax.numpy as jnp
+
+    if backend == "xla":
+        from tpu_reductions.ops.xla_reduce import make_xla_reduce
+
+        def stage_fn(x_np):
+            return jnp.asarray(x_np)
+
+        return stage_fn, make_xla_reduce(cfg.method)
+
+    from tpu_reductions.ops import pallas_reduce as pr
+
+    if cfg.dtype == "float64" and jax.default_backend() == "tpu":
+        # f64 never touches the device: host split -> f32 dd kernels ->
+        # host finish (dd_reduce.py). This replaces the reference's
+        # "incapable device -> QA_WAIVED" gate (reduction.cpp:148-155)
+        # with an actual implementation.
+        from tpu_reductions.ops.dd_reduce import make_dd_staged_reduce
+        dd_stage, dd_reduce = make_dd_staged_reduce(
+            cfg.method, cfg.n, threads=cfg.threads,
+            max_blocks=cfg.max_blocks)
+
+        def stage_fn(x_np):
+            return dd_stage(np.asarray(x_np, dtype=np.float64))
+
+        def reduce_fn(staged):
+            return dd_reduce(*staged)
+
+        return stage_fn, reduce_fn
+
+    stage_fn, reduce_fn = pr.make_staged_reduce(
+        cfg.method, cfg.n, cfg.dtype, threads=cfg.threads,
+        max_blocks=cfg.max_blocks, kernel=cfg.kernel,
+        cpu_final=cfg.cpu_final, cpu_thresh=cfg.cpu_thresh)
+    return stage_fn, reduce_fn
+
+
+def run_benchmark(cfg: ReduceConfig, logger: Optional[BenchLogger] = None
+                  ) -> BenchResult:
+    """Run one self-verifying benchmark configuration."""
+    import jax
+
+    logger = logger or BenchLogger(cfg.log_file, cfg.master_log)
+
+    if cfg.kernel not in LIVE_KERNELS:
+        # Mirrors the reference's intentionally-emptied kernels 0-5
+        # (reduction_kernel.cu:278-289): not an error, just not provided.
+        return BenchResult(cfg.method, cfg.dtype, cfg.n, cfg.backend,
+                           cfg.kernel, 0.0, 0.0, 0, QAStatus.WAIVED,
+                           float("nan"), float("nan"), float("nan"),
+                           waived_reason=f"kernel {cfg.kernel} not live "
+                                         "(only 6/7)")
+
+    backend = _resolve_backend(cfg)
+
+    if cfg.dtype == "float64":
+        # Capability gate — the compute-capability check analog
+        # (reduction.cpp:116-120,148-155). On TPU, x64/f64 must never be
+        # enabled (no native f64; on this image it wedges the device
+        # runtime): the Pallas backend substitutes the double-double path,
+        # and the XLA backend is WAIVED like the reference's CC<1.3 exit.
+        if jax.default_backend() == "tpu":
+            if backend == "xla":
+                return BenchResult(cfg.method, cfg.dtype, cfg.n, backend,
+                                   cfg.kernel, 0.0, 0.0, 0, QAStatus.WAIVED,
+                                   float("nan"), float("nan"), float("nan"),
+                                   waived_reason="no native f64 on TPU; use "
+                                                 "backend=pallas (dd path)")
+        else:
+            jax.config.update("jax_enable_x64", True)
+    # Host payload (reduction.cpp:698-705 analog), native filler when built.
+    x_np = oracle_mod.native_fill(cfg.n, cfg.dtype, rank=0, seed=cfg.seed)
+    if x_np is None:
+        x_np = host_data(cfg.n, cfg.dtype, rank=0, seed=cfg.seed)
+
+    stage_fn, reduce_fn = _make_device_fn(cfg, backend)
+    x_dev = jax.block_until_ready(stage_fn(x_np))   # H2D + pad, untimed
+
+    # Warm-up (reduction.cpp:729) + timed, synced iterations
+    # (reduction.cpp:731, sync points :319,373) via the shared discipline.
+    result, sw = time_fn(reduce_fn, x_dev, iterations=cfg.iterations,
+                         warmup=max(cfg.warmup, 1))
+    avg_s = sw.average_s
+    gbps = (cfg.nbytes / avg_s) / 1e9 if avg_s > 0 else float("inf")
+
+    # The canonical throughput line (reduction.cpp:744-745) -> master log.
+    logger.log_master(throughput_line(gbps, avg_s, cfg.n,
+                                      devices=1, workgroup=cfg.threads))
+
+    status = QAStatus.PASSED
+    dev_val = float(np.asarray(jax.device_get(result), dtype=np.float64))
+    host_val = float("nan")
+    diff = float("nan")
+    if cfg.verify:
+        host = oracle_mod.host_reduce(x_np, cfg.method)
+        passed, diff = oracle_mod.verify(result, host, cfg.method,
+                                         cfg.dtype, cfg.n)
+        host_val = float(np.asarray(host, dtype=np.float64))
+        status = QAStatus.PASSED if passed else QAStatus.FAILED
+        tol = tolerance(cfg.method, cfg.dtype, cfg.n)
+        logger.log(f"TPU result = {dev_val!r}")
+        logger.log(f"CPU result = {host_val!r} (tolerance {tol:g})")
+
+    return BenchResult(cfg.method, cfg.dtype, cfg.n, backend, cfg.kernel,
+                       gbps, avg_s, cfg.iterations, status, dev_val,
+                       host_val, diff)
+
+
+def main(argv=None) -> int:
+    """CLI entry: the reference `main` flow (reduction.cpp:84-204) —
+    QA RUNNING marker, parse, run (or shmoo), QA exit status."""
+    from tpu_reductions.config import parse_single_chip
+    from tpu_reductions.utils.qa import qa_finish, qa_start
+
+    name = "tpu_reductions"
+    qa_start(name, list(argv) if argv else sys.argv[1:])
+    cfg, shmoo = parse_single_chip(argv)
+    logger = BenchLogger(cfg.log_file, cfg.master_log)
+
+    if shmoo:
+        # Implemented, unlike the reference's stub (reduction.cpp:577-580).
+        from tpu_reductions.bench.sweep import run_shmoo
+        results = run_shmoo(cfg, logger=logger)
+        ok = all(r.passed or r.status == QAStatus.WAIVED for r in results)
+        return qa_finish(name, QAStatus.PASSED if ok else QAStatus.FAILED)
+
+    res = run_benchmark(cfg, logger=logger)
+    return qa_finish(name, res.status)

@@ -1,0 +1,80 @@
+"""L5: sweep drivers — the shmoo the reference stubbed out, plus the
+multi-config experiment sweep.
+
+The reference's `--shmoo` prints "Shmoo wasn't implemented in this modified
+kernel!" and exits (reduction.cpp:577-580), leaving its dead SDK sweep code
+behind (:581-657). Here the shmoo is real: a size sweep over
+N = 2^min..2^max for one (op, dtype), emitting one throughput row per size.
+
+The experiment-level sweep (sweep_all) is the analog of the SLURM pipeline
+(mpi/submit_all.sh sweeping node counts x 6 configs, with 5 repeats
+averaged offline by getAvgs.sh) — but in-process: no job scheduler is
+needed to drive one host, and results land directly in the
+raw -> collected -> averaged pipeline (aggregate.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import List, Optional
+
+from tpu_reductions.bench.driver import BenchResult, run_benchmark
+from tpu_reductions.config import ReduceConfig
+from tpu_reductions.utils.logging import BenchLogger
+
+
+def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
+              logger: Optional[BenchLogger] = None) -> List[BenchResult]:
+    """Size sweep 2^min_pow..2^max_pow for cfg's (method, dtype).
+
+    Mirrors the SDK shmoo's intent (1..32M elements, reduction.cpp:581-657
+    dead code) with fewer, denser points and the same per-size
+    benchmark+verify discipline. Iteration count shrinks for huge sizes to
+    keep wall time bounded, like the SDK's testIterations scaling.
+    """
+    logger = logger or BenchLogger(cfg.log_file, cfg.master_log)
+    results = []
+    for p in range(min_pow, max_pow + 1):
+        n = 1 << p
+        iters = max(3, min(cfg.iterations, (1 << 28) // n))
+        sub = dataclasses.replace(cfg, n=n, iterations=iters)
+        res = run_benchmark(sub, logger=logger)
+        logger.log(f"shmoo {cfg.method} {cfg.dtype} n=2^{p} "
+                   f"-> {res.gbps:.4f} GB/s [{res.status.name}]")
+        results.append(res)
+    return results
+
+
+def sweep_all(*, methods=("SUM", "MIN", "MAX"),
+              dtypes=("int32", "float64"), n: int = 1 << 24,
+              repeats: int = 5, iterations: int = 20,
+              backend: str = "auto",
+              out_dir: Optional[str] = None,
+              logger: Optional[BenchLogger] = None) -> List[dict]:
+    """The full experiment grid: {dtypes} x {methods}, `repeats` repeated
+    runs each (RETRY_COUNT analog, mpi/constants.h:5) — the in-process
+    equivalent of submit_all.sh's job fan-out. Writes one JSON-lines raw
+    file per run into out_dir/raw_output (the stdout-<jobid> analog)."""
+    logger = logger or BenchLogger(None, None)
+    raw_dir = Path(out_dir) / "raw_output" if out_dir else None
+    if raw_dir:
+        raw_dir.mkdir(parents=True, exist_ok=True)
+    rows = []
+    for dtype in dtypes:
+        for method in methods:
+            for rep in range(repeats):
+                cfg = ReduceConfig(method=method, dtype=dtype, n=n,
+                                   iterations=iterations, backend=backend,
+                                   seed=rep, log_file=None)
+                res = run_benchmark(cfg, logger=logger)
+                row = res.to_dict()
+                row["repeat"] = rep
+                rows.append(row)
+                logger.log(f"sweep {dtype} {method} rep={rep} "
+                           f"-> {res.gbps:.4f} GB/s [{res.status.name}]")
+                if raw_dir:
+                    fname = raw_dir / f"run-{dtype}-{method}-{rep}.json"
+                    fname.write_text(json.dumps(row) + "\n")
+    return rows

@@ -1,0 +1,262 @@
+"""L0: one typed flag/config system.
+
+Replaces the reference's three config tiers (SURVEY.md §5 "Config / flag
+system"): cutil `CmdArgReader` CLI flags (reference reduction.cpp:31-40,
+91-94,672-682), compile-time constants (mpi/constants.h:1-5), and
+launcher environment (mpi/ccni_vn.sh:3,6; mpi/submit_all.sh:3).
+
+Flag-name parity with the reference CLI (reduction.cpp:31-40):
+
+  --method={SUM|MIN|MAX}      required, exits if absent (reduction.cpp:124-128)
+  --type={int|float|double}   dtype, default int (reduction.cpp:96-109);
+                              also accepts int32/float32/float64
+  --n=<int>                   elements, default 1<<24 (reduction.cpp:665)
+  --threads=<int>             tile rows per grid step — the threads-per-block
+                              analog, default 256 (reduction.cpp:666)
+  --kernel=<int>              kernel id; only 6 (single-pass accumulator) and
+                              7 (two-pass partials) are live; 0-5 are WAIVED,
+                              mirroring the intentionally-emptied dispatch
+                              cases (reduction_kernel.cu:278-289)
+  --maxblocks=<int>           grid clamp, default 64 (reduction.cpp:668)
+  --cpufinal                  finish partial reduction on host
+                              (reduction.cpp:328-340)
+  --cputhresh=<int>           partial count below which host finishes,
+                              default 1 (reduction.cpp:667)
+  --shmoo                     size sweep — IMPLEMENTED here, unlike the
+                              reference's stub (reduction.cpp:577-580)
+  --backend={pallas|xla|auto} TPU kernel selection (no reference analog:
+                              xla is the always-correct comparator)
+
+MPI-side constants (mpi/constants.h) become flags of the collective driver:
+  --n / --iterations / --retries  (NUM_INTS, RETRY_COUNT analogs; the
+  hard-coded CLOCK_RATE has no analog — we use real wall clocks, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+# dtype aliases: reference spells them int/float/double (reduction.cpp:96-109)
+DTYPE_ALIASES = {
+    "int": "int32",
+    "float": "float32",
+    "double": "float64",
+    "int32": "int32",
+    "float32": "float32",
+    "float64": "float64",
+    "bfloat16": "bfloat16",  # TPU-native extension beyond the reference set
+}
+
+METHODS = ("SUM", "MIN", "MAX")
+BACKENDS = ("auto", "pallas", "xla")
+
+# Kernel ids: the reference kept only kernel 6 live and emptied 0-5
+# (reduction_kernel.cu:278-289). We map 6 -> single-pass accumulator Pallas
+# kernel, 7 -> two-pass partials Pallas kernel, and WAIVE 0-5.
+LIVE_KERNELS = (6, 7)
+KERNEL_SINGLE_PASS = 6
+KERNEL_TWO_PASS = 7
+
+
+@dataclasses.dataclass
+class ReduceConfig:
+    """Single-chip reduction benchmark configuration (L3 driver input)."""
+
+    method: str = "SUM"
+    dtype: str = "int32"
+    n: int = 1 << 24                 # default n=1<<24 (reduction.cpp:665)
+    threads: int = 256               # tile rows / grid step (reduction.cpp:666)
+    kernel: int = KERNEL_SINGLE_PASS
+    max_blocks: int = 64             # grid clamp (reduction.cpp:668)
+    cpu_final: bool = False          # --cpufinal (reduction.cpp:328-340)
+    cpu_thresh: int = 1              # --cputhresh (reduction.cpp:667)
+    backend: str = "auto"
+    iterations: int = 100            # timed iters (reduction.cpp:731)
+    warmup: int = 1                  # warm-up launches (reduction.cpp:729)
+    seed: int = 0                    # data seed (rank analog: reduce.c:38-41)
+    device: Optional[int] = None     # --device analog (reduction.cpp:36)
+    log_file: Optional[str] = "reduction.txt"   # shrSetLogFileName analog
+    master_log: Optional[str] = None # MASTERLOGFILE analog (shrUtils.cpp)
+    qatest: bool = False             # --qatest batch mode (shrQATest.h:90-97)
+    verify: bool = True
+
+    def __post_init__(self) -> None:
+        self.method = self.method.upper()
+        if self.method not in METHODS:
+            raise ValueError(f"method must be one of {METHODS}, got {self.method!r}")
+        if self.dtype not in DTYPE_ALIASES:
+            raise ValueError(f"unknown dtype {self.dtype!r}")
+        self.dtype = DTYPE_ALIASES[self.dtype]
+        if self.backend not in BACKENDS:
+            raise ValueError(f"backend must be one of {BACKENDS}, got {self.backend!r}")
+        if self.n <= 0:
+            raise ValueError("n must be positive")
+        if self.threads <= 0 or self.max_blocks <= 0:
+            raise ValueError("threads/max_blocks must be positive")
+
+    @property
+    def nbytes(self) -> int:
+        import numpy as np
+        return self.n * np.dtype(self.dtype).itemsize
+
+
+@dataclasses.dataclass
+class CollectiveConfig:
+    """Cross-chip collective reduction configuration (MPI_Reduce analog).
+
+    Mirrors mpi/reduce.c + mpi/constants.h + launcher scripts:
+      n            total elements across all shards (NUM_INTS/NUM_DOUBLES
+                   analog, constants.h:1-2 — but as a flag, not a constant)
+      retries      timed repetitions (RETRY_COUNT=5, constants.h:5)
+      num_devices  rank count (sbatch --nodes sweep, submit_all.sh:3-4)
+      mesh_shape   optional multi-axis mesh (torus analog)
+      mapping      mesh axis-order / device permutation — the
+                   BGLMPI_MAPPING=TXYZ analog (ccni_vn.sh:3)
+      mode         'vn' uses every addressable device, 'co' uses one device
+                   per host/chip — the BG/L virtual-node vs coprocessor mode
+                   analog (ccni_vn.sh:6)
+      rooted       True = semantically rooted reduce like MPI_Reduce(root=0)
+                   (reduce.c:76,90); False = all-reduce (psum everywhere)
+    """
+
+    method: str = "SUM"
+    dtype: str = "int32"
+    n: int = 1 << 24
+    retries: int = 5
+    warmup: int = 1                  # reduce.c:61-64 warm-up reduce
+    num_devices: Optional[int] = None
+    mesh_shape: Optional[tuple] = None
+    mapping: str = "default"
+    mode: str = "vn"
+    rooted: bool = False
+    backend: str = "xla"
+    seed: int = 0
+    verify: bool = True
+
+    def __post_init__(self) -> None:
+        self.method = self.method.upper()
+        if self.method not in METHODS:
+            raise ValueError(f"method must be one of {METHODS}, got {self.method!r}")
+        self.dtype = DTYPE_ALIASES[self.dtype]
+        if self.mode not in ("vn", "co"):
+            raise ValueError("mode must be 'vn' or 'co'")
+
+
+def _add_common_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--method", type=str, default=None,
+                   help="Reduction to benchmark: SUM|MIN|MAX (required, "
+                        "mirroring reduction.cpp:124-128)")
+    p.add_argument("--type", dest="dtype", type=str, default="int",
+                   help="int|float|double (or int32/float32/float64/bfloat16)")
+    p.add_argument("--n", type=int, default=1 << 24,
+                   help="Number of elements to reduce (default 2^24)")
+    p.add_argument("--seed", type=int, default=0, help="Data seed")
+    p.add_argument("--qatest", action="store_true",
+                   help="QA batch mode (shrQATest --qatest analog)")
+    p.add_argument("--no-verify", dest="verify", action="store_false",
+                   help="Skip host-oracle verification")
+
+
+def build_single_chip_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions",
+        description="Self-verifying single-chip TPU reduction benchmark "
+                    "(reference: cuda/C/src/reduction)",
+    )
+    _add_common_flags(p)
+    p.add_argument("--threads", type=int, default=256,
+                   help="Tile rows per grid step (threads-per-block analog)")
+    p.add_argument("--kernel", type=int, default=KERNEL_SINGLE_PASS,
+                   help="6=single-pass accumulator, 7=two-pass partials; "
+                        "0-5 WAIVED (reference emptied them)")
+    p.add_argument("--maxblocks", dest="max_blocks", type=int, default=64,
+                   help="Grid clamp (maxblocks analog)")
+    p.add_argument("--cpufinal", dest="cpu_final", action="store_true",
+                   help="Finish partial reduction on host")
+    p.add_argument("--cputhresh", dest="cpu_thresh", type=int, default=1,
+                   help="Host-finish threshold on partial count")
+    p.add_argument("--backend", type=str, default="auto",
+                   choices=list(BACKENDS))
+    p.add_argument("--iterations", type=int, default=100,
+                   help="Timed iterations (default 100, reduction.cpp:731)")
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--device", type=int, default=None,
+                   help="Device index (--device analog)")
+    p.add_argument("--shmoo", action="store_true",
+                   help="Run the size sweep 2^10..2^24 (implemented, unlike "
+                        "the reference's stub at reduction.cpp:577-580)")
+    p.add_argument("--logfile", dest="log_file", type=str,
+                   default="reduction.txt")
+    p.add_argument("--masterlog", dest="master_log", type=str, default=None)
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"),
+                   help="Force the JAX platform (e.g. cpu to benchmark the "
+                        "host path on a machine without a TPU)")
+    return p
+
+
+def parse_single_chip(argv=None):
+    """Parse CLI args -> (ReduceConfig, shmoo: bool).
+
+    Exits with an error if --method is missing, mirroring the reference's
+    required-flag behavior (reduction.cpp:124-128).
+    """
+    p = build_single_chip_parser()
+    ns = p.parse_args(argv)
+    if ns.method is None:
+        p.error("--method={SUM|MIN|MAX} is required "
+                "(reference exits too: reduction.cpp:124-128)")
+    if ns.dtype not in DTYPE_ALIASES:
+        p.error(f"unknown --type {ns.dtype!r}; expected one of "
+                f"{sorted(set(DTYPE_ALIASES))}")
+    if ns.method.upper() not in METHODS:
+        p.error(f"--method must be one of {METHODS}, got {ns.method!r}")
+    cfg = ReduceConfig(
+        method=ns.method, dtype=ns.dtype, n=ns.n, threads=ns.threads,
+        kernel=ns.kernel, max_blocks=ns.max_blocks, cpu_final=ns.cpu_final,
+        cpu_thresh=ns.cpu_thresh, backend=ns.backend,
+        iterations=ns.iterations, warmup=ns.warmup, seed=ns.seed,
+        device=ns.device, log_file=ns.log_file, master_log=ns.master_log,
+        qatest=ns.qatest, verify=ns.verify,
+    )
+    if ns.platform:
+        # must happen before the first jax backend touch; the axon plugin
+        # ignores JAX_PLATFORMS, so this goes through jax.config.
+        import jax
+        jax.config.update("jax_platforms", ns.platform)
+    return cfg, ns.shmoo
+
+
+def build_collective_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.collective",
+        description="Cross-chip collective reduction benchmark "
+                    "(reference: mpi/reduce.c over the BG/L torus)",
+    )
+    _add_common_flags(p)
+    p.add_argument("--retries", type=int, default=5,
+                   help="Timed repetitions (RETRY_COUNT analog)")
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--devices", dest="num_devices", type=int, default=None,
+                   help="Device count (rank-count analog)")
+    p.add_argument("--mapping", type=str, default="default",
+                   help="Mesh axis ordering (BGLMPI_MAPPING analog)")
+    p.add_argument("--mode", type=str, default="vn", choices=("vn", "co"),
+                   help="vn=all devices, co=one per chip (BG/L VN/CO analog)")
+    p.add_argument("--rooted", action="store_true",
+                   help="Rooted reduce-to-0 semantics like MPI_Reduce")
+    return p
+
+
+def parse_collective(argv=None) -> CollectiveConfig:
+    p = build_collective_parser()
+    ns = p.parse_args(argv)
+    if ns.method is None:
+        p.error("--method={SUM|MIN|MAX} is required")
+    return CollectiveConfig(
+        method=ns.method, dtype=ns.dtype, n=ns.n, retries=ns.retries,
+        warmup=ns.warmup, num_devices=ns.num_devices, mapping=ns.mapping,
+        mode=ns.mode, rooted=ns.rooted, seed=ns.seed, verify=ns.verify,
+    )

@@ -1,0 +1,18 @@
+"""L2: reduction op registry + kernels + host oracle.
+
+- registry: {SUM,MIN,MAX} x {int32,float32,float64(,bfloat16)} op table —
+  the analog of the reference's templated kernel fan-out
+  (reduction_kernel.cu:527-564) and MPI op table (reduce.c:21-28).
+- xla_reduce: jnp baseline — the always-correct comparator.
+- pallas_reduce: single-chip hierarchical Pallas kernels — the tree +
+  warp-synchronous "kernel 6" analog (reduction_kernel.cu:74-253).
+- oracle: host reference (Kahan) — reduction.cpp:206-249 analog, with a
+  native C++ backend in csrc/.
+"""
+
+from tpu_reductions.ops.registry import OPS, ReduceOpSpec, get_op, tolerance
+from tpu_reductions.ops.xla_reduce import xla_reduce
+from tpu_reductions.ops.oracle import host_reduce, verify
+
+__all__ = ["OPS", "ReduceOpSpec", "get_op", "tolerance",
+           "xla_reduce", "host_reduce", "verify"]

@@ -1,0 +1,163 @@
+"""Host oracle: the CPU reference every accelerator result is checked against.
+
+The reference verifies every run against a host reduction computed on the
+same data — Kahan-compensated sum for reals (reduction.cpp:214-227), linear
+scans for min/max (reduction.cpp:228-249) — with exact matching for ints and
+scaled tolerances for floats (reduction.cpp:750,763-765,776-779). That
+self-verifying-benchmark pattern is the whole test strategy (SURVEY.md §4).
+
+Two backends:
+- native: csrc/oracle.cpp via ctypes (true Kahan at C speed) — the
+  framework's native runtime component, auto-built with g++ on first use.
+- numpy fallback: math.fsum (exactly-rounded) for f64, float64-accumulated
+  np.sum for f32, np.min/max scans — used when the toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import math
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from tpu_reductions.ops.registry import get_op, tolerance
+
+_CSRC = Path(__file__).resolve().parents[2] / "csrc"
+_LIB_PATH = _CSRC / "liboracle.so"
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _build_native() -> bool:
+    try:
+        subprocess.run(["make", "-C", str(_CSRC)], check=True,
+                       capture_output=True, timeout=120)
+        return _LIB_PATH.exists()
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native oracle; None on any failure."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("TPU_REDUCTIONS_NO_NATIVE"):
+        return None
+    if not _LIB_PATH.exists() and not _build_native():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError:
+        return None
+    i64 = ctypes.c_int64
+    u32 = ctypes.c_uint32
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    sigs = {
+        "oracle_kahan_sum_f32": (ctypes.c_double, [f32p, i64]),
+        "oracle_kahan_sum_f64": (ctypes.c_double, [f64p, i64]),
+        "oracle_sum_i32": (ctypes.c_int32, [i32p, i64]),
+        "oracle_min_i32": (ctypes.c_int32, [i32p, i64]),
+        "oracle_max_i32": (ctypes.c_int32, [i32p, i64]),
+        "oracle_min_f32": (ctypes.c_float, [f32p, i64]),
+        "oracle_max_f32": (ctypes.c_float, [f32p, i64]),
+        "oracle_min_f64": (ctypes.c_double, [f64p, i64]),
+        "oracle_max_f64": (ctypes.c_double, [f64p, i64]),
+        "oracle_fill_i32": (None, [i32p, i64, u32, u32]),
+        "oracle_fill_f32": (None, [f32p, i64, u32, u32]),
+        "oracle_fill_f64": (None, [f64p, i64, u32, u32]),
+        "oracle_now_ns": (i64, []),
+    }
+    try:
+        for name, (res, args) in sigs.items():
+            fn = getattr(lib, name)
+            fn.restype = res
+            fn.argtypes = args
+    except AttributeError:
+        return None
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+_SUFFIX = {"int32": "i32", "float32": "f32", "float64": "f64"}
+
+
+def host_reduce(x: np.ndarray, method: str) -> np.ndarray:
+    """Compute the oracle reduction of `x` on the host.
+
+    SUM of reals returns float64 regardless of input dtype (the Kahan
+    accumulator's precision); SUM of int32 wraps mod 2^32 to match the
+    device's int32 accumulator; MIN/MAX return the input dtype.
+    """
+    method = method.upper()
+    x = np.ascontiguousarray(x)
+    dtype = str(x.dtype)
+    lib = _load()
+
+    if method == "SUM":
+        if dtype == "int32":
+            if lib is not None:
+                return np.int32(lib.oracle_sum_i32(x, x.size))
+            # int64 exact sum, then wrap to int32 — same result as a
+            # wrapping int32 accumulator.
+            return np.int64(x.sum(dtype=np.int64)).astype(np.int32)
+        if dtype == "float32":
+            if lib is not None:
+                return np.float64(lib.oracle_kahan_sum_f32(x, x.size))
+            return np.float64(x.sum(dtype=np.float64))
+        if dtype == "float64":
+            if lib is not None:
+                return np.float64(lib.oracle_kahan_sum_f64(x, x.size))
+            return np.float64(math.fsum(x.tolist()) if x.size < (1 << 22)
+                              else x.sum(dtype=np.float64))
+        # bf16 etc: accumulate in f64
+        return np.float64(x.astype(np.float64).sum())
+
+    if method in ("MIN", "MAX"):
+        if lib is not None and dtype in _SUFFIX:
+            fn = getattr(lib, f"oracle_{method.lower()}_{_SUFFIX[dtype]}")
+            return x.dtype.type(fn(x, x.size))
+        return get_op(method).np_reduce(x)
+
+    raise ValueError(f"unknown method {method!r}")
+
+
+def native_fill(n: int, dtype: str, rank: int = 0, seed: int = 0
+                ) -> Optional[np.ndarray]:
+    """Generate a payload with the native MT19937 filler; None if the
+    native library is unavailable (callers fall back to utils.rng)."""
+    lib = _load()
+    if lib is None or dtype not in _SUFFIX:
+        return None
+    out = np.empty(n, dtype=dtype)
+    getattr(lib, f"oracle_fill_{_SUFFIX[dtype]}")(out, n, rank, seed)
+    return out
+
+
+def verify(device_result, host_result, method: str, dtype: str, n: int
+           ) -> tuple[bool, float]:
+    """Acceptance check, mirroring reduction.cpp:750-780.
+
+    Returns (passed, abs_diff). Ints and MIN/MAX: exact. float32 SUM:
+    |diff| <= 1e-8*n. float64 SUM: |diff| <= 1e-12.
+    """
+    tol = tolerance(method, dtype, n)
+    diff = abs(float(np.asarray(device_result, dtype=np.float64))
+               - float(np.asarray(host_result, dtype=np.float64)))
+    if tol == 0.0:
+        # exact-match classes: compare in the value domain, not float
+        passed = np.asarray(device_result).astype(np.float64) == \
+            np.asarray(host_result).astype(np.float64)
+        return bool(passed), diff
+    return diff <= tol, diff

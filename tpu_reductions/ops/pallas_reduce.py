@@ -1,0 +1,329 @@
+"""L2a: single-chip hierarchical Pallas reduction kernels.
+
+TPU-native redesign of the reference's "kernel 6" CUDA reduction
+(reference cuda/C/src/reduction/reduction_kernel.cu:74-253 and its host-side
+multi-pass finishing loop, reduction.cpp:297-384). The mapping is
+architectural, not line-by-line (SURVEY.md §7):
+
+  CUDA mechanism (reference)                TPU mechanism (here)
+  ----------------------------------------  --------------------------------
+  grid-stride loop, 2 elems/thread/step     sequential Pallas grid; each
+  (Brent's theorem, kernel.cu:88-98)        step DMAs a (TM,128) HBM tile
+                                            into VMEM (pipelined by Pallas)
+  shared-memory tree 512->64 with           VPU lane/sublane reduction of
+  __syncthreads (kernel.cu:106-108)         the tile to an (8,128) vector
+  warp-synchronous final 32->1 on           (8,128)->scalar finish — a tiny
+  volatile smem (kernel.cu:110-122)         XLA reduce (or host finish)
+  block partials + kernel relaunch          per-block partial rows +
+  until <= cpuFinalThreshold                repeated Pallas passes
+  (reduction.cpp:343-357)                   (two-pass kernel)
+  --cpufinal host finishing                 fetch partials, finish with the
+  (reduction.cpp:328-340)                   host oracle combine
+  threads-per-block / maxBlocks knobs       TM tile rows / P partial rows
+  (getNumBlocksAndThreads,                  (choose_tiling below)
+  reduction.cpp:272-291)
+
+There is no warp-synchronous hazard class on TPU (SURVEY.md §5 "race
+detection") — the VPU is a lockstep vector unit and Pallas grids are
+sequential per core — so the reference's volatile-smem subtlety dissolves;
+correctness instead rests on monoid-identity padding (registry.py), which
+also fixes the reference's non-pow2 min/max OOB bugs by construction
+(reduction_kernel.cu:140,157,204,221 — see SURVEY.md §2.2).
+
+Kernel ids (config.KERNEL_*):
+  6  single-pass: one VMEM accumulator revisited across the whole grid.
+  7  two-pass: P partial rows (maxblocks analog), finished by further
+     passes / XLA / host according to cpu_final / cpu_thresh.
+
+float64: XLA-on-TPU emulates f64 but Mosaic/Pallas does not support it;
+pallas_reduce transparently uses a double-double (two-float32) kernel for
+f64 SUM fidelity — see dd_reduce.py — or falls back to XLA (see
+`f64_strategy`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_reductions.ops.registry import ReduceOpSpec, get_op
+
+LANES = 128      # TPU vector lane count (last-dim tile), pallas_guide.md
+SUBLANES = 8     # float32/int32 sublane tile
+
+
+def _interpret_default() -> bool:
+    """Pallas TPU lowering only runs on TPU; everywhere else (the CPU test
+    mesh, SURVEY.md §4) use interpreter mode."""
+    return jax.default_backend() != "tpu"
+
+
+def choose_tiling(n: int, threads: int = 256, max_blocks: int = 64
+                  ) -> tuple[int, int, int]:
+    """Pick (TM tile rows, P partial blocks, T tiles per block) for `n`
+    elements — the getNumBlocksAndThreads analog (reduction.cpp:272-291):
+    threads -> tile rows per grid step, maxBlocks -> grid clamp with
+    per-block striding over multiple tiles.
+
+    Returns (tm, p, t) with p * t * tm * LANES >= n.
+    """
+    rows = pl.cdiv(n, LANES)
+    tm = max(SUBLANES, min(int(threads), 2048))
+    tm -= tm % SUBLANES
+    num_tiles = pl.cdiv(rows, tm)
+    p = max(1, min(int(max_blocks), num_tiles))
+    t = pl.cdiv(num_tiles, p)
+    return tm, p, t
+
+
+def padded_2d_shape(n: int, tm: int, p: int, t: int) -> tuple[int, int]:
+    return (p * t * tm, LANES)
+
+
+def stage_padded(x: np.ndarray | jax.Array, tm: int, p: int, t: int,
+                 op: ReduceOpSpec):
+    """Pad a flat array to (P*T*TM, LANES) with the op's monoid identity and
+    reshape — done once at data-staging time, outside the timed loop (the
+    reference similarly fixes pow2/block geometry before timing)."""
+    x = jnp.ravel(jnp.asarray(x))
+    rows, lanes = padded_2d_shape(x.size, tm, p, t)
+    pad = rows * lanes - x.size
+    ident = op.identity(x.dtype)
+    x = jnp.pad(x, (0, pad), constant_values=ident)
+    return x.reshape(rows, lanes)
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+
+def _tile_to_sublane(tile: jax.Array, op: ReduceOpSpec, tm: int) -> jax.Array:
+    """(TM, 128) -> (8, 128): the shared-memory tree analog, done as a
+    sublane-group reduction on the VPU."""
+    if tm == SUBLANES:
+        return tile
+    t3 = tile.reshape(tm // SUBLANES, SUBLANES, LANES)
+    if op.name == "SUM":
+        return jnp.sum(t3, axis=0, dtype=tile.dtype)
+    if op.name == "MIN":
+        return jnp.min(t3, axis=0)
+    return jnp.max(t3, axis=0)
+
+
+def _single_pass_kernel(op: ReduceOpSpec, tm: int):
+    """Kernel 6 analog: every grid step folds its tile into one (8,128)
+    VMEM accumulator block (same out index every step, so the block stays
+    resident — the grid-stride accumulate)."""
+
+    def kernel(in_ref, acc_ref):
+        step = pl.program_id(0)
+        part = _tile_to_sublane(in_ref[:], op, tm)
+
+        @pl.when(step == 0)
+        def _():
+            acc_ref[:] = part
+
+        @pl.when(step > 0)
+        def _():
+            acc_ref[:] = op.jnp_combine(acc_ref[:], part)
+
+    return kernel
+
+
+def _two_pass_kernel(op: ReduceOpSpec, tm: int):
+    """Kernel 7: grid (P, T); block i accumulates T tiles into partial row
+    i — the numBlocks-partials structure (reduction.cpp:323 producing
+    blocks partials), with the maxblocks clamp expressed as per-block
+    striding."""
+
+    def kernel(in_ref, out_ref):
+        j = pl.program_id(1)
+        part = _tile_to_sublane(in_ref[:], op, tm)
+        row = part if SUBLANES == 1 else _fold_sublanes(part, op)
+
+        @pl.when(j == 0)
+        def _():
+            out_ref[:] = row
+
+        @pl.when(j > 0)
+        def _():
+            out_ref[:] = op.jnp_combine(out_ref[:], row)
+
+    return kernel
+
+
+def _fold_sublanes(part: jax.Array, op: ReduceOpSpec) -> jax.Array:
+    """(8, 128) -> (1, 128) lane vector."""
+    if op.name == "SUM":
+        return jnp.sum(part, axis=0, keepdims=True, dtype=part.dtype)
+    if op.name == "MIN":
+        return jnp.min(part, axis=0, keepdims=True)
+    return jnp.max(part, axis=0, keepdims=True)
+
+
+def single_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """Run the single-accumulator kernel over a staged (R, 128) array.
+    Returns the (8, 128) accumulator."""
+    rows = x2d.shape[0]
+    grid = (rows // tm,)
+    interpret = _interpret_default() if interpret is None else interpret
+    return pl.pallas_call(
+        _single_pass_kernel(op, tm),
+        out_shape=jax.ShapeDtypeStruct((SUBLANES, LANES), x2d.dtype),
+        grid=grid,
+        in_specs=[pl.BlockSpec((tm, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((SUBLANES, LANES), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x2d)
+
+
+def two_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int, p: int, t: int,
+                  interpret: Optional[bool] = None) -> jax.Array:
+    """Run the partials kernel over a staged (P*T*TM, 128) array.
+    Returns (P, 128) partial rows."""
+    interpret = _interpret_default() if interpret is None else interpret
+    return pl.pallas_call(
+        _two_pass_kernel(op, tm),
+        out_shape=jax.ShapeDtypeStruct((p, LANES), x2d.dtype),
+        grid=(p, t),
+        in_specs=[pl.BlockSpec((tm, LANES), lambda i, j: (i * t + j, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, LANES), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x2d)
+
+
+# ---------------------------------------------------------------------------
+# Public entry points
+# ---------------------------------------------------------------------------
+
+
+def finish(partials: jax.Array, op: ReduceOpSpec) -> jax.Array:
+    """Final (small) reduction of an accumulator/partials block to a scalar
+    — the warp-final analog. The block is at most a few KB, so a plain XLA
+    reduce is the right tool (fused, on-chip)."""
+    return op.jnp_reduce(partials)
+
+
+def host_finish(partials: jax.Array, op: ReduceOpSpec) -> np.ndarray:
+    """--cpufinal analog (reduction.cpp:328-340): fetch partials and finish
+    with the host combine. Uses the correct op (the reference's min/max
+    host-finish wrongly used `+=` — reduction.cpp:426-429,516-521)."""
+    return op.np_reduce(np.asarray(jax.device_get(partials)))
+
+
+def f64_strategy() -> str:
+    """How f64 is handled by the Pallas path on this backend:
+    'native' (interpret / CPU), 'dd' (double-double kernel on TPU), or
+    'xla' fallback. SURVEY.md §7 "hard parts"."""
+    return "native" if jax.default_backend() != "tpu" else "dd"
+
+
+def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
+                  max_blocks: int = 64, kernel: int = 6,
+                  cpu_final: bool = False, cpu_thresh: int = 1,
+                  interpret: Optional[bool] = None):
+    """Reduce a flat array to a scalar with the Pallas kernels.
+
+    Self-contained (pads/stages internally) — use `stage_padded` +
+    `make_staged_reduce` to keep staging out of a timed loop.
+    `cpu_final`/`cpu_thresh` mirror reduction.cpp:328-357: extra Pallas
+    passes run while more than `cpu_thresh` partial rows remain, then the
+    remainder is finished on host (cpu_final) or by XLA.
+    """
+    op = get_op(method)
+    # Inspect the dtype BEFORE any jnp conversion: on TPU x64 is never
+    # enabled, so jnp.ravel would silently downcast an f64 payload to f32
+    # and lose the double-double route.
+    if str(np.asarray(x).dtype if not isinstance(x, jax.Array) else x.dtype
+           ) == "float64" and jax.default_backend() == "tpu":
+        # No f64 on the TPU device at all — route through the
+        # double-double path (host split -> f32 kernel -> host finish).
+        from tpu_reductions.ops.dd_reduce import dd_pallas_reduce_f64
+        return dd_pallas_reduce_f64(x, method, threads=threads,
+                                    max_blocks=max_blocks)
+    x = jnp.ravel(x)
+
+    tm, p, t = choose_tiling(x.size, threads, max_blocks)
+    x2d = stage_padded(x, tm, p, t, op)
+
+    if kernel == 6:
+        acc = single_pass_call(x2d, op, tm, interpret=interpret)
+        if cpu_final:
+            return host_finish(acc, op)
+        return finish(acc, op)
+
+    if kernel == 7:
+        partials = two_pass_call(x2d, op, tm, p, t, interpret=interpret)
+        # Multi-pass: keep relaunching the kernel on the partials while more
+        # than cpu_thresh rows remain and a further pass is worthwhile
+        # (reduction.cpp:343-357). Sizes are static, so this Python loop
+        # unrolls at trace time into a fixed pass chain.
+        while partials.shape[0] > max(cpu_thresh, 1) and partials.shape[0] > SUBLANES:
+            tm2, p2, t2 = choose_tiling(partials.size, threads, max_blocks)
+            x2 = stage_padded(partials, tm2, p2, t2, op)
+            partials = two_pass_call(x2, op, tm2, p2, t2, interpret=interpret)
+        if cpu_final:
+            return host_finish(partials, op)
+        return finish(partials, op)
+
+    raise ValueError(f"kernel {kernel} is not live; only 6 and 7 "
+                     "(0-5 are WAIVED, mirroring reduction_kernel.cu:278-289)")
+
+
+def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
+                       max_blocks: int = 64, kernel: int = 6,
+                       cpu_final: bool = False, cpu_thresh: int = 1,
+                       interpret: Optional[bool] = None):
+    """Build (stage_fn, reduce_fn) for benchmarking: `stage_fn` pads/
+    reshapes host data once (outside the timed loop); `reduce_fn` takes
+    the staged (R,128) array and returns the scalar.
+
+    cpu_final/cpu_thresh mirror the reference's finishing knobs
+    (reduction.cpp:328-357): kernel 7 chains extra Pallas passes while
+    more than cpu_thresh partial rows remain; cpu_final fetches the
+    remaining partials and finishes them on host inside the timed region
+    (as --cpufinal does)."""
+    op = get_op(method)
+    tm, p, t = choose_tiling(n, threads, max_blocks)
+
+    def stage_fn(x):
+        return stage_padded(x, tm, p, t, op)
+
+    if kernel == 6:
+        def device_fn(x2d):
+            return single_pass_call(x2d, op, tm, interpret=interpret)
+    else:
+        def device_fn(x2d):
+            partials = two_pass_call(x2d, op, tm, p, t, interpret=interpret)
+            # static pass chain (sizes known at trace time) — the
+            # relaunch-while-too-many-partials loop of reduction.cpp:343-357
+            while (partials.shape[0] > max(cpu_thresh, 1)
+                   and partials.shape[0] > SUBLANES):
+                tm2, p2, t2 = choose_tiling(partials.size, threads,
+                                            max_blocks)
+                x2 = stage_padded(partials, tm2, p2, t2, op)
+                partials = two_pass_call(x2, op, tm2, p2, t2,
+                                         interpret=interpret)
+            return partials
+
+    if cpu_final:
+        jit_device = jax.jit(device_fn)
+
+        def reduce_fn(x2d):
+            return host_finish(jit_device(x2d), op)
+    else:
+        reduce_fn = jax.jit(lambda x2d: finish(device_fn(x2d), op))
+
+    return stage_fn, reduce_fn

@@ -1,0 +1,113 @@
+"""Reduction op registry: {SUM, MIN, MAX} over {int32, float32, float64}.
+
+The reference expresses this table twice: as 27 explicit template
+instantiations per op on the CUDA side (reduction_kernel.cu:527-564,
+dispatched via reduction.h:15-25) and as a {MPI_MAX,MPI_MIN,MPI_SUM} op
+struct table on the MPI side (reduce.c:21-28). Here it is one registry that
+every layer (XLA baseline, Pallas kernel, collectives, oracle, drivers)
+keys off — `jax.jit` retracing per (op, dtype, shape) plays the role of the
+compile-time template fan-out (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ReduceOpSpec:
+    """One reduction operator, described for every backend that needs it."""
+
+    name: str                       # SUM | MIN | MAX
+    jnp_reduce: Callable            # full-array reduce (XLA baseline)
+    jnp_combine: Callable           # elementwise combine (Pallas tree step)
+    np_reduce: Callable             # host fallback oracle
+    lax_collective: str             # psum | pmin | pmax (MPI_Op analog)
+    monoid_identity: Callable       # dtype -> identity scalar (for padding)
+
+    def identity(self, dtype) -> np.ndarray:
+        return self.monoid_identity(np.dtype(dtype))
+
+
+def _sum_identity(dt: np.dtype):
+    return dt.type(0)
+
+
+def _jnp_sum_same_dtype(x, **kw):
+    """SUM that accumulates in the input dtype (no int32->int64 / implicit
+    promotion under x64). Matching the device accumulator's width is what
+    makes int verification exact-match (reduction.cpp:748,776-777): both
+    sides wrap mod 2^32."""
+    return jnp.sum(x, dtype=x.dtype, **kw)
+
+
+def _min_identity(dt: np.dtype):
+    # Padding value must be the monoid identity so padded lanes never win:
+    # max representable for MIN, min representable for MAX. The reference
+    # instead guards loads with bounds checks (and gets the guard wrong for
+    # min/max — reduction_kernel.cu:157,221; see SURVEY.md §2.2 bugs).
+    if np.issubdtype(dt, np.integer):
+        return dt.type(np.iinfo(dt).max)
+    return dt.type(np.inf)
+
+
+def _max_identity(dt: np.dtype):
+    if np.issubdtype(dt, np.integer):
+        return dt.type(np.iinfo(dt).min)
+    return dt.type(-np.inf)
+
+
+OPS = {
+    "SUM": ReduceOpSpec(
+        name="SUM",
+        jnp_reduce=_jnp_sum_same_dtype,
+        jnp_combine=jnp.add,
+        np_reduce=np.sum,
+        lax_collective="psum",
+        monoid_identity=_sum_identity,
+    ),
+    "MIN": ReduceOpSpec(
+        name="MIN",
+        jnp_reduce=jnp.min,
+        jnp_combine=jnp.minimum,
+        np_reduce=np.min,
+        lax_collective="pmin",
+        monoid_identity=_min_identity,
+    ),
+    "MAX": ReduceOpSpec(
+        name="MAX",
+        jnp_reduce=jnp.max,
+        jnp_combine=jnp.maximum,
+        np_reduce=np.max,
+        lax_collective="pmax",
+        monoid_identity=_max_identity,
+    ),
+}
+
+
+def get_op(name: str) -> ReduceOpSpec:
+    try:
+        return OPS[name.upper()]
+    except KeyError:
+        raise ValueError(f"unknown reduction {name!r}; expected one of {list(OPS)}")
+
+
+def tolerance(method: str, dtype: str, n: int) -> float:
+    """Verification tolerance, matching the reference's acceptance rule
+    (reduction.cpp:750,763-765,776-779): ints exact; float32 1e-8*n;
+    float64 1e-12. MIN/MAX are exact selections for every dtype — only
+    SUM accumulates rounding error.
+    """
+    if dtype in ("int32", "int64"):
+        return 0.0
+    if method.upper() in ("MIN", "MAX"):
+        return 0.0
+    if dtype == "float64":
+        return 1e-12
+    if dtype == "bfloat16":
+        return 1e-2 * n   # bf16 extension: ~3 decimal digits of mantissa
+    return 1e-8 * n

@@ -1,0 +1,42 @@
+"""XLA-baseline reduction — the always-correct comparator (SURVEY.md §7 L2b).
+
+`jnp.sum/min/max` under `jit` lowers to a single fused XLA reduce that the
+compiler already tiles across HBM optimally; it plays the role the CPU
+reference played for the CUDA kernel (a second, independent implementation
+to validate the hand-written kernel against) while ALSO being a competitive
+performance baseline on TPU. The Pallas kernel (pallas_reduce.py) must match
+it bit-for-bit on ints and within registry.tolerance on floats.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from tpu_reductions.ops.registry import get_op
+
+
+@functools.partial(jax.jit, static_argnames=("method",))
+def xla_reduce(x: jax.Array, method: str = "SUM") -> jax.Array:
+    """Reduce `x` to a scalar with XLA's native reduction.
+
+    int32 SUM accumulates in int32 (wrapping), matching the reference's
+    int accumulator semantics (reduction.cpp:748,776-777) — the oracle
+    wraps identically, so int verification is exact-match.
+    """
+    return get_op(method).jnp_reduce(x)
+
+
+def make_xla_reduce(method: str):
+    """A jitted closure over the op, for benchmarking without re-passing
+    statics (each (method, dtype, shape) gets its own executable — the
+    template-instantiation fan-out analog, SURVEY.md §3.4)."""
+    op = get_op(method)
+
+    @jax.jit
+    def fn(x):
+        return op.jnp_reduce(x)
+
+    return fn

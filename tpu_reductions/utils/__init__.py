@@ -1,0 +1,18 @@
+"""L1 runtime utilities: timing, logging, QA protocol, deterministic RNG.
+
+TPU-idiomatic equivalents of the reference's vendored support libraries
+(SURVEY.md §2.3): cutil timers, shrUtils logging, shrQATest harness, and the
+MPI side's rdtsc + MT19937 header.
+"""
+
+from tpu_reductions.utils.qa import QAStatus, qa_start, qa_finish, qa_exit
+from tpu_reductions.utils.timing import Stopwatch, TimerRegistry, time_fn
+from tpu_reductions.utils.logging import BenchLogger, throughput_line, collective_row
+from tpu_reductions.utils.rng import host_data, rank_seed_key
+
+__all__ = [
+    "QAStatus", "qa_start", "qa_finish", "qa_exit",
+    "Stopwatch", "TimerRegistry", "time_fn",
+    "BenchLogger", "throughput_line", "collective_row",
+    "host_data", "rank_seed_key",
+]

@@ -1,0 +1,72 @@
+"""Logging fan-out + stable machine-parseable row schemas.
+
+The reference multiplexes every log line to console, a per-app log file and
+a master log file (shrLog/shrLogEx + shrSetLogFileName, reference
+cuda/shared/src/shrUtils.cpp:157,173-280; the benchmark routes its canonical
+throughput line to LOGBOTH|MASTER at reduction.cpp:744-745). The MPI side
+prints a fixed `DATATYPE OP NODES GB/sec` schema that the awk aggregation
+scripts depend on (reduce.c:67-69,81,95; getAvgs.sh:7-10). The row schema
+IS the metrics API (SURVEY.md §5) — both formats are preserved verbatim.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import Optional, TextIO
+
+
+def throughput_line(gbps: float, secs: float, n: int, *, name: str = "Reduction",
+                    devices: int = 1, workgroup: int = 256) -> str:
+    """The CUDA-side canonical throughput row (reduction.cpp:744-745):
+
+    `Reduction, Throughput = %.4f GB/s, Time = %.5f s, Size = %u Elements,
+     NumDevsUsed = %d, Workgroup = %u`
+    """
+    return (f"{name}, Throughput = {gbps:.4f} GB/s, Time = {secs:.5f} s, "
+            f"Size = {n} Elements, NumDevsUsed = {devices}, "
+            f"Workgroup = {workgroup}")
+
+
+def collective_row(dtype: str, op: str, ranks: int, gbps: float) -> str:
+    """The MPI-side rank-0 row (reduce.c:81,95): `DATATYPE OP RANKS GB/sec`
+    with the same upper-cased dtype spelling (INT/DOUBLE/FLOAT)."""
+    names = {"int32": "INT", "float64": "DOUBLE", "float32": "FLOAT",
+             "bfloat16": "BF16"}
+    return f"{names.get(dtype, dtype.upper())} {op.upper()} {ranks} {gbps:.3f}"
+
+
+COLLECTIVE_HEADER = "DATATYPE OP NODES GB/sec"  # header row (reduce.c:67-69)
+
+
+class BenchLogger:
+    """Console + per-app file + master-file log fan-out (shrUtils analog).
+
+    `log()` goes to console and the app file; `log_master()` additionally
+    appends to the master file — the LOGBOTH|MASTER mode used for the
+    canonical throughput line (reduction.cpp:744).
+    """
+
+    def __init__(self, app_file: Optional[str] = None,
+                 master_file: Optional[str] = None,
+                 console: Optional[TextIO] = None) -> None:
+        self.console = console or sys.stdout
+        self._app_path = Path(app_file) if app_file else None
+        self._master_path = Path(master_file) if master_file else None
+        if self._app_path:
+            # shrSetLogFileName truncates the per-app log on open
+            self._app_path.write_text("")
+
+    def _append(self, path: Optional[Path], msg: str) -> None:
+        if path is not None:
+            with path.open("a") as f:
+                f.write(msg + "\n")
+
+    def log(self, msg: str) -> None:
+        print(msg, file=self.console)
+        self.console.flush()
+        self._append(self._app_path, msg)
+
+    def log_master(self, msg: str) -> None:
+        self.log(msg)
+        self._append(self._master_path, msg)

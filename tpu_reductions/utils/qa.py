@@ -1,0 +1,45 @@
+"""QA pass/fail protocol — the shrQATest analog.
+
+The reference standardizes test output with `&&&& RUNNING/PASSED/FAILED/WAIVED`
+markers and maps status to the process exit code (reference
+cuda/shared/inc/shrQATest.h:83-112,224-229; wired into the benchmark at
+reduction.cpp:87,203; WAIVED used for incapable hardware at
+reduction.cpp:148-155). We keep the exact marker grammar so CI-style greps
+keep working, and keep exit code = status.
+"""
+
+from __future__ import annotations
+
+import enum
+import sys
+from typing import Optional
+
+
+class QAStatus(enum.IntEnum):
+    """Exit statuses, value == process exit code (shrQATest.h:51-57 analog)."""
+
+    PASSED = 0
+    FAILED = 1
+    WAIVED = 2
+
+
+def qa_start(name: str, argv: Optional[list] = None, *, out=None) -> None:
+    """Print the RUNNING marker (shrQAStart analog, shrQATest.h:83-112)."""
+    out = out or sys.stdout
+    args = " ".join(argv) if argv else ""
+    print(f"&&&& RUNNING {name} {args}".rstrip(), file=out)
+    out.flush()
+
+
+def qa_finish(name: str, status: QAStatus, *, out=None) -> int:
+    """Print the terminal marker and return the exit code
+    (shrQAFinishExit analog minus the exit, shrQATest.h:224-229)."""
+    out = out or sys.stdout
+    print(f"&&&& {name} {status.name}", file=out)
+    out.flush()
+    return int(status)
+
+
+def qa_exit(name: str, status: QAStatus) -> None:
+    """qa_finish + sys.exit — the full shrQAFinishExit behavior."""
+    sys.exit(qa_finish(name, status))

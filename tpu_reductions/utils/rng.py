@@ -1,0 +1,59 @@
+"""Deterministic data generation — the MT19937 / rand() analog.
+
+The reference generates benchmark payloads two ways:
+- CUDA side: libc `rand()` masked to a byte — `rand() & 0xFF` for ints and
+  `(rand() & 0xFF) / RAND_MAX` for reals (reference reduction.cpp:698-705).
+  Masking keeps int sums from overflowing catastrophically and keeps float
+  sums low-noise (SURVEY.md §4 "Determinism aids").
+- MPI side: a full vendored MT19937 seeded per-rank by `init_by_array` with
+  the first seed word offset by the rank (reduce.c:38-41,
+  externalfunctions.h:79,105,170).
+
+TPU-native version: numpy's Generator over the *actual MT19937* bit
+generator for host-side payloads (numpy ships Mersenne Twister — no vendored
+implementation needed), with the same rank-offset seeding discipline, and
+`jax.random` keys for anything generated on-device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import jax
+except Exception:  # pragma: no cover
+    jax = None
+
+# Seed array in the spirit of the reference's fixed init_by_array seeds with
+# a rank-dependent first word (reduce.c:38-41). Values differ by design —
+# we are not replicating the reference's exact streams, only its discipline.
+_BASE_SEED_WORDS = (0x1571, 0x2662, 0x3753, 0x4844)
+
+
+def _mt_for_rank(rank: int, seed: int = 0) -> np.random.Generator:
+    words = (_BASE_SEED_WORDS[0] + rank + seed,) + _BASE_SEED_WORDS[1:]
+    return np.random.Generator(np.random.MT19937(list(words)))
+
+
+def host_data(n: int, dtype: str, rank: int = 0, seed: int = 0) -> np.ndarray:
+    """Generate the benchmark payload for one rank/shard.
+
+    Distribution mirrors the reference's masked-byte scheme
+    (reduction.cpp:698-705): ints uniform in [0, 255]; reals
+    (uniform byte) / RAND_MAX — i.e. tiny positive reals — so SUM
+    verification tolerances behave like the reference's.
+    """
+    g = _mt_for_rank(rank, seed)
+    bytes_ = g.integers(0, 256, size=n, dtype=np.int64)
+    if dtype == "int32":
+        return bytes_.astype(np.int32)
+    rand_max = float(2**31 - 1)  # glibc RAND_MAX
+    return (bytes_ / rand_max).astype(dtype)
+
+
+def rank_seed_key(rank: int, seed: int = 0):
+    """A jax.random key with the same rank-offset discipline, for
+    on-device generation paths."""
+    if jax is None:  # pragma: no cover
+        raise RuntimeError("jax unavailable")
+    return jax.random.key(_BASE_SEED_WORDS[0] + rank + seed)

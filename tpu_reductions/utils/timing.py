@@ -1,0 +1,100 @@
+"""Timing — the cutil stopwatch registry, re-done for async accelerators.
+
+The reference brackets device-synchronized regions with a named-stopwatch
+registry over gettimeofday (cutCreateTimer/cutStartTimer/cutStopTimer/
+cutGetAverageTimerValue, reference cutil.cpp:1567-1692,
+stopwatch_linux.h:88-157) and, on the MPI side, raw rdtsc cycle counters
+divided by a hard-coded CLOCK_RATE (externalfunctions.h:7-43,
+constants.h:4).
+
+TPU-native version: `time.perf_counter` (monotonic wall clock — never a
+hard-coded clock rate) around `jax.block_until_ready`, which is the analog
+of `cutilDeviceSynchronize` (reduction.cpp:319,373). JAX dispatch is async,
+so forgetting to block measures launch overhead, not the kernel — the same
+hygiene failure the reference guards against by syncing before both timer
+edges.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+import jax
+
+
+@dataclass
+class Stopwatch:
+    """Accumulating stopwatch with per-session average.
+
+    Semantics mirror cutil's StopWatchLinux (stopwatch_linux.h:88-157):
+    total time accumulates across start/stop sessions; `average` is
+    total / number_of_sessions (cutGetAverageTimerValue returns ms —
+    we return seconds and let callers format).
+    """
+
+    total_s: float = 0.0
+    sessions: int = 0
+    _t0: Optional[float] = field(default=None, repr=False)
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> float:
+        if self._t0 is None:
+            raise RuntimeError("stopwatch stopped without start")
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self.total_s += dt
+        self.sessions += 1
+        return dt
+
+    def reset(self) -> None:
+        self.total_s = 0.0
+        self.sessions = 0
+        self._t0 = None
+
+    @property
+    def average_s(self) -> float:
+        """Mean session time (cutGetAverageTimerValue analog, cutil.cpp:1684)."""
+        return self.total_s / self.sessions if self.sessions else 0.0
+
+
+class TimerRegistry:
+    """Named stopwatch registry (cutCreateTimer handle-table analog)."""
+
+    def __init__(self) -> None:
+        self._timers: Dict[str, Stopwatch] = {}
+
+    def create(self, name: str) -> Stopwatch:
+        sw = Stopwatch()
+        self._timers[name] = sw
+        return sw
+
+    def __getitem__(self, name: str) -> Stopwatch:
+        return self._timers[name]
+
+    def delete(self, name: str) -> None:
+        self._timers.pop(name, None)
+
+
+def time_fn(fn: Callable, *args, iterations: int = 100, warmup: int = 1,
+            stopwatch: Optional[Stopwatch] = None):
+    """Benchmark `fn(*args)` the way the reference's hot loop does
+    (reduction.cpp:297-384): sync, start timer, run, sync, stop timer —
+    per iteration — after `warmup` untimed launches (reduction.cpp:729).
+
+    Returns (last_result, stopwatch).
+    """
+    sw = stopwatch or Stopwatch()
+    result = None
+    for _ in range(warmup):
+        result = jax.block_until_ready(fn(*args))
+    for _ in range(iterations):
+        # sync before starting the timer (cutilDeviceSynchronize analog,
+        # reduction.cpp:319) — everything previously dispatched has drained.
+        sw.start()
+        result = jax.block_until_ready(fn(*args))
+        sw.stop()
+    return result, sw
