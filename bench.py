@@ -1,0 +1,41 @@
+"""Round benchmark: one JSON line for the driver.
+
+Headline metric (BASELINE.json): single-chip reduction bandwidth, int32
+SUM at n=2^24 — the reference's flagship CUDA configuration
+(reduction.cpp:665: n=1<<24; mpi/CUdata.txt:6: 90.8413 GB/s on the
+course's GPU). vs_baseline = our GB/s / 90.8413.
+
+Runs the Pallas kernel path on the real chip via the standard
+self-verifying driver (verification included; a FAILED verify zeroes the
+metric so a wrong-but-fast kernel can't score).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+BASELINE_GBPS = 90.8413  # CUDA int SUM, n=2^24 (mpi/CUdata.txt:6)
+
+
+def main() -> int:
+    from tpu_reductions.bench.driver import run_benchmark
+    from tpu_reductions.config import ReduceConfig
+    from tpu_reductions.utils.logging import BenchLogger
+
+    cfg = ReduceConfig(method="SUM", dtype="int32", n=1 << 24,
+                       iterations=50, warmup=2, log_file=None)
+    res = run_benchmark(cfg, logger=BenchLogger(None, None,
+                                                console=sys.stderr))
+    value = res.gbps if res.passed else 0.0
+    print(json.dumps({
+        "metric": "single-chip int32 SUM reduction bandwidth, n=2^24",
+        "value": round(value, 4),
+        "unit": "GB/s",
+        "vs_baseline": round(value / BASELINE_GBPS, 4),
+    }))
+    return 0 if res.passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,94 @@
+"""L5 tests: sweep -> raw -> collected -> averaged -> plotted, end to end."""
+
+import numpy as np
+import pytest
+
+from tpu_reductions.bench.aggregate import (average, collect, pipeline,
+                                            write_results)
+from tpu_reductions.bench.plot import plot_vs_n, plot_vs_ranks
+from tpu_reductions.bench.sweep import run_shmoo, sweep_all, sweep_collective
+from tpu_reductions.config import ReduceConfig
+from tpu_reductions.utils.logging import BenchLogger
+
+
+def test_run_shmoo_sizes():
+    cfg = ReduceConfig(method="SUM", dtype="int32", n=1, iterations=2,
+                       log_file=None)
+    results = run_shmoo(cfg, min_pow=10, max_pow=12,
+                        logger=BenchLogger(None, None))
+    assert [r.n for r in results] == [1 << 10, 1 << 11, 1 << 12]
+    assert all(r.passed for r in results)
+
+
+def test_sweep_all_writes_raw(tmp_path):
+    rows = sweep_all(methods=("SUM",), dtypes=("int32",), n=4096,
+                     repeats=2, iterations=2, out_dir=str(tmp_path),
+                     logger=BenchLogger(None, None))
+    assert len(rows) == 2
+    raws = list((tmp_path / "raw_output").glob("*.json"))
+    assert len(raws) == 2
+
+
+def test_collective_sweep_and_full_pipeline(tmp_path):
+    rows = sweep_collective(rank_counts=(2, 4), methods=("SUM", "MAX"),
+                            dtypes=("int32",), n=1 << 12, retries=2,
+                            out_dir=str(tmp_path),
+                            logger=BenchLogger(None, None))
+    assert len(rows) == 2 * 2 * 2  # ranks x methods x retries
+    # raw job files exist (stdout-vn-<job> analog)
+    raws = list((tmp_path / "raw_output").glob("stdout-vn-*.txt"))
+    assert len(raws) == 2
+    # full aggregation: raw -> collected.txt -> results/*.txt
+    outs = pipeline(tmp_path / "raw_output", tmp_path)
+    assert (tmp_path / "collected.txt").exists()
+    names = sorted(p.name for p in outs)
+    assert names == ["INT_MAX.txt", "INT_SUM.txt"]
+    body = (tmp_path / "results" / "INT_SUM.txt").read_text().splitlines()
+    assert body[0] == "DATATYPE OP NODES GB/sec"
+    # two averaged rows (ranks 2 and 4), each the mean of 2 retries
+    assert len(body) == 3
+    dt, op, ranks, gbps = body[1].split()
+    assert (dt, op, ranks) == ("INT", "SUM", "2") and float(gbps) > 0
+
+
+def test_average_row_math():
+    rows = ["INT SUM 64 10.0", "INT SUM 64 20.0", "INT SUM 256 40.0",
+            "DOUBLE MAX 64 5.0"]
+    avgs = average(rows)
+    assert avgs[("INT", "SUM", 64)] == pytest.approx(15.0)
+    assert avgs[("INT", "SUM", 256)] == pytest.approx(40.0)
+    assert avgs[("DOUBLE", "MAX", 64)] == pytest.approx(5.0)
+
+
+def test_collect_mixed_formats(tmp_path):
+    (tmp_path / "a.txt").write_text("INT SUM 64 9.182\nnoise line\n")
+    (tmp_path / "b.json").write_text(
+        '{"dtype": "float64", "method": "MIN", "ranks": 8, '
+        '"reference_gbps": 1.5}\n')
+    rows = collect(tmp_path)
+    assert "INT SUM 64 9.182" in rows
+    assert "DOUBLE MIN 8 1.500" in rows
+
+
+def test_plots_render(tmp_path):
+    avgs = {("INT", "SUM", 2): 10.0, ("INT", "SUM", 4): 18.0,
+            ("INT", "MIN", 2): 9.0, ("INT", "MIN", 4): 16.0}
+    outs = plot_vs_ranks(avgs, "INT", tmp_path / "int",
+                         single_chip_lines={"single-chip SUM": 90.84})
+    exts = sorted(p.suffix for p in outs)
+    assert exts == [".eps", ".png"]  # reference emits EPS (makePlots.gp)
+    assert all(p.exists() and p.stat().st_size > 0 for p in outs)
+
+    shmoo_rows = [dict(dtype="int32", method="SUM", n=1 << p,
+                       gbps=float(p)) for p in range(10, 14)]
+    outs2 = plot_vs_n(shmoo_rows, tmp_path / "shmoo")
+    assert all(p.exists() for p in outs2)
+
+
+def test_graft_entry_contract():
+    import __graft_entry__ as ge
+    import jax
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert np.asarray(out).shape == ()
+    ge.dryrun_multichip(8)  # asserts internally

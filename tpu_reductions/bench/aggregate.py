@@ -1,0 +1,88 @@
+"""L5: raw -> collected -> averaged results pipeline (getAvgs.sh analog).
+
+The reference pipeline (SURVEY.md §3.3): per-job stdout files
+(mpi/raw_output/stdout-*) are manually concatenated into collected.txt,
+then mpi/getAvgs.sh greps per (DATATYPE, OP), averages GB/s per node count
+with awk+bc, and writes mpi/results/${DATATYPE}_${OP}.txt rows that
+makePlots.gp consumes. Same stages here, as functions instead of
+shell+awk+bc — and the row grammar is kept identical
+(`DATATYPE OP NODES GB/sec`, reduce.c:67-69) so existing awk/gnuplot
+tooling would still parse our files.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+from collections import defaultdict
+from pathlib import Path
+from typing import Dict, Iterable, List, Tuple
+
+Key = Tuple[str, str, int]   # (DATATYPE, OP, ranks)
+
+_DTYPE_NAMES = {"int32": "INT", "float64": "DOUBLE", "float32": "FLOAT",
+                "bfloat16": "BF16"}
+
+
+def collect(raw_dir: str | Path, out_file: str | Path | None = None
+            ) -> List[str]:
+    """Concatenate raw run outputs into data rows — the
+    `cat stdout-* > collected.txt` step. Accepts both row-format .txt and
+    the sweep's JSON-lines .json files."""
+    rows: List[str] = []
+    for f in sorted(Path(raw_dir).glob("*")):
+        if f.suffix == ".json":
+            for line in f.read_text().splitlines():
+                if not line.strip():
+                    continue
+                d = json.loads(line)
+                ranks = d.get("ranks", 1)
+                dt = _DTYPE_NAMES.get(d["dtype"], d["dtype"].upper())
+                gbps = d.get("reference_gbps", d.get("gbps"))
+                rows.append(f"{dt} {d['method']} {ranks} {gbps:.3f}")
+        else:
+            for line in f.read_text().splitlines():
+                parts = line.split()
+                if len(parts) == 4 and parts[2].isdigit():
+                    rows.append(line.strip())
+    if out_file:
+        Path(out_file).write_text("\n".join(rows) + "\n")
+    return rows
+
+
+def average(rows: Iterable[str]) -> Dict[Key, float]:
+    """Mean GB/s per (DATATYPE, OP, ranks) — the awk+bc loop of
+    getAvgs.sh:8-11."""
+    groups: Dict[Key, list] = defaultdict(list)
+    for row in rows:
+        dt, op, ranks, gbps = row.split()
+        groups[(dt, op, int(ranks))].append(float(gbps))
+    return {k: statistics.fmean(v) for k, v in groups.items()}
+
+
+def write_results(avgs: Dict[Key, float], out_dir: str | Path) -> List[Path]:
+    """Emit results/${DATATYPE}_${OP}.txt files (getAvgs.sh:12-14 analog):
+    one averaged `DATATYPE OP NODES GB/sec` row per rank count, ascending,
+    under the header row the downstream plotters expect."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    written = []
+    by_file: Dict[Tuple[str, str], list] = defaultdict(list)
+    for (dt, op, ranks), gbps in sorted(avgs.items()):
+        by_file[(dt, op)].append((ranks, gbps))
+    for (dt, op), series in by_file.items():
+        path = out / f"{dt}_{op}.txt"
+        lines = ["DATATYPE OP NODES GB/sec"]
+        lines += [f"{dt} {op} {ranks} {gbps:.3f}"
+                  for ranks, gbps in sorted(series)]
+        path.write_text("\n".join(lines) + "\n")
+        written.append(path)
+    return written
+
+
+def pipeline(raw_dir: str | Path, out_dir: str | Path) -> List[Path]:
+    """raw_output/ -> collected.txt -> results/*.txt in one call."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    rows = collect(raw_dir, out / "collected.txt")
+    return write_results(average(rows), out / "results")

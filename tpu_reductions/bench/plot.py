@@ -1,0 +1,129 @@
+"""L5: plotting — the makePlots.gp analog.
+
+The reference renders EPS figures with gnuplot (mpi/makePlots.gp:1-39):
+per-dtype bandwidth-vs-ranks curves for the three MPI ops, with the CUDA
+single-GPU numbers overlaid as constant horizontal lines
+(`f(x)=90.8413`, makePlots.gp:17-19,31-33), axes "Number of MPI Ranks" vs
+"Bandwidth (GB/sec)" (:12-13). Those figures feed writeup.tex.
+
+Here: matplotlib, emitting both PNG and EPS (the reference's format), plus
+a bandwidth-vs-N figure for the shmoo sweep the reference never got to
+plot. Falls back to writing a .gp gnuplot script when matplotlib is
+unavailable, so the pipeline still produces a plottable artifact.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, Optional, Sequence
+
+from tpu_reductions.bench.aggregate import Key
+
+
+def plot_vs_ranks(avgs: Dict[Key, float], dtype_name: str,
+                  out_base: str | Path,
+                  single_chip_lines: Optional[Dict[str, float]] = None,
+                  title: Optional[str] = None) -> Sequence[Path]:
+    """One dtype's bandwidth-vs-ranks figure (int.eps / double.eps analog).
+
+    single_chip_lines: {label: GB/s} constants drawn as horizontal lines —
+    the CUDA-overlay analog, now carrying the single-TPU-chip numbers.
+    """
+    series = {(dt, op): [] for (dt, op, _) in avgs if dt == dtype_name}
+    for (dt, op, ranks), gbps in sorted(avgs.items()):
+        if dt == dtype_name:
+            series[(dt, op)].append((ranks, gbps))
+    out_base = Path(out_base)
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:
+        return [_emit_gnuplot(series, dtype_name, out_base,
+                              single_chip_lines)]
+
+    fig, ax = plt.subplots(figsize=(7, 5))
+    for (_, op), pts in sorted(series.items()):
+        xs, ys = zip(*pts)
+        ax.plot(xs, ys, marker="o", label=f"{dtype_name} {op}")
+    if single_chip_lines:
+        for label, gbps in single_chip_lines.items():
+            ax.axhline(gbps, linestyle="--", linewidth=1, label=label)
+    ax.set_xlabel("Number of Mesh Ranks")        # makePlots.gp:12 analog
+    ax.set_ylabel("Bandwidth (GB/sec)")          # makePlots.gp:13
+    ax.set_xscale("log", base=2)
+    ax.legend()
+    ax.set_title(title or f"{dtype_name} collective reduction bandwidth")
+    ax.grid(True, alpha=0.3)
+    outs = []
+    for ext in ("png", "eps"):                   # reference emits EPS
+        p = out_base.with_suffix(f".{ext}")
+        fig.savefig(p, bbox_inches="tight")
+        outs.append(p)
+    plt.close(fig)
+    return outs
+
+
+def plot_vs_n(shmoo_rows: Sequence[dict], out_base: str | Path,
+              title: str = "Single-chip reduction bandwidth vs N"
+              ) -> Sequence[Path]:
+    """Bandwidth-vs-N curves from shmoo results (one line per
+    (method, dtype)) — the sweep plot the reference's stubbed shmoo never
+    produced. shmoo_rows: BenchResult.to_dict() dicts."""
+    out_base = Path(out_base)
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:
+        lines = [f"{r['dtype']} {r['method']} {r['n']} {r['gbps']:.3f}"
+                 for r in shmoo_rows]
+        p = out_base.with_suffix(".dat")
+        p.write_text("\n".join(lines) + "\n")
+        return [p]
+
+    groups: Dict[tuple, list] = {}
+    for r in shmoo_rows:
+        groups.setdefault((r["dtype"], r["method"]), []).append(
+            (r["n"], r["gbps"]))
+    fig, ax = plt.subplots(figsize=(7, 5))
+    for (dtype, method), pts in sorted(groups.items()):
+        xs, ys = zip(*sorted(pts))
+        ax.plot(xs, ys, marker="o", label=f"{dtype} {method}")
+    ax.set_xlabel("Elements (N)")
+    ax.set_ylabel("Bandwidth (GB/sec)")
+    ax.set_xscale("log", base=2)
+    ax.legend()
+    ax.set_title(title)
+    ax.grid(True, alpha=0.3)
+    outs = []
+    for ext in ("png", "eps"):
+        p = out_base.with_suffix(f".{ext}")
+        fig.savefig(p, bbox_inches="tight")
+        outs.append(p)
+    plt.close(fig)
+    return outs
+
+
+def _emit_gnuplot(series, dtype_name, out_base: Path,
+                  single_chip_lines) -> Path:
+    """matplotlib-free fallback: write a gnuplot script + data files in
+    the reference's own idiom (constants as f(x)=..., makePlots.gp:17-19)."""
+    gp = [f'set term postscript color\nset output "{out_base.stem}.eps"',
+          'set xlabel "Number of Mesh Ranks"',
+          'set ylabel "Bandwidth (GB/sec)"', "set logscale x 2"]
+    plots, idx = [], 0
+    for (dt, op), pts in sorted(series.items()):
+        dat = out_base.parent / f"{out_base.stem}_{op}.dat"
+        dat.write_text("\n".join(f"{r} {g}" for r, g in sorted(pts)) + "\n")
+        plots.append(f'"{dat.name}" using 1:2 with linespoints '
+                     f'title "{dt} {op}"')
+        idx += 1
+    for label, gbps in (single_chip_lines or {}).items():
+        gp.append(f"f{idx}(x)={gbps}")
+        plots.append(f'f{idx}(x) title "{label}"')
+        idx += 1
+    gp.append("plot " + ", ".join(plots))
+    path = out_base.with_suffix(".gp")
+    path.write_text("\n".join(gp) + "\n")
+    return path

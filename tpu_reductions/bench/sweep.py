@@ -47,6 +47,43 @@ def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
     return results
 
 
+def sweep_collective(*, rank_counts=(2, 4, 8), methods=("MAX", "MIN", "SUM"),
+                     dtypes=("int32", "float64"), n: int = 1 << 22,
+                     retries: int = 5, rooted: bool = False,
+                     mode: str = "vn", mapping: str = "default",
+                     out_dir: Optional[str] = None,
+                     logger: Optional[BenchLogger] = None) -> List[dict]:
+    """Rank-count sweep of the collective benchmark — the submit_all.sh
+    analog (sbatch --nodes {32,128,512}, mpi/submit_all.sh:3-4), with the
+    reference's op order (MAX, MIN, SUM — reduce.c:73) and RETRY_COUNT
+    repeats. Writes per-"job" row files into out_dir/raw_output, the
+    stdout-vn-<jobid> analog, ready for aggregate.pipeline()."""
+    from tpu_reductions.bench.collective_driver import run_collective_benchmark
+    from tpu_reductions.config import CollectiveConfig
+
+    logger = logger or BenchLogger(None, None)
+    raw_dir = Path(out_dir) / "raw_output" if out_dir else None
+    if raw_dir:
+        raw_dir.mkdir(parents=True, exist_ok=True)
+    rows = []
+    for k in rank_counts:
+        # per-job logger writing the stdout-<mode>-<jobid> analog: the
+        # driver itself emits the header + rows, exactly like the real
+        # per-job stdout (aggregate.collect skips the header row)
+        job_logger = BenchLogger(
+            str(raw_dir / f"stdout-{mode}-{k}ranks.txt") if raw_dir else None,
+            None, console=logger.console)
+        for dtype in dtypes:
+            for method in methods:
+                cfg = CollectiveConfig(method=method, dtype=dtype, n=n,
+                                       retries=retries, num_devices=k,
+                                       rooted=rooted, mode=mode,
+                                       mapping=mapping)
+                for res in run_collective_benchmark(cfg, logger=job_logger):
+                    rows.append(res.to_dict())
+    return rows
+
+
 def sweep_all(*, methods=("SUM", "MIN", "MAX"),
               dtypes=("int32", "float64"), n: int = 1 << 24,
               repeats: int = 5, iterations: int = 20,

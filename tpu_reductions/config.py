@@ -157,6 +157,10 @@ def _add_common_flags(p: argparse.ArgumentParser) -> None:
                    help="QA batch mode (shrQATest --qatest analog)")
     p.add_argument("--no-verify", dest="verify", action="store_false",
                    help="Skip host-oracle verification")
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"),
+                   help="Force the JAX platform (e.g. cpu to run on a "
+                        "machine without a TPU)")
 
 
 def build_single_chip_parser() -> argparse.ArgumentParser:
@@ -190,10 +194,6 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
     p.add_argument("--logfile", dest="log_file", type=str,
                    default="reduction.txt")
     p.add_argument("--masterlog", dest="master_log", type=str, default=None)
-    p.add_argument("--platform", type=str, default=None,
-                   choices=("cpu", "tpu"),
-                   help="Force the JAX platform (e.g. cpu to benchmark the "
-                        "host path on a machine without a TPU)")
     return p
 
 
@@ -221,12 +221,25 @@ def parse_single_chip(argv=None):
         device=ns.device, log_file=ns.log_file, master_log=ns.master_log,
         qatest=ns.qatest, verify=ns.verify,
     )
-    if ns.platform:
+    _apply_platform(ns)
+    return cfg, ns.shmoo
+
+
+def _apply_platform(ns) -> None:
+    if getattr(ns, "platform", None):
         # must happen before the first jax backend touch; the axon plugin
         # ignores JAX_PLATFORMS, so this goes through jax.config.
         import jax
         jax.config.update("jax_platforms", ns.platform)
-    return cfg, ns.shmoo
+        if ns.platform == "cpu" and getattr(ns, "num_devices", None):
+            # provision enough virtual CPU devices for the requested rank
+            # count (the host-platform analog of a pod slice); 'co' mode
+            # addresses every other device, so it needs twice as many.
+            # Only when --devices is explicit — otherwise leave any
+            # environment-provided device count (XLA_FLAGS) alone.
+            want = ns.num_devices * (2 if getattr(ns, "mode", "vn") == "co"
+                                     else 1)
+            jax.config.update("jax_num_cpu_devices", want)
 
 
 def build_collective_parser() -> argparse.ArgumentParser:
@@ -255,6 +268,7 @@ def parse_collective(argv=None) -> CollectiveConfig:
     ns = p.parse_args(argv)
     if ns.method is None:
         p.error("--method={SUM|MIN|MAX} is required")
+    _apply_platform(ns)
     return CollectiveConfig(
         method=ns.method, dtype=ns.dtype, n=ns.n, retries=ns.retries,
         warmup=ns.warmup, num_devices=ns.num_devices, mapping=ns.mapping,

@@ -1,0 +1,19 @@
+"""L3: distributed communication backend — mesh building + collective
+reductions over ICI/DCN.
+
+TPU-native equivalent of the reference's MPI backend (SURVEY.md §2.6):
+MPI_Reduce over the Blue Gene/L torus becomes jax.lax.psum/pmin/pmax under
+shard_map on a jax.sharding.Mesh; VN/CO node modes and the BGLMPI_MAPPING
+task-placement variable become device-granularity and mesh-axis-order
+options; SLURM + mpirun multi-node launch becomes the JAX distributed
+runtime (jax.distributed.initialize) over DCN.
+"""
+
+from tpu_reductions.parallel.mesh import (build_mesh, device_inventory,
+                                          initialize_distributed)
+from tpu_reductions.parallel.collectives import (bandwidth_report,
+                                                 make_collective_reduce,
+                                                 shard_payload)
+
+__all__ = ["build_mesh", "device_inventory", "initialize_distributed",
+           "make_collective_reduce", "shard_payload", "bandwidth_report"]

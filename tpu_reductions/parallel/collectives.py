@@ -1,0 +1,202 @@
+"""Cross-chip collective reductions — the MPI_Reduce analog over ICI.
+
+The reference times blocking rooted `MPI_Reduce(sendbuf, recvbuf, count,
+dtype, op, 0, MPI_COMM_WORLD)` (reduce.c:76,90): every rank holds
+N/commSize elements and the root receives the ELEMENTWISE op across ranks.
+The TPU-native equivalent (SURVEY.md §2.6):
+
+  MPI_Reduce(op)            ->  shard_map(lambda s: lax.psum/pmin/pmax(s, axis))
+                                over a Mesh — an all-reduce; "rooted"
+                                semantics via lax.psum_scatter (each rank
+                                keeps 1/k of the reduced array — the same
+                                bytes-on-wire as a rooted reduce tree)
+  per-rank sendbuf          ->  a global array sharded over the mesh axis
+  rank-0 recvbuf            ->  out_specs P(None) replication (all_reduce)
+                                or the scattered shard (reduce_scatter)
+
+Bandwidth accounting: the reference reports total-bytes / rank-0-time
+(reduce.c:78-79,92-93). We report that same "reference GB/s" for
+comparability, plus the standard collective metrics (NCCL-convention
+algorithm and bus bandwidth) so numbers are meaningful per-link:
+  algbw = payload_bytes / t
+  busbw = algbw * 2(k-1)/k   (all-reduce)   |   * (k-1)/k   (reduce-scatter)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax>=0.4.35 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from tpu_reductions.ops.registry import get_op
+
+_COLLECTIVES = {
+    "SUM": jax.lax.psum,
+    "MIN": jax.lax.pmin,
+    "MAX": jax.lax.pmax,
+}
+
+
+def shard_payload(x_global: np.ndarray, mesh: Mesh, axis: str) -> jax.Array:
+    """Place a global (k*L,) payload sharded over the mesh axis — each
+    device ends up with its rank's contiguous L-element block, the analog
+    of each MPI rank generating/holding its own sendbuf (reduce.c:43-57)."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.device_put(x_global, sharding)
+
+
+def make_collective_reduce(method: str, mesh: Mesh, axis: str = "ranks",
+                           rooted: bool = False) -> Callable:
+    """Build the jitted collective: sharded (k*L,) -> reduced array.
+
+    rooted=False: all-reduce; every rank holds the full elementwise-reduced
+    (L,) result (out replicated). The semantic superset of MPI_Reduce —
+    noted delta: the reference materializes the result only on rank 0.
+    rooted=True: reduce-scatter via lax.psum_scatter (+ index trick for
+    MIN/MAX, which have no native scatter variant: scatter after pmin by
+    slicing) — each rank keeps L/k of the reduced result, which is the
+    rooted-reduce wire cost.
+    """
+    method = method.upper()
+    prim = _COLLECTIVES[method]
+    k = mesh.shape[axis]
+
+    if not rooted:
+        def local(shard):
+            return prim(shard, axis)
+
+        fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P())
+        return jax.jit(fn)
+
+    if method == "SUM":
+        def local_scatter(shard):
+            # psum_scatter: elementwise sum across ranks, each rank keeps
+            # its L/k slice — half the wire cost of the full all-reduce.
+            return jax.lax.psum_scatter(shard, axis, tiled=True)
+
+        fn = shard_map(local_scatter, mesh=mesh, in_specs=P(axis),
+                       out_specs=P(axis))
+        return jax.jit(fn)
+
+    def local_minmax_scatter(shard):
+        # no pmin_scatter primitive: reduce fully, keep this rank's slice
+        # (XLA still schedules the slice-discard efficiently; wire cost is
+        # the all-reduce's — documented delta vs a true reduce tree).
+        full = prim(shard, axis)
+        r = jax.lax.axis_index(axis)
+        piece = full.shape[0] // k
+        return jax.lax.dynamic_slice_in_dim(full, r * piece, piece)
+
+    fn = shard_map(local_minmax_scatter, mesh=mesh, in_specs=P(axis),
+                   out_specs=P(axis))
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# float64 collectives with no device f64 (TPU path)
+# ---------------------------------------------------------------------------
+
+
+def make_dd_sum_all_reduce(mesh: Mesh, axis: str = "ranks") -> Callable:
+    """Elementwise f64-fidelity SUM across ranks carried as (hi, lo) f32
+    pairs — a RING all-reduce built from jax.lax.ppermute hops with
+    compensated (double-double) accumulation at every hop.
+
+    A plain psum of the hi/lo planes would round at f32 (~1e-7 relative),
+    missing the reference's f64 acceptance threshold of 1e-12
+    (reduction.cpp:764). The ring keeps the pair arithmetic error-free to
+    ~2^-48: rank r's block travels the ring, every rank folds each arriving
+    block with dd_add (dd_reduce._dd_add). k-1 hops of L elements each —
+    the classic ring all-reduce wire pattern the ICI torus is built for.
+
+    Note: each rank accumulates the blocks in a different rotation order,
+    so replicas can differ by O(2^-48) — far inside the 1e-12 acceptance
+    band; out_specs declares replication on that basis.
+    """
+    from tpu_reductions.ops.dd_reduce import _dd_add
+
+    k = mesh.shape[axis]
+    ring = [(i, (i + 1) % k) for i in range(k)]
+
+    def local(hi, lo):
+        def body(_, carry):
+            acc_hi, acc_lo, cur_hi, cur_lo = carry
+            nxt_hi = jax.lax.ppermute(cur_hi, axis, perm=ring)
+            nxt_lo = jax.lax.ppermute(cur_lo, axis, perm=ring)
+            a_hi, a_lo = _dd_add(acc_hi, acc_lo, nxt_hi, nxt_lo)
+            return a_hi, a_lo, nxt_hi, nxt_lo
+
+        acc_hi, acc_lo, _, _ = jax.lax.fori_loop(
+            0, k - 1, body, (hi, lo, hi, lo))
+        return acc_hi, acc_lo
+
+    fn = shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
+                   out_specs=(P(), P()), check_vma=False)
+    return jax.jit(fn)
+
+
+def make_key_minmax_all_reduce(method: str, mesh: Mesh,
+                               axis: str = "ranks") -> Callable:
+    """EXACT f64 MIN/MAX across ranks on order-preserving int32 key pairs
+    (dd_reduce.host_key_encode) using two collective phases:
+
+      phase 1: m_hi = pmin/pmax(k_hi)            -- winning high word
+      phase 2: m_lo = pmin/pmax(where(k_hi == m_hi, k_lo, sentinel))
+               -- among ranks tied on the high word, select the low word
+
+    (m_hi, m_lo) is then the exact lexicographic winner: ranks not tied at
+    the high word are masked to the sentinel (the identity for the op), so
+    they cannot win phase 2. Decode on host is bit-exact
+    (dd_reduce.host_key_decode).
+    """
+    method = method.upper()
+    assert method in ("MIN", "MAX")
+    prim = _COLLECTIVES[method]
+    sentinel = jnp.int32(2**31 - 1) if method == "MIN" else jnp.int32(-2**31)
+
+    def local(k_hi, k_lo):
+        m_hi = prim(k_hi, axis)
+        cand = jnp.where(k_hi == m_hi, k_lo, sentinel)
+        m_lo = prim(cand, axis)
+        return m_hi, m_lo
+
+    fn = shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
+                   out_specs=(P(), P()))
+    return jax.jit(fn)
+
+
+def host_collective_oracle(x_global: np.ndarray, k: int, method: str
+                           ) -> np.ndarray:
+    """Elementwise host oracle: reshape (k, L) and combine across ranks.
+    The reference MPI program verified nothing (SURVEY.md §4 — 'the MPI
+    program has no correctness oracle at all'); we add the missing check."""
+    op = get_op(method)
+    blocks = np.asarray(x_global).reshape(k, -1)
+    if method.upper() == "SUM" and blocks.dtype == np.int32:
+        # match the device's wrapping int32 accumulator
+        return blocks.astype(np.int64).sum(axis=0).astype(np.int32)
+    return op.np_reduce(blocks, axis=0)
+
+
+def bandwidth_report(payload_bytes: int, k: int, time_s: float,
+                     rooted: bool = False) -> dict:
+    """All the bandwidth conventions in one place (see module docstring)."""
+    ref_gbps = payload_bytes / time_s / 1e9 if time_s > 0 else float("inf")
+    algbw = ref_gbps
+    factor = ((k - 1) / k) if rooted else (2 * (k - 1) / k)
+    return {
+        "reference_gbps": ref_gbps,       # total-bytes / time (reduce.c:79)
+        "algbw_gbps": algbw,
+        "busbw_gbps": algbw * factor,
+        "ranks": k,
+        "payload_bytes": payload_bytes,
+        "collective": "reduce_scatter" if rooted else "all_reduce",
+    }

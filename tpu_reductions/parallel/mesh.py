@@ -1,0 +1,108 @@
+"""Mesh construction over ICI/DCN — the rank/placement layer.
+
+Reference mapping (SURVEY.md §2.6):
+- `MPI_Init/Comm_rank/Comm_size` (reduce.c:32-34) ≙ jax device discovery +
+  `build_mesh`; the mesh axis size is the comm size.
+- SLURM `--nodes` sweep (submit_all.sh:3-4) ≙ the `num_devices` argument.
+- Blue Gene VN vs CO mode — 2 ranks/node vs 1 (ccni_vn.sh:6, `-mode VN`)
+  ≙ `mode`: "vn" addresses every device, "co" one device per chip/host
+  pair (coarser granularity, fewer-but-fatter ranks).
+- `BGLMPI_MAPPING=TXYZ` task placement (ccni_vn.sh:3) ≙ `mapping`:
+  device-order permutations controlling which physical neighbors become
+  mesh neighbors (axis order determines which collectives ride which ICI
+  axis).
+- Multi-node launch (`mpirun` under sbatch) ≙ `initialize_distributed`
+  wrapping jax.distributed.initialize over DCN.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DEFAULT_AXIS = "ranks"
+
+MAPPINGS = ("default", "reversed", "interleaved")
+
+
+def device_inventory() -> dict:
+    """Discoverable topology facts (the deviceQuery analog, and the
+    `MPI_Comm_size` source of truth)."""
+    devs = jax.devices()
+    return {
+        "platform": jax.default_backend(),
+        "num_devices": len(devs),
+        "num_processes": jax.process_count(),
+        "process_index": jax.process_index(),
+        "device_kinds": sorted({d.device_kind for d in devs}),
+    }
+
+
+def _order_devices(devs: list, mapping: str) -> list:
+    """Permute device order — the BGLMPI_MAPPING analog. On a real torus
+    the order decides which logical neighbors are physical ICI neighbors;
+    'reversed' and 'interleaved' exist to expose placement sensitivity the
+    way TXYZ-vs-XYZT did on the Blue Gene."""
+    if mapping == "default":
+        return devs
+    if mapping == "reversed":
+        return devs[::-1]
+    if mapping == "interleaved":
+        return devs[0::2] + devs[1::2]
+    raise ValueError(f"unknown mapping {mapping!r}; one of {MAPPINGS}")
+
+
+def build_mesh(num_devices: Optional[int] = None,
+               mesh_shape: Optional[Sequence[int]] = None,
+               axis_names: Optional[Sequence[str]] = None,
+               mapping: str = "default",
+               mode: str = "vn") -> Mesh:
+    """Build the reduction mesh.
+
+    num_devices: rank count (defaults to all available after `mode`
+    filtering) — the sbatch --nodes analog. mesh_shape/axis_names allow a
+    multi-axis (torus-like) mesh; default is 1-D ("ranks",).
+    """
+    devs = jax.devices()
+    if mode == "co":
+        # coprocessor-mode analog: one rank per device *pair* (half the
+        # addressable ranks, each with the same per-rank payload).
+        devs = devs[0::2] if len(devs) > 1 else devs
+    elif mode != "vn":
+        raise ValueError("mode must be 'vn' or 'co'")
+    devs = _order_devices(devs, mapping)
+    if num_devices is not None:
+        if num_devices > len(devs):
+            raise ValueError(f"requested {num_devices} devices, "
+                             f"only {len(devs)} available in mode={mode!r}")
+        devs = devs[:num_devices]
+    if mesh_shape is None:
+        mesh_shape = (len(devs),)
+        axis_names = tuple(axis_names or (DEFAULT_AXIS,))
+    else:
+        mesh_shape = tuple(mesh_shape)
+        if math.prod(mesh_shape) != len(devs):
+            raise ValueError(f"mesh_shape {mesh_shape} != {len(devs)} devices")
+        axis_names = tuple(axis_names
+                           or (DEFAULT_AXIS,) if len(mesh_shape) == 1
+                           else tuple(f"ax{i}" for i in range(len(mesh_shape))))
+    dev_array = np.array(devs).reshape(mesh_shape)
+    return Mesh(dev_array, axis_names)
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up over DCN — the mpirun/SLURM launch analog
+    (ccni_vn.sh:6-8). No-op when single-process or already initialized;
+    on a real pod slice each host calls this before build_mesh and the
+    mesh then spans all hosts' devices."""
+    if num_processes in (None, 1):
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
