@@ -15,6 +15,8 @@
 #include <cstdint>
 #include <limits>
 #include <random>
+#include <thread>
+#include <vector>
 
 extern "C" {
 
@@ -76,6 +78,60 @@ DEFINE_MINMAX(i32, int32_t)
 DEFINE_MINMAX(f32, float)
 DEFINE_MINMAX(f64, double)
 #undef DEFINE_MINMAX
+
+}  // extern "C" (templates below need C++ linkage)
+
+// ---------------------------------------------------------------------------
+// Threaded oracles — native threads put to the one real use they have
+// here: large-payload host verification. (The reference vendored a
+// pthreads wrapper, cutil multithreading, that the benchmark linked but
+// never invoked — SURVEY.md §2.3.)
+// ---------------------------------------------------------------------------
+
+template <typename T>
+static double kahan_chunk(const T* data, int64_t n) {
+  double sum = 0.0, c = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    double y = static_cast<double>(data[i]) - c;
+    double t = sum + y;
+    c = (t - sum) - y;
+    sum = t;
+  }
+  return sum;
+}
+
+template <typename T>
+static double kahan_sum_mt(const T* data, int64_t n, int nthreads) {
+  if (nthreads < 2 || n < nthreads * 4096) return kahan_chunk(data, n);
+  std::vector<double> partial(nthreads, 0.0);
+  std::vector<std::thread> threads;
+  const int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t len = std::min<int64_t>(chunk, n - lo);
+    if (len <= 0) break;
+    threads.emplace_back(
+        [&partial, data, lo, len, t] { partial[t] = kahan_chunk(data + lo, len); });
+  }
+  for (auto& th : threads) th.join();
+  // combine the per-thread partials with one more compensated pass
+  return kahan_chunk(partial.data(), static_cast<int64_t>(partial.size()));
+}
+
+extern "C" {
+
+double oracle_kahan_sum_f32_mt(const float* data, int64_t n, int nthreads) {
+  return kahan_sum_mt(data, n, nthreads);
+}
+
+double oracle_kahan_sum_f64_mt(const double* data, int64_t n, int nthreads) {
+  return kahan_sum_mt(data, n, nthreads);
+}
+
+int oracle_hw_threads(void) {
+  unsigned hc = std::thread::hardware_concurrency();
+  return hc == 0 ? 1 : static_cast<int>(hc);
+}
 
 // ---------------------------------------------------------------------------
 // MT19937 payload generation (externalfunctions.h analog, via std::mt19937).

@@ -1,0 +1,77 @@
+#!/usr/bin/env bash
+# Full experiment pipeline — the submit_all.sh + getAvgs.sh + makePlots.gp
+# chain as one entry point (see SURVEY.md §3.3 for the reference pipeline).
+#
+# Usage: scripts/run_experiment.sh [OUT_DIR] [--platform cpu]
+#
+# On a machine with a TPU attached this sweeps the real chip; pass
+# "--platform cpu" (with optional DEVICES=k env) to run the whole pipeline
+# on virtual host devices.
+set -euo pipefail
+
+OUT=${1:-out}
+shift || true
+PLATFORM_ARGS=("$@")
+DEVICES=${DEVICES:-8}
+
+python - "$OUT" "$DEVICES" "${PLATFORM_ARGS[@]}" <<'PY'
+import sys
+
+out_dir, devices = sys.argv[1], int(sys.argv[2])
+platform = None
+if "--platform" in sys.argv:
+    platform = sys.argv[sys.argv.index("--platform") + 1]
+
+import jax
+if platform:
+    jax.config.update("jax_platforms", platform)
+    if platform == "cpu":
+        jax.config.update("jax_num_cpu_devices", devices)
+
+from pathlib import Path
+
+from tpu_reductions.bench.aggregate import average, collect, pipeline
+from tpu_reductions.bench.plot import plot_vs_ranks
+from tpu_reductions.bench.report import generate_report
+from tpu_reductions.bench.sweep import sweep_all, sweep_collective
+from tpu_reductions.utils.logging import BenchLogger
+
+out = Path(out_dir)
+log = BenchLogger(None, None)
+n_avail = len(jax.devices())
+ranks = [k for k in (2, 4, 8, 16, 32) if k <= n_avail] or [1]
+
+# 1) single-chip grid (runTest analog) -> single-chip overlay numbers.
+# Lands in its own raw dir: single-chip rows use a per-kernel-iteration
+# timing convention incomparable with the collective rows, so they must
+# not leak into the vs-ranks averages.
+sc_rows = sweep_all(n=1 << 22, repeats=2, iterations=10,
+                    out_dir=str(out / "single_chip"), logger=log)
+sc = {}
+for r in sc_rows:
+    if r["status"] == "PASSED":
+        dt = {"int32": "INT", "float64": "DOUBLE"}.get(r["dtype"],
+                                                       r["dtype"].upper())
+        sc.setdefault((dt, r["method"]), []).append(r["gbps"])
+sc = {k: sum(v) / len(v) for k, v in sc.items()}
+
+# 2) collective rank sweep (submit_all.sh analog)
+sweep_collective(rank_counts=ranks, n=1 << 20, retries=3,
+                 out_dir=str(out), logger=log)
+
+# 3) aggregate (getAvgs.sh analog)
+pipeline(out / "raw_output", out)
+avgs = average(collect(out / "raw_output"))
+
+# 4) plots (makePlots.gp analog) with single-chip overlays
+figures = []
+for dt in sorted({k[0] for k in avgs}):
+    lines = {f"single-chip {op}": g for (d, op), g in sc.items() if d == dt}
+    figures += plot_vs_ranks(avgs, dt, out / dt.lower(),
+                             single_chip_lines=lines or None)
+
+# 5) report (writeup.tex analog)
+paths = generate_report(avgs, single_chip=sc, figures=figures,
+                        out_dir=out, platform=jax.default_backend())
+print("report:", paths["md"], paths["tex"])
+PY

@@ -146,6 +146,35 @@ def test_collective_driver_rooted_and_modes():
         assert all(r.passed for r in res), kw
 
 
+def test_bf16_collective_sum_passes():
+    # regression: bf16 SUM must verify at bf16 tolerance, not f64's 1e-12
+    from tpu_reductions.bench.collective_driver import run_collective_benchmark
+    cfg = CollectiveConfig(method="SUM", dtype="bfloat16", n=K * L,
+                           retries=1, num_devices=4)
+    res = run_collective_benchmark(cfg)
+    assert all(r.passed for r in res)
+
+
+def test_mesh_axis_names_honored():
+    # regression: caller-provided names for multi-axis meshes were dropped
+    m = build_mesh(mesh_shape=(2, 4), axis_names=("x", "y"))
+    assert dict(m.shape) == {"x": 2, "y": 4}
+    with pytest.raises(ValueError):
+        build_mesh(mesh_shape=(2, 4), axis_names=("x",))
+
+
+def test_collect_skips_failed_runs(tmp_path):
+    # regression: FAILED/WAIVED rows must not pollute published averages
+    from tpu_reductions.bench.aggregate import collect
+    (tmp_path / "a.json").write_text(
+        '{"dtype": "int32", "method": "SUM", "gbps": 100.0, '
+        '"status": "PASSED"}\n'
+        '{"dtype": "int32", "method": "SUM", "gbps": 999.0, '
+        '"status": "FAILED"}\n')
+    rows = collect(tmp_path)
+    assert rows == ["INT SUM 1 100.000"]
+
+
 def test_collective_cli_main():
     from tpu_reductions.bench.collective_driver import main
     code = main(["--method=SUM", "--type=int", f"--n={K * L}",

@@ -1,0 +1,64 @@
+"""Diagnostics tests: consistency checker, tracing, new flag wiring."""
+
+import numpy as np
+import pytest
+
+from tpu_reductions.bench.driver import run_benchmark
+from tpu_reductions.config import ReduceConfig
+from tpu_reductions.utils.debug import consistency_check, trace_benchmark
+from tpu_reductions.utils.qa import QAStatus
+
+
+@pytest.mark.parametrize("dtype", ["int32", "float32", "float64"])
+@pytest.mark.parametrize("method", ["SUM", "MIN", "MAX"])
+def test_consistency_check_ok(method, dtype):
+    rep = consistency_check(method, dtype, 10_000, threads=32, max_blocks=4)
+    assert rep.ok, rep.describe()
+    assert "[OK]" in rep.describe()
+
+
+def test_consistency_report_mismatch_detection():
+    rep = consistency_check("SUM", "int32", 1000)
+    rep.compiled = rep.oracle + 1  # simulate a lowering bug
+    assert not rep.ok and "[MISMATCH]" in rep.describe()
+
+
+def test_trace_benchmark_writes_trace(tmp_path):
+    import jax.numpy as jnp
+    result = trace_benchmark(lambda x: x * 2, jnp.ones(16),
+                             trace_dir=str(tmp_path), iterations=2)
+    assert float(np.asarray(result)[0]) == 2.0
+    assert any(tmp_path.rglob("*"))  # trace artifacts exist
+
+
+def test_driver_check_flag():
+    cfg = ReduceConfig(method="SUM", dtype="float32", n=4096, iterations=2,
+                       check=True, log_file=None)
+    res = run_benchmark(cfg)
+    assert res.passed
+
+
+def test_driver_trace_flag(tmp_path):
+    cfg = ReduceConfig(method="SUM", dtype="int32", n=4096, iterations=2,
+                       trace_dir=str(tmp_path / "tr"), log_file=None)
+    res = run_benchmark(cfg)
+    assert res.passed and any((tmp_path / "tr").rglob("*"))
+
+
+def test_device_flag_valid_and_waived():
+    res = run_benchmark(ReduceConfig(method="SUM", dtype="int32", n=4096,
+                                     iterations=2, device=1, log_file=None))
+    assert res.passed  # 8 virtual devices exist
+    res2 = run_benchmark(ReduceConfig(method="SUM", dtype="int32", n=4096,
+                                      iterations=2, device=99,
+                                      log_file=None))
+    assert res2.status == QAStatus.WAIVED
+
+
+def test_qatest_quiet_console(capsys):
+    cfg = ReduceConfig(method="SUM", dtype="int32", n=4096, iterations=2,
+                       qatest=True, log_file=None)
+    res = run_benchmark(cfg)
+    assert res.passed
+    out = capsys.readouterr().out
+    assert "Throughput" not in out  # narrative suppressed in batch mode

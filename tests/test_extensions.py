@@ -1,0 +1,66 @@
+"""Extensions beyond the reference set: bfloat16, report generation,
+threaded oracle parity."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_reductions.bench.report import generate_report
+from tpu_reductions.ops import oracle
+from tpu_reductions.ops.pallas_reduce import (choose_tiling, pallas_reduce,
+                                              sublanes_for)
+
+
+def test_sublane_table():
+    assert sublanes_for("float32") == 8
+    assert sublanes_for("int32") == 8
+    assert sublanes_for(jnp.bfloat16) == 16
+    assert sublanes_for("float64") == 8  # interpret-only path
+
+
+def test_choose_tiling_bf16_alignment():
+    tm, p, t = choose_tiling(1 << 18, threads=24, dtype=jnp.bfloat16)
+    assert tm % 16 == 0  # bf16 sublane tile is (16, 128)
+
+
+@pytest.mark.parametrize("method", ["SUM", "MIN", "MAX"])
+def test_pallas_bf16(method):
+    n = 50_000
+    rng = np.random.default_rng(5)
+    # small-magnitude payload so bf16 SUM stays meaningful
+    x = jnp.asarray(rng.integers(0, 16, n), dtype=jnp.bfloat16)
+    got = np.asarray(pallas_reduce(x, method, threads=32,
+                                   max_blocks=4)).astype(np.float64)
+    xf = np.asarray(x).astype(np.float64)
+    if method == "SUM":
+        # bf16 accumulates in bf16: generous tolerance (registry: 1e-2*n)
+        assert abs(float(got) - xf.sum()) <= 1e-2 * n
+    else:
+        expect = xf.min() if method == "MIN" else xf.max()
+        assert float(got) == expect
+
+
+def test_generate_report(tmp_path):
+    avgs = {("INT", "SUM", 2): 10.0, ("INT", "SUM", 4): 18.5}
+    sc = {("INT", "SUM"): 1500.0}
+    figs = [tmp_path / "int.eps"]
+    (tmp_path / "int.eps").write_text("%!PS")
+    paths = generate_report(avgs, single_chip=sc, figures=figs,
+                            out_dir=tmp_path, platform="tpu")
+    md = paths["md"].read_text()
+    assert "| INT | SUM | 90.8413 | 1500.0000 | 16.51x |" in md
+    assert "| INT | SUM | 2 | 10.000 |" in md
+    tex = paths["tex"].read_text()
+    assert "\\begin{document}" in tex and "int.eps" in tex
+
+
+def test_threaded_oracle_matches_single():
+    if not oracle.native_available():
+        pytest.skip("native oracle not built")
+    lib = oracle._load()
+    x = np.random.default_rng(1).uniform(0, 1, 1 << 20).astype(np.float32)
+    st = lib.oracle_kahan_sum_f32(x, x.size)
+    mt = lib.oracle_kahan_sum_f32_mt(x, x.size, 4)
+    assert st == pytest.approx(mt, abs=1e-9)
+    assert lib.oracle_hw_threads() >= 1

@@ -36,6 +36,10 @@ def collect(raw_dir: str | Path, out_file: str | Path | None = None
                 if not line.strip():
                     continue
                 d = json.loads(line)
+                if d.get("status", "PASSED") != "PASSED":
+                    # failed/waived runs carry no trustworthy throughput —
+                    # exclude them from the published averages
+                    continue
                 ranks = d.get("ranks", 1)
                 dt = _DTYPE_NAMES.get(d["dtype"], d["dtype"].upper())
                 gbps = d.get("reference_gbps", d.get("gbps"))

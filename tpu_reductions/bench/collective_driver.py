@@ -21,6 +21,7 @@ Differences by design (documented, not accidental):
 from __future__ import annotations
 
 import dataclasses
+import os
 import sys
 from typing import List, Optional
 
@@ -99,10 +100,17 @@ def run_collective_benchmark(cfg: CollectiveConfig,
     # planes for MIN/MAX (see parallel.collectives docstrings).
     dd_planes = dtype == "float64" and jax.default_backend() == "tpu"
     x_np = _build_payload(cfg, k)
+    rooted = cfg.rooted
     if dd_planes:
         from tpu_reductions.ops.dd_reduce import host_key_encode, host_split
         from tpu_reductions.parallel.collectives import (
             make_dd_sum_all_reduce, make_key_minmax_all_reduce)
+        if rooted:
+            # the pair collectives are all-reduce shaped; record what
+            # actually runs so bandwidth labels/factors stay truthful
+            logger.log("note: --rooted is not supported on the f64 "
+                       "pair paths; running all-reduce")
+            rooted = False
         if method == "SUM":
             hi, lo = host_split(x_np)
             pair_fn = make_dd_sum_all_reduce(mesh, axis)
@@ -115,7 +123,7 @@ def run_collective_benchmark(cfg: CollectiveConfig,
             return pair_fn(*x)
     else:
         x_dev = shard_payload(x_np, mesh, axis)
-        run = make_collective_reduce(method, mesh, axis, rooted=cfg.rooted)
+        run = make_collective_reduce(method, mesh, axis, rooted=rooted)
 
     # bytes actually staged: k * (n // k) elements — when n % k != 0 the
     # remainder is dropped, as the reference's N/commSize split also does;
@@ -148,10 +156,10 @@ def run_collective_benchmark(cfg: CollectiveConfig,
                       if _check(got, expect, method, dtype, cfg)
                       else QAStatus.FAILED)
 
-        bw = bandwidth_report(payload_bytes, k, dt, rooted=cfg.rooted)
+        bw = bandwidth_report(payload_bytes, k, dt, rooted=rooted)
         logger.log(collective_row(dtype, method, k, bw["reference_gbps"]))
         results.append(CollectiveResult(
-            method, dtype, cfg.n, k, rep, cfg.rooted, dt,
+            method, dtype, cfg.n, k, rep, rooted, dt,
             bw["reference_gbps"], bw["busbw_gbps"], status))
     return results
 
@@ -182,10 +190,17 @@ def _check(got: np.ndarray, expect: np.ndarray, method: str, dtype: str,
         # guard stays for multi-host where only local shards return.
         expect = expect.reshape(-1)[: got.size]
     if dtype == "int32" or method in ("MIN", "MAX"):
+        if dtype == "bfloat16":
+            # device min/max selects an exact element, but it was rounded
+            # to bf16 on the way in; compare at bf16 resolution
+            return bool(np.allclose(got.astype(np.float64),
+                                    expect.astype(np.float64), rtol=1e-2))
         return bool(np.array_equal(got, expect))
-    rtol = 1e-6 if dtype == "float32" else 1e-12
-    return bool(np.allclose(got, expect, rtol=rtol,
-                            atol=rtol * max(1.0, float(np.abs(expect).max()))))
+    rtol = {"float32": 1e-6, "float64": 1e-12, "bfloat16": 1e-2}[dtype]
+    return bool(np.allclose(got.astype(np.float64),
+                            expect.astype(np.float64), rtol=rtol,
+                            atol=rtol * max(1.0, float(np.abs(
+                                expect.astype(np.float64)).max()))))
 
 
 def run_collective_suite(cfg: CollectiveConfig,
@@ -208,7 +223,10 @@ def main(argv=None) -> int:
     name = "tpu_reductions.collective"
     qa_start(name, list(argv) if argv else sys.argv[1:])
     cfg = parse_collective(argv)
-    logger = BenchLogger(None, None)
+    # --qatest batch mode: QA markers only on the console
+    logger = BenchLogger(None, None,
+                         console=open(os.devnull, "w")
+                         if cfg.qatest else None)
     try:
         results = run_collective_benchmark(cfg, logger=logger)
     except Exception as e:  # fail-fast with the QA protocol intact

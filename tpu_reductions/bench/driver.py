@@ -18,6 +18,7 @@ reference's `+=` bug (reduction.cpp:426-429,516-521; SURVEY.md §2.2).
 from __future__ import annotations
 
 import dataclasses
+import os
 import sys
 from typing import Optional
 
@@ -109,12 +110,40 @@ def _make_device_fn(cfg: ReduceConfig, backend: str):
     return stage_fn, reduce_fn
 
 
+def _make_logger(cfg: ReduceConfig) -> BenchLogger:
+    """--qatest batch mode (shrQATest.h:90-97): machine-readable only —
+    QA markers and log files, no narrative console output."""
+    return BenchLogger(cfg.log_file, cfg.master_log,
+                       console=open(os.devnull, "w") if cfg.qatest else None)
+
+
 def run_benchmark(cfg: ReduceConfig, logger: Optional[BenchLogger] = None
                   ) -> BenchResult:
     """Run one self-verifying benchmark configuration."""
     import jax
 
-    logger = logger or BenchLogger(cfg.log_file, cfg.master_log)
+    if logger is None:
+        logger = _make_logger(cfg)
+
+    if cfg.device is not None:
+        # --device analog (reduction.cpp:36): pin all placement to the
+        # chosen device for the duration of the run.
+        devs = jax.devices()
+        if not 0 <= cfg.device < len(devs):
+            return BenchResult(cfg.method, cfg.dtype, cfg.n, cfg.backend,
+                               cfg.kernel, 0.0, 0.0, 0, QAStatus.WAIVED,
+                               float("nan"), float("nan"), float("nan"),
+                               waived_reason=f"device {cfg.device} not "
+                                             f"present ({len(devs)} found)")
+        with jax.default_device(devs[cfg.device]):
+            return _run_benchmark_inner(
+                dataclasses.replace(cfg, device=None), logger)
+    return _run_benchmark_inner(cfg, logger)
+
+
+def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger
+                         ) -> BenchResult:
+    import jax
 
     if cfg.kernel not in LIVE_KERNELS:
         # Mirrors the reference's intentionally-emptied kernels 0-5
@@ -147,8 +176,31 @@ def run_benchmark(cfg: ReduceConfig, logger: Optional[BenchLogger] = None
     if x_np is None:
         x_np = host_data(cfg.n, cfg.dtype, rank=0, seed=cfg.seed)
 
+    if cfg.check:
+        # compiled/interpret/XLA consistency gate (bank-checker analog,
+        # SURVEY.md §5): refuse to benchmark a kernel that disagrees with
+        # its own interpreter or the XLA baseline.
+        from tpu_reductions.utils.debug import consistency_check
+        report = consistency_check(cfg.method, cfg.dtype,
+                                   min(cfg.n, 1 << 20),
+                                   threads=cfg.threads,
+                                   max_blocks=cfg.max_blocks,
+                                   kernel=cfg.kernel, seed=cfg.seed)
+        logger.log(report.describe())
+        if not report.ok:
+            return BenchResult(cfg.method, cfg.dtype, cfg.n, backend,
+                               cfg.kernel, 0.0, 0.0, 0, QAStatus.FAILED,
+                               report.compiled, report.oracle,
+                               abs(report.compiled - report.oracle))
+
     stage_fn, reduce_fn = _make_device_fn(cfg, backend)
     x_dev = jax.block_until_ready(stage_fn(x_np))   # H2D + pad, untimed
+
+    if cfg.trace_dir:
+        # jax.profiler capture of the hot loop (SURVEY.md §5 tracing)
+        from tpu_reductions.utils.debug import trace_benchmark
+        trace_benchmark(reduce_fn, x_dev, trace_dir=cfg.trace_dir)
+        logger.log(f"profiler trace written to {cfg.trace_dir}")
 
     # Warm-up (reduction.cpp:729) + timed, synced iterations
     # (reduction.cpp:731, sync points :319,373) via the shared discipline.
@@ -189,7 +241,7 @@ def main(argv=None) -> int:
     name = "tpu_reductions"
     qa_start(name, list(argv) if argv else sys.argv[1:])
     cfg, shmoo = parse_single_chip(argv)
-    logger = BenchLogger(cfg.log_file, cfg.master_log)
+    logger = _make_logger(cfg)
 
     if shmoo:
         # Implemented, unlike the reference's stub (reduction.cpp:577-580).

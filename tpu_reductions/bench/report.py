@@ -1,0 +1,127 @@
+"""L5: report generation — the writeup.tex analog.
+
+The reference's terminal artifact is a LaTeX report embedding the two EPS
+bandwidth figures with a findings narrative (writeup.tex:1-31, figures at
+:21-28). Here the report is generated from the measured data: a Markdown
+report (always) and a compilable LaTeX source (same content), embedding
+the figures produced by bench.plot and the averaged tables from
+bench.aggregate, plus the reference-baseline comparison the writeup drew
+by hand.
+"""
+
+from __future__ import annotations
+
+import datetime
+from pathlib import Path
+from typing import Dict, Optional, Sequence
+
+from tpu_reductions.bench.aggregate import Key
+
+# Reference headline numbers (BASELINE.md; mpi/CUdata.txt:2-8) for the
+# comparison table the writeup's narrative was built around.
+REFERENCE_SINGLE_GPU = {
+    ("INT", "SUM"): 90.8413, ("INT", "MIN"): 90.7905, ("INT", "MAX"): 90.7969,
+    ("DOUBLE", "SUM"): 92.7729, ("DOUBLE", "MIN"): 92.6014,
+    ("DOUBLE", "MAX"): 92.7552,
+}
+
+
+def _table(rows: Sequence[Sequence[str]], header: Sequence[str]) -> str:
+    out = ["| " + " | ".join(header) + " |",
+           "|" + "|".join("---" for _ in header) + "|"]
+    out += ["| " + " | ".join(str(c) for c in r) + " |" for r in rows]
+    return "\n".join(out)
+
+
+def generate_report(avgs: Dict[Key, float],
+                    single_chip: Optional[Dict[tuple, float]] = None,
+                    figures: Sequence[str | Path] = (),
+                    out_dir: str | Path = ".",
+                    platform: str = "tpu") -> Dict[str, Path]:
+    """Render report.md + report.tex from averaged collective results
+    (aggregate.average output) and optional single-chip numbers
+    {(DATATYPE, OP): GB/s}. Returns {"md": path, "tex": path}."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    date = datetime.date.today().isoformat()
+
+    # ---- tables ----------------------------------------------------------
+    coll_rows = [(dt, op, ranks, f"{gbps:.3f}")
+                 for (dt, op, ranks), gbps in sorted(avgs.items())]
+    coll_tbl = _table(coll_rows, ["dtype", "op", "ranks", "GB/s"])
+
+    sc_rows = []
+    for (dt, op), ref in sorted(REFERENCE_SINGLE_GPU.items()):
+        ours = (single_chip or {}).get((dt, op))
+        ratio = f"{ours / ref:.2f}x" if ours else "—"
+        sc_rows.append((dt, op, f"{ref:.4f}",
+                        f"{ours:.4f}" if ours else "—", ratio))
+    sc_tbl = _table(sc_rows, ["dtype", "op", "reference GPU GB/s",
+                              f"this framework ({platform}) GB/s", "ratio"])
+
+    fig_md = "\n\n".join(f"![{Path(f).stem}]({Path(f).name})"
+                         for f in figures)
+
+    md = f"""# TPU Reduction Benchmarks — generated report
+
+*Generated {date} by tpu_reductions.bench.report (the writeup.tex analog).*
+
+## Single-chip reductions vs the reference GPU
+
+The reference measured a single CC≥1.3 GPU at n=2^24 elements
+(mpi/CUdata.txt); this framework measures one TPU chip with the Pallas
+kernel path at the same n.
+
+{sc_tbl}
+
+## Collective reductions vs rank count
+
+Averaged over repeats (reference convention: total payload bytes /
+wall time — reduce.c:79 analog with real clocks).
+
+{coll_tbl}
+
+{fig_md}
+
+## Notes
+
+- Verification: every single-chip number is oracle-checked (Kahan host
+  reference); collective numbers are checked against an elementwise host
+  oracle. Failed runs report 0 and are excluded.
+- float64 on TPU uses the double-double / order-key 32-bit-pair paths;
+  wire bytes per element are identical to native f64.
+"""
+    md_path = out / "report.md"
+    md_path.write_text(md)
+
+    tex = _to_tex(sc_rows, coll_rows, figures, date)
+    tex_path = out / "report.tex"
+    tex_path.write_text(tex)
+    return {"md": md_path, "tex": tex_path}
+
+
+def _to_tex(sc_rows, coll_rows, figures, date) -> str:
+    def tabular(rows, cols, header):
+        lines = ["\\begin{tabular}{" + "l" * cols + "}",
+                 " & ".join(header) + " \\\\ \\hline"]
+        lines += [" & ".join(str(c) for c in r) + " \\\\" for r in rows]
+        lines.append("\\end{tabular}")
+        return "\n".join(lines)
+
+    figs = "\n".join(
+        "\\includegraphics[width=0.85\\textwidth]{%s}" % Path(f).name
+        for f in figures if str(f).endswith(".eps"))
+    return f"""\\documentclass{{article}}
+\\usepackage{{graphicx}}
+\\title{{TPU Reduction Benchmarks}}
+\\date{{{date}}}
+\\begin{{document}}
+\\maketitle
+\\section{{Single-chip reductions}}
+{tabular(sc_rows, 5, ["dtype", "op", "ref GPU", "TPU", "ratio"])}
+\\section{{Collective reductions}}
+{tabular(coll_rows, 4, ["dtype", "op", "ranks", "GB/s"])}
+\\section{{Figures}}
+{figs}
+\\end{{document}}
+"""

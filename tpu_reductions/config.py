@@ -81,6 +81,8 @@ class ReduceConfig:
     master_log: Optional[str] = None # MASTERLOGFILE analog (shrUtils.cpp)
     qatest: bool = False             # --qatest batch mode (shrQATest.h:90-97)
     verify: bool = True
+    trace_dir: Optional[str] = None  # jax.profiler trace capture dir
+    check: bool = False              # compiled/interpret/XLA consistency
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
@@ -134,6 +136,7 @@ class CollectiveConfig:
     backend: str = "xla"
     seed: int = 0
     verify: bool = True
+    qatest: bool = False             # batch mode: QA markers only
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
@@ -194,6 +197,12 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
     p.add_argument("--logfile", dest="log_file", type=str,
                    default="reduction.txt")
     p.add_argument("--masterlog", dest="master_log", type=str, default=None)
+    p.add_argument("--trace", dest="trace_dir", type=str, default=None,
+                   help="Capture a jax.profiler trace of the hot loop into "
+                        "this directory (cutil-timer observability analog)")
+    p.add_argument("--check", action="store_true",
+                   help="Run the compiled/interpret/XLA consistency check "
+                        "before benchmarking (bank-checker analog)")
     return p
 
 
@@ -219,7 +228,8 @@ def parse_single_chip(argv=None):
         cpu_thresh=ns.cpu_thresh, backend=ns.backend,
         iterations=ns.iterations, warmup=ns.warmup, seed=ns.seed,
         device=ns.device, log_file=ns.log_file, master_log=ns.master_log,
-        qatest=ns.qatest, verify=ns.verify,
+        qatest=ns.qatest, verify=ns.verify, trace_dir=ns.trace_dir,
+        check=ns.check,
     )
     _apply_platform(ns)
     return cfg, ns.shmoo
@@ -273,4 +283,5 @@ def parse_collective(argv=None) -> CollectiveConfig:
         method=ns.method, dtype=ns.dtype, n=ns.n, retries=ns.retries,
         warmup=ns.warmup, num_devices=ns.num_devices, mapping=ns.mapping,
         mode=ns.mode, rooted=ns.rooted, seed=ns.seed, verify=ns.verify,
+        qatest=ns.qatest,
     )

@@ -63,6 +63,11 @@ def _load() -> Optional[ctypes.CDLL]:
     sigs = {
         "oracle_kahan_sum_f32": (ctypes.c_double, [f32p, i64]),
         "oracle_kahan_sum_f64": (ctypes.c_double, [f64p, i64]),
+        "oracle_kahan_sum_f32_mt": (ctypes.c_double,
+                                    [f32p, i64, ctypes.c_int]),
+        "oracle_kahan_sum_f64_mt": (ctypes.c_double,
+                                    [f64p, i64, ctypes.c_int]),
+        "oracle_hw_threads": (ctypes.c_int, []),
         "oracle_sum_i32": (ctypes.c_int32, [i32p, i64]),
         "oracle_min_i32": (ctypes.c_int32, [i32p, i64]),
         "oracle_max_i32": (ctypes.c_int32, [i32p, i64]),
@@ -112,12 +117,21 @@ def host_reduce(x: np.ndarray, method: str) -> np.ndarray:
             # int64 exact sum, then wrap to int32 — same result as a
             # wrapping int32 accumulator.
             return np.int64(x.sum(dtype=np.int64)).astype(np.int32)
+        # threaded Kahan for large payloads (cutil-multithreading analog,
+        # actually used): identical result class, ~cores x faster
+        mt_threshold = 1 << 22
         if dtype == "float32":
             if lib is not None:
+                if x.size >= mt_threshold:
+                    return np.float64(lib.oracle_kahan_sum_f32_mt(
+                        x, x.size, min(8, lib.oracle_hw_threads())))
                 return np.float64(lib.oracle_kahan_sum_f32(x, x.size))
             return np.float64(x.sum(dtype=np.float64))
         if dtype == "float64":
             if lib is not None:
+                if x.size >= mt_threshold:
+                    return np.float64(lib.oracle_kahan_sum_f64_mt(
+                        x, x.size, min(8, lib.oracle_hw_threads())))
                 return np.float64(lib.oracle_kahan_sum_f64(x, x.size))
             return np.float64(math.fsum(x.tolist()) if x.size < (1 << 22)
                               else x.sum(dtype=np.float64))

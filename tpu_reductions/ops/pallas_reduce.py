@@ -55,7 +55,14 @@ from jax.experimental.pallas import tpu as pltpu
 from tpu_reductions.ops.registry import ReduceOpSpec, get_op
 
 LANES = 128      # TPU vector lane count (last-dim tile), pallas_guide.md
-SUBLANES = 8     # float32/int32 sublane tile
+SUBLANES = 8     # 32-bit sublane tile (f32/i32)
+
+
+def sublanes_for(dtype) -> int:
+    """Minimum sublane count by element width (pallas_guide.md tiling
+    table): 8 for 32-bit, 16 for bf16/f16, 32 for 8-bit. 64-bit types only
+    exist on the interpret path (CPU hosts), where 8 is fine."""
+    return {8: 8, 4: 8, 2: 16, 1: 32}[np.dtype(dtype).itemsize]
 
 
 def _interpret_default() -> bool:
@@ -64,18 +71,20 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def choose_tiling(n: int, threads: int = 256, max_blocks: int = 64
-                  ) -> tuple[int, int, int]:
+def choose_tiling(n: int, threads: int = 256, max_blocks: int = 64,
+                  dtype="float32") -> tuple[int, int, int]:
     """Pick (TM tile rows, P partial blocks, T tiles per block) for `n`
     elements — the getNumBlocksAndThreads analog (reduction.cpp:272-291):
     threads -> tile rows per grid step, maxBlocks -> grid clamp with
     per-block striding over multiple tiles.
 
-    Returns (tm, p, t) with p * t * tm * LANES >= n.
+    Returns (tm, p, t) with p * t * tm * LANES >= n; tm is aligned to the
+    dtype's minimum sublane tile.
     """
+    sub = sublanes_for(dtype) if np.dtype(dtype).itemsize < 4 else SUBLANES
     rows = pl.cdiv(n, LANES)
-    tm = max(SUBLANES, min(int(threads), 2048))
-    tm -= tm % SUBLANES
+    tm = max(sub, min(int(threads), 2048))
+    tm -= tm % sub
     num_tiles = pl.cdiv(rows, tm)
     p = max(1, min(int(max_blocks), num_tiles))
     t = pl.cdiv(num_tiles, p)
@@ -104,14 +113,26 @@ def stage_padded(x: np.ndarray | jax.Array, tm: int, p: int, t: int,
 # ---------------------------------------------------------------------------
 
 
-def _tile_to_sublane(tile: jax.Array, op: ReduceOpSpec, tm: int) -> jax.Array:
-    """(TM, 128) -> (8, 128): the shared-memory tree analog, done as a
-    sublane-group reduction on the VPU."""
-    if tm == SUBLANES:
-        return tile
-    t3 = tile.reshape(tm // SUBLANES, SUBLANES, LANES)
+def _acc_dtype(in_dtype, op: ReduceOpSpec):
+    """Accumulator dtype inside the kernel: f32 for bf16 SUM (bf16 stays
+    in HBM at 2 B/element — the bandwidth win — but accumulates at f32 in
+    VMEM, the TPU-native convention); input dtype otherwise."""
     if op.name == "SUM":
-        return jnp.sum(t3, axis=0, dtype=tile.dtype)
+        from tpu_reductions.ops.registry import accum_dtype
+        return accum_dtype(in_dtype)
+    return jnp.dtype(in_dtype)
+
+
+def _tile_to_sublane(tile: jax.Array, op: ReduceOpSpec, tm: int) -> jax.Array:
+    """(TM, 128) -> (sublane_tile, 128): the shared-memory tree analog,
+    done as a sublane-group reduction on the VPU."""
+    sub = sublanes_for(tile.dtype)
+    acc = _acc_dtype(tile.dtype, op)
+    if tm == sub:
+        return tile.astype(acc)
+    t3 = tile.reshape(tm // sub, sub, LANES)
+    if op.name == "SUM":
+        return jnp.sum(t3, axis=0, dtype=acc)
     if op.name == "MIN":
         return jnp.min(t3, axis=0)
     return jnp.max(t3, axis=0)
@@ -177,11 +198,13 @@ def single_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
     interpret = _interpret_default() if interpret is None else interpret
     return pl.pallas_call(
         _single_pass_kernel(op, tm),
-        out_shape=jax.ShapeDtypeStruct((SUBLANES, LANES), x2d.dtype),
+        out_shape=jax.ShapeDtypeStruct((sublanes_for(x2d.dtype), LANES),
+                                       _acc_dtype(x2d.dtype, op)),
         grid=grid,
         in_specs=[pl.BlockSpec((tm, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((SUBLANES, LANES), lambda i: (0, 0),
+        out_specs=pl.BlockSpec((sublanes_for(x2d.dtype), LANES),
+                               lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )(x2d)
@@ -194,7 +217,8 @@ def two_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int, p: int, t: int,
     interpret = _interpret_default() if interpret is None else interpret
     return pl.pallas_call(
         _two_pass_kernel(op, tm),
-        out_shape=jax.ShapeDtypeStruct((p, LANES), x2d.dtype),
+        out_shape=jax.ShapeDtypeStruct((p, LANES),
+                                       _acc_dtype(x2d.dtype, op)),
         grid=(p, t),
         in_specs=[pl.BlockSpec((tm, LANES), lambda i, j: (i * t + j, 0),
                                memory_space=pltpu.VMEM)],
@@ -255,7 +279,7 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
                                     max_blocks=max_blocks)
     x = jnp.ravel(x)
 
-    tm, p, t = choose_tiling(x.size, threads, max_blocks)
+    tm, p, t = choose_tiling(x.size, threads, max_blocks, x.dtype)
     x2d = stage_padded(x, tm, p, t, op)
 
     if kernel == 6:
@@ -271,7 +295,8 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
         # (reduction.cpp:343-357). Sizes are static, so this Python loop
         # unrolls at trace time into a fixed pass chain.
         while partials.shape[0] > max(cpu_thresh, 1) and partials.shape[0] > SUBLANES:
-            tm2, p2, t2 = choose_tiling(partials.size, threads, max_blocks)
+            tm2, p2, t2 = choose_tiling(partials.size, threads,
+                                        max_blocks, partials.dtype)
             x2 = stage_padded(partials, tm2, p2, t2, op)
             partials = two_pass_call(x2, op, tm2, p2, t2, interpret=interpret)
         if cpu_final:
@@ -296,7 +321,7 @@ def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
     remaining partials and finishes them on host inside the timed region
     (as --cpufinal does)."""
     op = get_op(method)
-    tm, p, t = choose_tiling(n, threads, max_blocks)
+    tm, p, t = choose_tiling(n, threads, max_blocks, dtype)
 
     def stage_fn(x):
         return stage_padded(x, tm, p, t, op)
@@ -312,7 +337,7 @@ def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
             while (partials.shape[0] > max(cpu_thresh, 1)
                    and partials.shape[0] > SUBLANES):
                 tm2, p2, t2 = choose_tiling(partials.size, threads,
-                                            max_blocks)
+                                            max_blocks, partials.dtype)
                 x2 = stage_padded(partials, tm2, p2, t2, op)
                 partials = two_pass_call(x2, op, tm2, p2, t2,
                                          interpret=interpret)

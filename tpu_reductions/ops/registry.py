@@ -41,8 +41,20 @@ def _jnp_sum_same_dtype(x, **kw):
     """SUM that accumulates in the input dtype (no int32->int64 / implicit
     promotion under x64). Matching the device accumulator's width is what
     makes int verification exact-match (reduction.cpp:748,776-777): both
-    sides wrap mod 2^32."""
-    return jnp.sum(x, dtype=x.dtype, **kw)
+    sides wrap mod 2^32. Exception: sub-32-bit floats accumulate in f32 —
+    the TPU-native convention (bf16 data stream, f32 accumulator);
+    accumulating in bf16 would swamp beyond ~1e3 elements."""
+    acc = accum_dtype(x.dtype)
+    return jnp.sum(x, dtype=acc, **kw)
+
+
+def accum_dtype(dtype):
+    """Accumulator dtype for SUM: f32 for sub-32-bit floats, else the
+    input dtype."""
+    dt = jnp.dtype(dtype)
+    if jnp.issubdtype(dt, jnp.floating) and dt.itemsize < 4:
+        return jnp.float32
+    return dt
 
 
 def _min_identity(dt: np.dtype):

@@ -87,9 +87,14 @@ def build_mesh(num_devices: Optional[int] = None,
         mesh_shape = tuple(mesh_shape)
         if math.prod(mesh_shape) != len(devs):
             raise ValueError(f"mesh_shape {mesh_shape} != {len(devs)} devices")
-        axis_names = tuple(axis_names
-                           or (DEFAULT_AXIS,) if len(mesh_shape) == 1
-                           else tuple(f"ax{i}" for i in range(len(mesh_shape))))
+        if axis_names is None:
+            axis_names = ((DEFAULT_AXIS,) if len(mesh_shape) == 1
+                          else tuple(f"ax{i}"
+                                     for i in range(len(mesh_shape))))
+        axis_names = tuple(axis_names)
+        if len(axis_names) != len(mesh_shape):
+            raise ValueError(f"{len(axis_names)} axis names for "
+                             f"{len(mesh_shape)}-d mesh")
     dev_array = np.array(devs).reshape(mesh_shape)
     return Mesh(dev_array, axis_names)
 

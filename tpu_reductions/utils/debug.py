@@ -1,0 +1,105 @@
+"""Diagnostics — the TPU analog of the reference's dormant correctness
+tooling (SURVEY.md §5 "race detection / sanitizers").
+
+The reference linked (but never invoked) an emulation-mode shared-memory
+bank-conflict checker (cuda/C/common/src/bank_checker.cpp), and its real
+race safety was by-construction (volatile smem warp tail + __syncthreads).
+On TPU that hazard class does not exist — Pallas grids are sequential per
+core and the VPU is lockstep — so the meaningful compiled-vs-model checks
+are numerical:
+
+- `consistency_check`: run the same payload through (a) the compiled
+  Pallas kernel, (b) the Pallas *interpreter* (the emulation-mode analog),
+  and (c) the XLA baseline, and compare all three against the host oracle.
+  Any spread between (a) and (b) indicates a lowering/tiling bug — the
+  class of bug the bank checker hunted.
+- `trace_benchmark`: capture a jax.profiler trace of the hot loop — the
+  observability the cutil timer stack approximated with stopwatches
+  (SURVEY.md §5 "tracing/profiling").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from tpu_reductions.ops import oracle as oracle_mod
+from tpu_reductions.ops.registry import tolerance
+
+
+@dataclasses.dataclass
+class ConsistencyReport:
+    method: str
+    dtype: str
+    n: int
+    compiled: float
+    interpreted: float
+    xla: float
+    oracle: float
+    tol: float
+
+    @property
+    def ok(self) -> bool:
+        vals = (self.compiled, self.interpreted, self.xla)
+        return all(abs(v - self.oracle) <= max(self.tol, 0.0) or
+                   (self.tol == 0.0 and v == self.oracle) for v in vals)
+
+    def describe(self) -> str:
+        s = "OK" if self.ok else "MISMATCH"
+        return (f"[{s}] {self.method}/{self.dtype} n={self.n}: "
+                f"compiled={self.compiled!r} interpreted={self.interpreted!r} "
+                f"xla={self.xla!r} oracle={self.oracle!r} tol={self.tol:g}")
+
+
+def consistency_check(method: str, dtype: str, n: int, *,
+                      threads: int = 256, max_blocks: int = 64,
+                      kernel: int = 6, seed: int = 0) -> ConsistencyReport:
+    """Compiled vs interpreted vs XLA vs host oracle, one payload."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_reductions.ops.pallas_reduce import pallas_reduce
+    from tpu_reductions.ops.xla_reduce import xla_reduce
+    from tpu_reductions.utils.rng import host_data
+
+    x_np = host_data(n, dtype, rank=0, seed=seed)
+    on_tpu = jax.default_backend() == "tpu"
+
+    if dtype == "float64":
+        # dd path handles both modes internally (no device f64 on TPU)
+        from tpu_reductions.ops.dd_reduce import dd_pallas_reduce_f64
+        compiled = float(dd_pallas_reduce_f64(x_np, method, threads=threads,
+                                              interpret=False if on_tpu
+                                              else None))
+        interp = float(dd_pallas_reduce_f64(x_np, method, threads=threads,
+                                            interpret=True))
+        xla = (float(xla_reduce(jnp.asarray(x_np), method))
+               if not on_tpu else compiled)   # no f64 XLA on TPU
+    else:
+        x = jnp.asarray(x_np)
+        compiled = float(pallas_reduce(x, method, threads=threads,
+                                       max_blocks=max_blocks, kernel=kernel,
+                                       interpret=False if on_tpu else None))
+        interp = float(pallas_reduce(x, method, threads=threads,
+                                     max_blocks=max_blocks, kernel=kernel,
+                                     interpret=True))
+        xla = float(xla_reduce(x, method))
+
+    orc = float(np.asarray(oracle_mod.host_reduce(x_np, method),
+                           dtype=np.float64))
+    return ConsistencyReport(method, dtype, n, compiled, interp, xla, orc,
+                             tolerance(method, dtype, n))
+
+
+def trace_benchmark(fn, *args, trace_dir: str, iterations: int = 3):
+    """Capture a jax.profiler trace of `iterations` executions of fn —
+    inspect with TensorBoard or xprof. Returns the last result."""
+    import jax
+
+    result = jax.block_until_ready(fn(*args))  # compile outside the trace
+    with jax.profiler.trace(trace_dir):
+        for _ in range(iterations):
+            result = jax.block_until_ready(fn(*args))
+    return result
