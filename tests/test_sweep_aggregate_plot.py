@@ -51,6 +51,15 @@ def test_collective_sweep_and_full_pipeline(tmp_path):
     assert (dt, op, ranks) == ("INT", "SUM", "2") and float(gbps) > 0
 
 
+def test_shmoo_collective_sizes():
+    from tpu_reductions.bench.sweep import shmoo_collective
+    rows = shmoo_collective(method="SUM", dtype="int32", num_devices=4,
+                            min_pow=10, max_pow=12, retries=1,
+                            logger=BenchLogger(None, None))
+    assert [r["n"] for r in rows] == [1 << 10, 1 << 11, 1 << 12]
+    assert all(r["status"] == "PASSED" and r["gbps"] > 0 for r in rows)
+
+
 def test_average_row_math():
     rows = ["INT SUM 64 10.0", "INT SUM 64 20.0", "INT SUM 256 40.0",
             "DOUBLE MAX 64 5.0"]

@@ -52,6 +52,37 @@ def test_time_fn_counts_iterations():
     assert float(result[0]) == 1.0
 
 
+def test_time_fn_modes_agree_on_result():
+    import jax.numpy as jnp
+    import pytest
+    for mode in ("periter", "bulk", "fetch"):
+        result, sw = time_fn(lambda x: x * 3, jnp.ones(8), iterations=4,
+                             warmup=1, mode=mode)
+        assert float(result[0]) == 3.0, mode
+        assert sw.sessions == 4 and sw.average_s > 0, mode
+    with pytest.raises(ValueError):
+        time_fn(lambda x: x, jnp.ones(8), mode="batch")
+
+
+def test_time_fn_bulk_preserves_accumulated_sessions():
+    # regression: bulk mode must not wipe a caller-provided stopwatch
+    import jax.numpy as jnp
+    sw = Stopwatch()
+    time_fn(lambda x: x + 1, jnp.ones(8), iterations=3, warmup=1,
+            stopwatch=sw)
+    assert sw.sessions == 3
+    time_fn(lambda x: x + 1, jnp.ones(8), iterations=5, warmup=0,
+            stopwatch=sw, mode="bulk")
+    assert sw.sessions == 8 and sw.total_s > 0
+
+
+def test_reduce_config_validates_timing():
+    import pytest
+    from tpu_reductions.config import ReduceConfig
+    with pytest.raises(ValueError):
+        ReduceConfig(method="SUM", timing="Bulk")
+
+
 def test_throughput_line_format():
     # reduction.cpp:744-745 format
     line = throughput_line(90.8413, 0.00074, 1 << 24, workgroup=256)

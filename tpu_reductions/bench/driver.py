@@ -205,7 +205,7 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger
     # Warm-up (reduction.cpp:729) + timed, synced iterations
     # (reduction.cpp:731, sync points :319,373) via the shared discipline.
     result, sw = time_fn(reduce_fn, x_dev, iterations=cfg.iterations,
-                         warmup=max(cfg.warmup, 1))
+                         warmup=max(cfg.warmup, 1), mode=cfg.timing)
     avg_s = sw.average_s
     gbps = (cfg.nbytes / avg_s) / 1e9 if avg_s > 0 else float("inf")
 

@@ -84,6 +84,30 @@ def sweep_collective(*, rank_counts=(2, 4, 8), methods=("MAX", "MIN", "SUM"),
     return rows
 
 
+def shmoo_collective(*, method: str = "SUM", dtype: str = "float64",
+                     num_devices: Optional[int] = None,
+                     min_pow: int = 10, max_pow: int = 24,
+                     retries: int = 3,
+                     logger: Optional[BenchLogger] = None) -> List[dict]:
+    """Payload-size sweep of the collective at a fixed rank count — the
+    bandwidth-vs-N axis of BASELINE config #5 ("full bandwidth sweep
+    N=2^10..2^30"), which the reference never had for its MPI side (its
+    payload was the fixed 2 GiB of constants.h:1-2)."""
+    from tpu_reductions.bench.collective_driver import run_collective_benchmark
+    from tpu_reductions.config import CollectiveConfig
+
+    logger = logger or BenchLogger(None, None)
+    rows = []
+    for p in range(min_pow, max_pow + 1):
+        cfg = CollectiveConfig(method=method, dtype=dtype, n=1 << p,
+                               retries=retries, num_devices=num_devices)
+        for res in run_collective_benchmark(cfg, logger=logger):
+            row = res.to_dict()
+            row["gbps"] = row["reference_gbps"]  # plot_vs_n key
+            rows.append(row)
+    return rows
+
+
 def sweep_all(*, methods=("SUM", "MIN", "MAX"),
               dtypes=("int32", "float64"), n: int = 1 << 24,
               repeats: int = 5, iterations: int = 20,

@@ -83,6 +83,7 @@ class ReduceConfig:
     verify: bool = True
     trace_dir: Optional[str] = None  # jax.profiler trace capture dir
     check: bool = False              # compiled/interpret/XLA consistency
+    timing: str = "periter"          # periter|bulk|fetch (timing.time_fn)
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
@@ -97,6 +98,9 @@ class ReduceConfig:
             raise ValueError("n must be positive")
         if self.threads <= 0 or self.max_blocks <= 0:
             raise ValueError("threads/max_blocks must be positive")
+        if self.timing not in ("periter", "bulk", "fetch"):
+            raise ValueError(f"timing must be periter|bulk|fetch, "
+                             f"got {self.timing!r}")
 
     @property
     def nbytes(self) -> int:
@@ -203,6 +207,11 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
     p.add_argument("--check", action="store_true",
                    help="Run the compiled/interpret/XLA consistency check "
                         "before benchmarking (bank-checker analog)")
+    p.add_argument("--timing", type=str, default="periter",
+                   choices=("periter", "bulk", "fetch"),
+                   help="Sync discipline: periter=reference structure; "
+                        "bulk=one span, amortized dispatch; fetch=host "
+                        "round-trip each iteration")
     return p
 
 
@@ -229,7 +238,7 @@ def parse_single_chip(argv=None):
         iterations=ns.iterations, warmup=ns.warmup, seed=ns.seed,
         device=ns.device, log_file=ns.log_file, master_log=ns.master_log,
         qatest=ns.qatest, verify=ns.verify, trace_dir=ns.trace_dir,
-        check=ns.check,
+        check=ns.check, timing=ns.timing,
     )
     _apply_platform(ns)
     return cfg, ns.shmoo

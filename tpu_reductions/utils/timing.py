@@ -80,21 +80,49 @@ class TimerRegistry:
 
 
 def time_fn(fn: Callable, *args, iterations: int = 100, warmup: int = 1,
-            stopwatch: Optional[Stopwatch] = None):
+            stopwatch: Optional[Stopwatch] = None, mode: str = "periter"):
     """Benchmark `fn(*args)` the way the reference's hot loop does
-    (reduction.cpp:297-384): sync, start timer, run, sync, stop timer —
-    per iteration — after `warmup` untimed launches (reduction.cpp:729).
+    (reduction.cpp:297-384): after `warmup` untimed launches
+    (reduction.cpp:729), timed iterations with device sync at the timer
+    edges (cutilDeviceSynchronize analog, reduction.cpp:319,373).
 
-    Returns (last_result, stopwatch).
+    mode selects the sync discipline (all report mean seconds/iteration):
+      periter  sync inside the loop around every launch — the reference's
+               exact structure; includes one dispatch+sync round-trip per
+               iteration.
+      bulk     one timed span around all iterations with a single final
+               sync — amortizes dispatch/sync overhead; the right mode
+               when per-launch round-trip latency (e.g. a remote tunnel)
+               would otherwise dominate or distort the measurement.
+      fetch    per-iteration, and additionally materializes the scalar on
+               the host each time (full D2H round trip) — the most
+               conservative bound.
+
+    Returns (last_result, stopwatch) with stopwatch.average_s = mean
+    per-iteration time.
     """
+    if mode not in ("periter", "bulk", "fetch"):
+        raise ValueError(f"unknown timing mode {mode!r}")
     sw = stopwatch or Stopwatch()
     result = None
     for _ in range(warmup):
         result = jax.block_until_ready(fn(*args))
+
+    if mode == "bulk":
+        sw.start()
+        for _ in range(iterations):
+            result = fn(*args)
+        jax.block_until_ready(result)
+        sw.stop()  # booked the whole span as one session...
+        # ...rebook it as `iterations` sessions so average_s is
+        # per-iteration, preserving anything accumulated before this call
+        sw.sessions += iterations - 1
+        return result, sw
+
     for _ in range(iterations):
-        # sync before starting the timer (cutilDeviceSynchronize analog,
-        # reduction.cpp:319) — everything previously dispatched has drained.
         sw.start()
         result = jax.block_until_ready(fn(*args))
+        if mode == "fetch":
+            jax.device_get(result)  # full host materialization round-trip
         sw.stop()
     return result, sw
