@@ -20,13 +20,19 @@ def test_run_shmoo_sizes():
     assert all(r.passed for r in results)
 
 
-def test_sweep_all_writes_raw(tmp_path):
+def test_sweep_all_writes_raw_and_resumes(tmp_path):
     rows = sweep_all(methods=("SUM",), dtypes=("int32",), n=4096,
                      repeats=2, iterations=2, out_dir=str(tmp_path),
                      logger=BenchLogger(None, None))
     assert len(rows) == 2
     raws = list((tmp_path / "raw_output").glob("*.json"))
     assert len(raws) == 2
+    # resume: second invocation reloads instead of re-running
+    first_gbps = [r["gbps"] for r in rows]
+    rows2 = sweep_all(methods=("SUM",), dtypes=("int32",), n=4096,
+                      repeats=2, iterations=2, out_dir=str(tmp_path),
+                      logger=BenchLogger(None, None))
+    assert [r["gbps"] for r in rows2] == first_gbps  # identical = reloaded
 
 
 def test_collective_sweep_and_full_pipeline(tmp_path):

@@ -113,11 +113,18 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
               repeats: int = 5, iterations: int = 20,
               backend: str = "auto",
               out_dir: Optional[str] = None,
+              resume: bool = True,
               logger: Optional[BenchLogger] = None) -> List[dict]:
     """The full experiment grid: {dtypes} x {methods}, `repeats` repeated
     runs each (RETRY_COUNT analog, mpi/constants.h:5) — the in-process
     equivalent of submit_all.sh's job fan-out. Writes one JSON-lines raw
-    file per run into out_dir/raw_output (the stdout-<jobid> analog)."""
+    file per run into out_dir/raw_output (the stdout-<jobid> analog).
+
+    resume=True skips grid cells whose raw file already exists and reloads
+    their rows — making an interrupted sweep restartable. This is the
+    honest extent of checkpoint/resume in this framework (and one step
+    beyond the reference, where only the offline *analysis* was resumable
+    via its accumulated files — SURVEY.md §5 "checkpoint/resume")."""
     logger = logger or BenchLogger(None, None)
     raw_dir = Path(out_dir) / "raw_output" if out_dir else None
     if raw_dir:
@@ -126,6 +133,21 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
     for dtype in dtypes:
         for method in methods:
             for rep in range(repeats):
+                fname = (raw_dir / f"run-{dtype}-{method}-{rep}.json"
+                         if raw_dir else None)
+                if resume and fname and fname.exists():
+                    row = json.loads(fname.read_text())
+                    # only reuse a cached cell that (a) succeeded and
+                    # (b) was measured under the SAME sweep parameters —
+                    # stale-config or failed cells are re-run
+                    if (row.get("status") == "PASSED"
+                            and row.get("n") == n
+                            and row.get("iterations") == iterations):
+                        rows.append(row)
+                        logger.log(f"sweep {dtype} {method} rep={rep} "
+                                   f"-> resumed ({row['gbps']:.4f} GB/s "
+                                   f"[{row['status']}])")
+                        continue
                 cfg = ReduceConfig(method=method, dtype=dtype, n=n,
                                    iterations=iterations, backend=backend,
                                    seed=rep, log_file=None)
@@ -135,7 +157,7 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                 rows.append(row)
                 logger.log(f"sweep {dtype} {method} rep={rep} "
                            f"-> {res.gbps:.4f} GB/s [{res.status.name}]")
-                if raw_dir:
-                    fname = raw_dir / f"run-{dtype}-{method}-{rep}.json"
+                if fname and res.passed:
+                    # failures are never cached: a retry must re-measure
                     fname.write_text(json.dumps(row) + "\n")
     return rows

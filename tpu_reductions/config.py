@@ -53,11 +53,13 @@ METHODS = ("SUM", "MIN", "MAX")
 BACKENDS = ("auto", "pallas", "xla")
 
 # Kernel ids: the reference kept only kernel 6 live and emptied 0-5
-# (reduction_kernel.cu:278-289). We map 6 -> single-pass accumulator Pallas
-# kernel, 7 -> two-pass partials Pallas kernel, and WAIVE 0-5.
-LIVE_KERNELS = (6, 7)
+# (reduction_kernel.cu:278-289). We map 6 -> single-pass fold-accumulator
+# Pallas kernel, 7 -> two-pass partials Pallas kernel, 8 -> single-pass
+# elementwise accumulator (extension), and WAIVE 0-5.
+LIVE_KERNELS = (6, 7, 8)
 KERNEL_SINGLE_PASS = 6
 KERNEL_TWO_PASS = 7
+KERNEL_ELEMENTWISE = 8
 
 
 @dataclasses.dataclass
@@ -180,7 +182,8 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
     p.add_argument("--threads", type=int, default=256,
                    help="Tile rows per grid step (threads-per-block analog)")
     p.add_argument("--kernel", type=int, default=KERNEL_SINGLE_PASS,
-                   help="6=single-pass accumulator, 7=two-pass partials; "
+                   help="6=single-pass fold accumulator, 7=two-pass "
+                        "partials, 8=single-pass elementwise accumulator; "
                         "0-5 WAIVED (reference emptied them)")
     p.add_argument("--maxblocks", dest="max_blocks", type=int, default=64,
                    help="Grid clamp (maxblocks analog)")

@@ -31,9 +31,15 @@ also fixes the reference's non-pow2 min/max OOB bugs by construction
 (reduction_kernel.cu:140,157,204,221 — see SURVEY.md §2.2).
 
 Kernel ids (config.KERNEL_*):
-  6  single-pass: one VMEM accumulator revisited across the whole grid.
+  6  single-pass: per step, fold the tile to a sublane block and combine
+     into one VMEM accumulator block revisited across the whole grid.
   7  two-pass: P partial rows (maxblocks analog), finished by further
      passes / XLA / host according to cpu_final / cpu_thresh.
+  8  single-pass elementwise: combine the whole (TM,128) tile into a
+     (TM,128) VMEM accumulator — no in-step fold at all (pure VPU
+     elementwise, no sublane relayout); larger final finish. An
+     extension beyond the reference's numbering, kept to let the
+     benchmark race the two accumulation structures.
 
 float64: XLA-on-TPU emulates f64 but Mosaic/Pallas does not support it;
 pallas_reduce transparently uses a double-double (two-float32) kernel for
@@ -138,14 +144,16 @@ def _tile_to_sublane(tile: jax.Array, op: ReduceOpSpec, tm: int) -> jax.Array:
     return jnp.max(t3, axis=0)
 
 
-def _single_pass_kernel(op: ReduceOpSpec, tm: int):
-    """Kernel 6 analog: every grid step folds its tile into one (8,128)
-    VMEM accumulator block (same out index every step, so the block stays
-    resident — the grid-stride accumulate)."""
+def _accumulator_kernel(op: ReduceOpSpec, transform):
+    """Shared single-pass structure: every grid step applies `transform`
+    to its tile and combines it into one resident VMEM accumulator block
+    (same out index every step — the grid-stride accumulate). Kernel 6
+    folds the tile to a sublane block first; kernel 8's transform is just
+    the accumulator-dtype cast."""
 
     def kernel(in_ref, acc_ref):
         step = pl.program_id(0)
-        part = _tile_to_sublane(in_ref[:], op, tm)
+        part = transform(in_ref[:], acc_ref.dtype)
 
         @pl.when(step == 0)
         def _():
@@ -156,6 +164,34 @@ def _single_pass_kernel(op: ReduceOpSpec, tm: int):
             acc_ref[:] = op.jnp_combine(acc_ref[:], part)
 
     return kernel
+
+
+def _accumulator_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
+                      transform, acc_rows: int,
+                      interpret: Optional[bool]) -> jax.Array:
+    rows = x2d.shape[0]
+    interpret = _interpret_default() if interpret is None else interpret
+    return pl.pallas_call(
+        _accumulator_kernel(op, transform),
+        out_shape=jax.ShapeDtypeStruct((acc_rows, LANES),
+                                       _acc_dtype(x2d.dtype, op)),
+        grid=(rows // tm,),
+        in_specs=[pl.BlockSpec((tm, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((acc_rows, LANES), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x2d)
+
+
+def elementwise_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """Kernel 8: whole-tile elementwise combine into a (TM,128) resident
+    accumulator — maximal VPU regularity, zero relayout per step.
+    Returns the (TM, 128) accumulator."""
+    return _accumulator_call(x2d, op, tm,
+                             lambda tile, acc_dt: tile.astype(acc_dt),
+                             acc_rows=tm, interpret=interpret)
 
 
 def _two_pass_kernel(op: ReduceOpSpec, tm: int):
@@ -191,23 +227,13 @@ def _fold_sublanes(part: jax.Array, op: ReduceOpSpec) -> jax.Array:
 
 def single_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
                      interpret: Optional[bool] = None) -> jax.Array:
-    """Run the single-accumulator kernel over a staged (R, 128) array.
-    Returns the (8, 128) accumulator."""
-    rows = x2d.shape[0]
-    grid = (rows // tm,)
-    interpret = _interpret_default() if interpret is None else interpret
-    return pl.pallas_call(
-        _single_pass_kernel(op, tm),
-        out_shape=jax.ShapeDtypeStruct((sublanes_for(x2d.dtype), LANES),
-                                       _acc_dtype(x2d.dtype, op)),
-        grid=grid,
-        in_specs=[pl.BlockSpec((tm, LANES), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((sublanes_for(x2d.dtype), LANES),
-                               lambda i: (0, 0),
-                               memory_space=pltpu.VMEM),
-        interpret=interpret,
-    )(x2d)
+    """Kernel 6: per step, fold the tile to its sublane block, then
+    combine into the resident accumulator. Returns the (sublane_tile, 128)
+    accumulator."""
+    return _accumulator_call(
+        x2d, op, tm,
+        lambda tile, _acc_dt: _tile_to_sublane(tile, op, tm),
+        acc_rows=sublanes_for(x2d.dtype), interpret=interpret)
 
 
 def two_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int, p: int, t: int,
@@ -282,8 +308,9 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
     tm, p, t = choose_tiling(x.size, threads, max_blocks, x.dtype)
     x2d = stage_padded(x, tm, p, t, op)
 
-    if kernel == 6:
-        acc = single_pass_call(x2d, op, tm, interpret=interpret)
+    if kernel in (6, 8):
+        call = single_pass_call if kernel == 6 else elementwise_call
+        acc = call(x2d, op, tm, interpret=interpret)
         if cpu_final:
             return host_finish(acc, op)
         return finish(acc, op)
@@ -303,7 +330,7 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
             return host_finish(partials, op)
         return finish(partials, op)
 
-    raise ValueError(f"kernel {kernel} is not live; only 6 and 7 "
+    raise ValueError(f"kernel {kernel} is not live; only 6, 7 and 8 "
                      "(0-5 are WAIVED, mirroring reduction_kernel.cu:278-289)")
 
 
@@ -326,9 +353,11 @@ def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
     def stage_fn(x):
         return stage_padded(x, tm, p, t, op)
 
-    if kernel == 6:
+    if kernel in (6, 8):
+        call = single_pass_call if kernel == 6 else elementwise_call
+
         def device_fn(x2d):
-            return single_pass_call(x2d, op, tm, interpret=interpret)
+            return call(x2d, op, tm, interpret=interpret)
     else:
         def device_fn(x2d):
             partials = two_pass_call(x2d, op, tm, p, t, interpret=interpret)
