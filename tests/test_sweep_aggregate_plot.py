@@ -35,6 +35,31 @@ def test_sweep_all_writes_raw_and_resumes(tmp_path):
     assert [r["gbps"] for r in rows2] == first_gbps  # identical = reloaded
 
 
+def test_sweep_resume_survives_truncated_raw_file(tmp_path):
+    sweep_all(methods=("SUM",), dtypes=("int32",), n=4096, repeats=1,
+              iterations=2, out_dir=str(tmp_path),
+              logger=BenchLogger(None, None))
+    raw, = (tmp_path / "raw_output").glob("*.json")
+    raw.write_text('{"status": "PASSED", "n": 4096, "trunc')
+    # an interrupted write must not brick the restartable sweep
+    rows = sweep_all(methods=("SUM",), dtypes=("int32",), n=4096, repeats=1,
+                     iterations=2, out_dir=str(tmp_path),
+                     logger=BenchLogger(None, None))
+    assert len(rows) == 1 and rows[0]["status"] == "PASSED"
+
+
+def test_sweep_resume_rejects_other_backend(tmp_path):
+    rows_x = sweep_all(methods=("SUM",), dtypes=("int32",), n=4096,
+                       repeats=1, iterations=2, backend="xla",
+                       out_dir=str(tmp_path), logger=BenchLogger(None, None))
+    assert rows_x[0]["backend"] == "xla"
+    # same out_dir, different backend: the cached xla row must NOT be reused
+    rows_p = sweep_all(methods=("SUM",), dtypes=("int32",), n=4096,
+                       repeats=1, iterations=2, backend="pallas",
+                       out_dir=str(tmp_path), logger=BenchLogger(None, None))
+    assert rows_p[0]["backend"] == "pallas"
+
+
 def test_collective_sweep_and_full_pipeline(tmp_path):
     rows = sweep_collective(rank_counts=(2, 4), methods=("SUM", "MAX"),
                             dtypes=("int32",), n=1 << 12, retries=2,

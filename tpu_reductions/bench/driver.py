@@ -152,7 +152,7 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger
                            cfg.kernel, 0.0, 0.0, 0, QAStatus.WAIVED,
                            float("nan"), float("nan"), float("nan"),
                            waived_reason=f"kernel {cfg.kernel} not live "
-                                         "(only 6/7)")
+                                         f"(live: {LIVE_KERNELS})")
 
     backend = _resolve_backend(cfg)
 

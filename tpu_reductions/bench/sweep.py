@@ -20,7 +20,8 @@ import json
 from pathlib import Path
 from typing import List, Optional
 
-from tpu_reductions.bench.driver import BenchResult, run_benchmark
+from tpu_reductions.bench.driver import (BenchResult, _resolve_backend,
+                                         run_benchmark)
 from tpu_reductions.config import ReduceConfig
 from tpu_reductions.utils.logging import BenchLogger
 
@@ -136,12 +137,20 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                 fname = (raw_dir / f"run-{dtype}-{method}-{rep}.json"
                          if raw_dir else None)
                 if resume and fname and fname.exists():
-                    row = json.loads(fname.read_text())
+                    try:
+                        row = json.loads(fname.read_text())
+                    except (json.JSONDecodeError, OSError):
+                        row = {}  # truncated by an interrupted run: re-run
                     # only reuse a cached cell that (a) succeeded and
                     # (b) was measured under the SAME sweep parameters —
-                    # stale-config or failed cells are re-run
+                    # stale-config or failed cells are re-run (cached rows
+                    # store the resolved backend, never "auto")
+                    want_backend = _resolve_backend(
+                        ReduceConfig(method=method, dtype=dtype,
+                                     backend=backend))
                     if (row.get("status") == "PASSED"
                             and row.get("n") == n
+                            and row.get("backend") == want_backend
                             and row.get("iterations") == iterations):
                         rows.append(row)
                         logger.log(f"sweep {dtype} {method} rep={rep} "
@@ -158,6 +167,10 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                 logger.log(f"sweep {dtype} {method} rep={rep} "
                            f"-> {res.gbps:.4f} GB/s [{res.status.name}]")
                 if fname and res.passed:
-                    # failures are never cached: a retry must re-measure
-                    fname.write_text(json.dumps(row) + "\n")
+                    # failures are never cached: a retry must re-measure;
+                    # write via temp+rename so an interrupt can't leave a
+                    # truncated cache file behind
+                    tmp = fname.with_suffix(".json.tmp")
+                    tmp.write_text(json.dumps(row) + "\n")
+                    tmp.replace(fname)
     return rows

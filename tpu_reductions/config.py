@@ -13,8 +13,9 @@ Flag-name parity with the reference CLI (reduction.cpp:31-40):
   --n=<int>                   elements, default 1<<24 (reduction.cpp:665)
   --threads=<int>             tile rows per grid step — the threads-per-block
                               analog, default 256 (reduction.cpp:666)
-  --kernel=<int>              kernel id; only 6 (single-pass accumulator) and
-                              7 (two-pass partials) are live; 0-5 are WAIVED,
+  --kernel=<int>              kernel id; 6 (single-pass accumulator),
+                              7 (two-pass partials) and 8 (elementwise
+                              accumulator) are live; 0-5 are WAIVED,
                               mirroring the intentionally-emptied dispatch
                               cases (reduction_kernel.cu:278-289)
   --maxblocks=<int>           grid clamp, default 64 (reduction.cpp:668)
