@@ -5,36 +5,58 @@ SUM at n=2^24 — the reference's flagship CUDA configuration
 (reduction.cpp:665: n=1<<24; mpi/CUdata.txt:6: 90.8413 GB/s on the
 course's GPU). vs_baseline = our GB/s / 90.8413.
 
-Runs the Pallas kernel path on the real chip via the standard
-self-verifying driver (verification included; a FAILED verify zeroes the
-metric so a wrong-but-fast kernel can't score).
+Autotunes over a small candidate set — the (kernel, threads) knobs the
+reference exposes as --kernel/--threads — and reports the fastest
+VERIFIED configuration. All candidates are timed before any result is
+materialized (run_benchmark_batch), and the per-iteration statistic is
+the median, which shrugs off the tunneled platform's occasional sync
+stalls; a FAILED verify disqualifies a candidate so a wrong-but-fast
+kernel can't score.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import sys
 
 BASELINE_GBPS = 90.8413  # CUDA int SUM, n=2^24 (mpi/CUdata.txt:6)
 
+# (backend, kernel, threads) candidates: the two single-pass Pallas
+# accumulator structures at their best tile heights, plus the XLA reduce.
+CANDIDATES = (
+    ("pallas", 8, 256),
+    ("pallas", 8, 2048),
+    ("pallas", 6, 1024),
+    ("pallas", 6, 128),
+    ("xla", 6, 256),
+)
+
 
 def main() -> int:
-    from tpu_reductions.bench.driver import run_benchmark
+    from tpu_reductions.bench.driver import run_benchmark_batch
     from tpu_reductions.config import ReduceConfig
     from tpu_reductions.utils.logging import BenchLogger
 
-    cfg = ReduceConfig(method="SUM", dtype="int32", n=1 << 24,
-                       iterations=50, warmup=2, log_file=None)
-    res = run_benchmark(cfg, logger=BenchLogger(None, None,
-                                                console=sys.stderr))
-    value = res.gbps if res.passed else 0.0
+    base = ReduceConfig(method="SUM", dtype="int32", n=1 << 24,
+                        iterations=50, warmup=2, stat="median",
+                        log_file=None)
+    cfgs = [dataclasses.replace(base, backend=b, kernel=k, threads=t)
+            for b, k, t in CANDIDATES]
+    logger = BenchLogger(None, None, console=sys.stderr)
+    results = run_benchmark_batch(cfgs, logger=logger)
+    for cfg, res in zip(cfgs, results):
+        print(f"# {cfg.backend} k{cfg.kernel} threads={cfg.threads}: "
+              f"{res.gbps:.1f} GB/s [{res.status.name}]", file=sys.stderr)
+    passed = [r for r in results if r.passed]
+    value = max((r.gbps for r in passed), default=0.0)
     print(json.dumps({
         "metric": "single-chip int32 SUM reduction bandwidth, n=2^24",
         "value": round(value, 4),
         "unit": "GB/s",
         "vs_baseline": round(value / BASELINE_GBPS, 4),
     }))
-    return 0 if res.passed else 1
+    return 0 if passed else 1
 
 
 if __name__ == "__main__":

@@ -57,3 +57,53 @@ def test_cli_main_exit_codes(tmp_path, monkeypatch):
     code = main(["--method=SUM", "--type=int", "--n=4096",
                  "--iterations=2", "--logfile", str(tmp_path / "r.txt")])
     assert code == 0
+
+
+def test_run_benchmark_batch_defers_materialization(monkeypatch):
+    """Batch runs must not materialize ANY device result until every timed
+    loop has finished (the tunneled-TPU first-fetch sync penalty)."""
+    import tpu_reductions.bench.driver as drv
+
+    order = []
+    real_time_fn = drv.time_fn
+
+    def spy_time_fn(*a, **kw):
+        order.append("timed")
+        return real_time_fn(*a, **kw)
+
+    real_finalize = drv._PendingResult.finalize
+
+    def spy_finalize(self):
+        order.append("finalized")
+        return real_finalize(self)
+
+    monkeypatch.setattr(drv, "time_fn", spy_time_fn)
+    monkeypatch.setattr(drv._PendingResult, "finalize", spy_finalize)
+    cfgs = [_cfg(), _cfg(method="MIN"), _cfg(method="MAX", backend="xla")]
+    results = drv.run_benchmark_batch(cfgs, logger=BenchLogger(None, None))
+    assert [r.status for r in results] == [QAStatus.PASSED] * 3
+    assert order == ["timed"] * 3 + ["finalized"] * 3
+
+
+def test_run_benchmark_batch_passes_through_waived():
+    res, = __import__("tpu_reductions.bench.driver",
+                      fromlist=["run_benchmark_batch"]).run_benchmark_batch(
+        [_cfg(kernel=3)], logger=BenchLogger(None, None))
+    assert res.status == QAStatus.WAIVED
+
+
+def test_batch_warns_on_leaky_timing_order():
+    """fetch/cpufinal configs materialize in-loop; batch flags them when
+    they are not last (they would taint later configs on the tunnel)."""
+    import io
+
+    import tpu_reductions.bench.driver as drv
+
+    buf = io.StringIO()
+    log = BenchLogger(None, None, console=buf)
+    drv.run_benchmark_batch([_cfg(timing="fetch"), _cfg()], logger=log)
+    assert "WARNING" in buf.getvalue()
+    buf2 = io.StringIO()
+    drv.run_benchmark_batch([_cfg(), _cfg(timing="fetch")],
+                            logger=BenchLogger(None, None, console=buf2))
+    assert "WARNING" not in buf2.getvalue()

@@ -95,3 +95,26 @@ def test_staged_reduce_matches():
 def test_waived_kernel_ids():
     with pytest.raises(ValueError):
         pallas_reduce(jnp.arange(16, dtype=jnp.float32), "SUM", kernel=3)
+
+
+def test_two_pass_partials_are_sublane_blocks():
+    """TPU lowering constraint regression: kernel 7's partials must be
+    (P*sublane, 128) blocks — a (1, 128) row per block cannot be lowered
+    on real hardware (only the interpreter accepts it)."""
+    from tpu_reductions.ops.pallas_reduce import (LANES, stage_padded,
+                                                  sublanes_for,
+                                                  two_pass_call)
+    from tpu_reductions.ops.registry import get_op
+
+    op = get_op("SUM")
+    n = 1 << 16
+    tm, p, t = choose_tiling(n, threads=64, max_blocks=4)
+    assert p > 1  # the constraint only bites with multiple partial blocks
+    x = host_data(n, "float32", rank=0)
+    x2d = stage_padded(x, tm, p, t, op)
+    partials = two_pass_call(x2d, op, tm, p, t, interpret=True)
+    sub = sublanes_for(np.float32)
+    assert partials.shape == (p * sub, LANES)
+    assert partials.shape[0] % 8 == 0
+    np.testing.assert_allclose(np.asarray(partials).sum(),
+                               np.asarray(x, np.float64).sum(), rtol=1e-5)

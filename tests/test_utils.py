@@ -125,3 +125,17 @@ def test_host_data_real_distribution():
     # (byte)/RAND_MAX: tiny positive reals (reduction.cpp:702-704)
     assert x.dtype == np.float64
     assert (x >= 0).all() and x.max() <= 255 / (2**31 - 1)
+
+
+def test_bulk_mode_median_falls_back_to_per_iteration_average():
+    """Bulk mode books one span; it must NOT surface as a median 'sample'
+    (that would inflate per-iteration time by the iteration count)."""
+    import jax.numpy as jnp
+
+    from tpu_reductions.utils.timing import time_fn
+
+    f = lambda x: x + 1
+    _, sw = time_fn(f, jnp.ones(8), iterations=10, warmup=1, mode="bulk")
+    assert sw.sessions == 10
+    assert not sw.samples
+    assert abs(sw.median_s - sw.average_s) < 1e-12

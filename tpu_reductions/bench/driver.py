@@ -76,10 +76,17 @@ def _make_device_fn(cfg: ReduceConfig, backend: str):
     import jax.numpy as jnp
 
     if backend == "xla":
+        from tpu_reductions.ops.pallas_reduce import (choose_tiling,
+                                                      stage_padded)
+        from tpu_reductions.ops.registry import get_op
         from tpu_reductions.ops.xla_reduce import make_xla_reduce
 
         def stage_fn(x_np):
-            return jnp.asarray(x_np)
+            # identity-padded (rows, 128) layout: XLA reduces a
+            # lane-aligned 2-D array measurably faster than the same
+            # bytes as a 1-D vector (it tiles the minor-128 dim directly)
+            tm, p, t = choose_tiling(cfg.n, dtype=cfg.dtype)
+            return stage_padded(x_np, tm, p, t, get_op(cfg.method))
 
         return stage_fn, make_xla_reduce(cfg.method)
 
@@ -117,9 +124,13 @@ def _make_logger(cfg: ReduceConfig) -> BenchLogger:
                        console=open(os.devnull, "w") if cfg.qatest else None)
 
 
-def run_benchmark(cfg: ReduceConfig, logger: Optional[BenchLogger] = None
-                  ) -> BenchResult:
-    """Run one self-verifying benchmark configuration."""
+def run_benchmark(cfg: ReduceConfig, logger: Optional[BenchLogger] = None,
+                  defer: bool = False):
+    """Run one self-verifying benchmark configuration.
+
+    defer=True returns a _PendingResult whose device value has not been
+    materialized yet (call .finalize() for the BenchResult) — see
+    run_benchmark_batch for why batch callers need this."""
     import jax
 
     if logger is None:
@@ -137,12 +148,80 @@ def run_benchmark(cfg: ReduceConfig, logger: Optional[BenchLogger] = None
                                              f"present ({len(devs)} found)")
         with jax.default_device(devs[cfg.device]):
             return _run_benchmark_inner(
-                dataclasses.replace(cfg, device=None), logger)
-    return _run_benchmark_inner(cfg, logger)
+                dataclasses.replace(cfg, device=None), logger, defer)
+    return _run_benchmark_inner(cfg, logger, defer)
 
 
-def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger
-                         ) -> BenchResult:
+@dataclasses.dataclass
+class _PendingResult:
+    """A timed-but-unverified run: the device result has NOT been
+    materialized on the host yet.
+
+    Rationale: on the tunneled TPU platform, the first device->host
+    materialization in a process permanently degrades every subsequent
+    host-device sync round-trip to ~70 ms (measured; the reference had no
+    such hazard because each benchmark was its own process —
+    mpi/submit_all.sh's one-job-per-config structure). Batch runs
+    therefore time ALL configs first and materialize/verify afterwards
+    (run_benchmark_batch); the host-oracle value is computed eagerly here
+    because it never touches the device."""
+
+    cfg: ReduceConfig
+    backend: str
+    gbps: float
+    avg_s: float
+    result: object        # un-materialized device array
+    host_val_raw: object  # host-oracle result (never touched the device)
+    logger: BenchLogger
+
+    def finalize(self) -> BenchResult:
+        import jax
+        cfg = self.cfg
+        status = QAStatus.PASSED
+        dev_val = float(np.asarray(jax.device_get(self.result),
+                                   dtype=np.float64))
+        host_val = float("nan")
+        diff = float("nan")
+        if cfg.verify:
+            passed, diff = oracle_mod.verify(self.result, self.host_val_raw,
+                                             cfg.method, cfg.dtype, cfg.n)
+            host_val = float(np.asarray(self.host_val_raw,
+                                        dtype=np.float64))
+            status = QAStatus.PASSED if passed else QAStatus.FAILED
+            tol = tolerance(cfg.method, cfg.dtype, cfg.n)
+            self.logger.log(f"TPU result = {dev_val!r}")
+            self.logger.log(f"CPU result = {host_val!r} (tolerance {tol:g})")
+        return BenchResult(cfg.method, cfg.dtype, cfg.n, self.backend,
+                           cfg.kernel, self.gbps, self.avg_s,
+                           cfg.iterations, status, dev_val, host_val, diff)
+
+
+def run_benchmark_batch(cfgs, logger: Optional[BenchLogger] = None):
+    """Run several configurations in one process: every timed loop runs
+    before ANY device result is materialized, so the tunnel's
+    first-materialization sync penalty (see _PendingResult) cannot taint
+    config 2..N's measurements. Returns a list of BenchResult.
+
+    Configs whose timed loop materializes on host BY DESIGN (--timing=fetch,
+    --cpufinal) defeat the deferral for every config after them; they are
+    allowed (the reference's --cpufinal does host work in-loop too) but
+    flagged, and belong last in a batch — or in their own process."""
+    cfgs = list(cfgs)
+    leaky = [i for i, c in enumerate(cfgs)
+             if c.timing == "fetch" or c.cpu_final]
+    if leaky and max(leaky) < len(cfgs) - 1 and logger is not None:
+        logger.log(f"WARNING: config(s) {leaky} materialize on host inside "
+                   "their timed loop (--timing=fetch/--cpufinal); on the "
+                   "tunneled platform this degrades sync latency for every "
+                   "LATER config in the batch — order them last")
+    pendings = [run_benchmark(cfg, logger=logger, defer=True)
+                for cfg in cfgs]
+    return [p.finalize() if isinstance(p, _PendingResult) else p
+            for p in pendings]
+
+
+def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
+                         defer: bool = False):
     import jax
 
     if cfg.kernel not in LIVE_KERNELS:
@@ -206,30 +285,18 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger
     # (reduction.cpp:731, sync points :319,373) via the shared discipline.
     result, sw = time_fn(reduce_fn, x_dev, iterations=cfg.iterations,
                          warmup=max(cfg.warmup, 1), mode=cfg.timing)
-    avg_s = sw.average_s
+    avg_s = sw.average_s if cfg.stat == "mean" else sw.median_s
     gbps = (cfg.nbytes / avg_s) / 1e9 if avg_s > 0 else float("inf")
 
     # The canonical throughput line (reduction.cpp:744-745) -> master log.
     logger.log_master(throughput_line(gbps, avg_s, cfg.n,
                                       devices=1, workgroup=cfg.threads))
 
-    status = QAStatus.PASSED
-    dev_val = float(np.asarray(jax.device_get(result), dtype=np.float64))
-    host_val = float("nan")
-    diff = float("nan")
-    if cfg.verify:
-        host = oracle_mod.host_reduce(x_np, cfg.method)
-        passed, diff = oracle_mod.verify(result, host, cfg.method,
-                                         cfg.dtype, cfg.n)
-        host_val = float(np.asarray(host, dtype=np.float64))
-        status = QAStatus.PASSED if passed else QAStatus.FAILED
-        tol = tolerance(cfg.method, cfg.dtype, cfg.n)
-        logger.log(f"TPU result = {dev_val!r}")
-        logger.log(f"CPU result = {host_val!r} (tolerance {tol:g})")
-
-    return BenchResult(cfg.method, cfg.dtype, cfg.n, backend, cfg.kernel,
-                       gbps, avg_s, cfg.iterations, status, dev_val,
-                       host_val, diff)
+    # Host oracle is pure host work (numpy / the C++ extension) — computed
+    # eagerly; device-result materialization is what gets deferred.
+    host = oracle_mod.host_reduce(x_np, cfg.method) if cfg.verify else None
+    pending = _PendingResult(cfg, backend, gbps, avg_s, result, host, logger)
+    return pending if defer else pending.finalize()
 
 
 def main(argv=None) -> int:

@@ -21,7 +21,7 @@ from pathlib import Path
 from typing import List, Optional
 
 from tpu_reductions.bench.driver import (BenchResult, _resolve_backend,
-                                         run_benchmark)
+                                         run_benchmark, run_benchmark_batch)
 from tpu_reductions.config import ReduceConfig
 from tpu_reductions.utils.logging import BenchLogger
 
@@ -36,15 +36,17 @@ def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
     keep wall time bounded, like the SDK's testIterations scaling.
     """
     logger = logger or BenchLogger(cfg.log_file, cfg.master_log)
-    results = []
+    cfgs = []
     for p in range(min_pow, max_pow + 1):
         n = 1 << p
         iters = max(3, min(cfg.iterations, (1 << 28) // n))
-        sub = dataclasses.replace(cfg, n=n, iterations=iters)
-        res = run_benchmark(sub, logger=logger)
-        logger.log(f"shmoo {cfg.method} {cfg.dtype} n=2^{p} "
+        cfgs.append(dataclasses.replace(cfg, n=n, iterations=iters))
+    # batch: all sizes are timed before any result is materialized, so the
+    # tunnel's first-materialization sync penalty can't taint later sizes
+    results = run_benchmark_batch(cfgs, logger=logger)
+    for sub, res in zip(cfgs, results):
+        logger.log(f"shmoo {cfg.method} {cfg.dtype} n={sub.n} "
                    f"-> {res.gbps:.4f} GB/s [{res.status.name}]")
-        results.append(res)
     return results
 
 
@@ -125,12 +127,21 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
     their rows — making an interrupted sweep restartable. This is the
     honest extent of checkpoint/resume in this framework (and one step
     beyond the reference, where only the offline *analysis* was resumable
-    via its accumulated files — SURVEY.md §5 "checkpoint/resume")."""
+    via its accumulated files — SURVEY.md §5 "checkpoint/resume").
+    Cache files land during the finalize phase, after ALL cells have been
+    timed (the deferral keeps the tunnel's first-materialization penalty
+    out of the measurements); an interrupt during timing re-measures the
+    un-cached cells on the next run."""
     logger = logger or BenchLogger(None, None)
     raw_dir = Path(out_dir) / "raw_output" if out_dir else None
     if raw_dir:
         raw_dir.mkdir(parents=True, exist_ok=True)
-    rows = []
+    # Phase 1: resolve resumed cells, queue the rest. Phase 2 times the
+    # whole queue before materializing/verifying anything — see
+    # driver.run_benchmark_batch (the tunnel's first device->host fetch
+    # degrades every later sync, so per-cell verify would taint cell 2..N).
+    rows: List[Optional[dict]] = []
+    queued = []  # (row_index, rep, fname, cfg)
     for dtype in dtypes:
         for method in methods:
             for rep in range(repeats):
@@ -160,17 +171,27 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                 cfg = ReduceConfig(method=method, dtype=dtype, n=n,
                                    iterations=iterations, backend=backend,
                                    seed=rep, log_file=None)
-                res = run_benchmark(cfg, logger=logger)
-                row = res.to_dict()
-                row["repeat"] = rep
-                rows.append(row)
-                logger.log(f"sweep {dtype} {method} rep={rep} "
-                           f"-> {res.gbps:.4f} GB/s [{res.status.name}]")
-                if fname and res.passed:
-                    # failures are never cached: a retry must re-measure;
-                    # write via temp+rename so an interrupt can't leave a
-                    # truncated cache file behind
-                    tmp = fname.with_suffix(".json.tmp")
-                    tmp.write_text(json.dumps(row) + "\n")
-                    tmp.replace(fname)
+                queued.append((len(rows), rep, fname, cfg))
+                rows.append(None)  # placeholder, filled in phase 2
+    # Time the whole queue first (no materialization — see above), then
+    # finalize cell by cell, writing each cache file as soon as its cell
+    # verifies so an interrupt mid-finalize loses at most the tail.
+    from tpu_reductions.bench.driver import _PendingResult
+    pendings = [run_benchmark(cfg, logger=logger, defer=True)
+                for _, _, _, cfg in queued]
+    for (idx, rep, fname, cfg), pending in zip(queued, pendings):
+        res = (pending.finalize() if isinstance(pending, _PendingResult)
+               else pending)
+        row = res.to_dict()
+        row["repeat"] = rep
+        rows[idx] = row
+        logger.log(f"sweep {cfg.dtype} {cfg.method} rep={rep} "
+                   f"-> {res.gbps:.4f} GB/s [{res.status.name}]")
+        if fname and res.passed:
+            # failures are never cached: a retry must re-measure; write
+            # via temp+rename so an interrupt can't leave a truncated
+            # cache file behind
+            tmp = fname.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(row) + "\n")
+            tmp.replace(fname)
     return rows

@@ -27,6 +27,9 @@ Flag-name parity with the reference CLI (reduction.cpp:31-40):
                               reference's stub (reduction.cpp:577-580)
   --backend={pallas|xla|auto} TPU kernel selection (no reference analog:
                               xla is the always-correct comparator)
+  --stat={mean|median}        per-iteration time statistic; mean matches
+                              cutGetAverageTimerValue, median shrugs off
+                              interconnect sync stalls
 
 MPI-side constants (mpi/constants.h) become flags of the collective driver:
   --n / --iterations / --retries  (NUM_INTS, RETRY_COUNT analogs; the
@@ -87,6 +90,8 @@ class ReduceConfig:
     trace_dir: Optional[str] = None  # jax.profiler trace capture dir
     check: bool = False              # compiled/interpret/XLA consistency
     timing: str = "periter"          # periter|bulk|fetch (timing.time_fn)
+    stat: str = "mean"               # mean (reference parity) | median
+                                     # (robust to tunnel sync stalls)
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
@@ -104,6 +109,8 @@ class ReduceConfig:
         if self.timing not in ("periter", "bulk", "fetch"):
             raise ValueError(f"timing must be periter|bulk|fetch, "
                              f"got {self.timing!r}")
+        if self.stat not in ("mean", "median"):
+            raise ValueError(f"stat must be mean|median, got {self.stat!r}")
 
     @property
     def nbytes(self) -> int:
@@ -216,6 +223,11 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
                    help="Sync discipline: periter=reference structure; "
                         "bulk=one span, amortized dispatch; fetch=host "
                         "round-trip each iteration")
+    p.add_argument("--stat", type=str, default="mean",
+                   choices=("mean", "median"),
+                   help="Per-iteration statistic feeding GB/s: mean = "
+                        "cutGetAverageTimerValue parity; median = robust "
+                        "to interconnect/tunnel sync stalls")
     return p
 
 
@@ -242,7 +254,7 @@ def parse_single_chip(argv=None):
         iterations=ns.iterations, warmup=ns.warmup, seed=ns.seed,
         device=ns.device, log_file=ns.log_file, master_log=ns.master_log,
         qatest=ns.qatest, verify=ns.verify, trace_dir=ns.trace_dir,
-        check=ns.check, timing=ns.timing,
+        check=ns.check, timing=ns.timing, stat=ns.stat,
     )
     _apply_platform(ns)
     return cfg, ns.shmoo

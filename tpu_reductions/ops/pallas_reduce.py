@@ -195,34 +195,29 @@ def elementwise_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
 
 
 def _two_pass_kernel(op: ReduceOpSpec, tm: int):
-    """Kernel 7: grid (P, T); block i accumulates T tiles into partial row
-    i — the numBlocks-partials structure (reduction.cpp:323 producing
-    blocks partials), with the maxblocks clamp expressed as per-block
-    striding."""
+    """Kernel 7: grid (P, T); block i accumulates T tiles into partial
+    sublane block i — the numBlocks-partials structure (reduction.cpp:323
+    producing blocks partials), with the maxblocks clamp expressed as
+    per-block striding.
+
+    Each partial is a full (sublane, 128) block, not a single row: TPU
+    lowering requires output blocks whose second-to-last dim is a multiple
+    of the sublane tile (pallas_guide.md tiling table), so a (1, 128)
+    partial row — the literal numBlocks analog — cannot be lowered."""
 
     def kernel(in_ref, out_ref):
         j = pl.program_id(1)
         part = _tile_to_sublane(in_ref[:], op, tm)
-        row = part if SUBLANES == 1 else _fold_sublanes(part, op)
 
         @pl.when(j == 0)
         def _():
-            out_ref[:] = row
+            out_ref[:] = part
 
         @pl.when(j > 0)
         def _():
-            out_ref[:] = op.jnp_combine(out_ref[:], row)
+            out_ref[:] = op.jnp_combine(out_ref[:], part)
 
     return kernel
-
-
-def _fold_sublanes(part: jax.Array, op: ReduceOpSpec) -> jax.Array:
-    """(8, 128) -> (1, 128) lane vector."""
-    if op.name == "SUM":
-        return jnp.sum(part, axis=0, keepdims=True, dtype=part.dtype)
-    if op.name == "MIN":
-        return jnp.min(part, axis=0, keepdims=True)
-    return jnp.max(part, axis=0, keepdims=True)
 
 
 def single_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
@@ -239,16 +234,18 @@ def single_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
 def two_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int, p: int, t: int,
                   interpret: Optional[bool] = None) -> jax.Array:
     """Run the partials kernel over a staged (P*T*TM, 128) array.
-    Returns (P, 128) partial rows."""
+    Returns (P*sublane, 128) partials — sublane block i is block i's
+    partial (see _two_pass_kernel on why a block, not a row)."""
     interpret = _interpret_default() if interpret is None else interpret
+    sub = sublanes_for(x2d.dtype)
     return pl.pallas_call(
         _two_pass_kernel(op, tm),
-        out_shape=jax.ShapeDtypeStruct((p, LANES),
+        out_shape=jax.ShapeDtypeStruct((p * sub, LANES),
                                        _acc_dtype(x2d.dtype, op)),
         grid=(p, t),
         in_specs=[pl.BlockSpec((tm, LANES), lambda i, j: (i * t + j, 0),
                                memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((1, LANES), lambda i, j: (i, 0),
+        out_specs=pl.BlockSpec((sub, LANES), lambda i, j: (i, 0),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
     )(x2d)

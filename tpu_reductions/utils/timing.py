@@ -36,6 +36,7 @@ class Stopwatch:
 
     total_s: float = 0.0
     sessions: int = 0
+    samples: list = field(default_factory=list)
     _t0: Optional[float] = field(default=None, repr=False)
 
     def start(self) -> None:
@@ -48,17 +49,31 @@ class Stopwatch:
         self._t0 = None
         self.total_s += dt
         self.sessions += 1
+        self.samples.append(dt)
         return dt
 
     def reset(self) -> None:
         self.total_s = 0.0
         self.sessions = 0
+        self.samples = []
         self._t0 = None
 
     @property
     def average_s(self) -> float:
         """Mean session time (cutGetAverageTimerValue analog, cutil.cpp:1684)."""
         return self.total_s / self.sessions if self.sessions else 0.0
+
+    @property
+    def median_s(self) -> float:
+        """Median session time — robust against the tunneled platform's
+        occasional multi-ms sync stalls, which blow up a mean the way no
+        local-PCIe stall ever hit the reference's gettimeofday averages.
+        Falls back to average_s when sessions weren't booked individually
+        (bulk mode)."""
+        if not self.samples:
+            return self.average_s
+        import statistics
+        return statistics.median(self.samples)
 
 
 class TimerRegistry:
@@ -115,8 +130,11 @@ def time_fn(fn: Callable, *args, iterations: int = 100, warmup: int = 1,
         jax.block_until_ready(result)
         sw.stop()  # booked the whole span as one session...
         # ...rebook it as `iterations` sessions so average_s is
-        # per-iteration, preserving anything accumulated before this call
+        # per-iteration, preserving anything accumulated before this call.
+        # The span is NOT a per-iteration sample: drop it so median_s
+        # falls back to the (correctly rebooked) average.
         sw.sessions += iterations - 1
+        sw.samples.pop()
         return result, sw
 
     for _ in range(iterations):
