@@ -22,13 +22,15 @@ import sys
 
 BASELINE_GBPS = 90.8413  # CUDA int SUM, n=2^24 (mpi/CUdata.txt:6)
 
-# (backend, kernel, threads) candidates: the two single-pass Pallas
-# accumulator structures at their best tile heights, plus the XLA reduce.
+# (backend, kernel, threads) candidates: the strongest configurations
+# from the full tile-geometry race (bench/autotune.py on the real chip) —
+# the two single-pass Pallas accumulator structures at their best tile
+# heights, plus the XLA reduce as the comparator.
 CANDIDATES = (
-    ("pallas", 8, 256),
-    ("pallas", 8, 2048),
     ("pallas", 6, 1024),
+    ("pallas", 8, 2048),
     ("pallas", 6, 128),
+    ("pallas", 8, 256),
     ("xla", 6, 256),
 )
 

@@ -1,0 +1,47 @@
+"""L5 autotuner tests: the tile-geometry race that replaces the
+reference's hand-set --threads/--maxblocks knobs (reduction.cpp:666-668;
+SURVEY.md §7 step 3). Runs on the virtual CPU platform via conftest."""
+
+import json
+
+from tpu_reductions.bench.autotune import autotune, candidate_configs, main
+from tpu_reductions.config import ReduceConfig
+
+
+def _base(n=1 << 14):
+    return ReduceConfig(method="SUM", dtype="int32", n=n, iterations=3,
+                        warmup=1, log_file=None)
+
+
+def test_candidate_grid_shapes():
+    cfgs = candidate_configs(_base())
+    assert all(c.backend == "pallas" for c in cfgs)
+    kernels = {c.kernel for c in cfgs}
+    assert kernels == {6, 7, 8}
+    # two-pass candidates vary max_blocks; single-pass pin it to 64
+    assert {c.max_blocks for c in cfgs if c.kernel == 7} == {64, 256}
+    assert {c.max_blocks for c in cfgs if c.kernel != 7} == {64}
+
+
+def test_autotune_ranks_verified_first():
+    grid = ((6, 256, 64), (8, 256, 64), (7, 256, 64))
+    pairs = autotune(_base(), grid=grid)
+    assert len(pairs) == 3
+    # every candidate verifies on the interpret path, so ordering is by
+    # throughput alone — descending
+    assert all(res.passed for _, res in pairs)
+    speeds = [res.gbps for _, res in pairs]
+    assert speeds == sorted(speeds, reverse=True)
+
+
+def test_autotune_cli_writes_json(tmp_path, capsys):
+    out = tmp_path / "tune.json"
+    rc = main(["--method=SUM", "--type=int", "--n=16384", "--iterations=2",
+               f"--out={out}"])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert data["dtype"] == "int32" and data["n"] == 16384
+    assert data["best"] is not None
+    assert data["best"]["status"] == "PASSED"
+    assert len(data["ranked"]) == len(candidate_configs(_base()))
+    assert "best:" in capsys.readouterr().out

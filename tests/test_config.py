@@ -46,6 +46,19 @@ def test_cli_round_trip():
     assert not shmoo
 
 
+def test_shmoo_range_flags():
+    # --shmoo yields the (min_pow, max_pow) range; default 2^10..2^24,
+    # extensible to BASELINE config #5's 2^30
+    _, shmoo = parse_single_chip(["--method=SUM", "--shmoo"])
+    assert shmoo == (10, 24)
+    _, shmoo = parse_single_chip(
+        ["--method=SUM", "--shmoo", "--shmoo-min=12", "--shmoo-max=30"])
+    assert shmoo == (12, 30)
+    with pytest.raises(SystemExit):
+        parse_single_chip(["--method=SUM", "--shmoo", "--shmoo-min=20",
+                           "--shmoo-max=10"])
+
+
 def test_collective_cli():
     ccfg = parse_collective(["--method=SUM", "--type=double", "--n=1024",
                              "--devices=8", "--mode=co", "--rooted"])

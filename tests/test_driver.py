@@ -107,3 +107,42 @@ def test_batch_warns_on_leaky_timing_order():
     drv.run_benchmark_batch([_cfg(), _cfg(timing="fetch")],
                             logger=BenchLogger(None, None, console=buf2))
     assert "WARNING" not in buf2.getvalue()
+    # mixed case: a leaky LAST config must not mask the leaky FIRST one
+    buf3 = io.StringIO()
+    drv.run_benchmark_batch(
+        [_cfg(timing="fetch"), _cfg(), _cfg(timing="fetch")],
+        logger=BenchLogger(None, None, console=buf3))
+    assert "WARNING" in buf3.getvalue()
+    # --check materializes before later timed loops: leaky too
+    buf4 = io.StringIO()
+    drv.run_benchmark_batch([_cfg(check=True), _cfg()],
+                            logger=BenchLogger(None, None, console=buf4))
+    assert "WARNING" in buf4.getvalue()
+
+
+def test_batch_on_result_hook():
+    """on_result fires once per config, in order, after finalize."""
+    import tpu_reductions.bench.driver as drv
+
+    seen = []
+    cfgs = [_cfg(), _cfg(method="MIN")]
+    results = drv.run_benchmark_batch(
+        cfgs, logger=BenchLogger(None, None),
+        on_result=lambda cfg, res: seen.append((cfg.method, res.passed)))
+    assert seen == [("SUM", True), ("MIN", True)]
+    assert all(r.passed for r in results)
+
+
+def test_kernel7_bf16_minmax_terminates():
+    """bf16 MIN/MAX partials carry a 16-row sublane tile; the multi-pass
+    loop's floor must track the partials' own tile or it never exits
+    (regression: trace-time hang)."""
+    from tpu_reductions.ops.pallas_reduce import pallas_reduce
+
+    import jax.numpy as jnp
+    for method in ("MIN", "MAX"):
+        x = np.random.default_rng(0).integers(-100, 100, 1 << 16)
+        got = pallas_reduce(jnp.asarray(x, jnp.bfloat16), method, kernel=7)
+        want = (np.min if method == "MIN" else np.max)(
+            np.asarray(x, np.float32).astype(jnp.bfloat16))
+        assert float(got) == float(want)

@@ -1,0 +1,132 @@
+"""L5: tile-geometry autotuner for the single-chip Pallas kernels.
+
+The reference exposes its kernel geometry as hand-set knobs
+(`--threads`/`--maxblocks`, reference reduction.cpp:666-668) chosen by the
+user per GPU; getNumBlocksAndThreads (reduction.cpp:272-291) merely clamps
+them. On TPU the analogous knobs are the VMEM tile height (threads -> TM
+rows) and the partial-block count (maxblocks -> P), and the right values
+depend on the payload, dtype and accumulator structure — so this module
+races a candidate grid and reports the fastest VERIFIED configuration
+(SURVEY.md §7 step 3: "tile-shape autotuning replaces the
+threads/maxblocks knobs").
+
+All candidates are timed before any result is materialized
+(driver.run_benchmark_batch) so the tunneled platform's
+first-materialization sync penalty cannot taint later candidates, and a
+FAILED verify disqualifies a candidate so a wrong-but-fast kernel can
+never win.
+
+CLI:
+    python -m tpu_reductions.bench.autotune --method=SUM --type=int \
+        --n=16777216 [--platform=cpu] [--out=tune.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+from tpu_reductions.bench.driver import BenchResult, run_benchmark_batch
+from tpu_reductions.config import (DTYPE_ALIASES, KERNEL_ELEMENTWISE,
+                                   KERNEL_SINGLE_PASS, KERNEL_TWO_PASS,
+                                   METHODS, ReduceConfig, _apply_platform)
+from tpu_reductions.utils.logging import BenchLogger
+
+# (kernel, threads, max_blocks) candidate grid. Threads sweeps the VMEM
+# tile height across its useful range (8 rows = one sublane tile, 2048 =
+# the choose_tiling clamp); max_blocks only matters for the two-pass
+# kernel's partial count, so the single-pass kernels pin it to the
+# reference default of 64 (reduction.cpp:668).
+DEFAULT_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
+    [(KERNEL_SINGLE_PASS, t, 64) for t in (64, 128, 256, 512, 1024, 2048)]
+    + [(KERNEL_ELEMENTWISE, t, 64) for t in (64, 128, 256, 512, 1024, 2048)]
+    + [(KERNEL_TWO_PASS, t, mb) for t in (256, 1024) for mb in (64, 256)]
+)
+
+
+def candidate_configs(base: ReduceConfig,
+                      grid: Sequence[Tuple[int, int, int]] = DEFAULT_GRID,
+                      ) -> List[ReduceConfig]:
+    """Expand the (kernel, threads, max_blocks) grid into benchmark
+    configs sharing `base`'s op/dtype/n/timing discipline — the candidate
+    space the reference leaves to hand-set --threads/--maxblocks knobs
+    (reduction.cpp:666-668)."""
+    return [dataclasses.replace(base, backend="pallas", kernel=k,
+                                threads=t, max_blocks=mb)
+            for k, t, mb in grid]
+
+
+def autotune(base: ReduceConfig,
+             grid: Sequence[Tuple[int, int, int]] = DEFAULT_GRID,
+             logger: Optional[BenchLogger] = None,
+             ) -> List[Tuple[ReduceConfig, BenchResult]]:
+    """Race the grid; return (config, result) pairs sorted fastest-first
+    with verified (PASSED) candidates ranked strictly above the rest.
+
+    Replaces getNumBlocksAndThreads' static clamping of user-picked knobs
+    (reduction.cpp:272-291) with measurement (SURVEY.md §7 step 3)."""
+    logger = logger or BenchLogger(None, None)
+    cfgs = candidate_configs(base, grid)
+    results = run_benchmark_batch(cfgs, logger=logger)
+    pairs = list(zip(cfgs, results))
+    pairs.sort(key=lambda cr: (not cr[1].passed, -cr[1].gbps))
+    return pairs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.autotune",
+        description="Race the Pallas tile-geometry grid and report the "
+                    "fastest verified configuration",
+    )
+    p.add_argument("--method", type=str, default="SUM")
+    p.add_argument("--type", dest="dtype", type=str, default="int")
+    p.add_argument("--n", type=int, default=1 << 24)
+    p.add_argument("--iterations", type=int, default=50)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--stat", type=str, default="median",
+                   choices=("mean", "median"))
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"))
+    p.add_argument("--out", type=str, default=None,
+                   help="Write the ranked results as JSON to this path")
+    ns = p.parse_args(argv)
+    if ns.dtype not in DTYPE_ALIASES:
+        p.error(f"unknown --type {ns.dtype!r}")
+    if ns.method.upper() not in METHODS:
+        p.error(f"--method must be one of {METHODS}, got {ns.method!r}")
+    if ns.n <= 0:
+        p.error("--n must be positive")
+    _apply_platform(ns)
+
+    base = ReduceConfig(method=ns.method, dtype=ns.dtype, n=ns.n,
+                        iterations=ns.iterations, warmup=ns.warmup,
+                        stat=ns.stat, log_file=None)
+    logger = BenchLogger(None, None, console=sys.stderr)
+    pairs = autotune(base, logger=logger)
+    rows = []
+    for cfg, res in pairs:
+        rows.append({"kernel": cfg.kernel, "threads": cfg.threads,
+                     "max_blocks": cfg.max_blocks, "gbps": round(res.gbps, 4),
+                     "status": res.status.name})
+        print(f"kernel={cfg.kernel} threads={cfg.threads:>5} "
+              f"maxblocks={cfg.max_blocks:>4}  {res.gbps:10.2f} GB/s "
+              f"[{res.status.name}]")
+    best = rows[0] if pairs and pairs[0][1].passed else None
+    if best:
+        print(f"best: kernel={best['kernel']} threads={best['threads']} "
+              f"maxblocks={best['max_blocks']} -> {best['gbps']} GB/s")
+    if ns.out:
+        with open(ns.out, "w") as f:
+            json.dump({"method": ns.method.upper(),
+                       "dtype": DTYPE_ALIASES[ns.dtype], "n": ns.n,
+                       "best": best, "ranked": rows}, f, indent=1)
+        print(f"wrote {ns.out}")
+    return 0 if best else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

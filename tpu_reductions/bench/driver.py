@@ -196,28 +196,44 @@ class _PendingResult:
                            cfg.iterations, status, dev_val, host_val, diff)
 
 
-def run_benchmark_batch(cfgs, logger: Optional[BenchLogger] = None):
+def run_benchmark_batch(cfgs, logger: Optional[BenchLogger] = None,
+                        on_result=None):
     """Run several configurations in one process: every timed loop runs
     before ANY device result is materialized, so the tunnel's
     first-materialization sync penalty (see _PendingResult) cannot taint
     config 2..N's measurements. Returns a list of BenchResult.
 
-    Configs whose timed loop materializes on host BY DESIGN (--timing=fetch,
-    --cpufinal) defeat the deferral for every config after them; they are
+    Configs that materialize on host BEFORE later configs' timed loops BY
+    DESIGN (--timing=fetch, --cpufinal in-loop; --check / --trace before
+    the loop) defeat the deferral for every config after them; they are
     allowed (the reference's --cpufinal does host work in-loop too) but
-    flagged, and belong last in a batch — or in their own process."""
+    flagged whenever any non-leaky config comes after a leaky one — order
+    them last, or give them their own process.
+
+    on_result(cfg, result), when given, is called right after each
+    config's finalize — the hook batch callers (sweep_all) use to write
+    per-cell cache files as soon as each cell verifies."""
     cfgs = list(cfgs)
     leaky = [i for i, c in enumerate(cfgs)
-             if c.timing == "fetch" or c.cpu_final]
-    if leaky and max(leaky) < len(cfgs) - 1 and logger is not None:
-        logger.log(f"WARNING: config(s) {leaky} materialize on host inside "
-                   "their timed loop (--timing=fetch/--cpufinal); on the "
-                   "tunneled platform this degrades sync latency for every "
-                   "LATER config in the batch — order them last")
+             if c.timing == "fetch" or c.cpu_final or c.check
+             or c.trace_dir]
+    tainted = ([i for i in range(min(leaky) + 1, len(cfgs))
+                if i not in set(leaky)] if leaky else [])
+    if tainted and logger is not None:
+        logger.log(f"WARNING: config(s) {leaky} materialize on host before "
+                   "later timed loops (--timing=fetch/--cpufinal/--check/"
+                   "--trace); on the tunneled platform this degrades sync "
+                   f"latency for later config(s) {tainted} — order leaky "
+                   "configs last")
     pendings = [run_benchmark(cfg, logger=logger, defer=True)
                 for cfg in cfgs]
-    return [p.finalize() if isinstance(p, _PendingResult) else p
-            for p in pendings]
+    results = []
+    for cfg, p in zip(cfgs, pendings):
+        res = p.finalize() if isinstance(p, _PendingResult) else p
+        if on_result is not None:
+            on_result(cfg, res)
+        results.append(res)
+    return results
 
 
 def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
@@ -313,7 +329,8 @@ def main(argv=None) -> int:
     if shmoo:
         # Implemented, unlike the reference's stub (reduction.cpp:577-580).
         from tpu_reductions.bench.sweep import run_shmoo
-        results = run_shmoo(cfg, logger=logger)
+        results = run_shmoo(cfg, min_pow=shmoo[0], max_pow=shmoo[1],
+                            logger=logger)
         ok = all(r.passed or r.status == QAStatus.WAIVED for r in results)
         return qa_finish(name, QAStatus.PASSED if ok else QAStatus.FAILED)
 

@@ -21,7 +21,7 @@ from pathlib import Path
 from typing import List, Optional
 
 from tpu_reductions.bench.driver import (BenchResult, _resolve_backend,
-                                         run_benchmark, run_benchmark_batch)
+                                         run_benchmark_batch)
 from tpu_reductions.config import ReduceConfig
 from tpu_reductions.utils.logging import BenchLogger
 
@@ -174,14 +174,13 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                 queued.append((len(rows), rep, fname, cfg))
                 rows.append(None)  # placeholder, filled in phase 2
     # Time the whole queue first (no materialization — see above), then
-    # finalize cell by cell, writing each cache file as soon as its cell
-    # verifies so an interrupt mid-finalize loses at most the tail.
-    from tpu_reductions.bench.driver import _PendingResult
-    pendings = [run_benchmark(cfg, logger=logger, defer=True)
-                for _, _, _, cfg in queued]
-    for (idx, rep, fname, cfg), pending in zip(queued, pendings):
-        res = (pending.finalize() if isinstance(pending, _PendingResult)
-               else pending)
+    # finalize cell by cell; run_benchmark_batch's on_result hook writes
+    # each cache file as soon as its cell verifies so an interrupt
+    # mid-finalize loses at most the tail.
+    cells = iter(queued)
+
+    def on_result(cfg, res):
+        idx, rep, fname, _ = next(cells)
         row = res.to_dict()
         row["repeat"] = rep
         rows[idx] = row
@@ -194,4 +193,7 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
             tmp = fname.with_suffix(".json.tmp")
             tmp.write_text(json.dumps(row) + "\n")
             tmp.replace(fname)
+
+    run_benchmark_batch([cfg for _, _, _, cfg in queued], logger=logger,
+                        on_result=on_result)
     return rows

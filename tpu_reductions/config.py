@@ -207,8 +207,14 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
     p.add_argument("--device", type=int, default=None,
                    help="Device index (--device analog)")
     p.add_argument("--shmoo", action="store_true",
-                   help="Run the size sweep 2^10..2^24 (implemented, unlike "
-                        "the reference's stub at reduction.cpp:577-580)")
+                   help="Run the size sweep 2^shmoo-min..2^shmoo-max "
+                        "(implemented, unlike the reference's stub at "
+                        "reduction.cpp:577-580)")
+    p.add_argument("--shmoo-min", dest="shmoo_min", type=int, default=10,
+                   help="Smallest shmoo size as a power of two (default 10)")
+    p.add_argument("--shmoo-max", dest="shmoo_max", type=int, default=24,
+                   help="Largest shmoo size as a power of two (default 24; "
+                        "BASELINE config #5 sweeps to 30)")
     p.add_argument("--logfile", dest="log_file", type=str,
                    default="reduction.txt")
     p.add_argument("--masterlog", dest="master_log", type=str, default=None)
@@ -232,8 +238,10 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
 
 
 def parse_single_chip(argv=None):
-    """Parse CLI args -> (ReduceConfig, shmoo: bool).
+    """Parse CLI args -> (ReduceConfig, shmoo).
 
+    shmoo is None unless --shmoo was given, in which case it is the
+    (min_pow, max_pow) size range — truthy, so `if shmoo:` keeps working.
     Exits with an error if --method is missing, mirroring the reference's
     required-flag behavior (reduction.cpp:124-128).
     """
@@ -257,7 +265,10 @@ def parse_single_chip(argv=None):
         check=ns.check, timing=ns.timing, stat=ns.stat,
     )
     _apply_platform(ns)
-    return cfg, ns.shmoo
+    if ns.shmoo and not 0 < ns.shmoo_min <= ns.shmoo_max:
+        p.error(f"--shmoo-min/--shmoo-max must satisfy 0 < min <= max, "
+                f"got {ns.shmoo_min}/{ns.shmoo_max}")
+    return cfg, ((ns.shmoo_min, ns.shmoo_max) if ns.shmoo else None)
 
 
 def _apply_platform(ns) -> None:

@@ -317,8 +317,12 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
         # Multi-pass: keep relaunching the kernel on the partials while more
         # than cpu_thresh rows remain and a further pass is worthwhile
         # (reduction.cpp:343-357). Sizes are static, so this Python loop
-        # unrolls at trace time into a fixed pass chain.
-        while partials.shape[0] > max(cpu_thresh, 1) and partials.shape[0] > SUBLANES:
+        # unrolls at trace time into a fixed pass chain. The floor is the
+        # partials' OWN sublane tile (16 rows for bf16 min/max, 8 for
+        # 32-bit) — one block is as small as a pass can get, so comparing
+        # against the 32-bit constant would spin forever on bf16.
+        while (partials.shape[0] > max(cpu_thresh, 1)
+               and partials.shape[0] > sublanes_for(partials.dtype)):
             tm2, p2, t2 = choose_tiling(partials.size, threads,
                                         max_blocks, partials.dtype)
             x2 = stage_padded(partials, tm2, p2, t2, op)
@@ -359,9 +363,10 @@ def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
         def device_fn(x2d):
             partials = two_pass_call(x2d, op, tm, p, t, interpret=interpret)
             # static pass chain (sizes known at trace time) — the
-            # relaunch-while-too-many-partials loop of reduction.cpp:343-357
+            # relaunch-while-too-many-partials loop of reduction.cpp:343-357;
+            # floor = the partials' own sublane tile (see pallas_reduce)
             while (partials.shape[0] > max(cpu_thresh, 1)
-                   and partials.shape[0] > SUBLANES):
+                   and partials.shape[0] > sublanes_for(partials.dtype)):
                 tm2, p2, t2 = choose_tiling(partials.size, threads,
                                             max_blocks, partials.dtype)
                 x2 = stage_padded(partials, tm2, p2, t2, op)
