@@ -7,9 +7,14 @@ course's GPU). vs_baseline = our GB/s / 90.8413.
 
 Autotunes over a small candidate set — the (kernel, threads) knobs the
 reference exposes as --kernel/--threads — and reports the fastest
-VERIFIED configuration. All candidates are timed before any result is
-materialized (run_benchmark_batch), and the per-iteration statistic is
-the median, which shrugs off the tunneled platform's occasional sync
+VERIFIED configuration. Timing is the chained slope mode
+(--timing=chained, ops/chain.py): K data-dependent iterations inside one
+compiled program, timed to host materialization at two trip counts, per
+-iteration time = the slope. This is the only honest mode on this
+platform — its tunneled PJRT backend acknowledges dispatches without
+awaiting execution, so per-launch synced timing reads a flat ~20-30 us
+ack floor regardless of N (utils/calibrate.py measures and flags this).
+The per-slope statistic is the median, which shrugs off multi-ms tunnel
 stalls; a FAILED verify disqualifies a candidate so a wrong-but-fast
 kernel can't score.
 """
@@ -41,7 +46,8 @@ def main() -> int:
     from tpu_reductions.utils.logging import BenchLogger
 
     base = ReduceConfig(method="SUM", dtype="int32", n=1 << 24,
-                        iterations=50, warmup=2, stat="median",
+                        iterations=64, warmup=2, stat="median",
+                        timing="chained", chain_reps=5,
                         log_file=None)
     cfgs = [dataclasses.replace(base, backend=b, kernel=k, threads=t)
             for b, k, t in CANDIDATES]

@@ -1,0 +1,154 @@
+"""Chained-timing path: correctness of the data-dependent chain, slope
+timing, driver integration, and the calibration diagnostic.
+
+The chain exists because a tunneled backend's sync primitive may not
+await execution (ops/chain.py); these tests pin its semantics on the
+honest CPU platform where both timing styles must agree.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_reductions.config import ReduceConfig
+from tpu_reductions.ops.chain import make_chained_reduce
+from tpu_reductions.ops.pallas_reduce import (choose_tiling,
+                                              make_staged_core,
+                                              stage_padded)
+from tpu_reductions.ops.registry import get_op
+from tpu_reductions.utils.timing import time_chained
+
+
+def _numpy_chain(x2d: np.ndarray, method: str, k: int):
+    """Simulate the chain: reduce, fold the scalar into [0,0], repeat.
+    Returns the k-th reduction result."""
+    op = get_op(method)
+    x = x2d.copy()
+    last = None
+    for _ in range(k):
+        last = op.np_reduce(x.ravel())
+        x[0, 0] = op.np_reduce(
+            np.array([x[0, 0], last], dtype=x.dtype))
+    return last
+
+
+@pytest.mark.parametrize("method", ["SUM", "MIN", "MAX"])
+@pytest.mark.parametrize("k", [1, 3])
+def test_chained_xla_matches_numpy_chain(method, k):
+    op = get_op(method)
+    rng = np.random.default_rng(0)
+    x = rng.integers(-100, 100, size=1 << 12).astype(np.int32)
+    tm, p, t = choose_tiling(x.size, dtype="int32")
+    x2d = np.asarray(stage_padded(x, tm, p, t, op))
+    chained = make_chained_reduce(op.jnp_reduce, op)
+    got = np.asarray(jax.device_get(chained(x2d, k)))
+    expect = _numpy_chain(x2d, method, k)
+    assert got == expect
+
+
+@pytest.mark.parametrize("kernel", [6, 7, 8])
+def test_chained_pallas_core_matches_numpy_chain(kernel):
+    method = "SUM"
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 255, size=(1 << 12) + 37).astype(np.int32)
+    op, stage_fn, core = make_staged_core(method, x.size, "int32",
+                                          kernel=kernel)
+    x2d = stage_fn(x)
+    chained = make_chained_reduce(core, op)
+    got = np.asarray(jax.device_get(chained(x2d, 3)))
+    expect = _numpy_chain(np.asarray(x2d), method, 3)
+    assert got == expect
+
+
+def test_chained_k_is_dynamic_one_compile():
+    """k is a traced argument: one executable must serve several trip
+    counts (one tunnel compile, many timings)."""
+    op = get_op("SUM")
+    x = np.arange(1 << 10, dtype=np.float32)
+    tm, p, t = choose_tiling(x.size, dtype="float32")
+    x2d = stage_padded(x, tm, p, t, op)
+    chained = make_chained_reduce(op.jnp_reduce, op)
+    r1 = chained(x2d, 1)
+    r5 = chained(x2d, 5)
+    assert chained._cache_size() == 1
+    assert np.isfinite(float(r1)) and np.isfinite(float(r5))
+
+
+def test_chained_does_not_mutate_staged_input():
+    """The perturbation happens on the loop carry inside jit — the
+    caller's staged buffer (reused for verification) must be untouched."""
+    op = get_op("SUM")
+    x = np.arange(1 << 10, dtype=np.int32)
+    tm, p, t = choose_tiling(x.size, dtype="int32")
+    x2d = jax.device_put(stage_padded(x, tm, p, t, op))
+    before = np.asarray(x2d).copy()
+    chained = make_chained_reduce(op.jnp_reduce, op)
+    jax.device_get(chained(x2d, 4))
+    assert np.array_equal(np.asarray(x2d), before)
+
+
+def test_time_chained_books_slope_samples():
+    op = get_op("SUM")
+    x = np.arange(1 << 16, dtype=np.float32)
+    tm, p, t = choose_tiling(x.size, dtype="float32")
+    x2d = jax.device_put(stage_padded(x, tm, p, t, op))
+    chained = make_chained_reduce(op.jnp_reduce, op)
+    sw = time_chained(chained, x2d, k_lo=1, k_hi=9, reps=3)
+    assert sw.sessions == 3 and len(sw.samples) == 3
+    # CPU is an honest platform: the median slope must be positive
+    assert sw.median_s > 0
+
+
+def test_time_chained_rejects_bad_span():
+    with pytest.raises(ValueError):
+        time_chained(lambda x, k: x, None, k_lo=5, k_hi=5)
+
+
+def test_driver_chained_mode_end_to_end():
+    from tpu_reductions.bench.driver import run_benchmark
+    cfg = ReduceConfig(method="SUM", dtype="int32", n=1 << 21,
+                       iterations=16, chain_reps=3, timing="chained",
+                       stat="median", log_file=None)
+    res = run_benchmark(cfg)
+    assert res.passed, res.waived_reason
+    assert res.gbps > 0
+
+
+def test_driver_chained_falls_back_for_cpufinal():
+    from tpu_reductions.bench.driver import run_benchmark
+    cfg = ReduceConfig(method="MAX", dtype="int32", n=1 << 12,
+                       iterations=2, timing="chained", cpu_final=True,
+                       kernel=7, log_file=None)
+    res = run_benchmark(cfg)   # must not crash; falls back to fetch
+    assert res.passed
+
+
+def test_config_validates_chained_fields():
+    cfg = ReduceConfig(method="SUM", timing="chained")
+    assert cfg.chain_reps == 5
+    with pytest.raises(ValueError):
+        ReduceConfig(method="SUM", timing="chained", chain_reps=0)
+    with pytest.raises(ValueError):
+        ReduceConfig(method="SUM", timing="nonsense")
+
+
+def test_cli_parses_chained_flags():
+    from tpu_reductions.config import parse_single_chip
+    cfg, shmoo = parse_single_chip(
+        ["--method=SUM", "--timing=chained", "--chainreps=3"])
+    assert cfg.timing == "chained" and cfg.chain_reps == 3
+
+
+def test_calibrate_on_cpu_is_honest():
+    from tpu_reductions.utils.calibrate import calibrate
+    cal = calibrate(n=1 << 20, iters=4, reps=5, chain_span=8)
+    assert cal.platform == "cpu"
+    assert cal.block_awaits_execution   # CPU blocking is real
+    assert cal.chained_per_iter_s > 0
+    assert cal.honest_gbps > 0
+    text = cal.describe()
+    assert "trustworthy" in text
+    d = cal.to_dict()
+    assert d["block_awaits_execution"] is True

@@ -10,11 +10,12 @@ races a candidate grid and reports the fastest VERIFIED configuration
 (SURVEY.md §7 step 3: "tile-shape autotuning replaces the
 threads/maxblocks knobs").
 
-All candidates are timed before any result is materialized
-(driver.run_benchmark_batch) so the tunneled platform's
-first-materialization sync penalty cannot taint later candidates, and a
-FAILED verify disqualifies a candidate so a wrong-but-fast kernel can
-never win.
+Timing defaults to the chained slope mode (--timing=chained,
+ops/chain.py): on the tunneled TPU, per-launch synced timing reads a
+flat dispatch-ack floor regardless of tile geometry (utils/calibrate.py),
+which would make every candidate score identically and the ranking pure
+noise. A FAILED verify disqualifies a candidate so a wrong-but-fast
+kernel can never win.
 
 CLI:
     python -m tpu_reductions.bench.autotune --method=SUM --type=int \
@@ -89,6 +90,11 @@ def main(argv=None) -> int:
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--stat", type=str, default="median",
                    choices=("mean", "median"))
+    p.add_argument("--timing", type=str, default="chained",
+                   choices=("periter", "bulk", "fetch", "chained"),
+                   help="Sync discipline; chained is the only honest "
+                        "mode on the tunneled TPU (ops/chain.py)")
+    p.add_argument("--chainreps", dest="chain_reps", type=int, default=5)
     p.add_argument("--platform", type=str, default=None,
                    choices=("cpu", "tpu"))
     p.add_argument("--out", type=str, default=None,
@@ -104,7 +110,8 @@ def main(argv=None) -> int:
 
     base = ReduceConfig(method=ns.method, dtype=ns.dtype, n=ns.n,
                         iterations=ns.iterations, warmup=ns.warmup,
-                        stat=ns.stat, log_file=None)
+                        stat=ns.stat, timing=ns.timing,
+                        chain_reps=ns.chain_reps, log_file=None)
     logger = BenchLogger(None, None, console=sys.stderr)
     pairs = autotune(base, logger=logger)
     rows = []

@@ -117,6 +117,34 @@ def _make_device_fn(cfg: ReduceConfig, backend: str):
     return stage_fn, reduce_fn
 
 
+def _make_chained_fn(cfg: ReduceConfig, backend: str):
+    """Build the jitted chained reduction `chained(x2d, k)` for honest
+    slope timing (ops/chain.py), or None when the configuration cannot be
+    chained on-device: --cpufinal does host work inside the timed region
+    by definition (reduction.cpp:328-340), and the f64-on-TPU
+    double-double path finishes on host (dd_reduce.py)."""
+    import jax
+
+    if cfg.cpu_final:
+        return None
+    if cfg.dtype == "float64" and jax.default_backend() == "tpu":
+        return None
+
+    from tpu_reductions.ops.chain import make_chained_reduce
+
+    if backend == "xla":
+        from tpu_reductions.ops.registry import get_op
+        op = get_op(cfg.method)
+        return make_chained_reduce(op.jnp_reduce, op)
+
+    from tpu_reductions.ops.pallas_reduce import make_staged_core
+    op, _stage, core = make_staged_core(
+        cfg.method, cfg.n, cfg.dtype, threads=cfg.threads,
+        max_blocks=cfg.max_blocks, kernel=cfg.kernel,
+        cpu_thresh=cfg.cpu_thresh)
+    return make_chained_reduce(core, op)
+
+
 def _make_logger(cfg: ReduceConfig) -> BenchLogger:
     """--qatest batch mode (shrQATest.h:90-97): machine-readable only —
     QA markers and log files, no narrative console output."""
@@ -157,14 +185,18 @@ class _PendingResult:
     """A timed-but-unverified run: the device result has NOT been
     materialized on the host yet.
 
-    Rationale: on the tunneled TPU platform, the first device->host
-    materialization in a process permanently degrades every subsequent
-    host-device sync round-trip to ~70 ms (measured; the reference had no
-    such hazard because each benchmark was its own process —
-    mpi/submit_all.sh's one-job-per-config structure). Batch runs
-    therefore time ALL configs first and materialize/verify afterwards
-    (run_benchmark_batch); the host-oracle value is computed eagerly here
-    because it never touches the device."""
+    Rationale: on the tunneled TPU platform the sync primitive behaves
+    differently before and after a process's first device->host
+    materialization — pre-fetch, `block_until_ready` returns on dispatch
+    ack (fake-fast); post-fetch, it pays real execution plus ~tens of ms
+    of tunnel latency (utils/calibrate.py measures both regimes). Legacy
+    per-launch timing modes (periter/bulk) are therefore only mutually
+    comparable while the process has materialized nothing, so batch runs
+    time ALL configs first and materialize/verify afterwards
+    (run_benchmark_batch). The chained mode needs no such care — its
+    slope cancels constant costs in either regime — but keeps the same
+    deferral so mixed batches stay well-ordered. The host-oracle value is
+    computed eagerly here because it never touches the device."""
 
     cfg: ReduceConfig
     backend: str
@@ -199,32 +231,35 @@ class _PendingResult:
 def run_benchmark_batch(cfgs, logger: Optional[BenchLogger] = None,
                         on_result=None):
     """Run several configurations in one process: every timed loop runs
-    before ANY device result is materialized, so the tunnel's
-    first-materialization sync penalty (see _PendingResult) cannot taint
-    config 2..N's measurements. Returns a list of BenchResult.
+    before ANY device result is materialized, so all legacy-mode timings
+    happen in the same pre-fetch sync regime (see _PendingResult) and
+    stay mutually comparable. Returns a list of BenchResult.
 
     Configs that materialize on host BEFORE later configs' timed loops BY
-    DESIGN (--timing=fetch, --cpufinal in-loop; --check / --trace before
-    the loop) defeat the deferral for every config after them; they are
-    allowed (the reference's --cpufinal does host work in-loop too) but
-    flagged whenever any non-leaky config comes after a leaky one — order
-    them last, or give them their own process.
+    DESIGN (--timing=fetch or --timing=chained, --cpufinal in-loop;
+    --check / --trace before the loop) flip the process into the
+    post-fetch regime for every config after them; they are allowed (the
+    reference's --cpufinal does host work in-loop too) but flagged
+    whenever any non-leaky config comes after a leaky one — order them
+    last, or give them their own process. Chained configs are themselves
+    immune (the slope cancels regime constants) — an all-chained batch
+    warns about nothing.
 
     on_result(cfg, result), when given, is called right after each
     config's finalize — the hook batch callers (sweep_all) use to write
     per-cell cache files as soon as each cell verifies."""
     cfgs = list(cfgs)
     leaky = [i for i, c in enumerate(cfgs)
-             if c.timing == "fetch" or c.cpu_final or c.check
+             if c.timing in ("fetch", "chained") or c.cpu_final or c.check
              or c.trace_dir]
     tainted = ([i for i in range(min(leaky) + 1, len(cfgs))
                 if i not in set(leaky)] if leaky else [])
     if tainted and logger is not None:
         logger.log(f"WARNING: config(s) {leaky} materialize on host before "
-                   "later timed loops (--timing=fetch/--cpufinal/--check/"
-                   "--trace); on the tunneled platform this degrades sync "
-                   f"latency for later config(s) {tainted} — order leaky "
-                   "configs last")
+                   "later timed loops (--timing=fetch/--timing=chained/"
+                   "--cpufinal/--check/--trace); on the tunneled platform "
+                   "this flips the sync regime for later config(s) "
+                   f"{tainted} — order leaky configs last")
     pendings = [run_benchmark(cfg, logger=logger, defer=True)
                 for cfg in cfgs]
     results = []
@@ -299,9 +334,35 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
 
     # Warm-up (reduction.cpp:729) + timed, synced iterations
     # (reduction.cpp:731, sync points :319,373) via the shared discipline.
-    result, sw = time_fn(reduce_fn, x_dev, iterations=cfg.iterations,
-                         warmup=max(cfg.warmup, 1), mode=cfg.timing)
-    avg_s = sw.average_s if cfg.stat == "mean" else sw.median_s
+    timing_mode = cfg.timing
+    chained = _make_chained_fn(cfg, backend) if timing_mode == "chained" \
+        else None
+    if timing_mode == "chained" and chained is None:
+        logger.log("NOTE: timing=chained needs an all-device reduce "
+                   "(--cpufinal and the f64 dd path finish on host); "
+                   "falling back to timing=fetch")
+        timing_mode = "fetch"
+    if chained is not None:
+        from tpu_reductions.utils.timing import time_chained
+        sw = time_chained(chained, x_dev, k_lo=1,
+                          k_hi=1 + cfg.iterations, reps=cfg.chain_reps)
+        avg_s = sw.average_s if cfg.stat == "mean" else sw.median_s
+        if avg_s <= 0:
+            # every constant cancelled and noise still swamped the signal
+            # — refuse to report a bandwidth from a non-positive slope.
+            # (Return BEFORE dispatching the verification reduce: nothing
+            # may be left in flight on the tunnel when a caller exits.)
+            return BenchResult(cfg.method, cfg.dtype, cfg.n, backend,
+                               cfg.kernel, 0.0, avg_s, cfg.iterations,
+                               QAStatus.WAIVED, float("nan"), float("nan"),
+                               float("nan"),
+                               waived_reason="chained timing slope non-"
+                                             "positive (interconnect noise)")
+        result = reduce_fn(x_dev)   # untimed — the verification value
+    else:
+        result, sw = time_fn(reduce_fn, x_dev, iterations=cfg.iterations,
+                             warmup=max(cfg.warmup, 1), mode=timing_mode)
+        avg_s = sw.average_s if cfg.stat == "mean" else sw.median_s
     gbps = (cfg.nbytes / avg_s) / 1e9 if avg_s > 0 else float("inf")
 
     # The canonical throughput line (reduction.cpp:744-745) -> master log.

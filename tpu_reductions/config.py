@@ -89,7 +89,8 @@ class ReduceConfig:
     verify: bool = True
     trace_dir: Optional[str] = None  # jax.profiler trace capture dir
     check: bool = False              # compiled/interpret/XLA consistency
-    timing: str = "periter"          # periter|bulk|fetch (timing.time_fn)
+    timing: str = "periter"          # periter|bulk|fetch|chained
+    chain_reps: int = 5              # slope repetitions for timing=chained
     stat: str = "mean"               # mean (reference parity) | median
                                      # (robust to tunnel sync stalls)
 
@@ -106,9 +107,11 @@ class ReduceConfig:
             raise ValueError("n must be positive")
         if self.threads <= 0 or self.max_blocks <= 0:
             raise ValueError("threads/max_blocks must be positive")
-        if self.timing not in ("periter", "bulk", "fetch"):
-            raise ValueError(f"timing must be periter|bulk|fetch, "
+        if self.timing not in ("periter", "bulk", "fetch", "chained"):
+            raise ValueError(f"timing must be periter|bulk|fetch|chained, "
                              f"got {self.timing!r}")
+        if self.chain_reps <= 0:
+            raise ValueError("chain_reps must be positive")
         if self.stat not in ("mean", "median"):
             raise ValueError(f"stat must be mean|median, got {self.stat!r}")
 
@@ -225,10 +228,15 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
                    help="Run the compiled/interpret/XLA consistency check "
                         "before benchmarking (bank-checker analog)")
     p.add_argument("--timing", type=str, default="periter",
-                   choices=("periter", "bulk", "fetch"),
+                   choices=("periter", "bulk", "fetch", "chained"),
                    help="Sync discipline: periter=reference structure; "
                         "bulk=one span, amortized dispatch; fetch=host "
-                        "round-trip each iteration")
+                        "round-trip each iteration; chained=K data-"
+                        "dependent in-program iterations, slope-timed to "
+                        "host materialization — the honest mode on "
+                        "tunneled/async backends (ops/chain.py)")
+    p.add_argument("--chainreps", dest="chain_reps", type=int, default=5,
+                   help="Slope repetitions for --timing=chained")
     p.add_argument("--stat", type=str, default="mean",
                    choices=("mean", "median"),
                    help="Per-iteration statistic feeding GB/s: mean = "
@@ -262,7 +270,8 @@ def parse_single_chip(argv=None):
         iterations=ns.iterations, warmup=ns.warmup, seed=ns.seed,
         device=ns.device, log_file=ns.log_file, master_log=ns.master_log,
         qatest=ns.qatest, verify=ns.verify, trace_dir=ns.trace_dir,
-        check=ns.check, timing=ns.timing, stat=ns.stat,
+        check=ns.check, timing=ns.timing, chain_reps=ns.chain_reps,
+        stat=ns.stat,
     )
     _apply_platform(ns)
     if ns.shmoo and not 0 < ns.shmoo_min <= ns.shmoo_max:

@@ -1,0 +1,72 @@
+"""Data-dependent chained reduction — honest timing on async backends.
+
+The reference times its hot loop by bracketing every launch with a device
+sync (reduction.cpp:319-320,373-374 around the 100-iteration loop at
+reduction.cpp:731). That discipline assumes the sync primitive actually
+waits for device execution. On a tunneled/async PJRT backend that
+assumption can FAIL: `jax.block_until_ready` may return once the launch
+is acknowledged, long before the kernel runs, so a per-iteration timed
+loop measures dispatch-acknowledgement latency (a flat ~20-30 us floor
+regardless of N — measured on this image's tunneled TPU; a 1 GiB reduce
+"completed" in 26 us, 40x over the chip's HBM roof).
+
+The fix is structural, not statistical: run K iterations *inside one
+compiled program*, each iteration's input data-dependent on the previous
+iteration's result so XLA can neither hoist the loop-invariant reduction
+out of the loop nor elide any iteration, and force completion by
+materializing the final dependent scalar on the host. Timing two trip
+counts K_lo < K_hi and taking the slope
+    (t(K_hi) - t(K_lo)) / (K_hi - K_lo)
+cancels every constant cost — dispatch, tunnel round-trip, compile-cache
+lookup, host sync — leaving the true per-iteration device time. The
+slope estimator is valid on honest platforms too (it is just amortized
+timing), so it is the portable default for bandwidth numbers.
+
+Mechanism: the staged (rows, 128) array is the `lax.fori_loop` carry;
+each step reduces it, then folds the step's scalar into element [0, 0]
+with the op's own combine (a one-element dynamic-update on a loop-carried
+buffer — updated in place by XLA, not copied). The perturbation makes
+iteration i+1's input depend on iteration i's output; it deliberately
+changes the reduced value, so correctness is verified on a separate
+unchained call (bench/driver.py) and the chained scalar is used for
+timing only.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from tpu_reductions.ops.registry import ReduceOpSpec
+
+
+def make_chained_reduce(core: Callable[[jax.Array], jax.Array],
+                        op: ReduceOpSpec):
+    """Wrap a device-only scalar reduction `core(x2d) -> scalar` into
+    `chained(x2d, k) -> scalar` running k data-dependent iterations inside
+    one jitted program.
+
+    `k` is a traced argument (the fori_loop lowers to a while loop), so
+    one executable serves every trip count — one tunnel compile, many
+    timings. The returned scalar transitively depends on every
+    iteration's reduction, so materializing it on the host bounds the
+    completion of all k kernel executions.
+    """
+    def chained(x2d: jax.Array, k) -> jax.Array:
+        out = jax.eval_shape(core, x2d)
+        init = jnp.zeros(out.shape, out.dtype)
+
+        def body(_, carry):
+            x, _last = carry
+            s = core(x)
+            # fold the step scalar into one element: in-place one-element
+            # update on the loop-carried buffer; breaks loop-invariance
+            x = x.at[0, 0].set(op.jnp_combine(x[0, 0], s.astype(x.dtype)))
+            return x, s
+
+        _, last = jax.lax.fori_loop(0, k, body, (x2d, init))
+        return last
+
+    return jax.jit(chained)

@@ -335,19 +335,14 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
                      "(0-5 are WAIVED, mirroring reduction_kernel.cu:278-289)")
 
 
-def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
+def _make_staged_parts(method: str, n: int, dtype, *, threads: int = 256,
                        max_blocks: int = 64, kernel: int = 6,
-                       cpu_final: bool = False, cpu_thresh: int = 1,
+                       cpu_thresh: int = 1,
                        interpret: Optional[bool] = None):
-    """Build (stage_fn, reduce_fn) for benchmarking: `stage_fn` pads/
-    reshapes host data once (outside the timed loop); `reduce_fn` takes
-    the staged (R,128) array and returns the scalar.
-
-    cpu_final/cpu_thresh mirror the reference's finishing knobs
-    (reduction.cpp:328-357): kernel 7 chains extra Pallas passes while
-    more than cpu_thresh partial rows remain; cpu_final fetches the
-    remaining partials and finishes them on host inside the timed region
-    (as --cpufinal does)."""
+    """(op, stage_fn, device_fn): the staging closure and the un-jitted
+    device-only partials function shared by make_staged_reduce (which
+    adds the finish) and make_staged_core (which must stay chainable —
+    ops/chain.py traces it inside a fori_loop)."""
     op = get_op(method)
     tm, p, t = choose_tiling(n, threads, max_blocks, dtype)
 
@@ -374,6 +369,26 @@ def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
                                          interpret=interpret)
             return partials
 
+    return op, stage_fn, device_fn
+
+
+def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
+                       max_blocks: int = 64, kernel: int = 6,
+                       cpu_final: bool = False, cpu_thresh: int = 1,
+                       interpret: Optional[bool] = None):
+    """Build (stage_fn, reduce_fn) for benchmarking: `stage_fn` pads/
+    reshapes host data once (outside the timed loop); `reduce_fn` takes
+    the staged (R,128) array and returns the scalar.
+
+    cpu_final/cpu_thresh mirror the reference's finishing knobs
+    (reduction.cpp:328-357): kernel 7 chains extra Pallas passes while
+    more than cpu_thresh partial rows remain; cpu_final fetches the
+    remaining partials and finishes them on host inside the timed region
+    (as --cpufinal does)."""
+    op, stage_fn, device_fn = _make_staged_parts(
+        method, n, dtype, threads=threads, max_blocks=max_blocks,
+        kernel=kernel, cpu_thresh=cpu_thresh, interpret=interpret)
+
     if cpu_final:
         jit_device = jax.jit(device_fn)
 
@@ -383,3 +398,20 @@ def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
         reduce_fn = jax.jit(lambda x2d: finish(device_fn(x2d), op))
 
     return stage_fn, reduce_fn
+
+
+def make_staged_core(method: str, n: int, dtype, *, threads: int = 256,
+                     max_blocks: int = 64, kernel: int = 6,
+                     cpu_thresh: int = 1,
+                     interpret: Optional[bool] = None):
+    """Build (op, stage_fn, core) with `core(x2d) -> scalar` entirely
+    on-device (no host finish) — the chainable form consumed by
+    ops/chain.make_chained_reduce for honest slope timing."""
+    op, stage_fn, device_fn = _make_staged_parts(
+        method, n, dtype, threads=threads, max_blocks=max_blocks,
+        kernel=kernel, cpu_thresh=cpu_thresh, interpret=interpret)
+
+    def core(x2d):
+        return finish(device_fn(x2d), op)
+
+    return op, stage_fn, core

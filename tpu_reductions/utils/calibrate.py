@@ -1,0 +1,183 @@
+"""Timing-calibration diagnostic: can this platform's sync be trusted?
+
+The reference could take `cutilDeviceSynchronize` at face value
+(reduction.cpp:319,373) — a local CUDA runtime really does block until
+the kernel finishes. A tunneled/async PJRT backend breaks that
+assumption: `jax.block_until_ready` may return on dispatch
+acknowledgement (~tens of us) long before execution, so a synced timed
+loop measures the tunnel, not the kernel (measured here: a 1 GiB reduce
+"completing" in 26 us — 40x the chip's HBM roof). The reference has no
+analog because it never ran over a tunnel; this module is the framework's
+sanity gate for every bandwidth number it prints.
+
+`calibrate()` measures, in hazard-safe order (everything queued is
+drained before exit):
+
+  1. single_blocked_s        median time of one blocked heavy launch,
+                             BEFORE any host materialization
+  2. amortized_blocked_s     per-iteration time of N back-to-back
+                             launches with one final block (pre-mat.)
+  3. roundtrip_s             device_get round trip of the heavy result
+                             (the process's first true materialization)
+  4. chained_per_iter_s      slope-timed chained reduction
+                             (ops/chain.py) — the ground truth: constant
+                             costs cancel, data dependencies forbid
+                             elision
+  5. post_fetch_single_blocked_s   (1) again, after materialization —
+                             documents backends whose blocking becomes
+                             honest once a fetch has occurred
+
+Verdict: block_awaits_execution = single_blocked_s covers at least half
+of chained_per_iter_s. When False, per-iteration synced timing
+(--timing=periter/bulk) is meaningless on this platform and
+--timing=chained is the only honest mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class TimingCalibration:
+    platform: str
+    n: int
+    dtype: str
+    single_blocked_s: float
+    amortized_blocked_s: float
+    roundtrip_s: float
+    chained_per_iter_s: float
+    post_fetch_single_blocked_s: float
+
+    @property
+    def block_awaits_execution(self) -> bool:
+        # A broken sync shows a blocked launch 1-3 orders of magnitude
+        # below the chained ground truth (ack floor vs real kernel time);
+        # an honest one lands within a small factor (the chain adds the
+        # carry-update write, which some backends implement as a copy).
+        return self.single_blocked_s >= 0.25 * self.chained_per_iter_s
+
+    @property
+    def honest_gbps(self) -> float:
+        bytes_ = self.n * np.dtype(self.dtype).itemsize
+        return (bytes_ / self.chained_per_iter_s) / 1e9 \
+            if self.chained_per_iter_s > 0 else float("nan")
+
+    def describe(self) -> str:
+        verdict = ("sync primitive awaits device execution: timed loops "
+                   "are trustworthy"
+                   if self.block_awaits_execution else
+                   "sync primitive does NOT await device execution: "
+                   "per-iteration synced timing is meaningless here — "
+                   "use --timing=chained")
+        return "\n".join([
+            f"timing calibration on platform={self.platform} "
+            f"(heavy op: SUM over {self.n} x {self.dtype})",
+            f"  blocked single launch (pre-fetch) : "
+            f"{self.single_blocked_s * 1e6:10.1f} us",
+            f"  amortized back-to-back (pre-fetch): "
+            f"{self.amortized_blocked_s * 1e6:10.1f} us/iter",
+            f"  host materialization round trip   : "
+            f"{self.roundtrip_s * 1e6:10.1f} us",
+            f"  chained slope (ground truth)      : "
+            f"{self.chained_per_iter_s * 1e6:10.1f} us/iter "
+            f"({self.honest_gbps:.1f} GB/s)",
+            f"  blocked single launch (post-fetch): "
+            f"{self.post_fetch_single_blocked_s * 1e6:10.1f} us",
+            f"  -> {verdict}",
+        ])
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["block_awaits_execution"] = self.block_awaits_execution
+        d["honest_gbps"] = self.honest_gbps
+        return d
+
+
+def calibrate(n: int = 1 << 24, dtype: str = "float32",
+              iters: int = 32, reps: int = 5,
+              chain_span: int = 16) -> TimingCalibration:
+    """Run the calibration ladder on the current default backend."""
+    import jax
+
+    from tpu_reductions.ops.chain import make_chained_reduce
+    from tpu_reductions.ops.pallas_reduce import (choose_tiling,
+                                                  stage_padded)
+    from tpu_reductions.ops.registry import get_op
+    from tpu_reductions.utils.rng import host_data
+    from tpu_reductions.utils.timing import time_chained
+
+    op = get_op("SUM")
+    tm, p, t = choose_tiling(n, dtype=dtype)
+    x2d = jax.block_until_ready(
+        stage_padded(host_data(n, dtype, rank=0), tm, p, t, op))
+    f = jax.jit(op.jnp_reduce)
+    jax.block_until_ready(f(x2d))   # compile, still no materialization
+
+    def blocked_single() -> float:
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(x2d))
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+
+    single = blocked_single()
+
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(iters):
+        r = f(x2d)
+    jax.block_until_ready(r)
+    amortized = (time.perf_counter() - t0) / iters
+
+    # first true materialization — also drains everything queued above,
+    # so an early exit can never abandon in-flight work on the tunnel
+    t0 = time.perf_counter()
+    jax.device_get(r)
+    roundtrip = time.perf_counter() - t0
+
+    chained = make_chained_reduce(op.jnp_reduce, op)
+    sw = time_chained(chained, x2d, k_lo=1, k_hi=1 + chain_span, reps=reps)
+    chained_s = sw.median_s
+
+    post = blocked_single()
+
+    return TimingCalibration(
+        platform=jax.default_backend(), n=n, dtype=dtype,
+        single_blocked_s=single, amortized_blocked_s=amortized,
+        roundtrip_s=roundtrip, chained_per_iter_s=chained_s,
+        post_fetch_single_blocked_s=post)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.utils.calibrate",
+        description="Measure whether this platform's sync primitive can "
+                    "be trusted for benchmark timing")
+    p.add_argument("--n", type=int, default=1 << 24)
+    p.add_argument("--type", dest="dtype", type=str, default="float32")
+    p.add_argument("--iters", type=int, default=32)
+    p.add_argument("--reps", type=int, default=5)
+    p.add_argument("--chainspan", dest="chain_span", type=int, default=16)
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"))
+    ns = p.parse_args(argv)
+    from tpu_reductions.config import _apply_platform
+    _apply_platform(ns)
+    cal = calibrate(n=ns.n, dtype=ns.dtype, iters=ns.iters, reps=ns.reps,
+                    chain_span=ns.chain_span)
+    print(cal.describe())
+    print(json.dumps(cal.to_dict()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -144,3 +144,40 @@ def time_fn(fn: Callable, *args, iterations: int = 100, warmup: int = 1,
             jax.device_get(result)  # full host materialization round-trip
         sw.stop()
     return result, sw
+
+
+def time_chained(chained_fn, x, k_lo: int, k_hi: int, reps: int = 5,
+                 stopwatch: Optional[Stopwatch] = None) -> Stopwatch:
+    """Slope-based per-iteration timing of a chained reduction
+    (ops/chain.py): time `chained_fn(x, k)` to host materialization at two
+    trip counts and divide the difference by (k_hi - k_lo).
+
+    Every constant cost — dispatch acknowledgement, tunnel round-trip,
+    host sync — appears in both measurements and cancels in the slope;
+    what remains is the true per-iteration device time. This is the
+    honest analog of the reference's synced 100-iteration loop
+    (reduction.cpp:731,319,373) on platforms where the sync primitive
+    itself cannot be trusted to await execution (see ops/chain.py).
+
+    Books one slope sample per rep into the stopwatch (median_s is the
+    robust statistic; individual slopes can go negative under multi-ms
+    interconnect stalls and the median shrugs them off).
+    """
+    if not k_lo < k_hi:
+        raise ValueError(f"need k_lo < k_hi, got {k_lo} >= {k_hi}")
+    sw = stopwatch or Stopwatch()
+    span = k_hi - k_lo
+
+    def run(k) -> float:
+        t0 = time.perf_counter()
+        jax.device_get(chained_fn(x, k))   # materialization = completion
+        return time.perf_counter() - t0
+
+    run(k_lo)   # warm-up: compile (k is traced — one executable for both)
+    run(k_hi)   # warm-up: queue drain at the long trip count
+    for _ in range(reps):
+        slope = (run(k_hi) - run(k_lo)) / span
+        sw.total_s += slope
+        sw.sessions += 1
+        sw.samples.append(slope)
+    return sw
