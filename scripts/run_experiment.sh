@@ -40,12 +40,17 @@ out = Path(out_dir)
 log = BenchLogger(None, None)
 n_avail = len(jax.devices())
 ranks = [k for k in (2, 4, 8, 16, 32) if k <= n_avail] or [1]
+# On the tunneled TPU, per-launch synced timing reads the dispatch-ack
+# floor, not the kernel (utils/calibrate.py): use the chained slope mode
+# there; the CPU's sync is honest and periter keeps reference parity.
+timing = "chained" if jax.default_backend() == "tpu" else "periter"
+log.log(f"timing discipline: {timing}")
 
 # 1) single-chip grid (runTest analog) -> single-chip overlay numbers.
 # Lands in its own raw dir: single-chip rows use a per-kernel-iteration
 # timing convention incomparable with the collective rows, so they must
 # not leak into the vs-ranks averages.
-sc_rows = sweep_all(n=1 << 22, repeats=2, iterations=10,
+sc_rows = sweep_all(n=1 << 22, repeats=2, iterations=10, timing=timing,
                     out_dir=str(out / "single_chip"), logger=log)
 sc = {}
 for r in sc_rows:
@@ -56,7 +61,7 @@ for r in sc_rows:
 sc = {k: sum(v) / len(v) for k, v in sc.items()}
 
 # 2) collective rank sweep (submit_all.sh analog)
-sweep_collective(rank_counts=ranks, n=1 << 20, retries=3,
+sweep_collective(rank_counts=ranks, n=1 << 20, retries=3, timing=timing,
                  out_dir=str(out), logger=log)
 
 # 3) aggregate (getAvgs.sh analog)

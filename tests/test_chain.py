@@ -152,3 +152,26 @@ def test_calibrate_on_cpu_is_honest():
     assert "trustworthy" in text
     d = cal.to_dict()
     assert d["block_awaits_execution"] is True
+
+
+def test_chained_fallback_records_actual_timing(monkeypatch):
+    """When chained was asked but impossible (f64 dd path, --cpufinal),
+    the result must record the discipline actually used so sweep resume
+    caches can never launder a fetch measurement as a chained one."""
+    import tpu_reductions.bench.driver as drv
+    monkeypatch.setattr(drv, "_make_chained_fn", lambda cfg, backend: None)
+    cfg = ReduceConfig(method="SUM", dtype="int32", n=1 << 12,
+                       iterations=2, timing="chained", log_file=None)
+    res = drv.run_benchmark(cfg)
+    assert res.passed
+    assert res.timing == "fetch"
+
+
+def test_chained_result_records_chained_timing():
+    from tpu_reductions.bench.driver import run_benchmark
+    cfg = ReduceConfig(method="SUM", dtype="int32", n=1 << 21,
+                       iterations=16, chain_reps=3, timing="chained",
+                       stat="median", log_file=None)
+    res = run_benchmark(cfg)
+    if res.passed:
+        assert res.timing == "chained"

@@ -132,3 +132,25 @@ def test_graft_entry_contract():
     out = jax.jit(fn)(*args)
     assert np.asarray(out).shape == ()
     ge.dryrun_multichip(8)  # asserts internally
+
+
+def test_sweep_collective_chained_timing(tmp_path):
+    from tpu_reductions.bench.sweep import sweep_collective
+    rows = sweep_collective(rank_counts=(4,), methods=("SUM",),
+                            dtypes=("int32",), n=1 << 12, retries=2,
+                            timing="chained", chain_span=2,
+                            out_dir=str(tmp_path))
+    assert len(rows) == 2
+    assert all(r["status"] in ("PASSED", "WAIVED") for r in rows)
+
+
+def test_sweep_all_resume_keyed_on_timing(tmp_path):
+    """A cell cached under periter must NOT be resumed by a chained sweep
+    — the disciplines measure different things."""
+    from tpu_reductions.bench.sweep import sweep_all
+    kw = dict(methods=("SUM",), dtypes=("int32",), n=1 << 12, repeats=1,
+              iterations=2, out_dir=str(tmp_path))
+    first = sweep_all(timing="periter", **kw)
+    assert first[0]["timing"] == "periter"
+    second = sweep_all(timing="chained", chain_reps=2, **kw)
+    assert second[0]["timing"] == "chained"

@@ -143,6 +143,61 @@ def run_collective_benchmark(cfg: CollectiveConfig,
     if cfg.verify:
         expect = host_collective_oracle(x_np, k, method)
 
+    timing = cfg.timing
+    if timing == "chained" and dd_planes:
+        # the pair collectives carry (hi, lo) planes; the chain folds a
+        # single scalar back into one carried array — not pair-shaped.
+        logger.log("note: timing=chained is not supported on the f64 "
+                   "pair paths; falling back to periter")
+        timing = "periter"
+
+    if timing == "chained":
+        # Honest slope mode (ops/chain.py): reduce.c's rdtsc-bracketed
+        # per-collective timing (reduce.c:73-77) assumes a sync that
+        # really waits; on the tunneled platform it does not, so each
+        # "retry" row here is one slope sample over chain_span
+        # data-dependent in-program collectives. Chains the SAME closure
+        # that was warmed up and verified above.
+        import statistics
+
+        from tpu_reductions.parallel.collectives import \
+            make_chained_collective
+        from tpu_reductions.utils.timing import time_chained
+        chained = make_chained_collective(method, mesh, axis,
+                                          rooted=rooted, coll=run)
+        sw = time_chained(chained, x_dev, k_lo=1, k_hi=1 + cfg.chain_span,
+                          reps=cfg.retries)
+        status = QAStatus.PASSED
+        if cfg.verify and expect is not None:
+            got = _gather_result(out, method, cfg, k, dd_planes)
+            status = (QAStatus.PASSED
+                      if _check(got, expect, method, dtype, cfg)
+                      else QAStatus.FAILED)
+        pos = [s for s in sw.samples if s > 0]
+        if not pos:
+            # noise swamped every slope — one WAIVED row, never a FAILED
+            # bandwidth claim
+            results.append(CollectiveResult(
+                method, dtype, cfg.n, k, 0, rooted, 0.0, 0.0, 0.0,
+                QAStatus.WAIVED))
+            return results
+        med = statistics.median(pos)
+        for rep, dt in enumerate(sw.samples):
+            if dt <= 0:
+                # an individual stall-poisoned slope: substitute the
+                # median of the clean samples (time_chained's documented
+                # robustness statistic) rather than waiving the rep
+                logger.log(f"note: rep {rep} slope non-positive "
+                           f"(interconnect stall); using median")
+                dt = med
+            bw = bandwidth_report(payload_bytes, k, dt, rooted=rooted)
+            logger.log(collective_row(dtype, method, k,
+                                      bw["reference_gbps"]))
+            results.append(CollectiveResult(
+                method, dtype, cfg.n, k, rep, rooted, dt,
+                bw["reference_gbps"], bw["busbw_gbps"], status))
+        return results
+
     for rep in range(cfg.retries):
         sw = Stopwatch()
         sw.start()
@@ -232,7 +287,9 @@ def main(argv=None) -> int:
     except Exception as e:  # fail-fast with the QA protocol intact
         logger.log(f"error: {type(e).__name__}: {e}")
         return qa_finish(name, QAStatus.FAILED)
-    ok = all(r.passed for r in results)
+    # WAIVED rows (noise-swamped chained slopes, unsupported combos) are
+    # not failures — same tolerance as the single-chip shmoo exit
+    ok = all(r.passed or r.status == QAStatus.WAIVED for r in results)
     return qa_finish(name, QAStatus.PASSED if ok else QAStatus.FAILED)
 
 

@@ -51,6 +51,11 @@ class BenchResult:
     oracle_result: float
     abs_diff: float
     waived_reason: Optional[str] = None
+    timing: Optional[str] = None     # discipline actually used — may be
+                                     # the fetch fallback when chained was
+                                     # requested but impossible (dd path,
+                                     # --cpufinal); sweeps key resume
+                                     # caches on this, never on the ask
 
     @property
     def passed(self) -> bool:
@@ -173,7 +178,8 @@ def run_benchmark(cfg: ReduceConfig, logger: Optional[BenchLogger] = None,
                                cfg.kernel, 0.0, 0.0, 0, QAStatus.WAIVED,
                                float("nan"), float("nan"), float("nan"),
                                waived_reason=f"device {cfg.device} not "
-                                             f"present ({len(devs)} found)")
+                                             f"present ({len(devs)} found)",
+                               timing=cfg.timing)
         with jax.default_device(devs[cfg.device]):
             return _run_benchmark_inner(
                 dataclasses.replace(cfg, device=None), logger, defer)
@@ -205,6 +211,8 @@ class _PendingResult:
     result: object        # un-materialized device array
     host_val_raw: object  # host-oracle result (never touched the device)
     logger: BenchLogger
+    timing: Optional[str] = None   # discipline actually used (may be the
+                                   # fetch fallback — see BenchResult)
 
     def finalize(self) -> BenchResult:
         import jax
@@ -225,7 +233,8 @@ class _PendingResult:
             self.logger.log(f"CPU result = {host_val!r} (tolerance {tol:g})")
         return BenchResult(cfg.method, cfg.dtype, cfg.n, self.backend,
                            cfg.kernel, self.gbps, self.avg_s,
-                           cfg.iterations, status, dev_val, host_val, diff)
+                           cfg.iterations, status, dev_val, host_val, diff,
+                           timing=self.timing or cfg.timing)
 
 
 def run_benchmark_batch(cfgs, logger: Optional[BenchLogger] = None,
@@ -282,7 +291,8 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
                            cfg.kernel, 0.0, 0.0, 0, QAStatus.WAIVED,
                            float("nan"), float("nan"), float("nan"),
                            waived_reason=f"kernel {cfg.kernel} not live "
-                                         f"(live: {LIVE_KERNELS})")
+                                         f"(live: {LIVE_KERNELS})",
+                           timing=cfg.timing)
 
     backend = _resolve_backend(cfg)
 
@@ -297,8 +307,10 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
                 return BenchResult(cfg.method, cfg.dtype, cfg.n, backend,
                                    cfg.kernel, 0.0, 0.0, 0, QAStatus.WAIVED,
                                    float("nan"), float("nan"), float("nan"),
-                                   waived_reason="no native f64 on TPU; use "
-                                                 "backend=pallas (dd path)")
+                                   waived_reason="no native f64 on TPU; "
+                                                 "use backend=pallas (dd "
+                                                 "path)",
+                                   timing=cfg.timing)
         else:
             jax.config.update("jax_enable_x64", True)
     # Host payload (reduction.cpp:698-705 analog), native filler when built.
@@ -321,7 +333,8 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
             return BenchResult(cfg.method, cfg.dtype, cfg.n, backend,
                                cfg.kernel, 0.0, 0.0, 0, QAStatus.FAILED,
                                report.compiled, report.oracle,
-                               abs(report.compiled - report.oracle))
+                               abs(report.compiled - report.oracle),
+                               timing=cfg.timing)
 
     stage_fn, reduce_fn = _make_device_fn(cfg, backend)
     x_dev = jax.block_until_ready(stage_fn(x_np))   # H2D + pad, untimed
@@ -357,7 +370,8 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
                                QAStatus.WAIVED, float("nan"), float("nan"),
                                float("nan"),
                                waived_reason="chained timing slope non-"
-                                             "positive (interconnect noise)")
+                                             "positive (interconnect noise)",
+                               timing="chained")
         result = reduce_fn(x_dev)   # untimed — the verification value
     else:
         result, sw = time_fn(reduce_fn, x_dev, iterations=cfg.iterations,
@@ -372,7 +386,9 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
     # Host oracle is pure host work (numpy / the C++ extension) — computed
     # eagerly; device-result materialization is what gets deferred.
     host = oracle_mod.host_reduce(x_np, cfg.method) if cfg.verify else None
-    pending = _PendingResult(cfg, backend, gbps, avg_s, result, host, logger)
+    pending = _PendingResult(cfg, backend, gbps, avg_s, result, host, logger,
+                             timing=("chained" if chained is not None
+                                     else timing_mode))
     return pending if defer else pending.finalize()
 
 

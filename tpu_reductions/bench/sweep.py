@@ -41,8 +41,9 @@ def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
         n = 1 << p
         iters = max(3, min(cfg.iterations, (1 << 28) // n))
         cfgs.append(dataclasses.replace(cfg, n=n, iterations=iters))
-    # batch: all sizes are timed before any result is materialized, so the
-    # tunnel's first-materialization sync penalty can't taint later sizes
+    # batch: legacy timing modes are timed before any result is
+    # materialized so every size runs in the same sync regime; chained
+    # configs are regime-immune (driver.run_benchmark_batch)
     results = run_benchmark_batch(cfgs, logger=logger)
     for sub, res in zip(cfgs, results):
         logger.log(f"shmoo {cfg.method} {cfg.dtype} n={sub.n} "
@@ -54,6 +55,7 @@ def sweep_collective(*, rank_counts=(2, 4, 8), methods=("MAX", "MIN", "SUM"),
                      dtypes=("int32", "float64"), n: int = 1 << 22,
                      retries: int = 5, rooted: bool = False,
                      mode: str = "vn", mapping: str = "default",
+                     timing: str = "periter", chain_span: int = 16,
                      out_dir: Optional[str] = None,
                      logger: Optional[BenchLogger] = None) -> List[dict]:
     """Rank-count sweep of the collective benchmark — the submit_all.sh
@@ -81,7 +83,8 @@ def sweep_collective(*, rank_counts=(2, 4, 8), methods=("MAX", "MIN", "SUM"),
                 cfg = CollectiveConfig(method=method, dtype=dtype, n=n,
                                        retries=retries, num_devices=k,
                                        rooted=rooted, mode=mode,
-                                       mapping=mapping)
+                                       mapping=mapping, timing=timing,
+                                       chain_span=chain_span)
                 for res in run_collective_benchmark(cfg, logger=job_logger):
                     rows.append(res.to_dict())
     return rows
@@ -91,6 +94,7 @@ def shmoo_collective(*, method: str = "SUM", dtype: str = "float64",
                      num_devices: Optional[int] = None,
                      min_pow: int = 10, max_pow: int = 24,
                      retries: int = 3,
+                     timing: str = "periter", chain_span: int = 16,
                      logger: Optional[BenchLogger] = None) -> List[dict]:
     """Payload-size sweep of the collective at a fixed rank count — the
     bandwidth-vs-N axis of BASELINE config #5 ("full bandwidth sweep
@@ -103,7 +107,8 @@ def shmoo_collective(*, method: str = "SUM", dtype: str = "float64",
     rows = []
     for p in range(min_pow, max_pow + 1):
         cfg = CollectiveConfig(method=method, dtype=dtype, n=1 << p,
-                               retries=retries, num_devices=num_devices)
+                               retries=retries, num_devices=num_devices,
+                               timing=timing, chain_span=chain_span)
         for res in run_collective_benchmark(cfg, logger=logger):
             row = res.to_dict()
             row["gbps"] = row["reference_gbps"]  # plot_vs_n key
@@ -115,6 +120,7 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
               dtypes=("int32", "float64"), n: int = 1 << 24,
               repeats: int = 5, iterations: int = 20,
               backend: str = "auto",
+              timing: str = "periter", chain_reps: int = 5,
               out_dir: Optional[str] = None,
               resume: bool = True,
               logger: Optional[BenchLogger] = None) -> List[dict]:
@@ -129,17 +135,16 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
     beyond the reference, where only the offline *analysis* was resumable
     via its accumulated files — SURVEY.md §5 "checkpoint/resume").
     Cache files land during the finalize phase, after ALL cells have been
-    timed (the deferral keeps the tunnel's first-materialization penalty
-    out of the measurements); an interrupt during timing re-measures the
-    un-cached cells on the next run."""
+    timed (the deferral keeps every legacy-mode cell in the same
+    pre-fetch sync regime — driver.run_benchmark_batch); an interrupt
+    during timing re-measures the un-cached cells on the next run."""
     logger = logger or BenchLogger(None, None)
     raw_dir = Path(out_dir) / "raw_output" if out_dir else None
     if raw_dir:
         raw_dir.mkdir(parents=True, exist_ok=True)
     # Phase 1: resolve resumed cells, queue the rest. Phase 2 times the
-    # whole queue before materializing/verifying anything — see
-    # driver.run_benchmark_batch (the tunnel's first device->host fetch
-    # degrades every later sync, so per-cell verify would taint cell 2..N).
+    # whole queue before materializing/verifying anything so legacy-mode
+    # cells share one sync regime (chained cells are regime-immune).
     rows: List[Optional[dict]] = []
     queued = []  # (row_index, rep, fname, cfg)
     for dtype in dtypes:
@@ -162,7 +167,8 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                     if (row.get("status") == "PASSED"
                             and row.get("n") == n
                             and row.get("backend") == want_backend
-                            and row.get("iterations") == iterations):
+                            and row.get("iterations") == iterations
+                            and row.get("timing", "periter") == timing):
                         rows.append(row)
                         logger.log(f"sweep {dtype} {method} rep={rep} "
                                    f"-> resumed ({row['gbps']:.4f} GB/s "
@@ -170,6 +176,9 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                         continue
                 cfg = ReduceConfig(method=method, dtype=dtype, n=n,
                                    iterations=iterations, backend=backend,
+                                   timing=timing, chain_reps=chain_reps,
+                                   stat="median" if timing == "chained"
+                                   else "mean",
                                    seed=rep, log_file=None)
                 queued.append((len(rows), rep, fname, cfg))
                 rows.append(None)  # placeholder, filled in phase 2
@@ -183,6 +192,9 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
         idx, rep, fname, _ = next(cells)
         row = res.to_dict()
         row["repeat"] = rep
+        # row["timing"] comes from the result: the discipline actually
+        # used (the driver may fall back from chained to fetch), so the
+        # resume key can never launder one discipline as another
         rows[idx] = row
         logger.log(f"sweep {cfg.dtype} {cfg.method} rep={rep} "
                    f"-> {res.gbps:.4f} GB/s [{res.status.name}]")

@@ -154,6 +154,9 @@ class CollectiveConfig:
     seed: int = 0
     verify: bool = True
     qatest: bool = False             # batch mode: QA markers only
+    timing: str = "periter"          # periter (reduce.c structure) |
+                                     # chained (honest slope mode)
+    chain_span: int = 16             # in-program iterations per slope
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
@@ -162,6 +165,11 @@ class CollectiveConfig:
         self.dtype = DTYPE_ALIASES[self.dtype]
         if self.mode not in ("vn", "co"):
             raise ValueError("mode must be 'vn' or 'co'")
+        if self.timing not in ("periter", "chained"):
+            raise ValueError(f"timing must be periter|chained, "
+                             f"got {self.timing!r}")
+        if self.chain_span <= 0:
+            raise ValueError("chain_span must be positive")
 
 
 def _add_common_flags(p: argparse.ArgumentParser) -> None:
@@ -315,6 +323,15 @@ def build_collective_parser() -> argparse.ArgumentParser:
                    help="vn=all devices, co=one per chip (BG/L VN/CO analog)")
     p.add_argument("--rooted", action="store_true",
                    help="Rooted reduce-to-0 semantics like MPI_Reduce")
+    p.add_argument("--timing", type=str, default="periter",
+                   choices=("periter", "chained"),
+                   help="periter = reduce.c's sync-per-collective "
+                        "structure; chained = data-dependent in-program "
+                        "iterations, slope-timed (the honest mode on "
+                        "tunneled/async backends)")
+    p.add_argument("--chainspan", dest="chain_span", type=int, default=16,
+                   help="In-program iterations per slope for "
+                        "--timing=chained")
     return p
 
 
@@ -328,5 +345,5 @@ def parse_collective(argv=None) -> CollectiveConfig:
         method=ns.method, dtype=ns.dtype, n=ns.n, retries=ns.retries,
         warmup=ns.warmup, num_devices=ns.num_devices, mapping=ns.mapping,
         mode=ns.mode, rooted=ns.rooted, seed=ns.seed, verify=ns.verify,
-        qatest=ns.qatest,
+        qatest=ns.qatest, timing=ns.timing, chain_span=ns.chain_span,
     )

@@ -105,6 +105,47 @@ def make_collective_reduce(method: str, mesh: Mesh, axis: str = "ranks",
 # ---------------------------------------------------------------------------
 
 
+def make_chained_collective(method: str, mesh: Mesh, axis: str = "ranks",
+                            rooted: bool = False,
+                            coll: Callable = None) -> Callable:
+    """`chained(x_sharded, k) -> scalar`: k data-dependent collective
+    reductions inside one compiled program, for honest slope timing
+    (ops/chain.py rationale — on the tunneled platform a blocked launch
+    returns on dispatch ack, so reduce.c's rdtsc-around-MPI_Reduce timing
+    structure (reduce.c:73-77) cannot be transplanted as-is).
+
+    Each fori_loop step runs the collective, then folds element [0] of
+    the reduced output back into shard 0 of the carried payload with the
+    op's own combine — the next step's collective is data-dependent on
+    this step's, so XLA can neither hoist the loop-invariant collective
+    nor elide any iteration. Fetching the returned scalar bounds the
+    completion of all k collectives.
+
+    Pass `coll` to chain an already-built collective closure (so the
+    timed collective is provably the same one the caller verified);
+    otherwise one is built from (method, mesh, axis, rooted)."""
+    op = get_op(method)
+    if coll is None:
+        coll = make_collective_reduce(method, mesh, axis, rooted=rooted)
+
+    def chained(x, k):
+        out_sds = jax.eval_shape(coll, x)
+        init = jnp.zeros((), out_sds.dtype)   # scalar carry: the loop
+        # state stays identically sharded however coll's output is laid
+        # out (replicated all-reduce vs scattered rooted reduce)
+
+        def body(_, carry):
+            x, _last = carry
+            s = coll(x)[0]
+            x = x.at[0].set(op.jnp_combine(x[0], s.astype(x.dtype)))
+            return x, s
+
+        _, last = jax.lax.fori_loop(0, k, body, (x, init))
+        return last
+
+    return jax.jit(chained)
+
+
 def make_dd_sum_all_reduce(mesh: Mesh, axis: str = "ranks") -> Callable:
     """Elementwise f64-fidelity SUM across ranks carried as (hi, lo) f32
     pairs — a RING all-reduce built from jax.lax.ppermute hops with
