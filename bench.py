@@ -23,9 +23,39 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import subprocess
 import sys
 
 BASELINE_GBPS = 90.8413  # CUDA int SUM, n=2^24 (mpi/CUdata.txt:6)
+
+# The tunneled TPU can wedge machine-wide (jax.devices() hangs in every
+# process — see CLAUDE.md "hard-won environment facts"); a benchmark that
+# hangs at device discovery is worse than one that reports the outage.
+DEVICE_PROBE_TIMEOUT_S = 180
+
+
+def _device_probe() -> str | None:
+    """Probe device discovery in a subprocess so a wedged tunnel can't
+    hang THIS process; the probe is tiny and drains itself (one scalar
+    materialization) before exiting. Returns None when healthy, else a
+    one-line diagnostic distinguishing a hang (wedged tunnel) from an
+    init failure (whose traceback tail is surfaced, not swallowed)."""
+    code = ("import jax; "
+            "print(len(jax.devices()), flush=True); "
+            "import jax.numpy as jnp; "
+            "print(int(jnp.asarray(1) + 1))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           timeout=DEVICE_PROBE_TIMEOUT_S,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return (f"device discovery hung >{DEVICE_PROBE_TIMEOUT_S}s "
+                "(wedged tunnel lease?)")
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-3:]
+        return ("device init failed (not a hang): "
+                + (" | ".join(tail) or f"exit {r.returncode}"))
+    return None
 
 # (backend, kernel, threads) candidates: the strongest configurations
 # from the full tile-geometry race (bench/autotune.py on the real chip) —
@@ -41,6 +71,19 @@ CANDIDATES = (
 
 
 def main() -> int:
+    outage = _device_probe()
+    if outage is not None:
+        print(f"accelerator unavailable: {outage}; reporting the outage "
+              "instead of hanging", file=sys.stderr)
+        print(json.dumps({
+            "metric": "single-chip int32 SUM reduction bandwidth, n=2^24",
+            "value": 0.0,
+            "unit": "GB/s",
+            "vs_baseline": 0.0,
+            "note": f"accelerator unavailable: {outage}",
+        }))
+        return 1
+
     from tpu_reductions.bench.driver import run_benchmark_batch
     from tpu_reductions.config import ReduceConfig
     from tpu_reductions.utils.logging import BenchLogger

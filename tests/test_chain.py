@@ -175,3 +175,13 @@ def test_chained_result_records_chained_timing():
     res = run_benchmark(cfg)
     if res.passed:
         assert res.timing == "chained"
+
+
+def test_resolved_timing_matches_fallback_rules():
+    from tpu_reductions.bench.driver import resolved_timing
+    assert resolved_timing(ReduceConfig(
+        method="SUM", timing="chained", cpu_final=True)) == "fetch"
+    assert resolved_timing(ReduceConfig(
+        method="SUM", timing="chained")) == "chained"
+    assert resolved_timing(ReduceConfig(
+        method="SUM", timing="periter", cpu_final=True)) == "periter"

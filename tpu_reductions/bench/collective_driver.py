@@ -175,11 +175,12 @@ def run_collective_benchmark(cfg: CollectiveConfig,
                       else QAStatus.FAILED)
         pos = [s for s in sw.samples if s > 0]
         if not pos:
-            # noise swamped every slope — one WAIVED row, never a FAILED
-            # bandwidth claim
+            # noise swamped every slope — no bandwidth claim can be made.
+            # A failed VERIFICATION still fails (correctness outranks the
+            # timing outage); only a verified run is waived.
             results.append(CollectiveResult(
                 method, dtype, cfg.n, k, 0, rooted, 0.0, 0.0, 0.0,
-                QAStatus.WAIVED))
+                status if status == QAStatus.FAILED else QAStatus.WAIVED))
             return results
         med = statistics.median(pos)
         for rep, dt in enumerate(sw.samples):

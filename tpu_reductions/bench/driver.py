@@ -122,17 +122,34 @@ def _make_device_fn(cfg: ReduceConfig, backend: str):
     return stage_fn, reduce_fn
 
 
-def _make_chained_fn(cfg: ReduceConfig, backend: str):
-    """Build the jitted chained reduction `chained(x2d, k)` for honest
-    slope timing (ops/chain.py), or None when the configuration cannot be
-    chained on-device: --cpufinal does host work inside the timed region
-    by definition (reduction.cpp:328-340), and the f64-on-TPU
-    double-double path finishes on host (dd_reduce.py)."""
+def _chain_supported(cfg: ReduceConfig) -> bool:
+    """Whether cfg's reduce is all-device and therefore chainable:
+    --cpufinal does host work inside the timed region by definition
+    (reduction.cpp:328-340), and the f64-on-TPU double-double path
+    finishes on host (dd_reduce.py). Deterministic per (cfg, platform)."""
     import jax
 
     if cfg.cpu_final:
-        return None
+        return False
     if cfg.dtype == "float64" and jax.default_backend() == "tpu":
+        return False
+    return True
+
+
+def resolved_timing(cfg: ReduceConfig) -> str:
+    """The discipline a run of cfg will ACTUALLY use (chained falls back
+    to fetch when the reduce is not chainable) — what BenchResult.timing
+    records and what sweep resume caches must be keyed on."""
+    if cfg.timing == "chained" and not _chain_supported(cfg):
+        return "fetch"
+    return cfg.timing
+
+
+def _make_chained_fn(cfg: ReduceConfig, backend: str):
+    """Build the jitted chained reduction `chained(x2d, k)` for honest
+    slope timing (ops/chain.py), or None when the configuration cannot be
+    chained on-device (_chain_supported)."""
+    if not _chain_supported(cfg):
         return None
 
     from tpu_reductions.ops.chain import make_chained_reduce

@@ -21,6 +21,7 @@ from pathlib import Path
 from typing import List, Optional
 
 from tpu_reductions.bench.driver import (BenchResult, _resolve_backend,
+                                         resolved_timing,
                                          run_benchmark_batch)
 from tpu_reductions.config import ReduceConfig
 from tpu_reductions.utils.logging import BenchLogger
@@ -159,16 +160,20 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                         row = {}  # truncated by an interrupted run: re-run
                     # only reuse a cached cell that (a) succeeded and
                     # (b) was measured under the SAME sweep parameters —
-                    # stale-config or failed cells are re-run (cached rows
-                    # store the resolved backend, never "auto")
-                    want_backend = _resolve_backend(
-                        ReduceConfig(method=method, dtype=dtype,
-                                     backend=backend))
+                    # stale-config or failed cells are re-run. Cached rows
+                    # store what actually ran (the resolved backend, never
+                    # "auto"; the resolved discipline, e.g. the f64 dd
+                    # path's deterministic chained->fetch fallback), so
+                    # the keys compare against the same resolution.
+                    probe = ReduceConfig(method=method, dtype=dtype,
+                                         backend=backend, timing=timing,
+                                         chain_reps=chain_reps)
                     if (row.get("status") == "PASSED"
                             and row.get("n") == n
-                            and row.get("backend") == want_backend
+                            and row.get("backend") == _resolve_backend(probe)
                             and row.get("iterations") == iterations
-                            and row.get("timing", "periter") == timing):
+                            and row.get("timing", "periter")
+                            == resolved_timing(probe)):
                         rows.append(row)
                         logger.log(f"sweep {dtype} {method} rep={rep} "
                                    f"-> resumed ({row['gbps']:.4f} GB/s "
