@@ -46,6 +46,12 @@ ranks = [k for k in (2, 4, 8, 16, 32) if k <= n_avail] or [1]
 timing = "chained" if jax.default_backend() == "tpu" else "periter"
 log.log(f"timing discipline: {timing}")
 
+# measure + record the sync-trust calibration the report cites
+from tpu_reductions.utils.calibrate import calibrate
+cal = calibrate(n=1 << 20, iters=8, reps=3, chain_span=8).to_dict()
+log.log("calibration: block_awaits_execution="
+        f"{cal['block_awaits_execution']}")
+
 # 1) single-chip grid (runTest analog) -> single-chip overlay numbers.
 # Lands in its own raw dir: single-chip rows use a per-kernel-iteration
 # timing convention incomparable with the collective rows, so they must
@@ -77,6 +83,7 @@ for dt in sorted({k[0] for k in avgs}):
 
 # 5) report (writeup.tex analog)
 paths = generate_report(avgs, single_chip=sc, figures=figures,
-                        out_dir=out, platform=jax.default_backend())
+                        out_dir=out, platform=jax.default_backend(),
+                        calibration=cal)
 print("report:", paths["md"], paths["tex"])
 PY

@@ -154,3 +154,18 @@ def test_sweep_all_resume_keyed_on_timing(tmp_path):
     assert first[0]["timing"] == "periter"
     second = sweep_all(timing="chained", chain_reps=2, **kw)
     assert second[0]["timing"] == "chained"
+
+
+def test_report_includes_calibration_note(tmp_path):
+    from tpu_reductions.bench.report import generate_report
+    avgs = {("INT", "SUM", 8): 1.5}
+    honest = {"platform": "cpu", "block_awaits_execution": True,
+              "single_blocked_s": 1e-4, "chained_per_iter_s": 1e-4}
+    paths = generate_report(avgs, out_dir=tmp_path, calibration=honest)
+    assert "Timing calibration" in paths["md"].read_text()
+    broken = dict(honest, block_awaits_execution=False)
+    paths = generate_report(avgs, out_dir=tmp_path, calibration=broken)
+    assert "chained slope mode" in paths["md"].read_text()
+    # no calibration -> no note, report still renders
+    paths = generate_report(avgs, out_dir=tmp_path)
+    assert "Timing calibration" not in paths["md"].read_text()

@@ -26,6 +26,28 @@ REFERENCE_SINGLE_GPU = {
 }
 
 
+def _calibration_note(cal: Optional[dict]) -> str:
+    """One bullet documenting the timing methodology the numbers rest on
+    (utils/calibrate.py — the reference needed no such note because a
+    local CUDA sync really blocks; a tunneled backend's may not)."""
+    if not cal:
+        return ""
+    if cal.get("block_awaits_execution"):
+        how = ("the platform's sync primitive awaits execution; "
+               "per-launch synced timing is valid")
+    else:
+        how = ("the platform's sync primitive does NOT await execution "
+               "(blocked launch {:.0f} us vs {:.0f} us true per-iteration"
+               " cost); bandwidths use the chained slope mode wherever "
+               "the reduce is all-device — host-finishing paths (the f64 "
+               "pair collectives, --cpufinal) can only fall back to "
+               "per-launch timing and their rows carry that caveat"
+               .format(cal.get("single_blocked_s", 0) * 1e6,
+                       cal.get("chained_per_iter_s", 0) * 1e6))
+    return ("- Timing calibration ({} platform): {}.\n"
+            .format(cal.get("platform", "?"), how))
+
+
 def _table(rows: Sequence[Sequence[str]], header: Sequence[str]) -> str:
     out = ["| " + " | ".join(header) + " |",
            "|" + "|".join("---" for _ in header) + "|"]
@@ -37,10 +59,14 @@ def generate_report(avgs: Dict[Key, float],
                     single_chip: Optional[Dict[tuple, float]] = None,
                     figures: Sequence[str | Path] = (),
                     out_dir: str | Path = ".",
-                    platform: str = "tpu") -> Dict[str, Path]:
+                    platform: str = "tpu",
+                    calibration: Optional[dict] = None) -> Dict[str, Path]:
     """Render report.md + report.tex from averaged collective results
     (aggregate.average output) and optional single-chip numbers
-    {(DATATYPE, OP): GB/s}. Returns {"md": path, "tex": path}."""
+    {(DATATYPE, OP): GB/s}. `calibration` (a
+    utils.calibrate.TimingCalibration.to_dict()) documents whether the
+    platform's sync primitive could be trusted and which timing
+    discipline produced the numbers. Returns {"md": path, "tex": path}."""
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     date = datetime.date.today().isoformat()
@@ -90,17 +116,18 @@ wall time — reduce.c:79 analog with real clocks).
   oracle. Failed runs report 0 and are excluded.
 - float64 on TPU uses the double-double / order-key 32-bit-pair paths;
   wire bytes per element are identical to native f64.
-"""
+{_calibration_note(calibration)}"""
     md_path = out / "report.md"
     md_path.write_text(md)
 
-    tex = _to_tex(sc_rows, coll_rows, figures, date)
+    tex = _to_tex(sc_rows, coll_rows, figures, date,
+                  calibration=calibration)
     tex_path = out / "report.tex"
     tex_path.write_text(tex)
     return {"md": md_path, "tex": tex_path}
 
 
-def _to_tex(sc_rows, coll_rows, figures, date) -> str:
+def _to_tex(sc_rows, coll_rows, figures, date, calibration=None) -> str:
     def tabular(rows, cols, header):
         lines = ["\\begin{tabular}{" + "l" * cols + "}",
                  " & ".join(header) + " \\\\ \\hline"]
@@ -123,5 +150,13 @@ def _to_tex(sc_rows, coll_rows, figures, date) -> str:
 {tabular(coll_rows, 4, ["dtype", "op", "ranks", "GB/s"])}
 \\section{{Figures}}
 {figs}
+\\section{{Methodology}}
+{_tex_escape(_calibration_note(calibration)) or
+ "Timing: per-launch device-synchronized iterations."}
 \\end{{document}}
 """
+
+
+def _tex_escape(s: str) -> str:
+    return (s.replace("&", "\\&").replace("%", "\\%")
+             .replace("#", "\\#").replace("_", "\\_"))

@@ -168,12 +168,14 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                     probe = ReduceConfig(method=method, dtype=dtype,
                                          backend=backend, timing=timing,
                                          chain_reps=chain_reps)
+                    want_timing = resolved_timing(probe)
                     if (row.get("status") == "PASSED"
                             and row.get("n") == n
                             and row.get("backend") == _resolve_backend(probe)
                             and row.get("iterations") == iterations
-                            and row.get("timing", "periter")
-                            == resolved_timing(probe)):
+                            and row.get("timing", "periter") == want_timing
+                            and (want_timing != "chained"
+                                 or row.get("chain_reps") == chain_reps)):
                         rows.append(row)
                         logger.log(f"sweep {dtype} {method} rep={rep} "
                                    f"-> resumed ({row['gbps']:.4f} GB/s "
@@ -200,6 +202,9 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
         # row["timing"] comes from the result: the discipline actually
         # used (the driver may fall back from chained to fetch), so the
         # resume key can never launder one discipline as another
+        if row.get("timing") == "chained":
+            row["chain_reps"] = cfg.chain_reps   # second resume key:
+            # slope medians over different rep counts don't mix either
         rows[idx] = row
         logger.log(f"sweep {cfg.dtype} {cfg.method} rep={rep} "
                    f"-> {res.gbps:.4f} GB/s [{res.status.name}]")
