@@ -8,11 +8,15 @@
   warp-synchronous "kernel 6" analog (reduction_kernel.cu:74-253).
 - oracle: host reference (Kahan) — reduction.cpp:206-249 analog, with a
   native C++ backend in csrc/.
+- chain: data-dependent chained reduction for honest slope timing on
+  async/tunneled backends (no reference analog — its local CUDA sync
+  could be trusted).
 """
 
+from tpu_reductions.ops.chain import make_chained_reduce
+from tpu_reductions.ops.oracle import host_reduce, verify
 from tpu_reductions.ops.registry import OPS, ReduceOpSpec, get_op, tolerance
 from tpu_reductions.ops.xla_reduce import xla_reduce
-from tpu_reductions.ops.oracle import host_reduce, verify
 
 __all__ = ["OPS", "ReduceOpSpec", "get_op", "tolerance",
-           "xla_reduce", "host_reduce", "verify"]
+           "xla_reduce", "host_reduce", "verify", "make_chained_reduce"]
