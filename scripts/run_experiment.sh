@@ -46,11 +46,16 @@ ranks = [k for k in (2, 4, 8, 16, 32) if k <= n_avail] or [1]
 timing = "chained" if jax.default_backend() == "tpu" else "periter"
 log.log(f"timing discipline: {timing}")
 
-# measure + record the sync-trust calibration the report cites
+# measure + record the sync-trust calibration the report cites; persist
+# it so `python -m tpu_reductions.bench.report out/ --calibration
+# out/calibration.json` can regenerate the writeup offline
+import json
 from tpu_reductions.utils.calibrate import calibrate
 cal = calibrate(n=1 << 20, iters=8, reps=3, chain_span=8).to_dict()
 log.log("calibration: block_awaits_execution="
         f"{cal['block_awaits_execution']}")
+out.mkdir(parents=True, exist_ok=True)
+(out / "calibration.json").write_text(json.dumps(cal, indent=1))
 
 # 1) single-chip grid (runTest analog) -> single-chip overlay numbers.
 # Lands in its own raw dir: single-chip rows use a per-kernel-iteration

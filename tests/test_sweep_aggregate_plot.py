@@ -169,3 +169,42 @@ def test_report_includes_calibration_note(tmp_path):
     # no calibration -> no note, report still renders
     paths = generate_report(avgs, out_dir=tmp_path)
     assert "Timing calibration" not in paths["md"].read_text()
+
+
+def test_report_cli_offline_regeneration(tmp_path, capsys):
+    from tpu_reductions.bench.report import main as report_main
+    raw = tmp_path / "raw_output"
+    raw.mkdir()
+    (raw / "stdout-vn-8ranks.txt").write_text(
+        "DATATYPE OP NODES GB/sec\nINT SUM 8 1.500\nINT SUM 8 2.500\n")
+    cal = tmp_path / "cal.json"
+    cal.write_text('{"platform": "cpu", "block_awaits_execution": true, '
+                   '"single_blocked_s": 1e-4, "chained_per_iter_s": 1e-4}')
+    rc = report_main([str(tmp_path), "--calibration", str(cal),
+                      "--platform=cpu"])
+    assert rc == 0
+    md = (tmp_path / "report.md").read_text()
+    assert "| INT | SUM | 8 | 2.000 |" in md     # mean of 1.5, 2.5
+    assert "Timing calibration" in md
+    assert (tmp_path / "report.tex").exists()
+
+
+def test_report_cli_reconstructs_single_chip_and_default_calibration(tmp_path):
+    import json as _json
+    from tpu_reductions.bench.report import main as report_main
+    raw = tmp_path / "raw_output"
+    raw.mkdir()
+    (raw / "stdout-vn-8ranks.txt").write_text(
+        "DATATYPE OP NODES GB/sec\nINT SUM 8 1.000\n")
+    sc_raw = tmp_path / "single_chip" / "raw_output"
+    sc_raw.mkdir(parents=True)
+    (sc_raw / "run-int32-SUM-0.json").write_text(_json.dumps(
+        {"method": "SUM", "dtype": "int32", "gbps": 200.0,
+         "status": "PASSED"}) + "\n")
+    (tmp_path / "calibration.json").write_text(
+        '{"platform": "cpu", "block_awaits_execution": true}')
+    rc = report_main([str(tmp_path), "--platform=cpu"])
+    assert rc == 0
+    md = (tmp_path / "report.md").read_text()
+    assert "200.0000" in md and "2.20x" in md   # 200 / 90.8413
+    assert "Timing calibration" in md           # default calibration.json

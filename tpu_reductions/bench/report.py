@@ -160,3 +160,71 @@ def _to_tex(sc_rows, coll_rows, figures, date, calibration=None) -> str:
 def _tex_escape(s: str) -> str:
     return (s.replace("&", "\\&").replace("%", "\\%")
              .replace("#", "\\#").replace("_", "\\_"))
+
+
+def main(argv=None) -> int:
+    """Regenerate the report offline from an experiment out_dir — the
+    analysis-side resumability the reference's file-based pipeline had
+    (raw_output -> collected.txt -> results/ -> writeup; SURVEY.md §3.3):
+    re-running the writeup never re-runs the cluster.
+
+        python -m tpu_reductions.bench.report out/ [--calibration cal.json]
+    """
+    import argparse
+    import json
+
+    from tpu_reductions.bench.aggregate import average, collect
+
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.bench.report",
+        description="Regenerate report.md/report.tex from an experiment "
+                    "output directory (no benchmarks are re-run)")
+    p.add_argument("out_dir", help="Directory holding raw_output/ from a "
+                                   "previous run_experiment/sweep")
+    p.add_argument("--calibration", type=str, default=None,
+                   help="Path to a calibration JSON (utils.calibrate "
+                        "output); defaults to <out_dir>/calibration.json "
+                        "when present (run_experiment.sh writes it)")
+    p.add_argument("--platform", type=str, default="tpu",
+                   help="Platform label for the comparison table")
+    ns = p.parse_args(argv)
+
+    out = Path(ns.out_dir)
+    raw = out / "raw_output"
+    if not raw.is_dir():
+        p.error(f"{raw} not found — run the experiment pipeline first")
+    avgs = average(collect(raw))
+
+    # single-chip overlay numbers from the sweep's cached cells — the
+    # same reconstruction run_experiment.sh does from live results
+    sc: dict = {}
+    sc_raw = out / "single_chip" / "raw_output"
+    if sc_raw.is_dir():
+        for f in sorted(sc_raw.glob("*.json")):
+            for line in f.read_text().splitlines():
+                if not line.strip():
+                    continue
+                r = json.loads(line)
+                if r.get("status") != "PASSED":
+                    continue
+                dt = {"int32": "INT", "float64": "DOUBLE"}.get(
+                    r["dtype"], r["dtype"].upper())
+                sc.setdefault((dt, r["method"]), []).append(r["gbps"])
+        sc = {k: sum(v) / len(v) for k, v in sc.items()}
+
+    cal_path = Path(ns.calibration) if ns.calibration \
+        else out / "calibration.json"
+    cal = json.loads(cal_path.read_text()) if cal_path.exists() else None
+    if ns.calibration and cal is None:
+        p.error(f"{cal_path} not found")
+
+    figures = sorted(out.glob("*.eps")) + sorted(out.glob("*.png"))
+    paths = generate_report(avgs, single_chip=sc or None, figures=figures,
+                            out_dir=out, platform=ns.platform,
+                            calibration=cal)
+    print(f"report: {paths['md']} {paths['tex']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
