@@ -60,10 +60,12 @@ def make_collective_reduce(method: str, mesh: Mesh, axis: str = "ranks",
     rooted=False: all-reduce; every rank holds the full elementwise-reduced
     (L,) result (out replicated). The semantic superset of MPI_Reduce —
     noted delta: the reference materializes the result only on rank 0.
-    rooted=True: reduce-scatter via lax.psum_scatter (+ index trick for
-    MIN/MAX, which have no native scatter variant: scatter after pmin by
-    slicing) — each rank keeps L/k of the reduced result, which is the
-    rooted-reduce wire cost.
+    rooted=True: reduce-scatter — each rank keeps L/k of the reduced
+    result, the rooted-reduce wire cost. SUM uses lax.psum_scatter;
+    MIN/MAX (no native scatter variant) use a ppermute recursive-halving
+    butterfly at the same (k-1)/k wire cost when the rank count is a
+    power of two and lengths divide, and fall back to
+    reduce-fully-then-slice (all-reduce wire cost) otherwise.
     """
     method = method.upper()
     prim = _COLLECTIVES[method]
@@ -89,13 +91,47 @@ def make_collective_reduce(method: str, mesh: Mesh, axis: str = "ranks",
     def local_minmax_scatter(shard):
         # no pmin_scatter primitive: reduce fully, keep this rank's slice
         # (XLA still schedules the slice-discard efficiently; wire cost is
-        # the all-reduce's — documented delta vs a true reduce tree).
+        # the all-reduce's — the fallback when recursive halving can't
+        # apply: non-power-of-two rank counts or indivisible lengths).
         full = prim(shard, axis)
         r = jax.lax.axis_index(axis)
         piece = full.shape[0] // k
         return jax.lax.dynamic_slice_in_dim(full, r * piece, piece)
 
-    fn = shard_map(local_minmax_scatter, mesh=mesh, in_specs=P(axis),
+    def local_minmax_halving(shard):
+        # Recursive-halving reduce-scatter on ppermute — the min/max
+        # twin of psum_scatter at the same (k-1)/k wire cost: log2(k)
+        # butterfly rounds, each exchanging the half of the working
+        # buffer the partner is responsible for and combining the rest.
+        # Round-by-round the kept offset follows this rank's bit at the
+        # current distance, which lands rank r on exactly slice r of the
+        # reduced vector (rank-major, psum_scatter tiled layout).
+        op = get_op(method)
+        r = jax.lax.axis_index(axis)
+        buf = shard
+        size = shard.shape[0]
+        d = k // 2
+        while d >= 1:
+            size //= 2
+            bit = (r // d) % 2
+            keep = jax.lax.dynamic_slice_in_dim(buf, bit * size, size)
+            send = jax.lax.dynamic_slice_in_dim(buf, (1 - bit) * size,
+                                                size)
+            recv = jax.lax.ppermute(send, axis,
+                                    [(i, i ^ d) for i in range(k)])
+            buf = op.jnp_combine(keep, recv)
+            d //= 2
+        return buf
+
+    def dispatch(shard):
+        # the halving butterfly needs a power-of-two rank count and a
+        # per-rank length divisible by k (each of log2(k) rounds halves
+        # it); both are static at trace time — fall back otherwise
+        if (k & (k - 1)) == 0 and k > 1 and shard.shape[0] % k == 0:
+            return local_minmax_halving(shard)
+        return local_minmax_scatter(shard)
+
+    fn = shard_map(dispatch, mesh=mesh, in_specs=P(axis),
                    out_specs=P(axis))
     return jax.jit(fn)
 
