@@ -224,6 +224,10 @@ def dd_pallas_call(hi2d: jax.Array, lo2d: jax.Array, method: str, tm: int,
                                 memory_space=pltpu.VMEM),
                    pl.BlockSpec((tm, LANES), lambda i: (0, 0),
                                 memory_space=pltpu.VMEM)],
+        # sequential accumulator grid (same structure as pallas_reduce's
+        # single-pass kernels): declare it so Mosaic never parallelizes
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(hi2d, lo2d)
 

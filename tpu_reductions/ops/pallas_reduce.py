@@ -180,6 +180,11 @@ def _accumulator_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
                                memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((acc_rows, LANES), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
+        # every step revisits the one accumulator block: the grid is
+        # inherently sequential — declare it so Mosaic never tries to
+        # split it across cores
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x2d)
 
@@ -247,6 +252,12 @@ def two_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int, p: int, t: int,
                                memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((sub, LANES), lambda i, j: (i, 0),
                                memory_space=pltpu.VMEM),
+        # block i owns partial block i exclusively: the P axis is
+        # embarrassingly parallel (Mosaic may split it across cores on
+        # multi-core TPUs — the numBlocks concurrency the CUDA grid had);
+        # the T axis revisits block i's accumulator, so it stays serial
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x2d)
 
