@@ -281,3 +281,33 @@ def test_rooted_minmax_fallback_indivisible(method):
     piece = 100 // K
     np.testing.assert_array_equal(got.ravel(),
                                   expect.ravel()[: piece * K])
+
+
+def test_dd_ring_rs_ag_path_and_indivisible_fallback():
+    """Divisible lengths take the reduce-scatter + all-gather ring
+    (visible as dynamic_update_slice chunk writes in the jaxpr);
+    indivisible lengths fall back to the naive accumulate ring. Both must
+    hit f64 tolerance."""
+    mesh = build_mesh()
+    fn = make_dd_sum_all_reduce(mesh, "ranks")
+    # divisible: L=1024 % 8 == 0 -> RS+AG
+    x = _payload("float64")
+    hi, lo = host_split(x)
+    sh, sl = shard_payload(hi, mesh, "ranks"), shard_payload(lo, mesh, "ranks")
+    jaxpr = str(jax.make_jaxpr(fn)(sh, sl))
+    assert "dynamic_update_slice" in jaxpr
+    # (numerics of the divisible path are already pinned by
+    # test_dd_sum_ring_all_reduce_f64_fidelity, which takes it too)
+    # indivisible: per-rank length 100 % 8 != 0 -> naive ring
+    x2 = np.concatenate([host_data(100, "float64", rank=r)
+                         for r in range(K)])
+    h2, l2 = host_split(x2)
+    s2h = shard_payload(h2, mesh, "ranks")
+    s2l = shard_payload(l2, mesh, "ranks")
+    jaxpr2 = str(jax.make_jaxpr(fn)(s2h, s2l))
+    assert "dynamic_update_slice" not in jaxpr2
+    o2h, o2l = fn(s2h, s2l)
+    got2 = (np.asarray(o2h, dtype=np.float64)
+            + np.asarray(o2l, dtype=np.float64))
+    np.testing.assert_allclose(got2, x2.reshape(K, 100).sum(axis=0),
+                               rtol=0, atol=1e-12)

@@ -189,31 +189,76 @@ def make_dd_sum_all_reduce(mesh: Mesh, axis: str = "ranks") -> Callable:
 
     A plain psum of the hi/lo planes would round at f32 (~1e-7 relative),
     missing the reference's f64 acceptance threshold of 1e-12
-    (reduction.cpp:764). The ring keeps the pair arithmetic error-free to
-    ~2^-48: rank r's block travels the ring, every rank folds each arriving
-    block with dd_add (dd_reduce._dd_add). k-1 hops of L elements each —
-    the classic ring all-reduce wire pattern the ICI torus is built for.
+    (reduction.cpp:764). The pair arithmetic stays error-free to ~2^-48:
+    every combine is a dd_add (dd_reduce._dd_add).
 
-    Note: each rank accumulates the blocks in a different rotation order,
-    so replicas can differ by O(2^-48) — far inside the 1e-12 acceptance
-    band; out_specs declares replication on that basis.
+    Wire pattern: when the per-rank length divides by k, the classic
+    bandwidth-optimal ring — a reduce-scatter phase (k-1 hops of L/k
+    chunks, each arriving chunk dd-added into the matching local chunk;
+    after the last hop rank r owns the fully reduced chunk (r+1) mod k)
+    followed by an all-gather phase (k-1 hops circulating the reduced
+    chunks) — 2L(k-1)/k per rank per plane, the pattern the ICI torus is
+    built for. Each chunk is reduced exactly once then broadcast, so
+    replicas are bit-identical. Indivisible lengths fall back to the
+    naive accumulate-around-the-ring (k-1 full-L hops; replicas there
+    can differ by O(2^-48) rotation-order error — far inside the 1e-12
+    acceptance band).
     """
     from tpu_reductions.ops.dd_reduce import _dd_add
 
     k = mesh.shape[axis]
     ring = [(i, (i + 1) % k) for i in range(k)]
 
-    def local(hi, lo):
+    def _hop(pair):
+        return (jax.lax.ppermute(pair[0], axis, perm=ring),
+                jax.lax.ppermute(pair[1], axis, perm=ring))
+
+    def local_naive(hi, lo):
         def body(_, carry):
             acc_hi, acc_lo, cur_hi, cur_lo = carry
-            nxt_hi = jax.lax.ppermute(cur_hi, axis, perm=ring)
-            nxt_lo = jax.lax.ppermute(cur_lo, axis, perm=ring)
+            nxt_hi, nxt_lo = _hop((cur_hi, cur_lo))
             a_hi, a_lo = _dd_add(acc_hi, acc_lo, nxt_hi, nxt_lo)
             return a_hi, a_lo, nxt_hi, nxt_lo
 
         acc_hi, acc_lo, _, _ = jax.lax.fori_loop(
             0, k - 1, body, (hi, lo, hi, lo))
         return acc_hi, acc_lo
+
+    def local_rs_ag(hi, lo):
+        r = jax.lax.axis_index(axis)
+        c = hi.shape[0] // k
+
+        def chunk(buf, idx):
+            return jax.lax.dynamic_slice_in_dim(buf, idx * c, c)
+
+        def put(buf, piece, idx):
+            return jax.lax.dynamic_update_slice_in_dim(buf, piece,
+                                                       idx * c, axis=0)
+
+        def rs_body(s, carry):
+            hi, lo = carry
+            send = (r - s) % k           # chunk this rank forwards
+            tgt = (r - s - 1) % k        # chunk the arriving hop matches
+            rx_hi, rx_lo = _hop((chunk(hi, send), chunk(lo, send)))
+            a_hi, a_lo = _dd_add(chunk(hi, tgt), chunk(lo, tgt),
+                                 rx_hi, rx_lo)
+            return put(hi, a_hi, tgt), put(lo, a_lo, tgt)
+
+        hi, lo = jax.lax.fori_loop(0, k - 1, rs_body, (hi, lo))
+
+        def ag_body(s, carry):
+            hi, lo = carry
+            send = (r + 1 - s) % k       # reduced chunk moving clockwise
+            tgt = (r - s) % k
+            rx_hi, rx_lo = _hop((chunk(hi, send), chunk(lo, send)))
+            return put(hi, rx_hi, tgt), put(lo, rx_lo, tgt)
+
+        return jax.lax.fori_loop(0, k - 1, ag_body, (hi, lo))
+
+    def local(hi, lo):
+        if k > 1 and hi.shape[0] % k == 0:   # static at trace time
+            return local_rs_ag(hi, lo)
+        return local_naive(hi, lo)
 
     fn = shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
                    out_specs=(P(), P()), check_vma=False)
