@@ -3,10 +3,20 @@
 The reference pinned exactly one geometry (n=2^24, threads=256,
 maxblocks=64 — reduction.cpp:665-668) and its min/max kernels carried
 latent non-pow2 bugs precisely because nothing ever varied the geometry
-(reduction_kernel.cu:140,157,204,221; SURVEY.md §2.2). This fuzz varies
+(reduction_kernel.cu:140,157,204,221; SURVEY.md §2.2). This module varies
 everything the CLI exposes — size (pow2 and ragged), op, dtype, kernel
 structure, tile geometry, finishing knobs — and holds one invariant: the
 device result must match the host oracle within the registry tolerance.
+
+Two tiers:
+  * default suite — a bounded, deterministic geometry sweep chosen to hit
+    every edge class (n=1, ragged, pow2, tile==sublane, max_blocks
+    extremes, multi-pass chains) in seconds;
+  * `-m slow` — the open-ended hypothesis fuzz (deadline=None by design:
+    per-example compile times vary too much to bound). Round 1's version
+    ran >50 min in the default suite because a kernel-7 geometry with
+    tm == sublane tile made the multi-pass loop non-terminating — fixed
+    in pallas_reduce._multipass_finish and pinned in EDGE_GEOMETRIES.
 """
 
 import numpy as np
@@ -35,6 +45,49 @@ def _check(got, x, method, dtype, n):
     assert ok, (method, dtype, n, diff)
 
 
+# Deterministic edge-class sweep for the default suite: one geometry per
+# hazard class the fuzz exists to cover.
+EDGE_GEOMETRIES = [
+    # n=1 / tiny
+    dict(n=1, method="SUM", dtype="int32", kernel=6, threads=8, max_blocks=1),
+    dict(n=3, method="MIN", dtype="float32", kernel=7, threads=16,
+         max_blocks=2),
+    # ragged non-pow2 (the reference's min/max bug class)
+    dict(n=12345, method="MAX", dtype="bfloat16", kernel=7, threads=16,
+         max_blocks=64),
+    dict(n=100_001, method="MIN", dtype="int32", kernel=6, threads=100,
+         max_blocks=7),
+    # pow2
+    dict(n=1 << 14, method="SUM", dtype="float32", kernel=8, threads=256,
+         max_blocks=64),
+    # tm == sublane tile with max_blocks >= num_tiles: the kernel-7
+    # geometry whose multi-pass loop used to never terminate (round-1
+    # VERDICT weak #3; each pass emitted exactly as many partial rows as
+    # it consumed until the halving clamp in _multipass_finish) — must
+    # now finish AND verify. The bf16 SUM variant also crosses the
+    # partials dtype transition (bf16 in, f32 partials: sublane 16 -> 8).
+    dict(n=1 << 14, method="SUM", dtype="bfloat16", kernel=7, threads=8,
+         max_blocks=64),
+    dict(n=1 << 14, method="MIN", dtype="int32", kernel=7, threads=8,
+         max_blocks=64),
+    # max_blocks=1 serial chain
+    dict(n=1 << 13, method="MAX", dtype="int32", kernel=7, threads=8,
+         max_blocks=1),
+]
+
+
+@pytest.mark.parametrize("g", EDGE_GEOMETRIES,
+                         ids=lambda g: (f"n{g['n']}-{g['method']}-"
+                                        f"{g['dtype']}-k{g['kernel']}-"
+                                        f"t{g['threads']}-mb{g['max_blocks']}"))
+def test_pallas_reduce_edge_geometries(g):
+    x = host_data(g["n"], g["dtype"], rank=0, seed=0)
+    got = pallas_reduce(x, g["method"], threads=g["threads"],
+                        max_blocks=g["max_blocks"], kernel=g["kernel"])
+    _check(got, x, g["method"], g["dtype"], g["n"])
+
+
+@pytest.mark.slow
 @settings(max_examples=40, deadline=None)
 @given(geometry)
 def test_pallas_reduce_matches_oracle_any_geometry(g):
@@ -54,6 +107,7 @@ def test_xla_reduce_matches_oracle(n, method, dtype):
     _check(got, x, method, dtype, n)
 
 
+@pytest.mark.slow
 @settings(max_examples=10, deadline=None)
 @given(st.integers(min_value=1, max_value=1 << 12),
        st.sampled_from(["SUM", "MIN", "MAX"]),
@@ -65,3 +119,14 @@ def test_pallas_cpufinal_and_thresh_any_geometry(n, method, thresh):
     got = pallas_reduce(x, method, kernel=7, cpu_final=True,
                         cpu_thresh=thresh, threads=16, max_blocks=4)
     _check(got, x, method, "int32", n)
+
+
+def test_pallas_cpufinal_and_thresh_edge_cases():
+    """Deterministic default-suite cover for the cpu_final/cpu_thresh
+    knobs (the slow fuzz above explores the space)."""
+    for n, method, thresh in [(1, "SUM", 1), (4097, "MIN", 3),
+                              (1 << 12, "MAX", 9)]:
+        x = host_data(n, "int32", rank=0, seed=2)
+        got = pallas_reduce(x, method, kernel=7, cpu_final=True,
+                            cpu_thresh=thresh, threads=16, max_blocks=4)
+        _check(got, x, method, "int32", n)

@@ -262,6 +262,35 @@ def two_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int, p: int, t: int,
     )(x2d)
 
 
+def _multipass_finish(partials: jax.Array, op: ReduceOpSpec, threads: int,
+                      max_blocks: int, cpu_thresh: int,
+                      interpret: Optional[bool]) -> jax.Array:
+    """Multi-pass finishing: keep relaunching the two-pass kernel on the
+    partials while more than cpu_thresh rows remain and a further pass is
+    worthwhile (reduction.cpp:343-357). Sizes are static, so this Python
+    loop unrolls at trace time into a fixed pass chain.
+
+    Two termination guards:
+      * floor — the partials' OWN sublane tile (16 rows for bf16 min/max,
+        8 for 32-bit); one block is as small as a pass can get, so
+        comparing against the 32-bit constant would spin forever on bf16;
+      * halving clamp — a pass emits p2 * sublane rows; clamp p2 so each
+        pass at least halves the partials. Without this, tm == sublane
+        tile with max_blocks >= num_tiles maps every tile to its own
+        partial block — zero shrinkage, and this trace-time loop never
+        terminates (the reference's relaunch loop halves by construction).
+    """
+    while (partials.shape[0] > max(cpu_thresh, 1)
+           and partials.shape[0] > sublanes_for(partials.dtype)):
+        sub2 = sublanes_for(partials.dtype)
+        mb2 = max(1, min(max_blocks, partials.shape[0] // (2 * sub2)))
+        tm2, p2, t2 = choose_tiling(partials.size, threads, mb2,
+                                    partials.dtype)
+        x2 = stage_padded(partials, tm2, p2, t2, op)
+        partials = two_pass_call(x2, op, tm2, p2, t2, interpret=interpret)
+    return partials
+
+
 # ---------------------------------------------------------------------------
 # Public entry points
 # ---------------------------------------------------------------------------
@@ -325,19 +354,8 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
 
     if kernel == 7:
         partials = two_pass_call(x2d, op, tm, p, t, interpret=interpret)
-        # Multi-pass: keep relaunching the kernel on the partials while more
-        # than cpu_thresh rows remain and a further pass is worthwhile
-        # (reduction.cpp:343-357). Sizes are static, so this Python loop
-        # unrolls at trace time into a fixed pass chain. The floor is the
-        # partials' OWN sublane tile (16 rows for bf16 min/max, 8 for
-        # 32-bit) — one block is as small as a pass can get, so comparing
-        # against the 32-bit constant would spin forever on bf16.
-        while (partials.shape[0] > max(cpu_thresh, 1)
-               and partials.shape[0] > sublanes_for(partials.dtype)):
-            tm2, p2, t2 = choose_tiling(partials.size, threads,
-                                        max_blocks, partials.dtype)
-            x2 = stage_padded(partials, tm2, p2, t2, op)
-            partials = two_pass_call(x2, op, tm2, p2, t2, interpret=interpret)
+        partials = _multipass_finish(partials, op, threads, max_blocks,
+                                     cpu_thresh, interpret)
         if cpu_final:
             return host_finish(partials, op)
         return finish(partials, op)
@@ -368,17 +386,8 @@ def _make_staged_parts(method: str, n: int, dtype, *, threads: int = 256,
     else:
         def device_fn(x2d):
             partials = two_pass_call(x2d, op, tm, p, t, interpret=interpret)
-            # static pass chain (sizes known at trace time) — the
-            # relaunch-while-too-many-partials loop of reduction.cpp:343-357;
-            # floor = the partials' own sublane tile (see pallas_reduce)
-            while (partials.shape[0] > max(cpu_thresh, 1)
-                   and partials.shape[0] > sublanes_for(partials.dtype)):
-                tm2, p2, t2 = choose_tiling(partials.size, threads,
-                                            max_blocks, partials.dtype)
-                x2 = stage_padded(partials, tm2, p2, t2, op)
-                partials = two_pass_call(x2, op, tm2, p2, t2,
-                                         interpret=interpret)
-            return partials
+            return _multipass_finish(partials, op, threads, max_blocks,
+                                     cpu_thresh, interpret)
 
     return op, stage_fn, device_fn
 
