@@ -54,7 +54,7 @@ def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
 
 def sweep_collective(*, rank_counts=(2, 4, 8), methods=("MAX", "MIN", "SUM"),
                      dtypes=("int32", "float64"), n: int = 1 << 22,
-                     retries: int = 5, rooted: bool = False,
+                     retries: int = 5, rooted="none",
                      mode: str = "vn", mapping: str = "default",
                      timing: str = "periter", chain_span: int = 16,
                      out_dir: Optional[str] = None,

@@ -136,8 +136,11 @@ class CollectiveConfig:
       mode         'vn' uses every addressable device, 'co' uses one device
                    per host/chip — the BG/L virtual-node vs coprocessor mode
                    analog (ccni_vn.sh:6)
-      rooted       True = semantically rooted reduce like MPI_Reduce(root=0)
-                   (reduce.c:76,90); False = all-reduce (psum everywhere)
+      rooted       'none' = all-reduce (psum everywhere); 'scatter' =
+                   reduce-scatter (rooted wire cost, each rank keeps L/k);
+                   'root' = true reduce-to-root like MPI_Reduce(root=0)
+                   (reduce.c:76,90) — root holds the full reduced array.
+                   Bools accepted: False -> 'none', True -> 'scatter'.
     """
 
     method: str = "SUM"
@@ -149,7 +152,7 @@ class CollectiveConfig:
     mesh_shape: Optional[tuple] = None
     mapping: str = "default"
     mode: str = "vn"
-    rooted: bool = False
+    rooted: str = "none"             # none|scatter|root (bools accepted)
     backend: str = "xla"
     seed: int = 0
     verify: bool = True
@@ -163,6 +166,8 @@ class CollectiveConfig:
         if self.method not in METHODS:
             raise ValueError(f"method must be one of {METHODS}, got {self.method!r}")
         self.dtype = DTYPE_ALIASES[self.dtype]
+        from tpu_reductions.parallel.collectives import normalize_rooted
+        self.rooted = normalize_rooted(self.rooted)
         if self.mode not in ("vn", "co"):
             raise ValueError("mode must be 'vn' or 'co'")
         if self.timing not in ("periter", "chained"):
@@ -321,8 +326,13 @@ def build_collective_parser() -> argparse.ArgumentParser:
                    help="Mesh axis ordering (BGLMPI_MAPPING analog)")
     p.add_argument("--mode", type=str, default="vn", choices=("vn", "co"),
                    help="vn=all devices, co=one per chip (BG/L VN/CO analog)")
-    p.add_argument("--rooted", action="store_true",
-                   help="Rooted reduce-to-0 semantics like MPI_Reduce")
+    p.add_argument("--rooted", nargs="?", const="scatter", default="none",
+                   choices=("none", "scatter", "root"),
+                   help="Rooted reduce semantics: bare --rooted = "
+                        "'scatter' (reduce-scatter, the rooted wire "
+                        "cost); 'root' = true reduce-to-root like "
+                        "MPI_Reduce(root=0) — the root rank holds the "
+                        "full reduced array (reduce.c:76,90)")
     p.add_argument("--timing", type=str, default="periter",
                    choices=("periter", "chained"),
                    help="periter = reduce.c's sync-per-collective "
