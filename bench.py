@@ -57,10 +57,14 @@ def _device_probe() -> str | None:
                 + (" | ".join(tail) or f"exit {r.returncode}"))
     return None
 
-# (backend, kernel, threads) candidates: the strongest configurations
-# from the full tile-geometry race (bench/autotune.py on the real chip) —
-# the two single-pass Pallas accumulator structures at their best tile
-# heights, plus the XLA reduce as the comparator.
+# (backend, kernel, threads) candidates: a structural prior, not a
+# verified tune — the two single-pass Pallas accumulator structures at
+# plausible tile heights plus the XLA comparator. Round 1's on-chip
+# tile race ranked these under per-launch timing that was later shown
+# to be dispatch-ack noise (docs/TIMING.md), and the round ended in a
+# tunnel outage before a chained re-run; re-derive with
+# `python -m tpu_reductions.bench.autotune --timing=chained` on a live
+# chip and replace this list with the committed tune output.
 CANDIDATES = (
     ("pallas", 6, 1024),
     ("pallas", 8, 2048),

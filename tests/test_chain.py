@@ -91,7 +91,10 @@ def test_chained_does_not_mutate_staged_input():
 
 def test_time_chained_books_slope_samples():
     op = get_op("SUM")
-    x = np.arange(1 << 16, dtype=np.float32)
+    # 2^22 elements (16 MiB): per-iteration time is milliseconds, so the
+    # slope stays positive even under CI load — a 2^16 payload's
+    # microsecond slopes went negative under contention (round-1 ADVICE)
+    x = np.arange(1 << 22, dtype=np.float32)
     tm, p, t = choose_tiling(x.size, dtype="float32")
     x2d = jax.device_put(stage_padded(x, tm, p, t, op))
     chained = make_chained_reduce(op.jnp_reduce, op)
@@ -152,6 +155,37 @@ def test_calibrate_on_cpu_is_honest():
     assert "trustworthy" in text
     d = cal.to_dict()
     assert d["block_awaits_execution"] is True
+
+
+def test_calibrate_indeterminate_fails_safe():
+    """A noise-swamped (non-positive) chained ground truth must yield an
+    INDETERMINATE verdict, never a vacuous 'trustworthy' (round-1
+    ADVICE on calibrate.py)."""
+    from tpu_reductions.utils.calibrate import TimingCalibration
+    c = TimingCalibration(platform="tpu", n=1 << 24, dtype="float32",
+                          single_blocked_s=1e-5, amortized_blocked_s=1e-5,
+                          roundtrip_s=1e-3, chained_per_iter_s=-1e-6,
+                          post_fetch_single_blocked_s=1e-5)
+    assert c.indeterminate
+    assert not c.block_awaits_execution
+    assert "INDETERMINATE" in c.describe()
+    d = c.to_dict()
+    assert d["indeterminate"] is True and d["block_awaits_execution"] is False
+
+
+def test_calibrate_flags_copy_lowered_carry():
+    """On an honest platform, a chained slope far above the amortized
+    blocked time means the chain's carry update is being lowered to a
+    buffer copy — the calibration must quantify and surface it (round-1
+    ADVICE on ops/chain.py)."""
+    from tpu_reductions.utils.calibrate import TimingCalibration
+    c = TimingCalibration(platform="cpu", n=1 << 24, dtype="float32",
+                          single_blocked_s=3e-3, amortized_blocked_s=1e-3,
+                          roundtrip_s=1e-3, chained_per_iter_s=3.5e-3,
+                          post_fetch_single_blocked_s=3e-3)
+    assert c.block_awaits_execution
+    assert c.chain_overhead_ratio == pytest.approx(3.5)
+    assert "buffer copy" in c.describe()
 
 
 def test_chained_fallback_records_actual_timing(monkeypatch):

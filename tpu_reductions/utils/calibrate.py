@@ -56,12 +56,37 @@ class TimingCalibration:
     post_fetch_single_blocked_s: float
 
     @property
+    def indeterminate(self) -> bool:
+        """The chained ground truth itself was noise-swamped (non-positive
+        median slope): NO verdict about the sync primitive can be formed.
+        Without this guard a broken platform would be declared trustworthy
+        vacuously (single_blocked_s >= 0.25 * nonpositive is always True —
+        round-1 ADVICE)."""
+        return self.chained_per_iter_s <= 0
+
+    @property
     def block_awaits_execution(self) -> bool:
         # A broken sync shows a blocked launch 1-3 orders of magnitude
         # below the chained ground truth (ack floor vs real kernel time);
         # an honest one lands within a small factor (the chain adds the
         # carry-update write, which some backends implement as a copy).
+        # Indeterminate calibrations fail SAFE: never certify a sync
+        # against a ground truth that measured nothing.
+        if self.indeterminate:
+            return False
         return self.single_blocked_s >= 0.25 * self.chained_per_iter_s
+
+    @property
+    def chain_overhead_ratio(self) -> float:
+        """chained slope / amortized blocked per-iteration time. Only
+        meaningful on honest platforms (where amortized timing is real):
+        a ratio well above 1 means the chain's carry update is being
+        lowered to a full buffer copy on this backend, and chained-mode
+        GB/s under-reports true kernel bandwidth by about this factor
+        (round-1 ADVICE on ops/chain.py)."""
+        if self.indeterminate or self.amortized_blocked_s <= 0:
+            return float("nan")
+        return self.chained_per_iter_s / self.amortized_blocked_s
 
     @property
     def honest_gbps(self) -> float:
@@ -70,12 +95,26 @@ class TimingCalibration:
             if self.chained_per_iter_s > 0 else float("nan")
 
     def describe(self) -> str:
-        verdict = ("sync primitive awaits device execution: timed loops "
-                   "are trustworthy"
-                   if self.block_awaits_execution else
-                   "sync primitive does NOT await device execution: "
-                   "per-iteration synced timing is meaningless here — "
-                   "use --timing=chained")
+        if self.indeterminate:
+            verdict = ("chained ground-truth slope non-positive (noise-"
+                       "swamped): verdict INDETERMINATE — no timing mode "
+                       "is certified; re-run calibration with a larger "
+                       "--n or more --reps")
+        elif self.block_awaits_execution:
+            verdict = ("sync primitive awaits device execution: timed "
+                       "loops are trustworthy")
+            ratio = self.chain_overhead_ratio
+            if ratio == ratio and ratio > 2.0:   # nan-safe
+                verdict += (f"; NOTE chained slope is {ratio:.1f}x the "
+                            "amortized blocked time — the chain's carry "
+                            "update is likely a buffer copy on this "
+                            "backend, so chained-mode GB/s under-reports "
+                            "by about that factor (prefer bulk/periter "
+                            "here)")
+        else:
+            verdict = ("sync primitive does NOT await device execution: "
+                       "per-iteration synced timing is meaningless here — "
+                       "use --timing=chained")
         return "\n".join([
             f"timing calibration on platform={self.platform} "
             f"(heavy op: SUM over {self.n} x {self.dtype})",
@@ -96,6 +135,8 @@ class TimingCalibration:
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["block_awaits_execution"] = self.block_awaits_execution
+        d["indeterminate"] = self.indeterminate
+        d["chain_overhead_ratio"] = self.chain_overhead_ratio
         d["honest_gbps"] = self.honest_gbps
         return d
 
