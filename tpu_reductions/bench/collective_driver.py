@@ -80,19 +80,15 @@ def run_collective_benchmark(cfg: CollectiveConfig,
 
     logger = logger or BenchLogger(None, None)
 
-    x64_before = None
-    if cfg.dtype == "float64" and jax.default_backend() != "tpu":
-        # scoped, not global: restored in the finally below so batch runs
-        # stay order-independent (round-1 VERDICT weak #7). Device work
-        # completes inside this function (results are host numpy), so the
-        # restore cannot strand an in-flight f64 computation.
-        x64_before = jax.config.jax_enable_x64
-        jax.config.update("jax_enable_x64", True)
-    try:
+    from tpu_reductions.utils.x64 import preserve_x64
+
+    # Scoped, not global (utils/x64.py): device work completes inside
+    # this function (results are host numpy), so the restore cannot
+    # strand an in-flight f64 computation.
+    with preserve_x64():
+        if cfg.dtype == "float64" and jax.default_backend() != "tpu":
+            jax.config.update("jax_enable_x64", True)
         return _run_collective_benchmark(cfg, logger)
-    finally:
-        if x64_before is not None:
-            jax.config.update("jax_enable_x64", x64_before)
 
 
 def _run_collective_benchmark(cfg: CollectiveConfig,
@@ -121,8 +117,10 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
     x_np = _build_payload(cfg, k)
     rooted = cfg.rooted
     per_rank = cfg.n // k
+    dd_scale = 0    # power-of-two pre-scale exponent of the dd SUM planes
     if dd_planes:
-        from tpu_reductions.ops.dd_reduce import host_key_encode, host_split
+        from tpu_reductions.ops.dd_reduce import (host_key_encode,
+                                                  host_split_scaled)
         from tpu_reductions.parallel.collectives import (
             make_dd_sum_all_reduce, make_key_minmax_all_reduce)
         if rooted == "scatter":
@@ -140,7 +138,12 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
                        "pair all-reduce (replicated output; root holds "
                        "the full array)")
         if method == "SUM":
-            hi, lo = host_split(x_np)
+            # full-range split: exact power-of-two pre-scale, undone at
+            # gather (on a real multi-host pod every process computes the
+            # same scale because every process stages the same global
+            # payload contract; a production variant would agree on the
+            # max exponent with one tiny pmax first)
+            hi, lo, dd_scale = host_split_scaled(x_np)
             pair_fn = make_dd_sum_all_reduce(mesh, axis)
             algorithm = dd_ring_algorithm(k, per_rank)
         else:
@@ -198,7 +201,8 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
                           reps=cfg.retries)
         status = QAStatus.PASSED
         if cfg.verify and expect is not None:
-            got = _gather_result(out, method, cfg, k, dd_planes)
+            got = _gather_result(out, method, cfg, k, dd_planes,
+                                 scale_exp=dd_scale)
             status = (QAStatus.PASSED
                       if _check(got, expect, method, dtype, cfg)
                       else QAStatus.FAILED)
@@ -237,7 +241,8 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
 
         status = QAStatus.PASSED
         if cfg.verify and expect is not None:
-            got = _gather_result(out, method, cfg, k, dd_planes)
+            got = _gather_result(out, method, cfg, k, dd_planes,
+                                 scale_exp=dd_scale)
             status = (QAStatus.PASSED
                       if _check(got, expect, method, dtype, cfg)
                       else QAStatus.FAILED)
@@ -251,14 +256,15 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
 
 
 def _gather_result(out, method: str, cfg: CollectiveConfig, k: int,
-                   dd_planes: bool) -> np.ndarray:
-    """Fetch the device result to host for verification."""
+                   dd_planes: bool, scale_exp: int = 0) -> np.ndarray:
+    """Fetch the device result to host for verification. scale_exp undoes
+    the dd SUM planes' exact power-of-two pre-scale (host_split_scaled)."""
     import jax
     if dd_planes:
         if method == "SUM":
             hi = np.asarray(jax.device_get(out[0]), dtype=np.float64)
             lo = np.asarray(jax.device_get(out[1]), dtype=np.float64)
-            return hi + lo
+            return np.ldexp(hi + lo, scale_exp)
         from tpu_reductions.ops.dd_reduce import host_key_decode
         return host_key_decode(np.asarray(jax.device_get(out[0])),
                                np.asarray(jax.device_get(out[1])))

@@ -192,8 +192,9 @@ def run_benchmark(cfg: ReduceConfig, logger: Optional[BenchLogger] = None,
     if logger is None:
         logger = _make_logger(cfg)
 
-    x64_before = jax.config.jax_enable_x64
-    try:
+    from tpu_reductions.utils.x64 import preserve_x64
+
+    with preserve_x64(restore=not defer):
         if cfg.device is not None:
             # --device analog (reduction.cpp:36): pin all placement to the
             # chosen device for the duration of the run.
@@ -210,9 +211,6 @@ def run_benchmark(cfg: ReduceConfig, logger: Optional[BenchLogger] = None,
                 return _run_benchmark_inner(
                     dataclasses.replace(cfg, device=None), logger, defer)
         return _run_benchmark_inner(cfg, logger, defer)
-    finally:
-        if not defer and jax.config.jax_enable_x64 != x64_before:
-            jax.config.update("jax_enable_x64", x64_before)
 
 
 @dataclasses.dataclass
@@ -298,9 +296,12 @@ def run_benchmark_batch(cfgs, logger: Optional[BenchLogger] = None,
                    "--cpufinal/--check/--trace); on the tunneled platform "
                    "this flips the sync regime for later config(s) "
                    f"{tainted} — order leaky configs last")
-    import jax
-    x64_before = jax.config.jax_enable_x64
-    try:
+    from tpu_reductions.utils.x64 import preserve_x64
+
+    # The scope closes only after every deferred f64 result has
+    # materialized — the reason deferred run_benchmark calls pass
+    # restore=False and the batch owns the restore (utils/x64.py).
+    with preserve_x64():
         pendings = [run_benchmark(cfg, logger=logger, defer=True)
                     for cfg in cfgs]
         results = []
@@ -310,13 +311,6 @@ def run_benchmark_batch(cfgs, logger: Optional[BenchLogger] = None,
                 on_result(cfg, res)
             results.append(res)
         return results
-    finally:
-        # restore only after every deferred f64 result has materialized
-        # (the flag gates creation of f64 values, not reads, but keeping
-        # the scope closed around the whole batch is the simplest honest
-        # contract — round-1 VERDICT weak #7)
-        if jax.config.jax_enable_x64 != x64_before:
-            jax.config.update("jax_enable_x64", x64_before)
 
 
 def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,

@@ -30,11 +30,17 @@ n=2^24 — reduction.cpp:698-705) total error is ~1e-15, comfortably inside
 1e-12. Verified against the exactly-rounded host sum in
 tests/test_dd_reduce.py.
 
-Limitation (SUM only): |x| must be < f32 max (~3.4e38), or hi overflows to
-inf. The benchmark payloads are tiny reals; full-range f64 SUM remains
-available via the XLA path on CPU hosts. MIN/MAX via keys are full-range
-and bit-exact (including -0.0 vs +0.0 ordering; NaNs are excluded by the
-payload contract, as in the reference).
+Range: the SUM path is full f64 range. A bare f32 split would overflow
+for |x| >= ~3.4e38, so the staged path pre-scales the payload by an exact
+power of two (host_split_scaled: ldexp by the max element's exponent, so
+the largest magnitude sits near 2^20) and the host finish undoes it —
+power-of-two scaling is exact in binary floating point, so the error
+budget is unchanged. Elements more than ~2^-169 smaller than the max
+underflow to zero in the scaled planes; their total possible contribution
+(n * max * 2^-169) is ~2^-145 relative, far inside the 1e-12 acceptance
+band. MIN/MAX via keys are full-range and bit-exact (including -0.0 vs
++0.0 ordering; NaNs are excluded by the payload contract, as in the
+reference).
 """
 
 from __future__ import annotations
@@ -59,11 +65,31 @@ from tpu_reductions.ops.pallas_reduce import (LANES, SUBLANES,
 
 def host_split(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """f64 -> (hi, lo) float32 pair with hi + lo == x to ~48 bits. Pure
-    numpy so the split can run before any device transfer."""
+    numpy so the split can run before any device transfer. Overflows for
+    |x| >= f32 max — use host_split_scaled for full-range payloads."""
     x = np.asarray(x, dtype=np.float64)
     hi = x.astype(np.float32)
     lo = (x - hi.astype(np.float64)).astype(np.float32)
     return hi, lo
+
+
+def host_split_scaled(x: np.ndarray
+                      ) -> tuple[np.ndarray, np.ndarray, int]:
+    """Full-range f64 -> (hi, lo, s): split ldexp(x, -s) where the integer
+    exponent shift s places the largest magnitude near 2^20 — far from
+    both f32 overflow (2^128) and the denormal floor for the lo plane.
+    Reconstruct with ldexp(hi + lo, s). Power-of-two rescaling is exact,
+    so precision matches host_split; payloads containing inf/nan are
+    rejected (the reference's payload contract excludes them)."""
+    x = np.asarray(x, dtype=np.float64)
+    m = float(np.max(np.abs(x))) if x.size else 0.0
+    if not np.isfinite(m):
+        raise ValueError("payload contains non-finite values; the dd "
+                         "split (like the reference payload contract) "
+                         "requires finite f64")
+    s = int(np.floor(np.log2(m))) - 20 if m > 0.0 else 0
+    hi, lo = host_split(np.ldexp(x, -s))
+    return hi, lo, s
 
 
 def split_hi_lo(x: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -112,21 +138,26 @@ _I32_MIN = np.int32(-2**31)
 
 def stage_split_padded(x: np.ndarray, method: str, threads: int = 256,
                        max_blocks: int = 64
-                       ) -> tuple[np.ndarray, np.ndarray, tuple[int, int, int]]:
+                       ) -> tuple[np.ndarray, np.ndarray,
+                                  tuple[int, int, int], int]:
     """Host-side staging: encode the f64 payload as two 32-bit planes and
     pad/reshape both to (P*T*TM, LANES).
 
-    SUM -> (hi, lo) float32 double-double planes, zero-padded.
-    MIN/MAX -> (k_hi, k_lo) int32 order-key planes, padded with the
-    largest/smallest key pair (the monoid identity in key space).
-    Returns (plane_hi, plane_lo, (tm, p, t))."""
+    SUM -> (hi, lo) float32 double-double planes (exact power-of-two
+    pre-scaled by 2^-s for full f64 range — host_split_scaled),
+    zero-padded. MIN/MAX -> (k_hi, k_lo) int32 order-key planes (always
+    full-range; s == 0), padded with the largest/smallest key pair (the
+    monoid identity in key space).
+    Returns (plane_hi, plane_lo, (tm, p, t), s) — finish with
+    host_finish_pairs(..., scale_exp=s)."""
     method = method.upper()
     flat = np.ravel(np.asarray(x, dtype=np.float64))
     tm, p, t = choose_tiling(flat.size, threads, max_blocks)
     rows = p * t * tm
     pad = rows * LANES - flat.size
+    s = 0
     if method == "SUM":
-        hi, lo = host_split(flat)
+        hi, lo, s = host_split_scaled(flat)
         pads = (np.float32(0.0), np.float32(0.0))
     else:
         hi, lo = host_key_encode(flat)
@@ -134,7 +165,7 @@ def stage_split_padded(x: np.ndarray, method: str, threads: int = 256,
                 else (_I32_MIN, _I32_MIN))
     hi = np.pad(hi, (0, pad), constant_values=pads[0]).reshape(rows, LANES)
     lo = np.pad(lo, (0, pad), constant_values=pads[1]).reshape(rows, LANES)
-    return hi, lo, (tm, p, t)
+    return hi, lo, (tm, p, t), s
 
 
 # ---------------------------------------------------------------------------
@@ -237,20 +268,22 @@ def dd_pallas_call(hi2d: jax.Array, lo2d: jax.Array, method: str, tm: int,
 # ---------------------------------------------------------------------------
 
 
-def host_finish_pairs(acc_hi, acc_lo, method: str) -> np.float64:
+def host_finish_pairs(acc_hi, acc_lo, method: str,
+                      scale_exp: int = 0) -> np.float64:
     """Finish the small (TM*128-pair) accumulator lattice on host — the
     warp-final analog at --cpufinal semantics (reduction.cpp:328-340).
 
-    SUM: promote f32 (hi, lo) planes to f64 and combine (pairwise np.sum
-    keeps error ~1e-16 relative at this size). MIN/MAX: rebuild the uint64
-    order keys, select (unsigned key order == f64 order), and decode —
-    bit-exact."""
+    SUM: promote f32 (hi, lo) planes to f64, combine (pairwise np.sum
+    keeps error ~1e-16 relative at this size), and undo the staging
+    pre-scale exactly with ldexp(., scale_exp). MIN/MAX: rebuild the
+    uint64 order keys, select (unsigned key order == f64 order), and
+    decode — bit-exact."""
     hi = np.asarray(jax.device_get(acc_hi))
     lo = np.asarray(jax.device_get(acc_lo))
     method = method.upper()
     if method == "SUM":
         z = hi.astype(np.float64) + lo.astype(np.float64)
-        return np.float64(z.sum())
+        return np.float64(np.ldexp(z.sum(), scale_exp))
     vals = host_key_decode(hi, lo)
     # Accumulator slots that only ever saw the padding identity decode to
     # NaN (the pad key is not a real float's image); the payload contract
@@ -270,17 +303,20 @@ def make_dd_staged_reduce(method: str, n: int, *, threads: int = 256,
     tm, _, _ = choose_tiling(n, threads, max_blocks)
 
     def stage_fn(x_np):
-        hi2d, lo2d, (tm2, _, _) = stage_split_padded(
+        hi2d, lo2d, (tm2, _, _), s = stage_split_padded(
             x_np, method, threads, max_blocks)
         assert tm2 == tm
-        return jnp.asarray(hi2d), jnp.asarray(lo2d)
+        # s rides along as a host-side int (untimed staging metadata,
+        # like the padding geometry); reduce_fn undoes it exactly
+        return jnp.asarray(hi2d), jnp.asarray(lo2d), s
 
     kernel_fn = jax.jit(lambda h, l: dd_pallas_call(h, l, method, tm,
                                                     interpret=interpret))
 
-    def reduce_fn(hi2d, lo2d):
+    def reduce_fn(hi2d, lo2d, scale_exp=0):
         acc_hi, acc_lo = kernel_fn(hi2d, lo2d)
-        return host_finish_pairs(acc_hi, acc_lo, method)
+        return host_finish_pairs(acc_hi, acc_lo, method,
+                                 scale_exp=scale_exp)
 
     return stage_fn, reduce_fn
 
@@ -292,11 +328,11 @@ def dd_pallas_reduce_f64(x, method: str = "SUM", *, threads: int = 256,
     f32 Pallas -> host finish). Accepts numpy or jax input."""
     x_np = np.asarray(jax.device_get(x) if isinstance(x, jax.Array) else x,
                       dtype=np.float64)
-    hi2d, lo2d, (tm, _, _) = stage_split_padded(x_np, method, threads,
-                                                max_blocks)
+    hi2d, lo2d, (tm, _, _), s = stage_split_padded(x_np, method, threads,
+                                                   max_blocks)
     acc_hi, acc_lo = dd_pallas_call(jnp.asarray(hi2d), jnp.asarray(lo2d),
                                     method, tm, interpret=interpret)
-    return host_finish_pairs(acc_hi, acc_lo, method)
+    return host_finish_pairs(acc_hi, acc_lo, method, scale_exp=s)
 
 
 def dd_pallas_sum_f64(x: jax.Array, *, threads: int = 256,

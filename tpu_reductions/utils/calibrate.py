@@ -83,8 +83,10 @@ class TimingCalibration:
         a ratio well above 1 means the chain's carry update is being
         lowered to a full buffer copy on this backend, and chained-mode
         GB/s under-reports true kernel bandwidth by about this factor
-        (round-1 ADVICE on ops/chain.py)."""
-        if self.indeterminate or self.amortized_blocked_s <= 0:
+        (round-1 ADVICE on ops/chain.py). NaN on dishonest/indeterminate
+        platforms — there the denominator is the fake dispatch-ack floor
+        and the ratio would measure nothing."""
+        if not self.block_awaits_execution or self.amortized_blocked_s <= 0:
             return float("nan")
         return self.chained_per_iter_s / self.amortized_blocked_s
 
