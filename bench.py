@@ -92,9 +92,14 @@ def main() -> int:
     from tpu_reductions.config import ReduceConfig
     from tpu_reductions.utils.logging import BenchLogger
 
+    # iterations = the chained span (driver.py: k_hi = 1 + iterations).
+    # On this tunnel the slope needs >= ~5 ms of in-program signal to
+    # clear multi-ms materialization jitter: span 16 measured a NEGATIVE
+    # median slope at n=2^24, span 256 a stable one (calibration_r02.json);
+    # at ~24 us/iter (VMEM-resident at this size) 256 iters = ~6 ms.
     base = ReduceConfig(method="SUM", dtype="int32", n=1 << 24,
-                        iterations=64, warmup=2, stat="median",
-                        timing="chained", chain_reps=5,
+                        iterations=256, warmup=2, stat="median",
+                        timing="chained", chain_reps=7,
                         log_file=None)
     cfgs = [dataclasses.replace(base, backend=b, kernel=k, threads=t)
             for b, k, t in CANDIDATES]
