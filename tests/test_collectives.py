@@ -252,7 +252,7 @@ def test_chained_waives_poisoned_reps_keeps_cardinality(monkeypatch):
     from tpu_reductions.utils.qa import QAStatus
 
     def fake_time_chained(chained_fn, x, k_lo, k_hi, reps=5,
-                          stopwatch=None):
+                          stopwatch=None, materialize=None):
         sw = timing_mod.Stopwatch()
         sw.samples = [-1e-3, 2e-3, 0.0][:reps]
         sw.sessions = len(sw.samples)
@@ -269,7 +269,8 @@ def test_chained_waives_poisoned_reps_keeps_cardinality(monkeypatch):
     assert res[0].time_s == 0.0 and res[0].reference_gbps == 0.0
     assert res[1].reference_gbps > 0
     # all poisoned: still `retries` rows, all WAIVED
-    def all_bad(chained_fn, x, k_lo, k_hi, reps=5, stopwatch=None):
+    def all_bad(chained_fn, x, k_lo, k_hi, reps=5, stopwatch=None,
+                materialize=None):
         sw = timing_mod.Stopwatch()
         sw.samples = [-1e-3] * reps
         sw.sessions = reps

@@ -98,7 +98,8 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
 
     from tpu_reductions.parallel.collectives import (
         bandwidth_report, collective_algorithm, dd_ring_algorithm,
-        host_collective_oracle, make_collective_reduce, shard_payload)
+        host_collective_oracle, local_view, local_view_and_selection,
+        make_collective_reduce, mesh_spans_processes, shard_payload)
     from tpu_reductions.parallel.mesh import build_mesh
 
     mesh = build_mesh(num_devices=cfg.num_devices,
@@ -198,13 +199,17 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
         chained = make_chained_collective(method, mesh, axis,
                                           rooted=rooted, coll=run)
         sw = time_chained(chained, x_dev, k_lo=1, k_hi=1 + cfg.chain_span,
-                          reps=cfg.retries)
+                          reps=cfg.retries,
+                          materialize=(local_view
+                                       if mesh_spans_processes(mesh)
+                                       else None))
         status = QAStatus.PASSED
         if cfg.verify and expect is not None:
-            got = _gather_result(out, method, cfg, k, dd_planes,
-                                 scale_exp=dd_scale)
+            got, sel = _gather_result(out, method, cfg, k, dd_planes,
+                                      scale_exp=dd_scale)
             status = (QAStatus.PASSED
-                      if _check(got, expect, method, dtype, cfg)
+                      if _check(got, expect, method, dtype, cfg,
+                                selector=sel)
                       else QAStatus.FAILED)
         for rep, dt in enumerate(sw.samples):
             if dt <= 0:
@@ -241,10 +246,11 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
 
         status = QAStatus.PASSED
         if cfg.verify and expect is not None:
-            got = _gather_result(out, method, cfg, k, dd_planes,
-                                 scale_exp=dd_scale)
+            got, sel = _gather_result(out, method, cfg, k, dd_planes,
+                                      scale_exp=dd_scale)
             status = (QAStatus.PASSED
-                      if _check(got, expect, method, dtype, cfg)
+                      if _check(got, expect, method, dtype, cfg,
+                                selector=sel)
                       else QAStatus.FAILED)
 
         bw = bandwidth_report(payload_bytes, k, dt, algorithm=algorithm)
@@ -257,32 +263,43 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
 
 def _gather_result(out, method: str, cfg: CollectiveConfig, k: int,
                    dd_planes: bool, scale_exp: int = 0) -> np.ndarray:
-    """Fetch the device result to host for verification. scale_exp undoes
-    the dd SUM planes' exact power-of-two pre-scale (host_split_scaled)."""
-    import jax
+    """Fetch this process's view of the device result for verification:
+    (view, selector) where view is the full array on one host or the
+    local shards on a multi-host mesh and selector indexes the global
+    result down to the view — possibly non-contiguous under an
+    interleaved mapping (parallel.collectives.local_view_and_selection).
+    scale_exp undoes the dd SUM planes' exact power-of-two pre-scale
+    (host_split_scaled)."""
+    from tpu_reductions.parallel.collectives import local_view_and_selection
     if dd_planes:
         if method == "SUM":
-            hi = np.asarray(jax.device_get(out[0]), dtype=np.float64)
-            lo = np.asarray(jax.device_get(out[1]), dtype=np.float64)
-            return np.ldexp(hi + lo, scale_exp)
+            hi_v, sel = local_view_and_selection(out[0])
+            lo_v, _ = local_view_and_selection(out[1])
+            hi = np.asarray(hi_v, dtype=np.float64)
+            lo = np.asarray(lo_v, dtype=np.float64)
+            return np.ldexp(hi + lo, scale_exp), sel
         from tpu_reductions.ops.dd_reduce import host_key_decode
-        return host_key_decode(np.asarray(jax.device_get(out[0])),
-                               np.asarray(jax.device_get(out[1])))
-    return np.asarray(jax.device_get(out))
+        hi_v, sel = local_view_and_selection(out[0])
+        lo_v, _ = local_view_and_selection(out[1])
+        return host_key_decode(hi_v, lo_v), sel
+    view, sel = local_view_and_selection(out)
+    return view, sel
 
 
 def _check(got: np.ndarray, expect: np.ndarray, method: str, dtype: str,
-           cfg: CollectiveConfig) -> bool:
+           cfg: CollectiveConfig, selector=slice(None)) -> bool:
     """Acceptance in the reference's spirit (reduction.cpp:750-780): ints
     and selections exact (the key-pair f64 min/max path is bit-exact too);
     float sums within scaled tolerance."""
     if cfg.rooted != "none" and got.size != expect.size:
         # reduce-scatter output is this process's view of the reduced
         # array; on one host all shards are addressable so sizes match —
-        # guard stays for multi-host where only local shards return.
-        # (rooted='root' output is the full replicated array: sizes match
-        # and this is a no-op.)
-        expect = expect.reshape(-1)[: got.size]
+        # on a multi-host mesh only the local shards return, at the
+        # global positions named by `selector` (which an interleaved
+        # mapping makes non-contiguous — collectives.
+        # local_view_and_selection). (rooted='root' output is the full
+        # replicated array: sizes match and this is a no-op.)
+        expect = expect.reshape(-1)[selector]
     if dtype == "int32" or method in ("MIN", "MAX"):
         if dtype == "bfloat16":
             # device min/max selects an exact element, but it was rounded
@@ -314,22 +331,36 @@ def main(argv=None) -> int:
     from tpu_reductions.config import parse_collective
     from tpu_reductions.utils.qa import qa_finish, qa_start
 
-    name = "tpu_reductions.collective"
-    qa_start(name, list(argv) if argv else sys.argv[1:])
     cfg = parse_collective(argv)
-    # --qatest batch mode: QA markers only on the console
+    if cfg.num_processes and cfg.num_processes > 1:
+        # multi-host bring-up BEFORE any device touch (the mpirun tier,
+        # ccni_vn.sh:6-8; recipe in docs/MULTIHOST.md)
+        from tpu_reductions.parallel.mesh import initialize_distributed
+        initialize_distributed(coordinator_address=cfg.coordinator,
+                               num_processes=cfg.num_processes,
+                               process_id=cfg.process_id)
+    import jax
+    rank0 = (cfg.num_processes or 1) <= 1 or jax.process_index() == 0
+    name = "tpu_reductions.collective"
+    if rank0:
+        qa_start(name, list(argv) if argv else sys.argv[1:])
+    # --qatest batch mode: QA markers only on the console; non-zero
+    # processes stay silent entirely — reduce.c prints from rank 0 only
+    # (reduce.c:68,81,95)
     logger = BenchLogger(None, None,
                          console=open(os.devnull, "w")
-                         if cfg.qatest else None)
+                         if (cfg.qatest or not rank0) else None)
+    qa_out = open(os.devnull, "w") if not rank0 else None
     try:
         results = run_collective_benchmark(cfg, logger=logger)
     except Exception as e:  # fail-fast with the QA protocol intact
         logger.log(f"error: {type(e).__name__}: {e}")
-        return qa_finish(name, QAStatus.FAILED)
+        return qa_finish(name, QAStatus.FAILED, out=qa_out)
     # WAIVED rows (noise-swamped chained slopes, unsupported combos) are
     # not failures — same tolerance as the single-chip shmoo exit
     ok = all(r.passed or r.status == QAStatus.WAIVED for r in results)
-    return qa_finish(name, QAStatus.PASSED if ok else QAStatus.FAILED)
+    return qa_finish(name, QAStatus.PASSED if ok else QAStatus.FAILED,
+                     out=qa_out)
 
 
 if __name__ == "__main__":

@@ -160,6 +160,12 @@ class CollectiveConfig:
     timing: str = "periter"          # periter (reduce.c structure) |
                                      # chained (honest slope mode)
     chain_span: int = 16             # in-program iterations per slope
+    # multi-host launch (the mpirun/SLURM tier, ccni_vn.sh:6-8): every
+    # participating process runs the same CLI with its own --process-id;
+    # see docs/MULTIHOST.md
+    coordinator: Optional[str] = None   # host0 address, e.g. "10.0.0.1:8476"
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
@@ -307,6 +313,17 @@ def _apply_platform(ns) -> None:
             # environment-provided device count (XLA_FLAGS) alone.
             want = ns.num_devices * (2 if getattr(ns, "mode", "vn") == "co"
                                      else 1)
+            nproc = getattr(ns, "num_processes", None) or 1
+            if nproc > 1:
+                # multi-host: --devices is the GLOBAL rank count; each
+                # process provisions only its local share
+                if want % nproc != 0:
+                    raise SystemExit(
+                        f"--devices={want} must be divisible by "
+                        f"--num-processes={nproc}: every process "
+                        "provisions devices/num_processes local virtual "
+                        "devices (docs/MULTIHOST.md)")
+                want //= nproc
             jax.config.update("jax_num_cpu_devices", want)
 
 
@@ -342,6 +359,16 @@ def build_collective_parser() -> argparse.ArgumentParser:
     p.add_argument("--chainspan", dest="chain_span", type=int, default=16,
                    help="In-program iterations per slope for "
                         "--timing=chained")
+    p.add_argument("--coordinator", type=str, default=None,
+                   help="Multi-host: coordinator address host:port "
+                        "(process 0's host); see docs/MULTIHOST.md")
+    p.add_argument("--num-processes", dest="num_processes", type=int,
+                   default=None,
+                   help="Multi-host: total participating processes")
+    p.add_argument("--process-id", dest="process_id", type=int,
+                   default=None,
+                   help="Multi-host: this process's id in [0, "
+                        "num_processes)")
     return p
 
 
@@ -356,4 +383,6 @@ def parse_collective(argv=None) -> CollectiveConfig:
         warmup=ns.warmup, num_devices=ns.num_devices, mapping=ns.mapping,
         mode=ns.mode, rooted=ns.rooted, seed=ns.seed, verify=ns.verify,
         qatest=ns.qatest, timing=ns.timing, chain_span=ns.chain_span,
+        coordinator=ns.coordinator, num_processes=ns.num_processes,
+        process_id=ns.process_id,
     )

@@ -121,12 +121,74 @@ WIRE_FACTORS = {
 }
 
 
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh includes devices owned by other processes —
+    the multi-host regime (N MPI ranks across nodes, reduce.c:32-34 ≙ N
+    jax processes over DCN), where only this process's shards are
+    addressable."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.ravel())
+
+
 def shard_payload(x_global: np.ndarray, mesh: Mesh, axis: str) -> jax.Array:
     """Place a global (k*L,) payload sharded over the mesh axis — each
     device ends up with its rank's contiguous L-element block, the analog
-    of each MPI rank generating/holding its own sendbuf (reduce.c:43-57)."""
+    of each MPI rank generating/holding its own sendbuf (reduce.c:43-57).
+
+    Multi-host meshes take the callback path: every process stages the
+    same deterministic global payload (the rank-offset MT19937 contract,
+    reduce.c:38-41 — seeds derive from GLOBAL rank, so all hosts agree)
+    and contributes only its addressable shards."""
     sharding = NamedSharding(mesh, P(axis))
+    if mesh_spans_processes(mesh):
+        return jax.make_array_from_callback(
+            x_global.shape, sharding, lambda idx: x_global[idx])
     return jax.device_put(x_global, sharding)
+
+
+def local_view(arr: jax.Array) -> np.ndarray:
+    """local_view_and_selection without the selector — this process's
+    recvbuf contents alone (e.g. as a chained-timing materializer,
+    utils/timing.time_chained)."""
+    return local_view_and_selection(arr)[0]
+
+
+def local_view_and_selection(arr: jax.Array):
+    """Materialize this process's view of a (possibly multi-host) array —
+    the analog of an MPI rank examining its recvbuf after MPI_Reduce
+    (reduce.c:76,90; only rank 0's was meaningful there, every process's
+    is here).
+
+    Returns (view, selector):
+      view      the full array when fully addressable (single host) or
+                when the output is replicated; else this process's shards
+                concatenated in global-index order.
+      selector  indexes the global result to what `view` holds:
+                slice(None) for a full/replicated view, else an integer
+                index array — which need NOT be contiguous (an
+                'interleaved' device mapping scatters one process's
+                shards across the global order), so a verifier must
+                apply it, not assume an offset.
+    """
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(arr)), slice(None)
+    shards = list(arr.addressable_shards)
+    if not shards:
+        raise RuntimeError(
+            "mesh excludes this process: no addressable shards (the "
+            "requested --devices count cut this process's devices out "
+            "of the mesh; every participating process must own at "
+            "least one mesh device)")
+    idx0 = shards[0].index[0] if shards[0].index else slice(None)
+    if idx0 == slice(None, None, None):     # replicated: any shard is whole
+        return np.asarray(shards[0].data), slice(None)
+    shards.sort(key=lambda s: s.index[0].start or 0)
+    view = np.concatenate([np.asarray(s.data) for s in shards])
+    sel = np.concatenate([
+        np.arange((s.index[0].start or 0),
+                  (s.index[0].start or 0) + int(np.asarray(s.data).shape[0]))
+        for s in shards])
+    return view, sel
 
 
 def make_collective_reduce(method: str, mesh: Mesh, axis: str = "ranks",

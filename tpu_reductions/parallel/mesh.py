@@ -56,6 +56,35 @@ def _order_devices(devs: list, mapping: str) -> list:
     raise ValueError(f"unknown mapping {mapping!r}; one of {MAPPINGS}")
 
 
+def coarsen_to_chips(devs: Sequence) -> list:
+    """One device per physical chip — the real CO-mode granularity.
+
+    Blue Gene CO mode ran 1 rank per node where VN ran one per core
+    (ccni_vn.sh:6). The TPU twin: on generations whose JAX devices are
+    per-TensorCore (v2/v3/v5p expose `coords` shared by a chip's cores
+    and a distinguishing `core_on_chip`), CO keeps the first core of
+    every chip. On single-device-per-chip generations (v4/v5e megacore)
+    every device already IS a chip and CO == VN — exactly as CO == VN on
+    a single-core Blue Gene node would have been.
+
+    Devices without chip topology (the virtual CPU test mesh) SIMULATE
+    the VN->CO halving by keeping every other device — that branch
+    exists so the CO code path is exercisable off-TPU, and is labeled a
+    simulation here and in PARITY.md, not claimed as a granularity
+    semantic.
+    """
+    if not all(hasattr(d, "coords") for d in devs):
+        return list(devs[0::2]) if len(devs) > 1 else list(devs)
+    seen: dict = {}
+    for d in devs:
+        chip = (d.process_index, getattr(d, "slice_index", 0),
+                tuple(d.coords))
+        if chip not in seen or getattr(d, "core_on_chip", 0) < \
+                getattr(seen[chip], "core_on_chip", 0):
+            seen[chip] = d
+    return list(seen.values())
+
+
 def build_mesh(num_devices: Optional[int] = None,
                mesh_shape: Optional[Sequence[int]] = None,
                axis_names: Optional[Sequence[str]] = None,
@@ -69,9 +98,7 @@ def build_mesh(num_devices: Optional[int] = None,
     """
     devs = jax.devices()
     if mode == "co":
-        # coprocessor-mode analog: one rank per device *pair* (half the
-        # addressable ranks, each with the same per-rank payload).
-        devs = devs[0::2] if len(devs) > 1 else devs
+        devs = coarsen_to_chips(devs)
     elif mode != "vn":
         raise ValueError("mode must be 'vn' or 'co'")
     devs = _order_devices(devs, mapping)
@@ -99,15 +126,37 @@ def build_mesh(num_devices: Optional[int] = None,
     return Mesh(dev_array, axis_names)
 
 
+def _distributed_client_active() -> bool:
+    """Whether jax.distributed.initialize has already run in this
+    process (calling it twice raises)."""
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client is not None
+    except Exception:       # private-API drift: assume not initialized
+        return False
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None) -> None:
+                           process_id: Optional[int] = None) -> bool:
     """Multi-host bring-up over DCN — the mpirun/SLURM launch analog
-    (ccni_vn.sh:6-8). No-op when single-process or already initialized;
-    on a real pod slice each host calls this before build_mesh and the
-    mesh then spans all hosts' devices."""
+    (ccni_vn.sh:6-8). Every participating process calls this before
+    build_mesh; the mesh then spans all processes' devices and the
+    collectives ride the cross-host transport (ICI within a slice, DCN/
+    gloo across hosts). Returns True when it initialized the runtime,
+    False when it no-opped (single-process, or already initialized —
+    jax.distributed.initialize raises if called twice, so the guard is
+    load-bearing, not cosmetic).
+
+    Launch recipe: docs/MULTIHOST.md (pod slice: one process per host,
+    same binary, coordinator = host 0; localhost demo: two CPU processes
+    over gloo — exercised by tests/test_mesh_distributed.py and
+    `python __graft_entry__.py`)."""
     if num_processes in (None, 1):
-        return
+        return False
+    if _distributed_client_active():
+        return False
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
+    return True

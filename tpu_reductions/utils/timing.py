@@ -147,7 +147,8 @@ def time_fn(fn: Callable, *args, iterations: int = 100, warmup: int = 1,
 
 
 def time_chained(chained_fn, x, k_lo: int, k_hi: int, reps: int = 5,
-                 stopwatch: Optional[Stopwatch] = None) -> Stopwatch:
+                 stopwatch: Optional[Stopwatch] = None,
+                 materialize=None) -> Stopwatch:
     """Slope-based per-iteration timing of a chained reduction
     (ops/chain.py): time `chained_fn(x, k)` to host materialization at two
     trip counts and divide the difference by (k_hi - k_lo).
@@ -167,10 +168,14 @@ def time_chained(chained_fn, x, k_lo: int, k_hi: int, reps: int = 5,
         raise ValueError(f"need k_lo < k_hi, got {k_lo} >= {k_hi}")
     sw = stopwatch or Stopwatch()
     span = k_hi - k_lo
+    # materialization = completion; multi-host callers pass a local-shard
+    # materializer (parallel.collectives.local_view) since device_get
+    # rejects arrays with non-addressable shards
+    fetch = materialize or jax.device_get
 
     def run(k) -> float:
         t0 = time.perf_counter()
-        jax.device_get(chained_fn(x, k))   # materialization = completion
+        fetch(chained_fn(x, k))
         return time.perf_counter() - t0
 
     run(k_lo)   # warm-up: compile (k is traced — one executable for both)
