@@ -219,3 +219,16 @@ def test_resolved_timing_matches_fallback_rules():
         method="SUM", timing="chained")) == "chained"
     assert resolved_timing(ReduceConfig(
         method="SUM", timing="periter", cpu_final=True)) == "periter"
+
+
+def test_auto_chain_span_scales_with_payload():
+    from tpu_reductions.ops.chain import auto_chain_span
+    # tiny payloads need many in-program iterations for slope signal...
+    small = auto_chain_span(1 << 10, "int32")
+    # ...huge ones carry milliseconds per iteration and need few
+    big = auto_chain_span(1 << 30, "int32")
+    assert small > big
+    assert 8 <= big <= small <= 4096
+    # monotone non-increasing across the sweep range
+    spans = [auto_chain_span(1 << p, "int32") for p in range(10, 31)]
+    assert all(a >= b for a, b in zip(spans, spans[1:]))

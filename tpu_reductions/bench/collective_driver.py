@@ -262,7 +262,7 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
 
 
 def _gather_result(out, method: str, cfg: CollectiveConfig, k: int,
-                   dd_planes: bool, scale_exp: int = 0) -> np.ndarray:
+                   dd_planes: bool, scale_exp: int = 0):
     """Fetch this process's view of the device result for verification:
     (view, selector) where view is the full array on one host or the
     local shards on a multi-host mesh and selector indexes the global
@@ -327,23 +327,59 @@ def run_collective_suite(cfg: CollectiveConfig,
     return results
 
 
+def _rank0_hint(args) -> bool:
+    """Whether this process will report, decided BEFORE parsing so the
+    '&&&& RUNNING' marker can precede any parse/bring-up failure (the
+    marker grammar must survive failures — downstream tooling greps it).
+    Only an explicit --process-id flag can demote a process here; auto-
+    detected pod ranks are resolved after bring-up."""
+    for i, a in enumerate(args):
+        if a.startswith("--process-id"):
+            val = (a.split("=", 1)[1] if "=" in a
+                   else (args[i + 1] if i + 1 < len(args) else "0"))
+            try:
+                return int(val) == 0
+            except ValueError:
+                return True
+    return True
+
+
 def main(argv=None) -> int:
     from tpu_reductions.config import parse_collective
     from tpu_reductions.utils.qa import qa_finish, qa_start
 
-    cfg = parse_collective(argv)
-    if cfg.num_processes and cfg.num_processes > 1:
-        # multi-host bring-up BEFORE any device touch (the mpirun tier,
-        # ccni_vn.sh:6-8; recipe in docs/MULTIHOST.md)
-        from tpu_reductions.parallel.mesh import initialize_distributed
-        initialize_distributed(coordinator_address=cfg.coordinator,
-                               num_processes=cfg.num_processes,
-                               process_id=cfg.process_id)
-    import jax
-    rank0 = (cfg.num_processes or 1) <= 1 or jax.process_index() == 0
+    args = list(argv) if argv else sys.argv[1:]
     name = "tpu_reductions.collective"
+    rank0 = _rank0_hint(args)
     if rank0:
-        qa_start(name, list(argv) if argv else sys.argv[1:])
+        qa_start(name, args)
+    qa_out = open(os.devnull, "w") if not rank0 else None
+    try:
+        cfg = parse_collective(argv)
+    except SystemExit as e:
+        # argparse already printed its usage/error; close the QA grammar
+        # before propagating its exit code (marker-stability contract)
+        if e.code not in (0, None):
+            qa_finish(name, QAStatus.FAILED, out=qa_out)
+        raise
+    except Exception as e:   # config validation (bad --method value, ...)
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+        return qa_finish(name, QAStatus.FAILED, out=qa_out)
+    try:
+        if cfg.num_processes and cfg.num_processes > 1:
+            # multi-host bring-up BEFORE any device touch (the mpirun
+            # tier, ccni_vn.sh:6-8; recipe in docs/MULTIHOST.md)
+            from tpu_reductions.parallel.mesh import initialize_distributed
+            initialize_distributed(coordinator_address=cfg.coordinator,
+                                   num_processes=cfg.num_processes,
+                                   process_id=cfg.process_id)
+        import jax
+        rank0 = ((cfg.num_processes or 1) <= 1
+                 or jax.process_index() == 0)
+    except Exception as e:   # dead coordinator, misconfigured slice, ...
+        print(f"error: multi-host bring-up failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return qa_finish(name, QAStatus.FAILED, out=qa_out)
     # --qatest batch mode: QA markers only on the console; non-zero
     # processes stay silent entirely — reduce.c prints from rank 0 only
     # (reduce.c:68,81,95)

@@ -40,7 +40,17 @@ def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
     cfgs = []
     for p in range(min_pow, max_pow + 1):
         n = 1 << p
-        iters = max(3, min(cfg.iterations, (1 << 28) // n))
+        if cfg.timing == "chained":
+            # iterations IS the slope span in chained mode: size it per
+            # payload (enough signal to clear tunnel jitter at small N,
+            # no wasted minutes at 2^30 — ops/chain.auto_chain_span),
+            # but never past the user's explicit --iterations bound
+            from tpu_reductions.ops.chain import auto_chain_span
+            iters = min(auto_chain_span(n, cfg.dtype),
+                        max(cfg.iterations, 8))
+            logger.log(f"shmoo n={n}: chained span {iters}")
+        else:
+            iters = max(3, min(cfg.iterations, (1 << 28) // n))
         cfgs.append(dataclasses.replace(cfg, n=n, iterations=iters))
     # batch: legacy timing modes are timed before any result is
     # materialized so every size runs in the same sync regime; chained

@@ -318,11 +318,13 @@ def _apply_platform(ns) -> None:
                 # multi-host: --devices is the GLOBAL rank count; each
                 # process provisions only its local share
                 if want % nproc != 0:
+                    co = (" (mode=co provisions 2x that in virtual "
+                          "devices)" if want != ns.num_devices else "")
                     raise SystemExit(
-                        f"--devices={want} must be divisible by "
-                        f"--num-processes={nproc}: every process "
-                        "provisions devices/num_processes local virtual "
-                        "devices (docs/MULTIHOST.md)")
+                        f"--devices={ns.num_devices}{co} must divide "
+                        f"evenly among --num-processes={nproc}: every "
+                        "process provisions an equal local share "
+                        "(docs/MULTIHOST.md)")
                 want //= nproc
             jax.config.update("jax_num_cpu_devices", want)
 

@@ -34,12 +34,50 @@ timing only.
 
 from __future__ import annotations
 
+import math
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 
 from tpu_reductions.ops.registry import ReduceOpSpec
+
+# VMEM capacity bound for the v5e-class chips this targets: working sets
+# at or under this can stay VMEM-resident across chained iterations
+# (measured: a 64 MiB carry reduced at ~2.8 TB/s, 3.4x the HBM roof —
+# calibration_r02.json), so the span estimate must assume the FAST
+# regime there or the slope signal comes up short.
+_VMEM_RESIDENT_BYTES = 112 * 1024 * 1024
+_VMEM_BYTES_PER_S = 3.5e12
+_TPU_HBM_BYTES_PER_S = 819e9      # v5e HBM roofline
+_CPU_BYTES_PER_S = 10e9
+
+
+def auto_chain_span(n: int, dtype: str, *, target_signal_s: float = 6e-3,
+                    lo: int = 8, hi: int = 4096) -> int:
+    """Pick the in-program iteration count (the slope span) for chained
+    timing at payload size n.
+
+    The slope (t(k_hi) - t(k_lo)) needs enough in-program signal to
+    clear the tunnel's multi-ms materialization jitter: span 16 at
+    n=2^24 measured a NEGATIVE median slope, span 256 a stable one
+    (calibration_r02.json) — but at n=2^30 one iteration already takes
+    ~5 ms and a fixed span 256 would burn minutes per sample. Estimate
+    the per-iteration time from the platform roofline (the VMEM-resident
+    rate for working sets that fit, since overestimating per-iter time
+    undersizes the span) and size the span to ~target_signal_s of real
+    device work, clamped to [lo, hi]."""
+    import numpy as np
+    bytes_per_iter = n * np.dtype(jnp.bfloat16 if dtype == "bfloat16"
+                                  else dtype).itemsize
+    if jax.default_backend() == "tpu":
+        rate = (_VMEM_BYTES_PER_S if bytes_per_iter <= _VMEM_RESIDENT_BYTES
+                else _TPU_HBM_BYTES_PER_S)
+    else:
+        rate = _CPU_BYTES_PER_S
+    est_iter_s = bytes_per_iter / rate
+    return max(lo, min(hi, math.ceil(target_signal_s / max(est_iter_s,
+                                                           1e-9))))
 
 
 def make_chained_reduce(core: Callable[[jax.Array], jax.Array],
