@@ -1,0 +1,91 @@
+#!/usr/bin/env bash
+# Flagship on-chip experiment -> examples/tpu_run (VERDICT r1 items 1-3):
+# calibration at honest scale, the tuned single-chip grid at n=2^24, and
+# the full bandwidth-vs-N curve to 2^30 (BASELINE config #5; the
+# reference's dead shmoo swept to 32M, reduction.cpp:581-657), with
+# plots and the generated report — the TPU twin of examples/cpu_demo.
+#
+# Usage: scripts/run_tpu_experiment.sh [OUT_DIR=examples/tpu_run]
+# Resumable: interrupted sweeps reuse verified cached cells (sweep_all)
+# on the next invocation.
+set -euo pipefail
+
+OUT=${1:-examples/tpu_run}
+
+python - "$OUT" <<'PY'
+import json
+import sys
+from pathlib import Path
+
+out = Path(sys.argv[1])
+out.mkdir(parents=True, exist_ok=True)
+
+import jax
+
+assert jax.default_backend() == "tpu", (
+    "this is the on-chip experiment; run scripts/run_experiment.sh "
+    "out/ --platform cpu for the host pipeline")
+
+from tpu_reductions.bench.plot import plot_vs_n
+from tpu_reductions.bench.report import generate_report
+from tpu_reductions.bench.sweep import run_shmoo, sweep_all
+from tpu_reductions.config import ReduceConfig
+from tpu_reductions.utils.calibrate import calibrate
+from tpu_reductions.utils.logging import BenchLogger
+
+log = BenchLogger(None, None)
+
+# 1) calibration at HONEST scale: >= 2^26 f32 so the working set exceeds
+# VMEM and the real per-iteration time clears the dispatch-ack floor
+# (docs/TIMING.md "Round-2 on-chip calibration findings")
+cal_file = out / "calibration.json"
+if cal_file.exists():
+    cal = json.loads(cal_file.read_text())
+    log.log("calibration: resumed from file")
+else:
+    cal = calibrate(n=1 << 26, iters=8, reps=7, chain_span=64).to_dict()
+    cal_file.write_text(json.dumps(cal, indent=1))
+log.log(f"calibration: block_awaits_execution="
+        f"{cal['block_awaits_execution']} "
+        f"honest_gbps={cal['honest_gbps']:.1f}")
+
+# 2) the tuned flagship grid at the reference's n=2^24
+# (reduction.cpp:665): kernel 6 threads=512 won the committed tile race
+# (tune_r02.json) at 6238 GB/s
+sc_rows = sweep_all(n=1 << 24, repeats=3, iterations=256,
+                    backend="pallas", kernel=6, threads=512,
+                    timing="chained",
+                    out_dir=str(out / "single_chip"), logger=log)
+sc = {}
+for r in sc_rows:
+    if r and r["status"] == "PASSED":
+        dt = {"int32": "INT", "float64": "DOUBLE"}.get(
+            r["dtype"], r["dtype"].upper())
+        sc.setdefault((dt, r["method"]), []).append(r["gbps"])
+sc = {k: sum(v) / len(v) for k, v in sc.items()}
+(out / "single_chip" / "averages.json").write_text(
+    json.dumps({f"{d} {m}": g for (d, m), g in sorted(sc.items())},
+               indent=1))
+
+# 3) bandwidth-vs-N: int32 SUM to 2^30 (4 GiB), f64 SUM to 2^28
+# (the dd planes double the footprint; 2^28 keeps headroom in 16 GiB
+# HBM). Spans auto-size per payload (ops/chain.auto_chain_span).
+shmoo_rows = []
+for dtype, max_pow in (("int32", 30), ("float64", 28)):
+    base = ReduceConfig(method="SUM", dtype=dtype, n=1 << 20,
+                        backend="pallas", kernel=6, threads=512,
+                        timing="chained", chain_reps=5, stat="median",
+                        iterations=4096, log_file=None)
+    res = run_shmoo(base, min_pow=10, max_pow=max_pow, logger=log)
+    shmoo_rows += [r.to_dict() for r in res if r.passed]
+(out / "shmoo.json").write_text(json.dumps(shmoo_rows, indent=1))
+figures = plot_vs_n(shmoo_rows, out / "bandwidth_vs_n",
+                    title="TPU v5e single-chip reduction bandwidth vs N")
+
+# 4) report: single-chip tables + curves + the calibration note (no
+# multi-chip rank sweep here — one physical chip; the CPU-mesh
+# collective example lives in examples/cpu_demo)
+paths = generate_report({}, single_chip=sc, figures=figures,
+                        out_dir=out, platform="tpu", calibration=cal)
+print("report:", paths["md"], paths["tex"])
+PY

@@ -350,18 +350,23 @@ def main(argv=None) -> int:
 
     args = list(argv) if argv else sys.argv[1:]
     name = "tpu_reductions.collective"
+    if any(a in ("-h", "--help") for a in args):
+        # help is not a benchmark run: no QA markers around usage text
+        parse_collective(argv)          # prints help, SystemExit(0)
     rank0 = _rank0_hint(args)
     if rank0:
         qa_start(name, args)
+    # marker balance: a printed RUNNING must ALWAYS get a terminal
+    # marker from this process, even if bring-up later demotes it from
+    # rank 0 (auto-detected pod ranks) — only row/log output goes quiet
     qa_out = open(os.devnull, "w") if not rank0 else None
     try:
         cfg = parse_collective(argv)
-    except SystemExit as e:
+    except SystemExit:
         # argparse already printed its usage/error; close the QA grammar
-        # before propagating its exit code (marker-stability contract)
-        if e.code not in (0, None):
-            qa_finish(name, QAStatus.FAILED, out=qa_out)
-        raise
+        # and keep the exit-code-equals-status contract (FAILED = 1,
+        # shrQATest.h:224-229 discipline) instead of argparse's 2
+        return qa_finish(name, QAStatus.FAILED, out=qa_out)
     except Exception as e:   # config validation (bad --method value, ...)
         print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
         return qa_finish(name, QAStatus.FAILED, out=qa_out)
@@ -374,19 +379,19 @@ def main(argv=None) -> int:
                                    num_processes=cfg.num_processes,
                                    process_id=cfg.process_id)
         import jax
-        rank0 = ((cfg.num_processes or 1) <= 1
-                 or jax.process_index() == 0)
+        reporting = ((cfg.num_processes or 1) <= 1
+                     or jax.process_index() == 0)
     except Exception as e:   # dead coordinator, misconfigured slice, ...
         print(f"error: multi-host bring-up failed: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
         return qa_finish(name, QAStatus.FAILED, out=qa_out)
-    # --qatest batch mode: QA markers only on the console; non-zero
-    # processes stay silent entirely — reduce.c prints from rank 0 only
-    # (reduce.c:68,81,95)
+    # --qatest batch mode: QA markers only on the console; non-reporting
+    # processes print no rows — reduce.c prints from rank 0 only
+    # (reduce.c:68,81,95). qa_out is NOT tightened here: a process that
+    # printed RUNNING under the pre-parse hint still closes its grammar.
     logger = BenchLogger(None, None,
                          console=open(os.devnull, "w")
-                         if (cfg.qatest or not rank0) else None)
-    qa_out = open(os.devnull, "w") if not rank0 else None
+                         if (cfg.qatest or not reporting) else None)
     try:
         results = run_collective_benchmark(cfg, logger=logger)
     except Exception as e:  # fail-fast with the QA protocol intact

@@ -43,11 +43,17 @@ def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
         if cfg.timing == "chained":
             # iterations IS the slope span in chained mode: size it per
             # payload (enough signal to clear tunnel jitter at small N,
-            # no wasted minutes at 2^30 — ops/chain.auto_chain_span),
-            # but never past the user's explicit --iterations bound
+            # no wasted minutes at 2^30 — ops/chain.auto_chain_span).
+            # An EXPLICIT --iterations bounds the span; the dataclass
+            # default does not (a default-100 cap would hold small-N
+            # spans in exactly the negative-slope regime auto-sizing
+            # exists to escape).
+            from tpu_reductions.config import ReduceConfig as _RC
             from tpu_reductions.ops.chain import auto_chain_span
-            iters = min(auto_chain_span(n, cfg.dtype),
-                        max(cfg.iterations, 8))
+            default_iters = _RC.__dataclass_fields__["iterations"].default
+            iters = auto_chain_span(n, cfg.dtype)
+            if cfg.iterations != default_iters:
+                iters = min(iters, max(cfg.iterations, 8))
             logger.log(f"shmoo n={n}: chained span {iters}")
         else:
             iters = max(3, min(cfg.iterations, (1 << 28) // n))
@@ -131,6 +137,7 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
               dtypes=("int32", "float64"), n: int = 1 << 24,
               repeats: int = 5, iterations: int = 20,
               backend: str = "auto",
+              threads: int = 256, kernel: Optional[int] = None,
               timing: str = "periter", chain_reps: int = 5,
               out_dir: Optional[str] = None,
               resume: bool = True,
@@ -177,11 +184,16 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                     # the keys compare against the same resolution.
                     probe = ReduceConfig(method=method, dtype=dtype,
                                          backend=backend, timing=timing,
-                                         chain_reps=chain_reps)
+                                         chain_reps=chain_reps,
+                                         threads=threads,
+                                         **({"kernel": kernel}
+                                            if kernel else {}))
                     want_timing = resolved_timing(probe)
                     if (row.get("status") == "PASSED"
                             and row.get("n") == n
                             and row.get("backend") == _resolve_backend(probe)
+                            and row.get("kernel") == probe.kernel
+                            and row.get("threads", 256) == threads
                             and row.get("iterations") == iterations
                             and row.get("timing", "periter") == want_timing
                             and (want_timing != "chained"
@@ -194,9 +206,12 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                 cfg = ReduceConfig(method=method, dtype=dtype, n=n,
                                    iterations=iterations, backend=backend,
                                    timing=timing, chain_reps=chain_reps,
+                                   threads=threads,
                                    stat="median" if timing == "chained"
                                    else "mean",
-                                   seed=rep, log_file=None)
+                                   seed=rep, log_file=None,
+                                   **({"kernel": kernel}
+                                      if kernel else {}))
                 queued.append((len(rows), rep, fname, cfg))
                 rows.append(None)  # placeholder, filled in phase 2
     # Time the whole queue first (no materialization — see above), then
@@ -209,6 +224,8 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
         idx, rep, fname, _ = next(cells)
         row = res.to_dict()
         row["repeat"] = rep
+        row["threads"] = cfg.threads    # resume key (kernel is already
+                                        # in BenchResult; threads is not)
         # row["timing"] comes from the result: the discipline actually
         # used (the driver may fall back from chained to fetch), so the
         # resume key can never launder one discipline as another

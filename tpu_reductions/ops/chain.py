@@ -42,14 +42,21 @@ import jax.numpy as jnp
 
 from tpu_reductions.ops.registry import ReduceOpSpec
 
-# VMEM capacity bound for the v5e-class chips this targets: working sets
-# at or under this can stay VMEM-resident across chained iterations
-# (measured: a 64 MiB carry reduced at ~2.8 TB/s, 3.4x the HBM roof —
-# calibration_r02.json), so the span estimate must assume the FAST
-# regime there or the slope signal comes up short.
-_VMEM_RESIDENT_BYTES = 112 * 1024 * 1024
-_VMEM_BYTES_PER_S = 3.5e12
-_TPU_HBM_BYTES_PER_S = 819e9      # v5e HBM roofline
+# Span-sizing rate model per device kind: (vmem_resident_bytes,
+# vmem_rate, hbm_rate). Working sets at or under the residency bound can
+# stay VMEM-resident across chained iterations (measured on v5e: a
+# 64 MiB carry reduced at ~2.8 TB/s, 3.4x the HBM roof —
+# calibration_r02.json), so the estimate must assume the FAST regime
+# there or the slope signal comes up short. Erring fast (bigger span)
+# costs seconds; erring slow risks the negative-slope failure mode, so
+# the unknown-TPU default reuses the fastest measured rates.
+_TPU_RATE_MODEL = {
+    # device_kind prefix: (resident_bytes, vmem_B/s, hbm_B/s)
+    "TPU v5 lite": (112 << 20, 3.5e12, 819e9),    # v5e, measured here
+    "TPU v5p": (80 << 20, 1.2e13, 2765e9),
+    "TPU v4": (100 << 20, 8e12, 1228e9),
+}
+_TPU_DEFAULT_RATES = (112 << 20, 3.5e12, 2765e9)
 _CPU_BYTES_PER_S = 10e9
 
 
@@ -71,8 +78,11 @@ def auto_chain_span(n: int, dtype: str, *, target_signal_s: float = 6e-3,
     bytes_per_iter = n * np.dtype(jnp.bfloat16 if dtype == "bfloat16"
                                   else dtype).itemsize
     if jax.default_backend() == "tpu":
-        rate = (_VMEM_BYTES_PER_S if bytes_per_iter <= _VMEM_RESIDENT_BYTES
-                else _TPU_HBM_BYTES_PER_S)
+        kind = jax.devices()[0].device_kind
+        resident, vmem_rate, hbm_rate = next(
+            (v for k, v in _TPU_RATE_MODEL.items() if kind.startswith(k)),
+            _TPU_DEFAULT_RATES)
+        rate = vmem_rate if bytes_per_iter <= resident else hbm_rate
     else:
         rate = _CPU_BYTES_PER_S
     est_iter_s = bytes_per_iter / rate
