@@ -11,6 +11,22 @@
 set -euo pipefail
 
 OUT=${1:-examples/tpu_run}
+cd "$(dirname "$0")/.."
+
+# A wedged axon tunnel hangs jax device discovery in-process (CLAUDE.md);
+# probe in a killable subprocess first, like bench.py does, instead of
+# hanging the whole experiment with no diagnostic.
+python - <<'PY'
+import sys
+
+sys.path.insert(0, ".")
+from bench import _device_probe
+
+outage = _device_probe()
+if outage is not None:
+    print(f"accelerator unavailable: {outage}", file=sys.stderr)
+    sys.exit(3)
+PY
 
 python - "$OUT" <<'PY'
 import json
@@ -80,7 +96,9 @@ for dtype, max_pow in (("int32", 30), ("float64", 28)):
     shmoo_rows += [r.to_dict() for r in res if r.passed]
 (out / "shmoo.json").write_text(json.dumps(shmoo_rows, indent=1))
 figures = plot_vs_n(shmoo_rows, out / "bandwidth_vs_n",
-                    title="TPU v5e single-chip reduction bandwidth vs N")
+                    title="TPU v5e single-chip reduction bandwidth vs N",
+                    hlines={"reference CUDA int SUM (90.8)": 90.8413,
+                            "v5e HBM roof (819)": 819.0})
 
 # 4) report: single-chip tables + curves + the calibration note (no
 # multi-chip rank sweep here — one physical chip; the CPU-mesh

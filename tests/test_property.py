@@ -88,7 +88,7 @@ def test_pallas_reduce_edge_geometries(g):
 
 
 @pytest.mark.slow
-@settings(max_examples=40, deadline=None)
+@settings(max_examples=150, deadline=None)
 @given(geometry)
 def test_pallas_reduce_matches_oracle_any_geometry(g):
     x = host_data(g["n"], g["dtype"], rank=0, seed=g["seed"])
@@ -108,7 +108,7 @@ def test_xla_reduce_matches_oracle(n, method, dtype):
 
 
 @pytest.mark.slow
-@settings(max_examples=10, deadline=None)
+@settings(max_examples=40, deadline=None)
 @given(st.integers(min_value=1, max_value=1 << 12),
        st.sampled_from(["SUM", "MIN", "MAX"]),
        st.sampled_from([1, 3, 9]))

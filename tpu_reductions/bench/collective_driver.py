@@ -362,7 +362,9 @@ def main(argv=None) -> int:
     qa_out = open(os.devnull, "w") if not rank0 else None
     try:
         cfg = parse_collective(argv)
-    except SystemExit:
+    except SystemExit as e:
+        if e.code in (0, None):      # a successful parser exit path
+            return 0
         # argparse already printed its usage/error; close the QA grammar
         # and keep the exit-code-equals-status contract (FAILED = 1,
         # shrQATest.h:224-229 discipline) instead of argparse's 2

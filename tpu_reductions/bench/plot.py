@@ -65,11 +65,17 @@ def plot_vs_ranks(avgs: Dict[Key, float], dtype_name: str,
 
 
 def plot_vs_n(shmoo_rows: Sequence[dict], out_base: str | Path,
-              title: str = "Single-chip reduction bandwidth vs N"
+              title: str = "Single-chip reduction bandwidth vs N",
+              hlines: Optional[Dict[str, float]] = None
               ) -> Sequence[Path]:
     """Bandwidth-vs-N curves from shmoo results (one line per
     (method, dtype)) — the sweep plot the reference's stubbed shmoo never
-    produced. shmoo_rows: BenchResult.to_dict() dicts."""
+    produced. shmoo_rows: BenchResult.to_dict() dicts.
+
+    hlines {label: GB/s} draws constant overlays — the makePlots.gp
+    idiom of plotting fixed comparators as horizontal functions
+    (f(x)=90.8413, makePlots.gp:17-19), used here for the reference
+    baseline and the chip's HBM roofline."""
     out_base = Path(out_base)
     try:
         import matplotlib
@@ -78,6 +84,8 @@ def plot_vs_n(shmoo_rows: Sequence[dict], out_base: str | Path,
     except Exception:
         lines = [f"{r['dtype']} {r['method']} {r['n']} {r['gbps']:.3f}"
                  for r in shmoo_rows]
+        lines += [f"# hline {label} {v:.3f}"
+                  for label, v in (hlines or {}).items()]
         p = out_base.with_suffix(".dat")
         p.write_text("\n".join(lines) + "\n")
         return [p]
@@ -90,6 +98,12 @@ def plot_vs_n(shmoo_rows: Sequence[dict], out_base: str | Path,
     for (dtype, method), pts in sorted(groups.items()):
         xs, ys = zip(*sorted(pts))
         ax.plot(xs, ys, marker="o", label=f"{dtype} {method}")
+    for i, (label, v) in enumerate(sorted((hlines or {}).items())):
+        ax.axhline(v, linestyle="--", linewidth=1,
+                   color=f"C{7 - (i % 3)}", alpha=0.8)
+        ax.annotate(label, xy=(1, v), xycoords=("axes fraction", "data"),
+                    xytext=(-4, 3), textcoords="offset points",
+                    ha="right", fontsize=8)
     ax.set_xlabel("Elements (N)")
     ax.set_ylabel("Bandwidth (GB/sec)")
     ax.set_xscale("log", base=2)

@@ -23,7 +23,7 @@ from typing import List, Optional
 from tpu_reductions.bench.driver import (BenchResult, _resolve_backend,
                                          resolved_timing,
                                          run_benchmark_batch)
-from tpu_reductions.config import ReduceConfig
+from tpu_reductions.config import KERNEL_SINGLE_PASS, ReduceConfig
 from tpu_reductions.utils.logging import BenchLogger
 
 
@@ -44,15 +44,14 @@ def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
             # iterations IS the slope span in chained mode: size it per
             # payload (enough signal to clear tunnel jitter at small N,
             # no wasted minutes at 2^30 — ops/chain.auto_chain_span).
-            # An EXPLICIT --iterations bounds the span; the dataclass
-            # default does not (a default-100 cap would hold small-N
-            # spans in exactly the negative-slope regime auto-sizing
-            # exists to escape).
-            from tpu_reductions.config import ReduceConfig as _RC
+            # An EXPLICIT --iterations bounds the span
+            # (cfg.iterations_explicit, set by the flag parser); an
+            # unset flag does not — a default-100 cap would hold
+            # small-N spans in exactly the negative-slope regime
+            # auto-sizing exists to escape.
             from tpu_reductions.ops.chain import auto_chain_span
-            default_iters = _RC.__dataclass_fields__["iterations"].default
             iters = auto_chain_span(n, cfg.dtype)
-            if cfg.iterations != default_iters:
+            if cfg.iterations_explicit:
                 iters = min(iters, max(cfg.iterations, 8))
             logger.log(f"shmoo n={n}: chained span {iters}")
         else:
@@ -137,7 +136,7 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
               dtypes=("int32", "float64"), n: int = 1 << 24,
               repeats: int = 5, iterations: int = 20,
               backend: str = "auto",
-              threads: int = 256, kernel: Optional[int] = None,
+              threads: int = 256, kernel: int = KERNEL_SINGLE_PASS,
               timing: str = "periter", chain_reps: int = 5,
               out_dir: Optional[str] = None,
               resume: bool = True,
@@ -185,9 +184,7 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                     probe = ReduceConfig(method=method, dtype=dtype,
                                          backend=backend, timing=timing,
                                          chain_reps=chain_reps,
-                                         threads=threads,
-                                         **({"kernel": kernel}
-                                            if kernel else {}))
+                                         threads=threads, kernel=kernel)
                     want_timing = resolved_timing(probe)
                     if (row.get("status") == "PASSED"
                             and row.get("n") == n
@@ -206,12 +203,10 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                 cfg = ReduceConfig(method=method, dtype=dtype, n=n,
                                    iterations=iterations, backend=backend,
                                    timing=timing, chain_reps=chain_reps,
-                                   threads=threads,
+                                   threads=threads, kernel=kernel,
                                    stat="median" if timing == "chained"
                                    else "mean",
-                                   seed=rep, log_file=None,
-                                   **({"kernel": kernel}
-                                      if kernel else {}))
+                                   seed=rep, log_file=None)
                 queued.append((len(rows), rep, fname, cfg))
                 rows.append(None)  # placeholder, filled in phase 2
     # Time the whole queue first (no materialization — see above), then

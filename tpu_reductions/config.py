@@ -93,6 +93,8 @@ class ReduceConfig:
     chain_reps: int = 5              # slope repetitions for timing=chained
     stat: str = "mean"               # mean (reference parity) | median
                                      # (robust to tunnel sync stalls)
+    iterations_explicit: bool = False   # user set --iterations (chained
+                                        # shmoo: treat as a span bound)
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
@@ -223,7 +225,7 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
                    help="Host-finish threshold on partial count")
     p.add_argument("--backend", type=str, default="auto",
                    choices=list(BACKENDS))
-    p.add_argument("--iterations", type=int, default=100,
+    p.add_argument("--iterations", type=int, default=None,
                    help="Timed iterations (default 100, reduction.cpp:731)")
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--device", type=int, default=None,
@@ -286,7 +288,9 @@ def parse_single_chip(argv=None):
         method=ns.method, dtype=ns.dtype, n=ns.n, threads=ns.threads,
         kernel=ns.kernel, max_blocks=ns.max_blocks, cpu_final=ns.cpu_final,
         cpu_thresh=ns.cpu_thresh, backend=ns.backend,
-        iterations=ns.iterations, warmup=ns.warmup, seed=ns.seed,
+        iterations=(ns.iterations if ns.iterations is not None else 100),
+        iterations_explicit=ns.iterations is not None,
+        warmup=ns.warmup, seed=ns.seed,
         device=ns.device, log_file=ns.log_file, master_log=ns.master_log,
         qatest=ns.qatest, verify=ns.verify, trace_dir=ns.trace_dir,
         check=ns.check, timing=ns.timing, chain_reps=ns.chain_reps,
@@ -296,6 +300,9 @@ def parse_single_chip(argv=None):
     if ns.shmoo and not 0 < ns.shmoo_min <= ns.shmoo_max:
         p.error(f"--shmoo-min/--shmoo-max must satisfy 0 < min <= max, "
                 f"got {ns.shmoo_min}/{ns.shmoo_max}")
+    # iterations_explicit: whether the user set --iterations (chained
+    # shmoo treats an explicit value as a span bound; the default is
+    # auto-sized per payload — bench/sweep.run_shmoo)
     return cfg, ((ns.shmoo_min, ns.shmoo_max) if ns.shmoo else None)
 
 
@@ -334,6 +341,11 @@ def build_collective_parser() -> argparse.ArgumentParser:
         prog="tpu_reductions.collective",
         description="Cross-chip collective reduction benchmark "
                     "(reference: mpi/reduce.c over the BG/L torus)",
+        # no prefix abbreviation: an abbreviated --hel would reach the
+        # parser as --help AFTER the QA RUNNING marker printed, forcing
+        # a marker for what is really a usage request; exact -h/--help
+        # are intercepted before any marker (collective_driver.main)
+        allow_abbrev=False,
     )
     _add_common_flags(p)
     p.add_argument("--retries", type=int, default=5,

@@ -56,7 +56,7 @@ _TPU_RATE_MODEL = {
     "TPU v5p": (80 << 20, 1.2e13, 2765e9),
     "TPU v4": (100 << 20, 8e12, 1228e9),
 }
-_TPU_DEFAULT_RATES = (112 << 20, 3.5e12, 2765e9)
+_TPU_DEFAULT_RATES = (112 << 20, 1.2e13, 2765e9)
 _CPU_BYTES_PER_S = 10e9
 
 
