@@ -448,3 +448,22 @@ def test_dd_ring_rs_ag_path_and_indivisible_fallback():
             + np.asarray(o2l, dtype=np.float64))
     np.testing.assert_allclose(got2, x2.reshape(K, 100).sum(axis=0),
                                rtol=0, atol=1e-12)
+
+
+@pytest.mark.parametrize("dtype,method", [("float32", "MIN"),
+                                          ("bfloat16", "SUM"),
+                                          ("bfloat16", "MAX")])
+def test_collective_driver_extension_dtypes(dtype, method):
+    """The beyond-reference dtypes (float32 rows under the FLOAT label,
+    bfloat16 under BF16) run the full driver path verified — reduce.c
+    only ever benchmarked int and double (reduce.c:43-57)."""
+    from tpu_reductions.bench.collective_driver import \
+        run_collective_benchmark
+    from tpu_reductions.config import CollectiveConfig
+    from tpu_reductions.utils.qa import QAStatus
+
+    cfg = CollectiveConfig(method=method, dtype=dtype, n=1 << 14,
+                           retries=2)
+    results = run_collective_benchmark(cfg)
+    assert len(results) == 2
+    assert all(r.status == QAStatus.PASSED for r in results)
