@@ -88,6 +88,14 @@ def generate_report(avgs: Dict[Key, float],
     fig_md = "\n\n".join(f"![{Path(f).stem}]({Path(f).name})"
                          for f in figures)
 
+    # single-chip-only runs (one physical chip, e.g. examples/tpu_run)
+    # have no rank sweep: omit the section rather than print a bare
+    # header over an empty table
+    coll_md = ("\n## Collective reductions vs rank count\n\n"
+               "Averaged over repeats (reference convention: total "
+               "payload bytes /\nwall time — reduce.c:79 analog with "
+               "real clocks).\n\n" + coll_tbl + "\n") if coll_rows else ""
+
     md = f"""# TPU Reduction Benchmarks — generated report
 
 *Generated {date} by tpu_reductions.bench.report (the writeup.tex analog).*
@@ -99,14 +107,7 @@ The reference measured a single CC≥1.3 GPU at n=2^24 elements
 kernel path at the same n.
 
 {sc_tbl}
-
-## Collective reductions vs rank count
-
-Averaged over repeats (reference convention: total payload bytes /
-wall time — reduce.c:79 analog with real clocks).
-
-{coll_tbl}
-
+{coll_md}
 {fig_md}
 
 ## Notes
@@ -138,6 +139,11 @@ def _to_tex(sc_rows, coll_rows, figures, date, calibration=None) -> str:
     figs = "\n".join(
         "\\includegraphics[width=0.85\\textwidth]{%s}" % Path(f).name
         for f in figures if str(f).endswith(".eps"))
+    # precomputed outside the f-string: backslashes are not allowed in
+    # f-string expressions before Python 3.12
+    coll_tex = ("\\section{Collective reductions}\n"
+                + tabular(coll_rows, 4, ["dtype", "op", "ranks", "GB/s"])
+                if coll_rows else "")
     return f"""\\documentclass{{article}}
 \\usepackage{{graphicx}}
 \\title{{TPU Reduction Benchmarks}}
@@ -146,8 +152,7 @@ def _to_tex(sc_rows, coll_rows, figures, date, calibration=None) -> str:
 \\maketitle
 \\section{{Single-chip reductions}}
 {tabular(sc_rows, 5, ["dtype", "op", "ref GPU", "TPU", "ratio"])}
-\\section{{Collective reductions}}
-{tabular(coll_rows, 4, ["dtype", "op", "ranks", "GB/s"])}
+{coll_tex}
 \\section{{Figures}}
 {figs}
 \\section{{Methodology}}
@@ -191,9 +196,17 @@ def main(argv=None) -> int:
 
     out = Path(ns.out_dir)
     raw = out / "raw_output"
-    if not raw.is_dir():
-        p.error(f"{raw} not found — run the experiment pipeline first")
-    avgs = average(collect(raw))
+    sc_raw_probe = out / "single_chip" / "raw_output"
+    if raw.is_dir():
+        avgs = average(collect(raw))
+    elif sc_raw_probe.is_dir():
+        # single-chip-only out dirs (run_tpu_experiment.sh on one
+        # physical chip) have no collective rank sweep — regenerate
+        # with an empty collective section rather than refusing
+        avgs = {}
+    else:
+        p.error(f"neither {raw} nor {sc_raw_probe} found — run the "
+                "experiment pipeline first")
 
     # single-chip overlay numbers from the sweep's cached cells — the
     # same reconstruction run_experiment.sh does from live results
