@@ -47,6 +47,17 @@ DEFAULT_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
     + [(KERNEL_TWO_PASS, t, mb) for t in (256, 1024) for mb in (64, 256)]
 )
 
+# Finer race around the round-2 winners (tune_r02.json: kernel 6
+# threads=512 at 6238 GB/s, kernel 7 threads=256 at 5075) — the
+# second-pass grid for squeezing past a coarse optimum.
+FINE_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
+    [(KERNEL_SINGLE_PASS, t, 64) for t in (320, 384, 448, 512, 640, 768)]
+    + [(KERNEL_TWO_PASS, t, mb) for t in (128, 192, 256, 384, 512)
+       for mb in (32, 64, 128)]
+)
+
+GRIDS = {"default": DEFAULT_GRID, "fine": FINE_GRID}
+
 
 def candidate_configs(base: ReduceConfig,
                       grid: Sequence[Tuple[int, int, int]] = DEFAULT_GRID,
@@ -97,6 +108,11 @@ def main(argv=None) -> int:
     p.add_argument("--chainreps", dest="chain_reps", type=int, default=5)
     p.add_argument("--platform", type=str, default=None,
                    choices=("cpu", "tpu"))
+    p.add_argument("--grid", type=str, default="default",
+                   choices=sorted(GRIDS),
+                   help="Candidate grid: 'default' spans the space, "
+                        "'fine' races tightly around the round-2 "
+                        "winners (tune_r02.json)")
     p.add_argument("--out", type=str, default=None,
                    help="Write the ranked results as JSON to this path")
     ns = p.parse_args(argv)
@@ -113,7 +129,7 @@ def main(argv=None) -> int:
                         stat=ns.stat, timing=ns.timing,
                         chain_reps=ns.chain_reps, log_file=None)
     logger = BenchLogger(None, None, console=sys.stderr)
-    pairs = autotune(base, logger=logger)
+    pairs = autotune(base, grid=GRIDS[ns.grid], logger=logger)
     rows = []
     for cfg, res in pairs:
         rows.append({"kernel": cfg.kernel, "threads": cfg.threads,

@@ -214,9 +214,32 @@ def main(argv=None) -> int:
     p.add_argument("--chainspan", dest="chain_span", type=int, default=16)
     p.add_argument("--platform", type=str, default=None,
                    choices=("cpu", "tpu"))
+    p.add_argument("--ladder", action="store_true",
+                   help="Run the two-regime ladder instead of one size: "
+                        "a VMEM-resident size (--n) and an HBM-bound one "
+                        "(4x --n). The trust verdict at VMEM-resident "
+                        "sizes is vacuous on broken-sync tunnels (real "
+                        "per-iter time ~ the ack floor), so only the "
+                        "large-size verdict decides (docs/TIMING.md)")
     ns = p.parse_args(argv)
     from tpu_reductions.config import _apply_platform
     _apply_platform(ns)
+    if ns.ladder:
+        rungs = [calibrate(n=ns.n, dtype=ns.dtype, iters=ns.iters,
+                           reps=ns.reps, chain_span=ns.chain_span),
+                 calibrate(n=ns.n * 4, dtype=ns.dtype, iters=ns.iters,
+                           reps=ns.reps,
+                           chain_span=max(8, ns.chain_span // 4))]
+        for cal in rungs:
+            print(cal.describe())
+        verdict = rungs[-1]   # the HBM-bound rung decides
+        print(json.dumps({
+            "rungs": [c.to_dict() for c in rungs],
+            "block_awaits_execution": verdict.block_awaits_execution,
+            "indeterminate": verdict.indeterminate,
+            "deciding_n": verdict.n,
+        }))
+        return 0
     cal = calibrate(n=ns.n, dtype=ns.dtype, iters=ns.iters, reps=ns.reps,
                     chain_span=ns.chain_span)
     print(cal.describe())
