@@ -34,14 +34,19 @@ BASELINE_GBPS = 90.8413  # CUDA int SUM, n=2^24 (mpi/CUdata.txt:6)
 DEVICE_PROBE_TIMEOUT_S = 180
 
 
-def _device_probe() -> str | None:
+def _device_probe(platform: str | None = None) -> str | None:
     """Probe device discovery in a subprocess so a wedged tunnel can't
     hang THIS process; the probe is tiny and drains itself (one scalar
-    materialization) before exiting. Returns None when healthy, else a
-    one-line diagnostic distinguishing a hang (wedged tunnel) from an
-    init failure (whose traceback tail is surfaced, not swallowed)."""
-    code = ("import jax; "
-            "print(len(jax.devices()), flush=True); "
+    materialization) before exiting. `platform` forces the backend the
+    probe tests to the one main() will actually use (the axon plugin
+    ignores JAX_PLATFORMS, so this goes through jax.config). Returns
+    None when healthy, else a one-line diagnostic distinguishing a hang
+    (wedged tunnel) from an init failure (whose traceback tail is
+    surfaced, not swallowed)."""
+    force = (f"jax.config.update('jax_platforms', {platform!r}); "
+             if platform else "")
+    code = ("import jax; " + force
+            + "print(len(jax.devices()), flush=True); "
             "import jax.numpy as jnp; "
             "print(int(jnp.asarray(1) + 1))")
     try:
@@ -71,14 +76,16 @@ CANDIDATES = (
 )
 
 
-def _snapshot_fallback(outage: str) -> dict:
+def _snapshot_fallback(outage: str, snap: str | None = None) -> dict:
     """On an accelerator outage, surface the round's committed verified
     measurement (captured and snapshotted mid-round per VERDICT r1 item
     1's 'measure early' discipline) instead of a bare 0.0 — clearly
-    labeled as the snapshot, never passed off as a fresh run."""
+    labeled as the snapshot, never passed off as a fresh run.
+    `snap` overrides the snapshot path (tests)."""
     import os
-    snap = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_r02_snapshot.json")
+    if snap is None:
+        snap = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r02_snapshot.json")
     try:
         with open(snap) as f:
             s = json.load(f)
@@ -107,13 +114,31 @@ def _snapshot_fallback(outage: str) -> dict:
         }
 
 
-def main() -> int:
-    outage = _device_probe()
+def main(argv=None) -> int:
+    """The round metric. No arguments = the flagship on-chip run; the
+    flags exist so the metric path itself is testable off-chip
+    (tests/test_bench_metric.py) — they do not change the headline
+    semantics."""
+    import argparse
+    p = argparse.ArgumentParser(prog="bench.py")
+    p.add_argument("--n", type=int, default=1 << 24)
+    p.add_argument("--iterations", type=int, default=256)
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"))
+    ns = p.parse_args(argv)
+    if ns.n <= 0:
+        p.error("--n must be positive")
+
+    outage = (None if ns.platform == "cpu"
+              else _device_probe(platform=ns.platform))
     if outage is not None:
         print(f"accelerator unavailable: {outage}; reporting the outage "
               "instead of hanging", file=sys.stderr)
         print(json.dumps(_snapshot_fallback(outage)))
         return 1
+
+    from tpu_reductions.config import _apply_platform
+    _apply_platform(ns)
 
     from tpu_reductions.bench.driver import run_benchmark_batch
     from tpu_reductions.config import ReduceConfig
@@ -124,8 +149,8 @@ def main() -> int:
     # clear multi-ms materialization jitter: span 16 measured a NEGATIVE
     # median slope at n=2^24, span 256 a stable one (calibration_r02.json);
     # at ~24 us/iter (VMEM-resident at this size) 256 iters = ~6 ms.
-    base = ReduceConfig(method="SUM", dtype="int32", n=1 << 24,
-                        iterations=256, warmup=2, stat="median",
+    base = ReduceConfig(method="SUM", dtype="int32", n=ns.n,
+                        iterations=ns.iterations, warmup=2, stat="median",
                         timing="chained", chain_reps=7,
                         log_file=None)
     cfgs = [dataclasses.replace(base, backend=b, kernel=k, threads=t)
@@ -137,8 +162,10 @@ def main() -> int:
               f"{res.gbps:.1f} GB/s [{res.status.name}]", file=sys.stderr)
     passed = [r for r in results if r.passed]
     value = max((r.gbps for r in passed), default=0.0)
+    label = (f"2^{ns.n.bit_length() - 1}" if ns.n & (ns.n - 1) == 0
+             else str(ns.n))
     print(json.dumps({
-        "metric": "single-chip int32 SUM reduction bandwidth, n=2^24",
+        "metric": f"single-chip int32 SUM reduction bandwidth, n={label}",
         "value": round(value, 4),
         "unit": "GB/s",
         "vs_baseline": round(value / BASELINE_GBPS, 4),

@@ -45,3 +45,16 @@ def test_autotune_cli_writes_json(tmp_path, capsys):
     assert data["best"]["status"] == "PASSED"
     assert len(data["ranked"]) == len(candidate_configs(_base()))
     assert "best:" in capsys.readouterr().out
+
+
+def test_fine_grid_is_valid_and_distinct():
+    """--grid=fine: every candidate is a valid (kernel, threads,
+    maxblocks) triple over the live kernels, with no duplicates — the
+    second-pass race around the committed round-2 winners."""
+    from tpu_reductions.bench.autotune import FINE_GRID, GRIDS
+    from tpu_reductions.config import LIVE_KERNELS
+
+    assert GRIDS["fine"] is FINE_GRID
+    assert len(set(FINE_GRID)) == len(FINE_GRID)
+    for k, t, mb in FINE_GRID:
+        assert k in LIVE_KERNELS and t > 0 and mb > 0

@@ -1,0 +1,68 @@
+"""bench.py — the round metric — smoke-tested off-chip. The metric path
+has to survive refactors between on-chip opportunities; these tests run
+its full candidate race on the CPU backend and pin the outage fallback's
+shape (a bad metric file is worse than a bad kernel: it silently
+misreports the whole round)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def test_bench_main_cpu_smoke(capsys):
+    bench = _load_bench()
+    rc = bench.main(["--n", "65536", "--iterations", "16",
+                     "--platform", "cpu"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    d = json.loads(out)
+    assert d["unit"] == "GB/s"
+    assert d["value"] > 0
+    assert d["metric"].endswith("n=2^16")
+    assert d["vs_baseline"] == round(d["value"] / bench.BASELINE_GBPS, 4)
+
+
+def test_bench_outage_fallback_surfaces_snapshot(capsys, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_device_probe",
+                        lambda platform=None: "fake wedge")
+    rc = bench.main([])
+    assert rc == 1          # outage is never a clean exit
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    d = json.loads(out)
+    # the committed mid-round verified snapshot, clearly labeled stale
+    assert d["stale"] is True
+    assert d["value"] > 0
+    assert "not a fresh run" in d["note"]
+    assert d["source"] == "BENCH_r02_snapshot.json"
+
+
+def test_bench_outage_without_snapshot_reports_zero(tmp_path):
+    bench = _load_bench()
+    # a missing snapshot file -> honest 0.0, never a crash
+    d = bench._snapshot_fallback("fake wedge",
+                                 snap=str(tmp_path / "missing.json"))
+    assert d["value"] == 0.0 and d["vs_baseline"] == 0.0
+    # a malformed snapshot (null value) degrades the same way
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"value": null}')
+    d2 = bench._snapshot_fallback("fake wedge", snap=str(bad))
+    assert d2["value"] == 0.0
+
+
+def test_bench_rejects_nonpositive_n():
+    import pytest
+    bench = _load_bench()
+    with pytest.raises(SystemExit):
+        bench.main(["--n", "0", "--platform", "cpu"])

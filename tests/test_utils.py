@@ -139,3 +139,26 @@ def test_bulk_mode_median_falls_back_to_per_iteration_average():
     assert sw.sessions == 10
     assert not sw.samples
     assert abs(sw.median_s - sw.average_s) < 1e-12
+
+
+def test_calibrate_ladder_cli_json_shape(capsys):
+    """--ladder: two rungs, the HBM-bound (last) rung decides the
+    verdict (docs/TIMING.md: VMEM-resident verdicts are vacuous on
+    broken-sync tunnels)."""
+    import json
+
+    from tpu_reductions.utils.calibrate import main as cal_main
+
+    rc = cal_main(["--n", "65536", "--iters", "4", "--reps", "2",
+                   "--chainspan", "8"])
+    assert rc == 0
+    capsys.readouterr()     # single-size mode works; now the ladder
+    rc = cal_main(["--n", "65536", "--iters", "4", "--reps", "2",
+                   "--chainspan", "8", "--ladder"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    d = json.loads(out)
+    assert len(d["rungs"]) == 2
+    assert d["deciding_n"] == d["rungs"][-1]["n"] == 65536 * 4
+    assert d["block_awaits_execution"] == \
+        d["rungs"][-1]["block_awaits_execution"]
