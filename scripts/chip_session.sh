@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# One command for a live-chip session, ordered by value-per-minute so a
+# tunnel that re-wedges mid-run still leaves the most important
+# artifacts committed (round-1 VERDICT: "measure early, snapshot
+# mid-round, re-verify at the end"):
+#   1. bench.py           headline metric        (~2 min)
+#   2. calibrate --ladder two-regime trust gate  (~2 min)
+#   3. autotune fine grid second-pass tile race  (~5 min)
+#   4. run_tpu_experiment full curve to 2^30     (the long tail)
+# Each step git-commits its artifacts before the next starts. The
+# drivers drain their device queues (results materialize on host), so
+# interrupting BETWEEN steps cannot strand in-flight work.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+step() {  # step <name> <artifact...> -- <cmd...>
+    local name=$1; shift
+    local arts=()
+    while [ "$1" != "--" ]; do arts+=("$1"); shift; done
+    shift
+    echo "=== chip_session: $name ==="
+    if "$@"; then
+        git add "${arts[@]}" 2>/dev/null || true
+        git diff --cached --quiet || git commit -q -m "On-chip artifacts: $name"
+    else
+        echo "=== chip_session: $name FAILED (continuing; earlier steps are committed) ==="
+    fi
+}
+
+step "headline bench" BENCH_live.json -- \
+    bash -c 'python bench.py | tee BENCH_live.json'
+
+step "calibration ladder" calibration_live.json -- \
+    bash -c 'python -m tpu_reductions.utils.calibrate --ladder \
+             --chainspan 256 --reps 7 | tail -1 > calibration_live.json'
+
+step "fine tile race" tune_fine.json -- \
+    python -m tpu_reductions.bench.autotune --method=SUM --type=int \
+        --n=16777216 --iterations=256 --grid=fine --out=tune_fine.json
+
+step "flagship experiment" examples/tpu_run -- \
+    bash scripts/run_tpu_experiment.sh examples/tpu_run
+
+echo "=== chip_session: done ==="
