@@ -7,32 +7,45 @@
 #   2. calibrate --ladder two-regime trust gate  (~2 min)
 #   3. autotune fine grid second-pass tile race  (~5 min)
 #   4. run_tpu_experiment full curve to 2^30     (the long tail)
-# Each step git-commits its artifacts before the next starts. The
-# drivers drain their device queues (results materialize on host), so
-# interrupting BETWEEN steps cannot strand in-flight work.
+# Each step git-commits ONLY its own artifacts before the next starts.
+# The drivers drain their device queues (results materialize on host),
+# so interrupting BETWEEN steps cannot strand in-flight work.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 step() {  # step <name> <artifact...> -- <cmd...>
     local name=$1; shift
     local arts=()
-    while [ "$1" != "--" ]; do arts+=("$1"); shift; done
+    while [ $# -gt 0 ] && [ "$1" != "--" ]; do arts+=("$1"); shift; done
+    if [ $# -eq 0 ]; then
+        echo "step '$name': missing -- sentinel" >&2
+        return 1
+    fi
     shift
     echo "=== chip_session: $name ==="
     if "$@"; then
-        git add "${arts[@]}" 2>/dev/null || true
-        git diff --cached --quiet || git commit -q -m "On-chip artifacts: $name"
+        if git add -- "${arts[@]}" \
+                && ! git diff --cached --quiet -- "${arts[@]}"; then
+            # commit restricted to the artifacts: pre-existing staged
+            # work must never be swept into an artifact commit
+            git commit -q -m "On-chip artifacts: $name" -- "${arts[@]}"
+        else
+            echo "=== chip_session: $name produced no new artifact ==="
+        fi
     else
         echo "=== chip_session: $name FAILED (continuing; earlier steps are committed) ==="
     fi
 }
 
+# pipefail INSIDE each bash -c: the child shell does not inherit the
+# outer setting, and without it a crashed python is masked by tee/tail
 step "headline bench" BENCH_live.json -- \
-    bash -c 'python bench.py | tee BENCH_live.json'
+    bash -c 'set -o pipefail; python bench.py | tee BENCH_live.json'
 
 step "calibration ladder" calibration_live.json -- \
-    bash -c 'python -m tpu_reductions.utils.calibrate --ladder \
-             --chainspan 256 --reps 7 | tail -1 > calibration_live.json'
+    bash -c 'set -o pipefail; \
+             python -m tpu_reductions.utils.calibrate --ladder \
+                 --chainspan 256 --reps 7 | tail -1 > calibration_live.json'
 
 step "fine tile race" tune_fine.json -- \
     python -m tpu_reductions.bench.autotune --method=SUM --type=int \
