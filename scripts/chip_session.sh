@@ -24,8 +24,13 @@ step() {  # step <name> <artifact...> -- <cmd...>
     shift
     echo "=== chip_session: $name ==="
     if "$@"; then
-        if git add -- "${arts[@]}" \
-                && ! git diff --cached --quiet -- "${arts[@]}"; then
+        # add per artifact: one missing path must not block committing
+        # the ones that were produced
+        local a
+        for a in "${arts[@]}"; do
+            git add -- "$a" || echo "=== chip_session: $name: no artifact $a ==="
+        done
+        if ! git diff --cached --quiet -- "${arts[@]}"; then
             # commit restricted to the artifacts: pre-existing staged
             # work must never be swept into an artifact commit
             git commit -q -m "On-chip artifacts: $name" -- "${arts[@]}"

@@ -17,7 +17,7 @@ def test_candidate_grid_shapes():
     cfgs = candidate_configs(_base())
     assert all(c.backend == "pallas" for c in cfgs)
     kernels = {c.kernel for c in cfgs}
-    assert kernels == {6, 7, 8}
+    assert kernels == {6, 7, 8, 9}
     # two-pass candidates vary max_blocks; single-pass pin it to 64
     assert {c.max_blocks for c in cfgs if c.kernel == 7} == {64, 256}
     assert {c.max_blocks for c in cfgs if c.kernel != 7} == {64}

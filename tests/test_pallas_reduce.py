@@ -118,3 +118,48 @@ def test_two_pass_partials_are_sublane_blocks():
     assert partials.shape[0] % 8 == 0
     np.testing.assert_allclose(np.asarray(partials).sum(),
                                np.asarray(x, np.float64).sum(), rtol=1e-5)
+
+
+def test_mxu_kernel_matches_oracle_floats():
+    """Kernel 9 (MXU ones-row matmul SUM, arXiv:1811.09736 /
+    2001.05585 technique): oracle-accurate for float dtypes across
+    pow2 and ragged sizes."""
+    for n in (1, 127, 4096, 100_000):
+        x = host_data(n, "float32", rank=0, seed=3)
+        got = float(pallas_reduce(x, "SUM", kernel=9))
+        ref = float(np.sum(x.astype(np.float64)))
+        assert abs(got - ref) <= 1e-8 * max(1, n) * max(
+            1.0, abs(ref)), (n, got, ref)
+
+
+def test_mxu_kernel_rejects_unsupported():
+    x32 = host_data(256, "int32", rank=0)
+    with pytest.raises(ValueError):
+        pallas_reduce(x32, "SUM", kernel=9)
+    xf = host_data(256, "float32", rank=0)
+    with pytest.raises(ValueError):
+        pallas_reduce(xf, "MIN", kernel=9)
+
+
+def test_mxu_kernel_driver_waives_unsupported():
+    """int32 SUM with --kernel=9 is WAIVED (incapable-hardware gate,
+    reduction.cpp:148-155), never FAILED."""
+    from tpu_reductions.bench.driver import run_benchmark
+    from tpu_reductions.config import ReduceConfig
+    from tpu_reductions.utils.qa import QAStatus
+
+    cfg = ReduceConfig(method="SUM", dtype="int32", n=1 << 12, kernel=9,
+                       iterations=2, log_file=None)
+    res = run_benchmark(cfg)
+    assert res.status == QAStatus.WAIVED
+    assert "MXU" in res.waived_reason
+
+
+def test_mxu_kernel_driver_passes_float():
+    from tpu_reductions.bench.driver import run_benchmark
+    from tpu_reductions.config import ReduceConfig
+
+    cfg = ReduceConfig(method="SUM", dtype="float32", n=1 << 14, kernel=9,
+                       iterations=3, log_file=None)
+    res = run_benchmark(cfg)
+    assert res.passed, res.waived_reason

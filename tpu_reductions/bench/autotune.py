@@ -32,8 +32,9 @@ from typing import List, Optional, Sequence, Tuple
 
 from tpu_reductions.bench.driver import BenchResult, run_benchmark_batch
 from tpu_reductions.config import (DTYPE_ALIASES, KERNEL_ELEMENTWISE,
-                                   KERNEL_SINGLE_PASS, KERNEL_TWO_PASS,
-                                   METHODS, ReduceConfig, _apply_platform)
+                                   KERNEL_MXU, KERNEL_SINGLE_PASS,
+                                   KERNEL_TWO_PASS, METHODS, ReduceConfig,
+                                   _apply_platform)
 from tpu_reductions.utils.logging import BenchLogger
 
 # (kernel, threads, max_blocks) candidate grid. Threads sweeps the VMEM
@@ -45,6 +46,9 @@ DEFAULT_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
     [(KERNEL_SINGLE_PASS, t, 64) for t in (64, 128, 256, 512, 1024, 2048)]
     + [(KERNEL_ELEMENTWISE, t, 64) for t in (64, 128, 256, 512, 1024, 2048)]
     + [(KERNEL_TWO_PASS, t, mb) for t in (256, 1024) for mb in (64, 256)]
+    # MXU matmul SUM (kernel 9): participates in float races; int/MIN/
+    # MAX configs WAIVE it (driver gate), ranking below every PASSED row
+    + [(KERNEL_MXU, t, 64) for t in (256, 512, 1024)]
 )
 
 # Finer race around the round-2 winners (tune_r02.json: kernel 6

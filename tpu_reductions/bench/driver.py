@@ -24,7 +24,8 @@ from typing import Optional
 
 import numpy as np
 
-from tpu_reductions.config import (KERNEL_SINGLE_PASS, LIVE_KERNELS,
+from tpu_reductions.config import (KERNEL_MXU, KERNEL_SINGLE_PASS,
+                                   LIVE_KERNELS,
                                    ReduceConfig)
 from tpu_reductions.ops import oracle as oracle_mod
 from tpu_reductions.ops.registry import tolerance
@@ -325,6 +326,25 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
                            float("nan"), float("nan"), float("nan"),
                            waived_reason=f"kernel {cfg.kernel} not live "
                                          f"(live: {LIVE_KERNELS})",
+                           timing=cfg.timing)
+
+    # float64 on the real chip routes through the dd path, which has
+    # its own kernel structure and ignores --kernel: a 'kernel 9' f64
+    # row there would be a mislabeled dd measurement, so it WAIVEs. Off
+    # -TPU (interpret path) f64 really runs the MXU-structured kernel.
+    mxu_dtypes = {"float32", "bfloat16"}
+    if jax.default_backend() != "tpu":
+        mxu_dtypes.add("float64")
+    if (cfg.kernel == KERNEL_MXU and cfg.backend != "xla"
+            and (cfg.method != "SUM" or cfg.dtype not in mxu_dtypes)):
+        # MIN/MAX have no matmul form; integer matmul is not exact on
+        # the MXU — WAIVED, the incapable-hardware gate of
+        # reduction.cpp:148-155, not a failure.
+        return BenchResult(cfg.method, cfg.dtype, cfg.n, cfg.backend,
+                           cfg.kernel, 0.0, 0.0, 0, QAStatus.WAIVED,
+                           float("nan"), float("nan"), float("nan"),
+                           waived_reason="kernel 9 (MXU) is SUM over "
+                                         "float dtypes only",
                            timing=cfg.timing)
 
     backend = _resolve_backend(cfg)

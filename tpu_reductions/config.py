@@ -14,8 +14,9 @@ Flag-name parity with the reference CLI (reduction.cpp:31-40):
   --threads=<int>             tile rows per grid step — the threads-per-block
                               analog, default 256 (reduction.cpp:666)
   --kernel=<int>              kernel id; 6 (single-pass accumulator),
-                              7 (two-pass partials) and 8 (elementwise
-                              accumulator) are live; 0-5 are WAIVED,
+                              7 (two-pass partials), 8 (elementwise
+                              accumulator) and 9 (MXU matmul SUM, float
+                              dtypes) are live; 0-5 are WAIVED,
                               mirroring the intentionally-emptied dispatch
                               cases (reduction_kernel.cu:278-289)
   --maxblocks=<int>           grid clamp, default 64 (reduction.cpp:668)
@@ -60,10 +61,12 @@ BACKENDS = ("auto", "pallas", "xla")
 # (reduction_kernel.cu:278-289). We map 6 -> single-pass fold-accumulator
 # Pallas kernel, 7 -> two-pass partials Pallas kernel, 8 -> single-pass
 # elementwise accumulator (extension), and WAIVE 0-5.
-LIVE_KERNELS = (6, 7, 8)
+LIVE_KERNELS = (6, 7, 8, 9)
 KERNEL_SINGLE_PASS = 6
 KERNEL_TWO_PASS = 7
 KERNEL_ELEMENTWISE = 8
+KERNEL_MXU = 9          # SUM over float dtypes: ones-row matmul on the
+                        # MXU (arXiv:1811.09736 / 2001.05585 technique)
 
 
 @dataclasses.dataclass
@@ -215,8 +218,9 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
                    help="Tile rows per grid step (threads-per-block analog)")
     p.add_argument("--kernel", type=int, default=KERNEL_SINGLE_PASS,
                    help="6=single-pass fold accumulator, 7=two-pass "
-                        "partials, 8=single-pass elementwise accumulator; "
-                        "0-5 WAIVED (reference emptied them)")
+                        "partials, 8=single-pass elementwise accumulator, "
+                        "9=MXU matmul SUM (float dtypes; other combos "
+                        "WAIVE); 0-5 WAIVED (reference emptied them)")
     p.add_argument("--maxblocks", dest="max_blocks", type=int, default=64,
                    help="Grid clamp (maxblocks analog)")
     p.add_argument("--cpufinal", dest="cpu_final", action="store_true",

@@ -119,6 +119,9 @@ def stage_padded(x: np.ndarray | jax.Array, tm: int, p: int, t: int,
 # ---------------------------------------------------------------------------
 
 
+MXU_ACC_ROWS = 8    # f32 sublane tile: the kernel-9 accumulator height
+
+
 def _acc_dtype(in_dtype, op: ReduceOpSpec):
     """Accumulator dtype inside the kernel: f32 for bf16 SUM (bf16 stays
     in HBM at 2 B/element — the bandwidth win — but accumulates at f32 in
@@ -197,6 +200,44 @@ def elementwise_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
     return _accumulator_call(x2d, op, tm,
                              lambda tile, acc_dt: tile.astype(acc_dt),
                              acc_rows=tm, interpret=interpret)
+
+
+def mxu_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
+             interpret: Optional[bool] = None) -> jax.Array:
+    """Kernel 9: SUM on the MXU (float dtypes only). Each grid step
+    reduces its (TM, 128) tile to per-lane column sums with a ones-row
+    matmul — sum(tile, axis=0) == onehot_row0(8, TM) @ tile — so the
+    adds ride the systolic array instead of the VPU (the tensor-core
+    reduction technique of arXiv:1811.09736 / arXiv:2001.05585, re-done
+    TPU-native). The (8, 128) resident accumulator's row 0 carries the
+    running column sums; rows 1-7 stay zero (the lhs is zero there), so
+    the standard whole-block `finish` is exact.
+
+    MIN/MAX have no matmul form and integer matmul is not exact on the
+    MXU — the driver WAIVEs those combos (the reference's incapable-
+    hardware gate, reduction.cpp:148-155)."""
+    if op.name != "SUM":
+        raise ValueError("kernel 9 (MXU) implements SUM only")
+    if not jnp.issubdtype(x2d.dtype, jnp.floating):
+        raise ValueError("kernel 9 (MXU) needs a float dtype; integer "
+                         "matmul is not exact on the MXU")
+
+    def transform(tile, acc_dt):
+        # one-hot row 0: row 0 of the product = column sums, rows 1-7
+        # exactly zero. f32 operands at HIGHEST precision: on TPU the
+        # dot still lowers to the MXU (bf16x3 passes), on the CPU
+        # interpret path it is a plain exact f32 matmul.
+        lhs = (jax.lax.broadcasted_iota(
+            jnp.int32, (MXU_ACC_ROWS, tile.shape[0]), 0) == 0
+        ).astype(acc_dt)
+        return jax.lax.dot_general(
+            lhs, tile.astype(acc_dt),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=acc_dt)
+
+    return _accumulator_call(x2d, op, tm, transform,
+                             acc_rows=MXU_ACC_ROWS, interpret=interpret)
 
 
 def _two_pass_kernel(op: ReduceOpSpec, tm: int):
@@ -345,8 +386,9 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
     tm, p, t = choose_tiling(x.size, threads, max_blocks, x.dtype)
     x2d = stage_padded(x, tm, p, t, op)
 
-    if kernel in (6, 8):
-        call = single_pass_call if kernel == 6 else elementwise_call
+    if kernel in (6, 8, 9):
+        call = {6: single_pass_call, 8: elementwise_call,
+                9: mxu_call}[kernel]
         acc = call(x2d, op, tm, interpret=interpret)
         if cpu_final:
             return host_finish(acc, op)
@@ -360,7 +402,7 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
             return host_finish(partials, op)
         return finish(partials, op)
 
-    raise ValueError(f"kernel {kernel} is not live; only 6, 7 and 8 "
+    raise ValueError(f"kernel {kernel} is not live; only 6, 7, 8 and 9 "
                      "(0-5 are WAIVED, mirroring reduction_kernel.cu:278-289)")
 
 
@@ -378,8 +420,9 @@ def _make_staged_parts(method: str, n: int, dtype, *, threads: int = 256,
     def stage_fn(x):
         return stage_padded(x, tm, p, t, op)
 
-    if kernel in (6, 8):
-        call = single_pass_call if kernel == 6 else elementwise_call
+    if kernel in (6, 8, 9):
+        call = {6: single_pass_call, 8: elementwise_call,
+                9: mxu_call}[kernel]
 
         def device_fn(x2d):
             return call(x2d, op, tm, interpret=interpret)
