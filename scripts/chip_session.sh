@@ -30,10 +30,10 @@ step() {  # step <name> <artifact...> -- <cmd...>
         local a
         local have=()
         for a in "${arts[@]}"; do
-            if git add -- "$a" 2>/dev/null; then
-                have+=("$a")
-            else
+            if [ ! -e "$a" ]; then
                 echo "=== chip_session: $name: no artifact $a ==="
+            elif git add -- "$a"; then   # real add failures stay loud
+                have+=("$a")
             fi
         done
         if [ ${#have[@]} -gt 0 ] \

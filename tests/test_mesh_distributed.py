@@ -158,3 +158,19 @@ def test_two_process_interleaved_scatter_verifies():
     assert p0.returncode == 0, (out0, err0)
     assert p1.returncode == 0, (out1, err1)
     assert "&&&& tpu_reductions.collective PASSED" in out0
+
+
+def test_indivisible_devices_per_process_rejected():
+    """--devices must split evenly across processes; the error speaks in
+    the user's own flag values (config._apply_platform)."""
+    p = subprocess.run(
+        [sys.executable, "-m", "tpu_reductions.bench.collective_driver",
+         "--method=SUM", "--type=int", "--platform=cpu", "--devices=3",
+         "--coordinator=127.0.0.1:1", "--num-processes=2",
+         "--process-id=0"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "XLA_FLAGS": ""})
+    assert p.returncode != 0
+    # the EXPLANATION must reach the user, not just the argv echo
+    assert "must divide" in p.stderr, (p.stdout, p.stderr)
+    assert "--devices=3" in p.stderr

@@ -394,9 +394,15 @@ def main(argv=None) -> int:
     except SystemExit as e:
         if e.code in (0, None):      # a successful parser exit path
             return 0
-        # argparse already printed its usage/error; close the QA grammar
-        # and keep the exit-code-equals-status contract (FAILED = 1,
-        # shrQATest.h:224-229 discipline) instead of argparse's 2
+        if isinstance(e.code, str):
+            # raise SystemExit("message") paths (config validation like
+            # the multi-host divisibility check) carry their explanation
+            # in the code — surface it; argparse's own errors (int
+            # codes) already printed theirs
+            print(f"error: {e.code}", file=sys.stderr)
+        # close the QA grammar and keep the exit-code-equals-status
+        # contract (FAILED = 1, shrQATest.h:224-229 discipline) instead
+        # of argparse's 2
         return qa_finish(name, QAStatus.FAILED, out=qa_out)
     except Exception as e:   # config validation (bad --method value, ...)
         print(f"error: {type(e).__name__}: {e}", file=sys.stderr)

@@ -353,6 +353,62 @@ def make_chained_collective(method: str, mesh: Mesh, axis: str = "ranks",
     return jax.jit(chained)
 
 
+
+def _ring_rs_ag(axis: str, k: int, bufs: tuple, to_wire, absorb,
+                from_wire) -> tuple:
+    """Shared ring reduce-scatter + all-gather scaffold (the
+    bandwidth-optimal 2(k-1)/k pattern the ICI torus is built for),
+    parameterized by the hop payload so the dd pair ring and the int8
+    quantized ring share ONE copy of the index arithmetic:
+
+      to_wire(chunks)   -> what crosses the wire for a chunk tuple
+      absorb(tgt, wire) -> chunk tuple after combining an arrival
+      from_wire(wire)   -> chunk tuple to store in the gather phase
+
+    bufs: per-rank (L,) buffers sharing one chunking; L must divide by
+    k (callers gate on this). RS phase: k-1 hops; after the last, rank
+    r owns fully reduced chunk (r+1)%k. The owned chunk is passed
+    through from_wire(to_wire(.)) before gathering so every replica is
+    bit-identical even when the wire form is lossy (identity for dd).
+    AG phase: k-1 hops forwarding the received wire form.
+    """
+    ring = [(i, (i + 1) % k) for i in range(k)]
+    r = jax.lax.axis_index(axis)
+    c = bufs[0].shape[0] // k
+
+    def chunk(bs, idx):
+        return tuple(jax.lax.dynamic_slice_in_dim(b, idx * c, c)
+                     for b in bs)
+
+    def put(bs, pieces, idx):
+        return tuple(jax.lax.dynamic_update_slice_in_dim(b, pc, idx * c,
+                                                         axis=0)
+                     for b, pc in zip(bs, pieces))
+
+    def hop(wire):
+        return tuple(jax.lax.ppermute(w, axis, perm=ring) for w in wire)
+
+    def rs_body(s_, bs):
+        send = (r - s_) % k          # chunk this rank forwards
+        tgt = (r - s_ - 1) % k       # chunk the arriving hop matches
+        rx = hop(to_wire(chunk(bs, send)))
+        return put(bs, absorb(chunk(bs, tgt), rx), tgt)
+
+    bufs = jax.lax.fori_loop(0, k - 1, rs_body, bufs)
+
+    own = (r + 1) % k
+    w0 = to_wire(chunk(bufs, own))
+    bufs = put(bufs, from_wire(w0), own)
+
+    def ag_body(s_, carry):
+        bs, w = carry
+        rx = hop(w)
+        return put(bs, from_wire(rx), (r - s_) % k), rx
+
+    bufs, _ = jax.lax.fori_loop(0, k - 1, ag_body, (bufs, w0))
+    return bufs
+
+
 def make_dd_sum_all_reduce(mesh: Mesh, axis: str = "ranks") -> Callable:
     """Elementwise f64-fidelity SUM across ranks carried as (hi, lo) f32
     pairs — a RING all-reduce built from jax.lax.ppermute hops with
@@ -395,40 +451,16 @@ def make_dd_sum_all_reduce(mesh: Mesh, axis: str = "ranks") -> Callable:
             0, k - 1, body, (hi, lo, hi, lo))
         return acc_hi, acc_lo
 
-    def local_rs_ag(hi, lo):
-        r = jax.lax.axis_index(axis)
-        c = hi.shape[0] // k
-
-        def chunk(buf, idx):
-            return jax.lax.dynamic_slice_in_dim(buf, idx * c, c)
-
-        def put(buf, piece, idx):
-            return jax.lax.dynamic_update_slice_in_dim(buf, piece,
-                                                       idx * c, axis=0)
-
-        def rs_body(s, carry):
-            hi, lo = carry
-            send = (r - s) % k           # chunk this rank forwards
-            tgt = (r - s - 1) % k        # chunk the arriving hop matches
-            rx_hi, rx_lo = _hop((chunk(hi, send), chunk(lo, send)))
-            a_hi, a_lo = _dd_add(chunk(hi, tgt), chunk(lo, tgt),
-                                 rx_hi, rx_lo)
-            return put(hi, a_hi, tgt), put(lo, a_lo, tgt)
-
-        hi, lo = jax.lax.fori_loop(0, k - 1, rs_body, (hi, lo))
-
-        def ag_body(s, carry):
-            hi, lo = carry
-            send = (r + 1 - s) % k       # reduced chunk moving clockwise
-            tgt = (r - s) % k
-            rx_hi, rx_lo = _hop((chunk(hi, send), chunk(lo, send)))
-            return put(hi, rx_hi, tgt), put(lo, rx_lo, tgt)
-
-        return jax.lax.fori_loop(0, k - 1, ag_body, (hi, lo))
-
     def local(hi, lo):
         if k > 1 and hi.shape[0] % k == 0:   # static at trace time
-            return local_rs_ag(hi, lo)
+            # shared ring scaffold; the dd wire form is the pair itself
+            # (lossless), so from_wire(to_wire(.)) is the identity
+            return _ring_rs_ag(
+                axis, k, (hi, lo),
+                to_wire=lambda ch: ch,
+                absorb=lambda tgt, rx: _dd_add(tgt[0], tgt[1],
+                                               rx[0], rx[1]),
+                from_wire=lambda w: w)
         return local_naive(hi, lo)
 
     fn = shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
@@ -473,7 +505,6 @@ def make_q8_sum_all_reduce(mesh: Mesh, axis: str = "ranks") -> Callable:
     (q8_ring_algorithm).
     """
     k = mesh.shape[axis]
-    ring = [(i, (i + 1) % k) for i in range(k)]
 
     def encode(x):
         xb = x.reshape(-1, Q8_BLOCK)
@@ -487,45 +518,18 @@ def make_q8_sum_all_reduce(mesh: Mesh, axis: str = "ranks") -> Callable:
         return (q.reshape(-1, Q8_BLOCK).astype(jnp.float32)
                 * s[:, None]).reshape(-1)
 
-    def _hop(q, s):
-        return (jax.lax.ppermute(q, axis, perm=ring),
-                jax.lax.ppermute(s, axis, perm=ring))
-
     def local(x):
         if k == 1 or x.shape[0] % (k * Q8_BLOCK) != 0:
             return jax.lax.psum(x, axis)    # exact fallback, f32 wire
-        r = jax.lax.axis_index(axis)
-        c = x.shape[0] // k
-
-        def chunk(buf, idx):
-            return jax.lax.dynamic_slice_in_dim(buf, idx * c, c)
-
-        def put(buf, piece, idx):
-            return jax.lax.dynamic_update_slice_in_dim(buf, piece,
-                                                       idx * c, axis=0)
-
-        def rs_body(s_, x):
-            send = (r - s_) % k
-            tgt = (r - s_ - 1) % k
-            rx_q, rx_s = _hop(*encode(chunk(x, send)))
-            return put(x, chunk(x, tgt) + decode(rx_q, rx_s), tgt)
-
-        x = jax.lax.fori_loop(0, k - 1, rs_body, x)
-
-        # rank r now owns reduced chunk (r+1)%k in f32; encode it once
-        # and circulate — the owner keeps the DECODED form of its own
-        # encoding so every replica is bit-identical
-        own = (r + 1) % k
-        q0, s0 = encode(chunk(x, own))
-        x = put(x, decode(q0, s0), own)
-
-        def ag_body(s_, carry):
-            x, q, s = carry
-            tgt = (r - s_) % k          # index the arriving chunk fills
-            rx_q, rx_s = _hop(q, s)
-            return put(x, decode(rx_q, rx_s), tgt), rx_q, rx_s
-
-        x, _, _ = jax.lax.fori_loop(0, k - 1, ag_body, (x, q0, s0))
+        # shared ring scaffold: the wire form is (int8 values, per-block
+        # f32 scales); accumulation stays f32 (absorb dequantizes), and
+        # the scaffold's own-chunk from_wire(to_wire(.)) pass makes
+        # every replica decode the same single encoding
+        (x,) = _ring_rs_ag(
+            axis, k, (x,),
+            to_wire=lambda ch: encode(ch[0]),
+            absorb=lambda tgt, rx: (tgt[0] + decode(*rx),),
+            from_wire=lambda w: (decode(*w),))
         return x
 
     fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(),
