@@ -537,3 +537,25 @@ def test_q8_driver_chained_timing():
     # chained slopes on a loaded CPU can WAIVE; correctness never FAILs
     assert all(r.status in (QAStatus.PASSED, QAStatus.WAIVED)
                for r in results)
+
+
+def test_chained_pair_collective_is_data_dependent():
+    """The pair-shaped chain (f64 dd / key paths' honest timing mode):
+    every in-program iteration really reruns the collective — the
+    chained scalar changes with the trip count."""
+    from tpu_reductions.parallel.collectives import (
+        make_chained_pair_collective)
+
+    mesh = build_mesh()
+    x = _payload("float64")
+    hi, lo = host_split(x)
+    pair_fn = make_dd_sum_all_reduce(mesh, "ranks")
+    chained = make_chained_pair_collective("SUM", pair_fn)
+    pair = (shard_payload(hi.astype(np.float32), mesh, "ranks"),
+            shard_payload(lo.astype(np.float32), mesh, "ranks"))
+    one = float(np.asarray(chained(pair, 1)))
+    three = float(np.asarray(chained(pair, 3)))
+    assert one != three
+    # trip count 1 matches the unchained collective's element 0
+    oh, _ = pair_fn(*pair)
+    assert one == pytest.approx(float(np.asarray(oh)[0]), rel=1e-6)

@@ -201,13 +201,6 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
                   else 0.0)
 
     timing = cfg.timing
-    if timing == "chained" and dd_planes:
-        # the pair collectives carry (hi, lo) planes; the chain folds a
-        # single scalar back into one carried array — not pair-shaped.
-        logger.log("note: timing=chained is not supported on the f64 "
-                   "pair paths; falling back to periter")
-        timing = "periter"
-
     if timing == "chained":
         # Honest slope mode (ops/chain.py): reduce.c's rdtsc-bracketed
         # per-collective timing (reduce.c:73-77) assumes a sync that
@@ -215,11 +208,16 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
         # "retry" row here is one slope sample over chain_span
         # data-dependent in-program collectives. Chains the SAME closure
         # that was warmed up and verified above.
-        from tpu_reductions.parallel.collectives import \
-            make_chained_collective
+        from tpu_reductions.parallel.collectives import (
+            make_chained_collective, make_chained_pair_collective)
         from tpu_reductions.utils.timing import time_chained
-        chained = make_chained_collective(method, mesh, axis,
-                                          rooted=rooted, coll=run)
+        if dd_planes:
+            # pair-shaped chain over the SAME verified closure (the
+            # (hi, lo) planes are the fori_loop carry)
+            chained = make_chained_pair_collective(method, pair_fn)
+        else:
+            chained = make_chained_collective(method, mesh, axis,
+                                              rooted=rooted, coll=run)
         sw = time_chained(chained, x_dev, k_lo=1, k_hi=1 + cfg.chain_span,
                           reps=cfg.retries,
                           materialize=(local_view

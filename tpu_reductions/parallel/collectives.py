@@ -312,39 +312,58 @@ def make_collective_reduce(method: str, mesh: Mesh, axis: str = "ranks",
 # ---------------------------------------------------------------------------
 
 
-def make_chained_collective(method: str, mesh: Mesh, axis: str = "ranks",
-                            rooted: bool = False,
+def make_chained_collective(method: str, mesh: Mesh = None,
+                            axis: str = "ranks", rooted: bool = False,
                             coll: Callable = None) -> Callable:
     """`chained(x_sharded, k) -> scalar`: k data-dependent collective
     reductions inside one compiled program, for honest slope timing
     (ops/chain.py rationale — on the tunneled platform a blocked launch
     returns on dispatch ack, so reduce.c's rdtsc-around-MPI_Reduce timing
-    structure (reduce.c:73-77) cannot be transplanted as-is).
+    structure (reduce.c:73-77) cannot be transplanted as-is; this is
+    that structure rebuilt with the sync INSIDE the compiled program).
 
-    Each fori_loop step runs the collective, then folds element [0] of
-    the reduced output back into shard 0 of the carried payload with the
-    op's own combine — the next step's collective is data-dependent on
-    this step's, so XLA can neither hoist the loop-invariant collective
-    nor elide any iteration. Fetching the returned scalar bounds the
-    completion of all k collectives.
+    `x` may be a single sharded plane or a tuple of planes (the dd SUM /
+    key MIN/MAX pair paths): each fori_loop step runs the collective,
+    then folds element [0] of the reduced output's first plane back into
+    shard 0 of the carried first plane with the op's own combine — the
+    next step's collective is data-dependent on this step's, so XLA can
+    neither hoist the loop-invariant collective nor elide any iteration.
+    (For MIN/MAX the carried value reaches a fixpoint after one step;
+    the dependency chain, and therefore per-iteration execution,
+    remains.) Fetching the returned scalar bounds the completion of all
+    k collectives; the chained scalar is for timing only — correctness
+    is verified on the unchained call (collective_driver).
 
-    Pass `coll` to chain an already-built collective closure (so the
-    timed collective is provably the same one the caller verified);
-    otherwise one is built from (method, mesh, axis, rooted)."""
+    Pass `coll` to chain an already-built closure (so the timed
+    collective is provably the one the caller verified): single-plane
+    closures take one array, pair closures take the planes as separate
+    arguments; otherwise one is built from (method, mesh, axis,
+    rooted)."""
     op = get_op(method)
     if coll is None:
         coll = make_collective_reduce(method, mesh, axis, rooted=rooted)
 
+    def call(x):
+        return coll(*x) if isinstance(x, tuple) else coll(x)
+
+    def first_plane(y):
+        return y[0] if isinstance(y, tuple) else y
+
     def chained(x, k):
-        out_sds = jax.eval_shape(coll, x)
-        init = jnp.zeros((), out_sds.dtype)   # scalar carry: the loop
-        # state stays identically sharded however coll's output is laid
-        # out (replicated all-reduce vs scattered rooted reduce)
+        out_sds = jax.eval_shape(call, x)
+        init = jnp.zeros((), first_plane(out_sds).dtype)  # scalar carry:
+        # the loop state stays identically sharded however coll's output
+        # is laid out (replicated all-reduce vs scattered rooted reduce)
 
         def body(_, carry):
             x, _last = carry
-            s = coll(x)[0]
-            x = x.at[0].set(op.jnp_combine(x[0], s.astype(x.dtype)))
+            s = first_plane(call(x))[0]
+            if isinstance(x, tuple):
+                x0 = x[0].at[0].set(
+                    op.jnp_combine(x[0][0], s.astype(x[0].dtype)))
+                x = (x0,) + x[1:]
+            else:
+                x = x.at[0].set(op.jnp_combine(x[0], s.astype(x.dtype)))
             return x, s
 
         _, last = jax.lax.fori_loop(0, k, body, (x, init))
@@ -352,6 +371,13 @@ def make_chained_collective(method: str, mesh: Mesh, axis: str = "ranks",
 
     return jax.jit(chained)
 
+
+def make_chained_pair_collective(method: str, coll: Callable) -> Callable:
+    """The pair-path spelling of make_chained_collective (same rebuilt
+    reduce.c:73-77 timing structure): `chained((hi, lo), k) -> scalar`
+    for the two-plane collectives (dd SUM, key MIN/MAX), whose closures
+    take the planes as separate arguments."""
+    return make_chained_collective(method, coll=coll)
 
 
 def _ring_rs_ag(axis: str, k: int, bufs: tuple, to_wire, absorb,
