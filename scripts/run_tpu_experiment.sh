@@ -106,11 +106,13 @@ sc = {k: sum(v) / len(v) for k, v in sc.items()}
     json.dumps({f"{d} {m}": g for (d, m), g in sorted(sc.items())},
                indent=1))
 
-# 3) bandwidth-vs-N: int32 SUM to 2^30 (4 GiB), f64 SUM to 2^28
-# (the dd planes double the footprint; 2^28 keeps headroom in 16 GiB
-# HBM). Spans auto-size per payload (ops/chain.auto_chain_span).
+# 3) bandwidth-vs-N: int32 SUM to 2^30 (4 GiB), bf16 to 2^30 (2 GiB —
+# the 2 B/element bandwidth win curve), f64 SUM to 2^28 (the dd planes
+# double the footprint; 2^28 keeps headroom in 16 GiB HBM). Spans
+# auto-size per payload (ops/chain.auto_chain_span).
 shmoo_rows = []
 for dtype, max_pow in (("int32", 14 if dryrun else 30),
+                       ("bfloat16", 14 if dryrun else 30),
                        ("float64", 13 if dryrun else 28)):
     base = ReduceConfig(method="SUM", dtype=dtype, n=1 << 20,
                         backend="pallas", kernel=6, threads=512,
