@@ -130,3 +130,23 @@ def test_pallas_cpufinal_and_thresh_edge_cases():
         got = pallas_reduce(x, method, kernel=7, cpu_final=True,
                             cpu_thresh=thresh, threads=16, max_blocks=4)
         _check(got, x, method, "int32", n)
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.lists(st.floats(min_value=-1e6, max_value=1e6, width=32),
+                min_size=1, max_size=64))
+def test_q8_single_encode_error_within_half_step(vals):
+    """The quantized ring's error model rests on one encode rounding at
+    most half an int8 step per block (collectives.make_q8_sum_all_reduce
+    docstring): pin the host-model bound for arbitrary payload blocks."""
+    import numpy as np
+
+    from tpu_reductions.parallel.collectives import Q8_BLOCK
+
+    x = np.zeros(Q8_BLOCK, dtype=np.float32)
+    x[: len(vals)] = np.asarray(vals, dtype=np.float32)
+    s = np.abs(x).max() / 127.0
+    s = 1.0 if s == 0 else s
+    q = np.clip(np.round(x / s), -127, 127).astype(np.int8)
+    err = np.abs(q.astype(np.float64) * s - x.astype(np.float64)).max()
+    assert err <= s / 2 + 1e-12

@@ -208,3 +208,30 @@ def test_report_cli_reconstructs_single_chip_and_default_calibration(tmp_path):
     md = (tmp_path / "report.md").read_text()
     assert "200.0000" in md and "2.20x" in md   # 200 / 90.8413
     assert "Timing calibration" in md           # default calibration.json
+
+
+def test_plot_vs_n_hlines_and_fallback(tmp_path, monkeypatch):
+    """Constant overlays (the makePlots.gp f(x)=const idiom,
+    makePlots.gp:17-19) render in both the matplotlib and the
+    no-matplotlib .dat fallback paths."""
+    from tpu_reductions.bench import plot as plot_mod
+
+    rows = [{"dtype": "int32", "method": "SUM", "n": 1 << p,
+             "gbps": float(p)} for p in range(10, 14)]
+    hl = {"reference (90.8)": 90.8413, "roof (819)": 819.0}
+    outs = plot_mod.plot_vs_n(rows, tmp_path / "vs_n", hlines=hl)
+    assert any(str(o).endswith((".png", ".dat")) for o in outs)
+    # force the fallback: hlines must land in the .dat too
+    import builtins
+    real_import = builtins.__import__
+
+    def no_mpl(name, *a, **k):
+        if name.startswith("matplotlib"):
+            raise ImportError(name)
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_mpl)
+    outs2 = plot_mod.plot_vs_n(rows, tmp_path / "vs_n_fb", hlines=hl)
+    dat = (tmp_path / "vs_n_fb.dat").read_text()
+    assert "# hline reference (90.8) 90.841" in dat
+    assert len(outs2) == 1
