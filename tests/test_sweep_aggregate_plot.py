@@ -235,3 +235,41 @@ def test_plot_vs_n_hlines_and_fallback(tmp_path, monkeypatch):
     dat = (tmp_path / "vs_n_fb.dat").read_text()
     assert "# hline reference (90.8) 90.841" in dat
     assert len(outs2) == 1
+
+
+def test_roofline_annotation_and_summary():
+    """Roofline accounting (VERDICT r1 item 2): HBM-bound rows carry a
+    fraction of the per-device-kind roof; VMEM-resident rows are tagged
+    as such and never given an HBM fraction."""
+    from tpu_reductions.bench.roofline import annotate, summarize
+
+    rows = [
+        {"dtype": "int32", "method": "SUM", "n": 1 << 24, "gbps": 6238.0},
+        {"dtype": "int32", "method": "SUM", "n": 1 << 28, "gbps": 713.0},
+    ]
+    ann = annotate(rows, device_kind="TPU v5 lite")
+    assert ann[0]["regime"] == "vmem_resident"
+    assert "hbm_fraction" not in ann[0]
+    assert ann[1]["regime"] == "hbm_bound"
+    assert ann[1]["hbm_fraction"] == pytest.approx(713.0 / 819.0,
+                                                  rel=1e-6)
+    lines = summarize(ann)
+    assert any("87% of the roof" in ln for ln in lines)
+    assert any("VMEM-resident peak 6238.0" in ln for ln in lines)
+    # unknown kinds fall back to the measured default, auditable by name
+    assert annotate(rows, device_kind="TPU vX")[0]["device_kind"] == "TPU vX"
+
+
+def test_report_includes_roofline_section(tmp_path):
+    from tpu_reductions.bench.report import generate_report
+
+    paths = generate_report({}, single_chip={("INT", "SUM"): 100.0},
+                            out_dir=tmp_path,
+                            roofline=["int32 SUM: HBM-bound peak ..."])
+    md = paths["md"].read_text()
+    assert "## Roofline" in md
+    assert "- int32 SUM: HBM-bound peak" in md
+    # and absent when not provided
+    paths2 = generate_report({}, single_chip={("INT", "SUM"): 100.0},
+                             out_dir=tmp_path / "b")
+    assert "## Roofline" not in paths2["md"].read_text()

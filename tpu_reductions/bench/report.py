@@ -60,7 +60,9 @@ def generate_report(avgs: Dict[Key, float],
                     figures: Sequence[str | Path] = (),
                     out_dir: str | Path = ".",
                     platform: str = "tpu",
-                    calibration: Optional[dict] = None) -> Dict[str, Path]:
+                    calibration: Optional[dict] = None,
+                    roofline: Optional[Sequence[str]] = None
+                    ) -> Dict[str, Path]:
     """Render report.md + report.tex from averaged collective results
     (aggregate.average output) and optional single-chip numbers
     {(DATATYPE, OP): GB/s}. `calibration` (a
@@ -96,6 +98,10 @@ def generate_report(avgs: Dict[Key, float],
                "payload bytes /\nwall time — reduce.c:79 analog with "
                "real clocks).\n\n" + coll_tbl + "\n") if coll_rows else ""
 
+    roof_md = ("\n## Roofline\n\n"
+               + "\n".join(f"- {ln}" for ln in roofline) + "\n"
+               ) if roofline else ""
+
     md = f"""# TPU Reduction Benchmarks — generated report
 
 *Generated {date} by tpu_reductions.bench.report (the writeup.tex analog).*
@@ -107,7 +113,7 @@ The reference measured a single CC≥1.3 GPU at n=2^24 elements
 kernel path at the same n.
 
 {sc_tbl}
-{coll_md}
+{coll_md}{roof_md}
 {fig_md}
 
 ## Notes
@@ -122,13 +128,14 @@ kernel path at the same n.
     md_path.write_text(md)
 
     tex = _to_tex(sc_rows, coll_rows, figures, date,
-                  calibration=calibration)
+                  calibration=calibration, roofline=roofline)
     tex_path = out / "report.tex"
     tex_path.write_text(tex)
     return {"md": md_path, "tex": tex_path}
 
 
-def _to_tex(sc_rows, coll_rows, figures, date, calibration=None) -> str:
+def _to_tex(sc_rows, coll_rows, figures, date, calibration=None,
+            roofline=None) -> str:
     def tabular(rows, cols, header):
         lines = ["\\begin{tabular}{" + "l" * cols + "}",
                  " & ".join(header) + " \\\\ \\hline"]
@@ -144,6 +151,11 @@ def _to_tex(sc_rows, coll_rows, figures, date, calibration=None) -> str:
     coll_tex = ("\\section{Collective reductions}\n"
                 + tabular(coll_rows, 4, ["dtype", "op", "ranks", "GB/s"])
                 if coll_rows else "")
+    roof_tex = ("\\section{Roofline}\n\\begin{itemize}\n"
+                + "\n".join(f"\\item {_tex_escape(ln)}"
+                             for ln in roofline)
+                + "\n\\end{itemize}"
+                if roofline else "")
     return f"""\\documentclass{{article}}
 \\usepackage{{graphicx}}
 \\title{{TPU Reduction Benchmarks}}
@@ -153,6 +165,7 @@ def _to_tex(sc_rows, coll_rows, figures, date, calibration=None) -> str:
 \\section{{Single-chip reductions}}
 {tabular(sc_rows, 5, ["dtype", "op", "ref GPU", "TPU", "ratio"])}
 {coll_tex}
+{roof_tex}
 \\section{{Figures}}
 {figs}
 \\section{{Methodology}}
@@ -232,9 +245,14 @@ def main(argv=None) -> int:
         p.error(f"{cal_path} not found")
 
     figures = sorted(out.glob("*.eps")) + sorted(out.glob("*.png"))
+    roof_lines = None
+    roof_path = out / "roofline.json"
+    if roof_path.exists():
+        from tpu_reductions.bench.roofline import summarize
+        roof_lines = summarize(json.loads(roof_path.read_text()))
     paths = generate_report(avgs, single_chip=sc or None, figures=figures,
                             out_dir=out, platform=ns.platform,
-                            calibration=cal)
+                            calibration=cal, roofline=roof_lines)
     print(f"report: {paths['md']} {paths['tex']}")
     return 0
 
