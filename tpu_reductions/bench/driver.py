@@ -24,8 +24,7 @@ from typing import Optional
 
 import numpy as np
 
-from tpu_reductions.config import (KERNEL_MXU, KERNEL_SINGLE_PASS,
-                                   LIVE_KERNELS,
+from tpu_reductions.config import (KERNEL_MXU, LIVE_KERNELS,
                                    ReduceConfig)
 from tpu_reductions.ops import oracle as oracle_mod
 from tpu_reductions.ops.registry import tolerance

@@ -16,7 +16,7 @@ fraction of the roof is the kernel-quality number.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 

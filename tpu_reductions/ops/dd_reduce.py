@@ -53,7 +53,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpu_reductions.ops.pallas_reduce import (LANES, SUBLANES,
+from tpu_reductions.ops.pallas_reduce import (LANES,
                                               _interpret_default,
                                               choose_tiling)
 

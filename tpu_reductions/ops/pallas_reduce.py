@@ -49,7 +49,6 @@ f64 SUM fidelity — see dd_reduce.py — or falls back to XLA (see
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

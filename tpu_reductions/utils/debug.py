@@ -21,7 +21,6 @@ are numerical:
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import numpy as np
 
