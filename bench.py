@@ -62,16 +62,20 @@ def _device_probe(platform: str | None = None) -> str | None:
                 + (" | ".join(tail) or f"exit {r.returncode}"))
     return None
 
-# (backend, kernel, threads) candidates: the top of the committed
-# chained-timing tile race run on the real chip (tune_r02.json, round 2
-# — 16 geometries, every one oracle-verified): kernel 6 threads=512 won
-# at 6238 GB/s, 68% over the XLA comparator's 3717. The runners-up and
-# the XLA baseline stay in the race so a regression in the leader is
-# caught by a verified fallback, not silence.
+# (backend, kernel, threads) candidates: the tops of the committed
+# chained-timing tile races run on the real chip (tune_r02.json round-2
+# first pass, 16 geometries all PASSED; tune_fine.json 2026-07-30 fine
+# pass, 21 geometries, 20 PASSED / 1 WAIVED — every candidate listed
+# below PASSED its oracle check in its race). The fine race crowned
+# kernel 7 threads=384 (maxblocks=64, the config default) at 22.7 TB/s
+# in the VMEM-resident regime, with kernel 6 threads=512 (the first
+# pass's 6238 GB/s winner) next. The runners-up and the XLA baseline
+# stay in the race so a regression in the leader is caught by a
+# verified fallback, not silence.
 CANDIDATES = (
+    ("pallas", 7, 384),
     ("pallas", 6, 512),
     ("pallas", 7, 256),
-    ("pallas", 6, 256),
     ("xla", 6, 256),
 )
 

@@ -83,9 +83,18 @@ if cal is None:
     cal = calibrate(n=cal_n, iters=8, reps=7 if not dryrun else 3,
                     chain_span=64 if not dryrun else 8).to_dict()
     cal_file.write_text(json.dumps(cal, indent=1))
+# honest_gbps serializes as null when calibration is indeterminate
+# (noise-swamped slope) — format it conditionally, and refuse to bench
+# against an indeterminate calibration on the real chip: the whole
+# point of step 1 is a trustworthy timing verdict
+gbps = cal.get("honest_gbps")
 log.log(f"calibration: block_awaits_execution="
         f"{cal['block_awaits_execution']} "
-        f"honest_gbps={cal['honest_gbps']:.1f}")
+        f"honest_gbps={'n/a' if gbps is None else format(gbps, '.1f')}")
+if cal.get("indeterminate") and not dryrun:
+    sys.exit("calibration indeterminate (noise-swamped slope) — "
+             "delete the out dir's calibration.json and retry in a "
+             "quieter window; refusing to bench against it")
 
 # 2) the tuned flagship grid at the reference's n=2^24
 # (reduction.cpp:665): kernel 6 threads=512 won the committed tile race
@@ -106,25 +115,56 @@ sc = {k: sum(v) / len(v) for k, v in sc.items()}
     json.dumps({f"{d} {m}": g for (d, m), g in sorted(sc.items())},
                indent=1))
 
-# 3) bandwidth-vs-N: int32 SUM to 2^30 (4 GiB), bf16 to 2^30 (2 GiB —
-# the 2 B/element bandwidth win curve), f64 SUM to 2^28 (the dd planes
-# double the footprint; 2^28 keeps headroom in 16 GiB HBM). Spans
-# auto-size per payload (ops/chain.auto_chain_span).
+# 3) bandwidth-vs-N: int32 SUM, bf16 SUM (2 B/element — the bandwidth
+# win curve), f64 SUM to 2^28 (the dd planes double the footprint;
+# 2^28 keeps headroom in 16 GiB HBM). Spans auto-size per payload
+# (ops/chain.auto_chain_span).
+#
+# Hard-won ordering (examples/tpu_run/RECOVERY.md): BOTH round-2
+# relay deaths happened while staging a 4 GiB (2^30) buffer, and rows
+# held only in memory died with the process. So (a) curves that have
+# never been measured run FIRST, (b) shmoo.json and the plots are
+# rewritten after EVERY curve so a mid-run death loses at most one
+# curve, and (c) the relay-hazardous 2^30 cells run LAST, one cell
+# per process-visible step, gated by HAZARD_CELLS=0 when a window
+# wants to skip them entirely.
+hazard_pow = 30
+hazard = os.environ.get("HAZARD_CELLS", "1") == "1" and not dryrun
+curves = (("bfloat16", 14 if dryrun else hazard_pow - 1),
+          ("float64", 13 if dryrun else 28),
+          ("int32", 14 if dryrun else hazard_pow - 1))
 shmoo_rows = []
-for dtype, max_pow in (("int32", 14 if dryrun else 30),
-                       ("bfloat16", 14 if dryrun else 30),
-                       ("float64", 13 if dryrun else 28)):
-    base = ReduceConfig(method="SUM", dtype=dtype, n=1 << 20,
+
+
+def persist(rows):
+    (out / "shmoo.json").write_text(json.dumps(rows, indent=1))
+    return plot_vs_n(rows, out / "bandwidth_vs_n",
+                     title="TPU v5e single-chip reduction bandwidth vs N",
+                     hlines={"reference CUDA int SUM (90.8)": 90.8413,
+                             "v5e HBM roof (819)": 819.0})
+
+
+def shmoo_cfg(dtype):
+    return ReduceConfig(method="SUM", dtype=dtype, n=1 << 20,
                         backend="pallas", kernel=6, threads=512,
                         timing="chained", chain_reps=2 if dryrun else 5,
                         stat="median", iterations=4096, log_file=None)
-    res = run_shmoo(base, min_pow=10, max_pow=max_pow, logger=log)
+
+
+for dtype, max_pow in curves:
+    res = run_shmoo(shmoo_cfg(dtype), min_pow=10, max_pow=max_pow,
+                    logger=log)
     shmoo_rows += [r.to_dict() for r in res if r.passed]
-(out / "shmoo.json").write_text(json.dumps(shmoo_rows, indent=1))
-figures = plot_vs_n(shmoo_rows, out / "bandwidth_vs_n",
-                    title="TPU v5e single-chip reduction bandwidth vs N",
-                    hlines={"reference CUDA int SUM (90.8)": 90.8413,
-                            "v5e HBM roof (819)": 819.0})
+    figures = persist(shmoo_rows)
+if hazard:
+    for dtype in ("int32", "bfloat16"):
+        log.log(f"hazard cell: {dtype} n=2^{hazard_pow} "
+                "(4 GiB-class staging killed the relay in both "
+                "round-2 windows; running it last, alone)")
+        res = run_shmoo(shmoo_cfg(dtype), min_pow=hazard_pow,
+                        max_pow=hazard_pow, logger=log)
+        shmoo_rows += [r.to_dict() for r in res if r.passed]
+        figures = persist(shmoo_rows)
 
 # 4) report: single-chip tables + curves + the calibration note + the
 # mechanical roofline analysis (VERDICT r1 item 2: "state the TPU

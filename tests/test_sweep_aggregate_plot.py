@@ -256,8 +256,30 @@ def test_roofline_annotation_and_summary():
     lines = summarize(ann)
     assert any("87% of the roof" in ln for ln in lines)
     assert any("VMEM-resident peak 6238.0" in ln for ln in lines)
+    # fully-verified inputs carry no caveat line
+    assert not any("CAVEAT" in ln for ln in lines)
     # unknown kinds fall back to the measured default, auditable by name
     assert annotate(rows, device_kind="TPU vX")[0]["device_kind"] == "TPU vX"
+
+
+def test_roofline_summary_flags_unverified_rows():
+    """Timing rows whose oracle check never ran (status RECOVERED, e.g.
+    re-materialized from a session log after a relay death —
+    scripts/recover_shmoo_from_log.py) must surface a caveat in the
+    summary lines so no generated report presents them as verified."""
+    from tpu_reductions.bench.roofline import annotate, summarize
+
+    rows = [
+        {"dtype": "int32", "method": "SUM", "n": 1 << 28, "gbps": 736.0,
+         "status": "RECOVERED", "verified": False},
+        {"dtype": "int32", "method": "SUM", "n": 1 << 24, "gbps": 6238.0,
+         "status": "PASSED"},
+    ]
+    lines = summarize(annotate(rows, device_kind="TPU v5 lite"))
+    caveats = [ln for ln in lines if "CAVEAT" in ln]
+    assert len(caveats) == 1
+    assert "1 of 2 rows" in caveats[0]
+    assert "RECOVERED" in caveats[0]
 
 
 def test_report_includes_roofline_section(tmp_path):

@@ -90,4 +90,17 @@ def summarize(annotated: Sequence[dict]) -> List[str]:
                 f"{bestv['gbps']:.1f} GB/s "
                 f"(n=2^{int(bestv['n']).bit_length() - 1}; above the "
                 "HBM roof by design — the working set stays on-chip)")
+    # rows whose oracle check never ran (e.g. timing recovered from a
+    # session log after a relay death) must not be presented as
+    # verified: carry the caveat into every generated report that
+    # includes these lines
+    unverified = [r for r in annotated
+                  if r.get("verified") is False
+                  or r.get("status") == "RECOVERED"]
+    if unverified:
+        lines.append(
+            f"CAVEAT: {len(unverified)} of {len(annotated)} rows above "
+            "are timing-only (status RECOVERED — the run died before "
+            "the oracle-verification phase); verified rows carry "
+            "status PASSED in the raw data.")
     return lines

@@ -140,7 +140,12 @@ class TimingCalibration:
         d["indeterminate"] = self.indeterminate
         d["chain_overhead_ratio"] = self.chain_overhead_ratio
         d["honest_gbps"] = self.honest_gbps
-        return d
+        # NaN sentinels (unmeasurable ratios/rates) must serialize as
+        # RFC 8259 null, not the bare literal NaN Python's json.dump
+        # emits by default — committed calibration artifacts are read
+        # by strict parsers, not just Python
+        return {k: (None if isinstance(v, float) and v != v else v)
+                for k, v in d.items()}
 
 
 def calibrate(n: int = 1 << 24, dtype: str = "float32",
