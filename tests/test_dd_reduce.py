@@ -121,3 +121,66 @@ def test_dd_reduce_f64_full_range_minmax(method):
     x = rng.uniform(-1, 1, 999) * 1e305
     got = float(dd_pallas_reduce_f64(x, method, threads=32))
     assert got == (x.min() if method == "MIN" else x.max())
+
+
+@pytest.mark.parametrize("method", ["SUM", "MIN", "MAX"])
+@pytest.mark.parametrize("n", [999, 100_000])
+def test_dd_device_reduce_all_device(method, n):
+    """The all-device f64 path (device pair-tree finish,
+    dd_reduce.device_finish_pairs): only an 8-byte scalar pair crosses
+    to host, and the result matches the host-finish path to full
+    accuracy. This is the structure that makes f64 chainable on the
+    real chip (driver._chain_supported)."""
+    from tpu_reductions.ops.dd_reduce import make_dd_device_reduce
+
+    x = np.random.default_rng(n + 1).uniform(-1, 1, n)
+    stage_fn, core, finish = make_dd_device_reduce(method, n, threads=32)
+    hi2d, lo2d, s = stage_fn(x)
+    s_hi, s_lo = core(hi2d, lo2d)
+    assert np.asarray(s_hi).shape == ()  # a true scalar pair
+    got = float(finish(s_hi, s_lo, scale_exp=s))
+    if method == "SUM":
+        assert abs(got - math.fsum(x.tolist())) < 1e-12
+    else:
+        assert got == (x.min() if method == "MIN" else x.max())
+
+
+@pytest.mark.parametrize("scale", [1e300, 1e-300])
+def test_dd_device_reduce_full_range(scale):
+    """Device finish composes with the exact power-of-two pre-scale."""
+    from tpu_reductions.ops.dd_reduce import make_dd_device_reduce
+
+    x = np.random.default_rng(11).uniform(-1, 1, 4097) * scale
+    stage_fn, core, finish = make_dd_device_reduce("SUM", x.size,
+                                                   threads=32)
+    hi2d, lo2d, s = stage_fn(x)
+    got = float(finish(*core(hi2d, lo2d), scale_exp=s))
+    exact = math.fsum(x.tolist())
+    tol = 1e-12 * max(abs(exact), float(np.abs(x).max()))
+    assert np.isfinite(got) and abs(got - exact) <= tol
+
+
+@pytest.mark.parametrize("method", ["SUM", "MIN"])
+def test_dd_pair_chain(method):
+    """The pair spelling of ops/chain.make_chained_reduce: a chained
+    (hi, lo) carry must trace, run k data-dependent iterations, and
+    return the first plane's scalar — the single-chip f64 analog of the
+    collective pair chain (driver._make_chained_fn wiring)."""
+    import jax
+
+    from tpu_reductions.ops.chain import make_chained_reduce
+    from tpu_reductions.ops.dd_reduce import make_dd_device_reduce
+    from tpu_reductions.ops.registry import get_op
+
+    n = 8192
+    x = np.random.default_rng(5).uniform(-1, 1, n)
+    stage_fn, core, _finish = make_dd_device_reduce(method, n, threads=32)
+    hi2d, lo2d, _s = stage_fn(x)
+    chained = make_chained_reduce(core, get_op(method))
+    out1 = jax.device_get(chained((hi2d, lo2d), 1))
+    out4 = jax.device_get(chained((hi2d, lo2d), 4))
+    assert np.asarray(out1).shape == ()
+    assert np.isfinite(float(out1)) and np.isfinite(float(out4))
+    if method == "MIN":
+        # min chains reach a fixpoint: value stable, dependency intact
+        assert float(out1) == float(out4)

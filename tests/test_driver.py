@@ -146,3 +146,46 @@ def test_kernel7_bf16_minmax_terminates():
         want = (np.min if method == "MIN" else np.max)(
             np.asarray(x, np.float32).astype(jnp.bfloat16))
         assert float(got) == float(want)
+
+
+def test_f64_dd_path_is_chained_on_tpu_backend(monkeypatch):
+    """Driver wiring for the all-device f64 path: when the backend
+    reports TPU, float64 routes through the dd pair kernels with the
+    DEVICE pair-tree finish, is chain-supported, and produces a
+    verified chained measurement (no fetch fallback). Simulated here by
+    faking the backend name while pinning Pallas to interpret mode —
+    the exact code path the real chip takes, minus Mosaic lowering."""
+    import jax
+
+    import tpu_reductions.ops.dd_reduce as dd
+    import tpu_reductions.ops.pallas_reduce as pr
+    from tpu_reductions.bench.driver import (_chain_supported,
+                                             resolved_timing)
+
+    # dd_reduce binds _interpret_default by name at import — patch BOTH
+    # modules' bindings or the dd kernels try a real Mosaic lowering on
+    # the CPU backend under the faked device name
+    monkeypatch.setattr(pr, "_interpret_default", lambda: True)
+    monkeypatch.setattr(dd, "_interpret_default", lambda: True)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    cfg = ReduceConfig(method="SUM", dtype="float64", n=4096,
+                       iterations=3, timing="chained", chain_reps=2,
+                       backend="pallas", threads=32, log_file=None)
+    assert _chain_supported(cfg)
+    assert resolved_timing(cfg) == "chained"
+    res = run_benchmark(cfg, logger=BenchLogger(None, None))
+    assert res.timing == "chained"
+    # chained slope CAN be noise-waived on a loaded host; correctness
+    # must hold whenever the run wasn't waived
+    if res.status != QAStatus.WAIVED:
+        assert res.status == QAStatus.PASSED
+        assert res.abs_diff < 1e-12
+    # --cpufinal keeps the host-finish spelling and falls back to fetch
+    cfg2 = ReduceConfig(method="MAX", dtype="float64", n=4096,
+                        iterations=3, timing="chained", cpu_final=True,
+                        backend="pallas", threads=32, log_file=None)
+    assert not _chain_supported(cfg2)
+    assert resolved_timing(cfg2) == "fetch"
+    res2 = run_benchmark(cfg2, logger=BenchLogger(None, None))
+    assert res2.status == QAStatus.PASSED and res2.timing == "fetch"

@@ -99,11 +99,29 @@ def _make_device_fn(cfg: ReduceConfig, backend: str):
 
     if cfg.dtype == "float64" and jax.default_backend() == "tpu":
         # f64 never touches the device: host split -> f32 dd kernels ->
-        # host finish (dd_reduce.py). This replaces the reference's
-        # "incapable device -> QA_WAIVED" gate (reduction.cpp:148-155)
-        # with an actual implementation.
-        from tpu_reductions.ops.dd_reduce import make_dd_staged_reduce
-        dd_stage, dd_reduce = make_dd_staged_reduce(
+        # device pair-tree finish, with only the final 8-byte scalar
+        # pair decoded on host (dd_reduce.py). This replaces the
+        # reference's "incapable device -> QA_WAIVED" gate
+        # (reduction.cpp:148-155) with an actual implementation whose
+        # timed region is pure device work — so f64 gets chained slope
+        # timing like every other dtype. --cpufinal keeps the
+        # host-finish spelling (reduction.cpp:328-340 semantics).
+        if cfg.cpu_final:
+            from tpu_reductions.ops.dd_reduce import make_dd_staged_reduce
+            dd_stage, dd_reduce = make_dd_staged_reduce(
+                cfg.method, cfg.n, threads=cfg.threads,
+                max_blocks=cfg.max_blocks)
+
+            def stage_fn(x_np):
+                return dd_stage(np.asarray(x_np, dtype=np.float64))
+
+            def reduce_fn(staged):
+                return dd_reduce(*staged)
+
+            return stage_fn, reduce_fn
+
+        from tpu_reductions.ops.dd_reduce import make_dd_device_reduce
+        dd_stage, dd_core, dd_finish = make_dd_device_reduce(
             cfg.method, cfg.n, threads=cfg.threads,
             max_blocks=cfg.max_blocks)
 
@@ -111,7 +129,9 @@ def _make_device_fn(cfg: ReduceConfig, backend: str):
             return dd_stage(np.asarray(x_np, dtype=np.float64))
 
         def reduce_fn(staged):
-            return dd_reduce(*staged)
+            hi2d, lo2d, s = staged
+            return dd_finish(*jax.device_get(dd_core(hi2d, lo2d)),
+                             scale_exp=s)
 
         return stage_fn, reduce_fn
 
@@ -125,15 +145,11 @@ def _make_device_fn(cfg: ReduceConfig, backend: str):
 def _chain_supported(cfg: ReduceConfig) -> bool:
     """Whether cfg's reduce is all-device and therefore chainable:
     --cpufinal does host work inside the timed region by definition
-    (reduction.cpp:328-340), and the f64-on-TPU double-double path
-    finishes on host (dd_reduce.py). Deterministic per (cfg, platform)."""
-    import jax
-
-    if cfg.cpu_final:
-        return False
-    if cfg.dtype == "float64" and jax.default_backend() == "tpu":
-        return False
-    return True
+    (reduction.cpp:328-340). The f64-on-TPU double-double path is
+    all-device since the pair-tree finish (dd_reduce.py
+    device_finish_pairs) — only --cpufinal forces its host finish.
+    Deterministic per (cfg, platform)."""
+    return not cfg.cpu_final
 
 
 def resolved_timing(cfg: ReduceConfig) -> str:
@@ -158,6 +174,24 @@ def _make_chained_fn(cfg: ReduceConfig, backend: str):
         from tpu_reductions.ops.registry import get_op
         op = get_op(cfg.method)
         return make_chained_reduce(op.jnp_reduce, op)
+
+    import jax
+
+    if cfg.dtype == "float64" and jax.default_backend() == "tpu":
+        # pair carry: the chained fn takes (hi2d, lo2d); the staged
+        # scale int is host metadata the timing loop never touches
+        from tpu_reductions.ops.dd_reduce import make_dd_device_reduce
+        from tpu_reductions.ops.registry import get_op
+        _stage, dd_core, _finish = make_dd_device_reduce(
+            cfg.method, cfg.n, threads=cfg.threads,
+            max_blocks=cfg.max_blocks)
+        pair_chained = make_chained_reduce(dd_core, get_op(cfg.method))
+
+        def chained(staged, k):
+            hi2d, lo2d, _s = staged
+            return pair_chained((hi2d, lo2d), k)
+
+        return chained
 
     from tpu_reductions.ops.pallas_reduce import make_staged_core
     op, _stage, core = make_staged_core(
@@ -404,7 +438,7 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
         else None
     if timing_mode == "chained" and chained is None:
         logger.log("NOTE: timing=chained needs an all-device reduce "
-                   "(--cpufinal and the f64 dd path finish on host); "
+                   "(--cpufinal finishes on host by definition); "
                    "falling back to timing=fetch")
         timing_mode = "fetch"
     if chained is not None:

@@ -39,9 +39,10 @@ def _calibration_note(cal: Optional[dict]) -> str:
         how = ("the platform's sync primitive does NOT await execution "
                "(blocked launch {:.0f} us vs {:.0f} us true per-iteration"
                " cost); bandwidths use the chained slope mode wherever "
-               "the reduce is all-device — host-finishing paths (the f64 "
-               "pair collectives, --cpufinal) can only fall back to "
-               "per-launch timing and their rows carry that caveat"
+               "the reduce is all-device (every dtype, including f64 "
+               "via the device pair-tree finish) — only --cpufinal "
+               "rows, host work by definition, fall back to per-launch "
+               "timing and carry that caveat"
                .format(cal.get("single_blocked_s", 0) * 1e6,
                        cal.get("chained_per_iter_s", 0) * 1e6))
     return ("- Timing calibration ({} platform): {}.\n"

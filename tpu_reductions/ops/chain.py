@@ -90,11 +90,18 @@ def auto_chain_span(n: int, dtype: str, *, target_signal_s: float = 6e-3,
                                                            1e-9))))
 
 
-def make_chained_reduce(core: Callable[[jax.Array], jax.Array],
-                        op: ReduceOpSpec):
-    """Wrap a device-only scalar reduction `core(x2d) -> scalar` into
-    `chained(x2d, k) -> scalar` running k data-dependent iterations inside
-    one jitted program.
+def make_chained_reduce(core: Callable, op: ReduceOpSpec):
+    """Wrap a device-only scalar reduction into `chained(x2d, k) ->
+    scalar` running k data-dependent iterations inside one jitted
+    program.
+
+    `core` is either `core(x2d) -> scalar` (single-plane paths) or
+    `core(hi2d, lo2d) -> (s_hi, s_lo)` with `x2d` passed as a 2-tuple of
+    planes (the f64 dd SUM / order-key MIN/MAX pair paths — the same
+    two spellings parallel.collectives' chain builder covers). For the
+    pair form, the first plane's scalar perturbs the first plane's
+    [0, 0] element: the dependency chain is what matters, the chained
+    value is for timing only (module docstring).
 
     `k` is a traced argument (the fori_loop lowers to a while loop), so
     one executable serves every trip count — one tunnel compile, many
@@ -102,16 +109,30 @@ def make_chained_reduce(core: Callable[[jax.Array], jax.Array],
     iteration's reduction, so materializing it on the host bounds the
     completion of all k kernel executions.
     """
-    def chained(x2d: jax.Array, k) -> jax.Array:
-        out = jax.eval_shape(core, x2d)
+    def chained(x2d, k) -> jax.Array:
+        pair = isinstance(x2d, tuple)
+
+        def call(x):
+            return core(*x) if pair else core(x)
+
+        def first(y):
+            return y[0] if isinstance(y, tuple) else y
+
+        out = first(jax.eval_shape(call, x2d))
         init = jnp.zeros(out.shape, out.dtype)
 
         def body(_, carry):
             x, _last = carry
-            s = core(x)
+            s = first(call(x))
             # fold the step scalar into one element: in-place one-element
             # update on the loop-carried buffer; breaks loop-invariance
-            x = x.at[0, 0].set(op.jnp_combine(x[0, 0], s.astype(x.dtype)))
+            if pair:
+                x0 = x[0].at[0, 0].set(
+                    op.jnp_combine(x[0][0, 0], s.astype(x[0].dtype)))
+                x = (x0,) + x[1:]
+            else:
+                x = x.at[0, 0].set(op.jnp_combine(x[0, 0],
+                                                  s.astype(x.dtype)))
             return x, s
 
         _, last = jax.lax.fori_loop(0, k, body, (x2d, init))

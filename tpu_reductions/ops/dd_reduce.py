@@ -264,8 +264,103 @@ def dd_pallas_call(hi2d: jax.Array, lo2d: jax.Array, method: str, tm: int,
 
 
 # ---------------------------------------------------------------------------
-# Host finish + public entry points
+# Device finish (all-device path) + host finish + public entry points
 # ---------------------------------------------------------------------------
+
+
+def device_finish_pairs(acc_hi: jax.Array, acc_lo: jax.Array,
+                        method: str) -> tuple[jax.Array, jax.Array]:
+    """Fold the (TM, LANES) pair accumulator down to ONE scalar pair on
+    device — the finish that lets the f64 path stay all-device so only
+    8 bytes ever cross to the host (and chained slope timing applies,
+    exactly as on the int/float paths).
+
+    jnp.sum/min/max cannot be used: the fold must preserve pair
+    semantics (compensated dd addition for SUM, lexicographic selection
+    for the MIN/MAX key pairs). Instead: a static log2 halving tree of
+    the same error-free transformations the kernel uses — pad the
+    flattened planes to a power of two with the op's identity, then
+    repeatedly combine the two halves elementwise. All 32-bit, jittable,
+    TPU-safe (no f64 anywhere).
+
+    Error budget (SUM): each _dd_add is an error-free transformation
+    renormalized to ~2^-48 relative accuracy, and the tree adds only
+    log2(TM*128) ~ 10-13 levels on top of the kernel's accumulation, so
+    the finish stays inside the same ~1e-15 budget as the host
+    promote-and-sum it replaces (module docstring error analysis);
+    MIN/MAX key selection is exact. Verified against the host finish in
+    tests/test_dd_reduce.py."""
+    method = method.upper()
+    hi, lo = jnp.ravel(acc_hi), jnp.ravel(acc_lo)
+    size = hi.shape[0]
+    pow2 = 1 << max(size - 1, 0).bit_length()
+    if pow2 != size:
+        if method == "SUM":
+            pad = jnp.zeros((pow2 - size,), hi.dtype)
+            hi, lo = (jnp.concatenate([hi, pad]),
+                      jnp.concatenate([lo, pad]))
+        else:
+            ident = _I32_MAX if method == "MIN" else _I32_MIN
+            pad = jnp.full((pow2 - size,), ident, hi.dtype)
+            hi, lo = (jnp.concatenate([hi, pad]),
+                      jnp.concatenate([lo, pad]))
+    while hi.shape[0] > 1:
+        h = hi.shape[0] // 2
+        if method == "SUM":
+            hi, lo = _dd_add(hi[:h], lo[:h], hi[h:], lo[h:])
+        else:
+            hi, lo = _dd_select(hi[:h], lo[:h], hi[h:], lo[h:],
+                                minimum=(method == "MIN"))
+    return hi[0], lo[0]
+
+
+def decode_pair_scalar(s_hi, s_lo, method: str,
+                       scale_exp: int = 0) -> np.float64:
+    """Convert the device's final scalar pair (8 bytes) to np.float64 on
+    host: SUM promotes and undoes the staging pre-scale exactly
+    (ldexp); MIN/MAX inverts the order-key bijection — bit-exact."""
+    if method.upper() == "SUM":
+        z = float(s_hi) + float(s_lo)
+        return np.float64(np.ldexp(z, scale_exp))
+    return np.float64(host_key_decode(np.asarray(s_hi, dtype=np.int32),
+                                      np.asarray(s_lo, dtype=np.int32)))
+
+
+def make_dd_device_reduce(method: str, n: int, *, threads: int = 256,
+                          max_blocks: int = 64,
+                          interpret: Optional[bool] = None):
+    """Build (stage_fn, core, finish) for the ALL-DEVICE f64 path:
+
+      stage_fn(np f64) -> (hi2d, lo2d, s) device planes + host scale int
+      core(hi2d, lo2d) -> (s_hi, s_lo) device scalar pair  [jittable —
+          kernel + device tree finish; this is the chainable reduce]
+      finish(s_hi, s_lo, scale_exp) -> np.float64  [8-byte host decode]
+
+    This is the f64 twin of pallas_reduce.make_staged_core: the timed
+    region is pure device work, so chained slope timing applies and the
+    f64 benchmark stops being bound by host-link transfer (the old
+    host_finish_pairs path remains as the --cpufinal spelling,
+    reduction.cpp:328-340)."""
+    tm, _, _ = choose_tiling(n, threads, max_blocks)
+    method = method.upper()
+
+    def stage_fn(x_np):
+        hi2d, lo2d, (tm2, _, _), s = stage_split_padded(
+            x_np, method, threads, max_blocks)
+        assert tm2 == tm
+        return jnp.asarray(hi2d), jnp.asarray(lo2d), s
+
+    @jax.jit
+    def core(hi2d, lo2d):
+        acc_hi, acc_lo = dd_pallas_call(hi2d, lo2d, method, tm,
+                                        interpret=interpret)
+        return device_finish_pairs(acc_hi, acc_lo, method)
+
+    def finish(s_hi, s_lo, scale_exp=0):
+        return decode_pair_scalar(s_hi, s_lo, method,
+                                  scale_exp=scale_exp)
+
+    return stage_fn, core, finish
 
 
 def host_finish_pairs(acc_hi, acc_lo, method: str,
