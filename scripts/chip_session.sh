@@ -2,11 +2,15 @@
 # One command for a live-chip session, ordered by value-per-minute so a
 # tunnel that re-wedges mid-run still leaves the most important
 # artifacts committed (round-1 VERDICT: "measure early, snapshot
-# mid-round, re-verify at the end"):
+# mid-round, re-verify at the end"; window-2 targets in
+# docs/PERF_NOTES.md):
 #   1. bench.py           headline metric        (~2 min)
 #   2. calibrate --ladder two-regime trust gate  (~2 min)
-#   3. autotune fine grid second-pass tile race  (~5 min)
-#   4. run_tpu_experiment full curve to 2^30     (the long tail)
+#   3. f64 chained spot   all-device dd check    (~2 min)
+#   4. autotune hbm grid  HBM-regime race @2^26  (~5 min)
+#   5. autotune fine grid second-pass tile race  (~5 min)
+#   6. run_tpu_experiment full curves            (the long tail;
+#      never-measured curves first, 4 GiB hazard cells last)
 # Each step git-commits ONLY its own artifacts before the next starts.
 # The drivers drain their device queues (results materialize on host),
 # so interrupting BETWEEN steps cannot strand in-flight work.
@@ -58,6 +62,22 @@ step "calibration ladder" calibration_live.json -- \
     bash -c 'set -o pipefail; \
              python -m tpu_reductions.utils.calibrate --ladder \
                  --chainspan 256 --reps 7 | tail -1 > calibration_live.json'
+
+# all-device f64 (ops/dd_reduce.device_finish_pairs): first on-chip
+# chained DOUBLE number — expected near the INT roof fraction instead
+# of the old transfer-bound 0.9 GB/s (docs/PERF_NOTES.md hypothesis 4)
+step "f64 chained spot" f64_chained_spot.txt -- \
+    bash -c 'set -o pipefail; \
+             python -m tpu_reductions --method=SUM --type=double \
+                 --n=16777216 --iterations=256 --timing=chained \
+                 --stat=median \
+                 --logfile=/tmp/f64spot.txt | tee f64_chained_spot.txt'
+
+# does k7 pipelining survive HBM streaming, and does any Pallas
+# geometry close the 5-8% gap to XLA at 2^26? (hypothesis 1)
+step "hbm regime race" tune_hbm.json -- \
+    python -m tpu_reductions.bench.autotune --method=SUM --type=int \
+        --n=67108864 --grid=hbm --comparator --out=tune_hbm.json
 
 step "fine tile race" tune_fine.json -- \
     python -m tpu_reductions.bench.autotune --method=SUM --type=int \

@@ -58,3 +58,45 @@ def test_fine_grid_is_valid_and_distinct():
     assert len(set(FINE_GRID)) == len(FINE_GRID)
     for k, t, mb in FINE_GRID:
         assert k in LIVE_KERNELS and t > 0 and mb > 0
+
+
+def test_hbm_grid_and_comparator_row():
+    """The 'hbm' preset exists for the HBM-regime race
+    (docs/PERF_NOTES.md next-window hypotheses) and --comparator
+    appends exactly one XLA row so the race records the baseline the
+    Pallas winner must beat in the same discipline."""
+    from tpu_reductions.bench.autotune import (GRIDS, HBM_GRID,
+                                               candidate_configs)
+    from tpu_reductions.config import ReduceConfig
+
+    assert GRIDS["hbm"] is HBM_GRID
+    base = ReduceConfig(method="SUM", dtype="int32", n=1 << 14,
+                        log_file=None)
+    cfgs = candidate_configs(base, HBM_GRID, comparator=True)
+    assert len(cfgs) == len(HBM_GRID) + 1
+    assert [c.backend for c in cfgs].count("xla") == 1
+    assert all(c.backend == "pallas" for c in cfgs[:-1])
+
+
+def test_autotune_cli_comparator_races_xla(capsys, tmp_path):
+    """End-to-end: a tiny --grid=hbm --comparator race on CPU ranks the
+    XLA row alongside the Pallas candidates and records backends in the
+    JSON output."""
+    import json
+
+    from tpu_reductions.bench import autotune as at
+
+    out = tmp_path / "t.json"
+    rc = at.main(["--method=SUM", "--type=int", "--n=8192",
+                  "--iterations=3", "--timing=fetch", "--grid=hbm",
+                  "--comparator", "--platform=cpu", f"--out={out}"])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    backends = {r["backend"] for r in data["ranked"]}
+    assert backends == {"pallas", "xla"}
+    assert sum(r["backend"] == "xla" for r in data["ranked"]) == 1
+    # the comparator is a fixed baseline, never the recommendation:
+    # best must be a tunable (pallas) geometry even when XLA ranks
+    # first (on CPU the XLA row routinely wins the race)
+    assert data["best"]["backend"] == "pallas"
+    assert data["best"]["status"] == "PASSED"

@@ -60,24 +60,44 @@ FINE_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
        for mb in (32, 64, 128)]
 )
 
-GRIDS = {"default": DEFAULT_GRID, "fine": FINE_GRID}
+# HBM-regime race (run with --n >= 2^26 so the working set exceeds
+# VMEM): big tiles for deep DMA on the single-pass kernels, and the
+# fine race's two-pass winner geometry (k7 t=384, tune_fine.json)
+# bracketed — the docs/PERF_NOTES.md next-window hypotheses 1 and 4.
+# Use --comparator to append the XLA row (the 779 GB/s = 95%-of-roof
+# rate calibration measured at 2^26; the gap to close).
+HBM_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
+    [(KERNEL_SINGLE_PASS, t, 64) for t in (512, 1024, 2048)]
+    + [(KERNEL_TWO_PASS, t, mb) for t in (256, 384, 512)
+       for mb in (64, 128)]
+)
+
+GRIDS = {"default": DEFAULT_GRID, "fine": FINE_GRID, "hbm": HBM_GRID}
 
 
 def candidate_configs(base: ReduceConfig,
                       grid: Sequence[Tuple[int, int, int]] = DEFAULT_GRID,
-                      ) -> List[ReduceConfig]:
+                      comparator: bool = False) -> List[ReduceConfig]:
     """Expand the (kernel, threads, max_blocks) grid into benchmark
     configs sharing `base`'s op/dtype/n/timing discipline — the candidate
     space the reference leaves to hand-set --threads/--maxblocks knobs
-    (reduction.cpp:666-668)."""
-    return [dataclasses.replace(base, backend="pallas", kernel=k,
+    (reduction.cpp:666-668). `comparator` appends one XLA-backend config
+    so the race records the always-correct baseline it must beat
+    (SURVEY.md §7 L2b) in the same run, same discipline."""
+    cfgs = [dataclasses.replace(base, backend="pallas", kernel=k,
                                 threads=t, max_blocks=mb)
             for k, t, mb in grid]
+    if comparator:
+        cfgs.append(dataclasses.replace(base, backend="xla",
+                                        kernel=KERNEL_SINGLE_PASS,
+                                        threads=256, max_blocks=64))
+    return cfgs
 
 
 def autotune(base: ReduceConfig,
              grid: Sequence[Tuple[int, int, int]] = DEFAULT_GRID,
              logger: Optional[BenchLogger] = None,
+             comparator: bool = False,
              ) -> List[Tuple[ReduceConfig, BenchResult]]:
     """Race the grid; return (config, result) pairs sorted fastest-first
     with verified (PASSED) candidates ranked strictly above the rest.
@@ -85,7 +105,7 @@ def autotune(base: ReduceConfig,
     Replaces getNumBlocksAndThreads' static clamping of user-picked knobs
     (reduction.cpp:272-291) with measurement (SURVEY.md §7 step 3)."""
     logger = logger or BenchLogger(None, None)
-    cfgs = candidate_configs(base, grid)
+    cfgs = candidate_configs(base, grid, comparator=comparator)
     results = run_benchmark_batch(cfgs, logger=logger)
     pairs = list(zip(cfgs, results))
     pairs.sort(key=lambda cr: (not cr[1].passed, -cr[1].gbps))
@@ -116,7 +136,11 @@ def main(argv=None) -> int:
                    choices=sorted(GRIDS),
                    help="Candidate grid: 'default' spans the space, "
                         "'fine' races tightly around the round-2 "
-                        "winners (tune_r02.json)")
+                        "winners (tune_r02.json), 'hbm' targets the "
+                        "HBM-bound regime (use with --n >= 2^26)")
+    p.add_argument("--comparator", action="store_true",
+                   help="Append one XLA-backend row to the race (the "
+                        "baseline the Pallas winner must beat)")
     p.add_argument("--out", type=str, default=None,
                    help="Write the ranked results as JSON to this path")
     ns = p.parse_args(argv)
@@ -133,18 +157,34 @@ def main(argv=None) -> int:
                         stat=ns.stat, timing=ns.timing,
                         chain_reps=ns.chain_reps, log_file=None)
     logger = BenchLogger(None, None, console=sys.stderr)
-    pairs = autotune(base, grid=GRIDS[ns.grid], logger=logger)
+    pairs = autotune(base, grid=GRIDS[ns.grid], logger=logger,
+                     comparator=ns.comparator)
     rows = []
     for cfg, res in pairs:
-        rows.append({"kernel": cfg.kernel, "threads": cfg.threads,
-                     "max_blocks": cfg.max_blocks, "gbps": round(res.gbps, 4),
+        # the XLA comparator ignores the geometry knobs entirely — a
+        # serialized kernel/threads value there would read as "the
+        # geometry XLA was measured at"; record null instead
+        xla = cfg.backend == "xla"
+        rows.append({"backend": cfg.backend,
+                     "kernel": None if xla else cfg.kernel,
+                     "threads": None if xla else cfg.threads,
+                     "max_blocks": None if xla else cfg.max_blocks,
+                     "gbps": round(res.gbps, 4),
                      "status": res.status.name})
-        print(f"kernel={cfg.kernel} threads={cfg.threads:>5} "
-              f"maxblocks={cfg.max_blocks:>4}  {res.gbps:10.2f} GB/s "
+        geom = ("(geometry n/a)          " if xla else
+                f"kernel={cfg.kernel} threads={cfg.threads:>5} "
+                f"maxblocks={cfg.max_blocks:>4}")
+        print(f"{cfg.backend:>6} {geom}  {res.gbps:10.2f} GB/s "
               f"[{res.status.name}]")
-    best = rows[0] if pairs and pairs[0][1].passed else None
+    # best = the fastest VERIFIED **tunable** (pallas) candidate: the
+    # comparator row is a fixed baseline, not a geometry this tool can
+    # recommend, and it must not mask "every Pallas candidate failed"
+    # (exit 1) just because the baseline passed
+    best = next((r for r, (cfg, res) in zip(rows, pairs)
+                 if res.passed and cfg.backend == "pallas"), None)
     if best:
-        print(f"best: kernel={best['kernel']} threads={best['threads']} "
+        print(f"best: {best['backend']} kernel={best['kernel']} "
+              f"threads={best['threads']} "
               f"maxblocks={best['max_blocks']} -> {best['gbps']} GB/s")
     if ns.out:
         with open(ns.out, "w") as f:

@@ -111,29 +111,19 @@ def _make_device_fn(cfg: ReduceConfig, backend: str):
             dd_stage, dd_reduce = make_dd_staged_reduce(
                 cfg.method, cfg.n, threads=cfg.threads,
                 max_blocks=cfg.max_blocks)
-
-            def stage_fn(x_np):
-                return dd_stage(np.asarray(x_np, dtype=np.float64))
-
-            def reduce_fn(staged):
-                return dd_reduce(*staged)
-
-            return stage_fn, reduce_fn
+            return dd_stage, lambda staged: dd_reduce(*staged)
 
         from tpu_reductions.ops.dd_reduce import make_dd_device_reduce
         dd_stage, dd_core, dd_finish = make_dd_device_reduce(
             cfg.method, cfg.n, threads=cfg.threads,
             max_blocks=cfg.max_blocks)
 
-        def stage_fn(x_np):
-            return dd_stage(np.asarray(x_np, dtype=np.float64))
-
         def reduce_fn(staged):
             hi2d, lo2d, s = staged
             return dd_finish(*jax.device_get(dd_core(hi2d, lo2d)),
                              scale_exp=s)
 
-        return stage_fn, reduce_fn
+        return dd_stage, reduce_fn
 
     stage_fn, reduce_fn = pr.make_staged_reduce(
         cfg.method, cfg.n, cfg.dtype, threads=cfg.threads,

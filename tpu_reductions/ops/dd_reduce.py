@@ -271,9 +271,12 @@ def dd_pallas_call(hi2d: jax.Array, lo2d: jax.Array, method: str, tm: int,
 def device_finish_pairs(acc_hi: jax.Array, acc_lo: jax.Array,
                         method: str) -> tuple[jax.Array, jax.Array]:
     """Fold the (TM, LANES) pair accumulator down to ONE scalar pair on
-    device — the finish that lets the f64 path stay all-device so only
-    8 bytes ever cross to the host (and chained slope timing applies,
-    exactly as on the int/float paths).
+    device — the pair-arithmetic analog of the reference's on-device
+    final fold (the warp-synchronous 32->1 tail, reduction_kernel.cu:
+    110-122, and the multi-pass partials finish, reduction.cpp:343-357)
+    — so the f64 path stays all-device, only 8 bytes ever cross to the
+    host, and chained slope timing applies exactly as on the int/float
+    paths.
 
     jnp.sum/min/max cannot be used: the fold must preserve pair
     semantics (compensated dd addition for SUM, lexicographic selection
@@ -317,13 +320,30 @@ def device_finish_pairs(acc_hi: jax.Array, acc_lo: jax.Array,
 def decode_pair_scalar(s_hi, s_lo, method: str,
                        scale_exp: int = 0) -> np.float64:
     """Convert the device's final scalar pair (8 bytes) to np.float64 on
-    host: SUM promotes and undoes the staging pre-scale exactly
+    host — the D2H of the final result scalar (reduction.cpp:377-381),
+    pair-encoded: SUM promotes and undoes the staging pre-scale exactly
     (ldexp); MIN/MAX inverts the order-key bijection — bit-exact."""
     if method.upper() == "SUM":
         z = float(s_hi) + float(s_lo)
         return np.float64(np.ldexp(z, scale_exp))
     return np.float64(host_key_decode(np.asarray(s_hi, dtype=np.int32),
                                       np.asarray(s_lo, dtype=np.int32)))
+
+
+def _make_stage_fn(method: str, tm: int, threads: int, max_blocks: int):
+    """One staging closure shared by the device- and host-finish
+    builders: np f64 payload -> (hi2d, lo2d) device planes + the
+    ride-along scale int (untimed staging metadata, like the padding
+    geometry)."""
+
+    def stage_fn(x_np):
+        hi2d, lo2d, (tm2, _, _), s = stage_split_padded(
+            np.asarray(x_np, dtype=np.float64), method, threads,
+            max_blocks)
+        assert tm2 == tm
+        return jnp.asarray(hi2d), jnp.asarray(lo2d), s
+
+    return stage_fn
 
 
 def make_dd_device_reduce(method: str, n: int, *, threads: int = 256,
@@ -343,12 +363,7 @@ def make_dd_device_reduce(method: str, n: int, *, threads: int = 256,
     reduction.cpp:328-340)."""
     tm, _, _ = choose_tiling(n, threads, max_blocks)
     method = method.upper()
-
-    def stage_fn(x_np):
-        hi2d, lo2d, (tm2, _, _), s = stage_split_padded(
-            x_np, method, threads, max_blocks)
-        assert tm2 == tm
-        return jnp.asarray(hi2d), jnp.asarray(lo2d), s
+    stage_fn = _make_stage_fn(method, tm, threads, max_blocks)
 
     @jax.jit
     def core(hi2d, lo2d):
@@ -396,14 +411,7 @@ def make_dd_staged_reduce(method: str, n: int, *, threads: int = 256,
     reduce_fn(hi2d, lo2d) -> np.float64 scalar (timed: kernel + host
     finish, the --cpufinal structure)."""
     tm, _, _ = choose_tiling(n, threads, max_blocks)
-
-    def stage_fn(x_np):
-        hi2d, lo2d, (tm2, _, _), s = stage_split_padded(
-            x_np, method, threads, max_blocks)
-        assert tm2 == tm
-        # s rides along as a host-side int (untimed staging metadata,
-        # like the padding geometry); reduce_fn undoes it exactly
-        return jnp.asarray(hi2d), jnp.asarray(lo2d), s
+    stage_fn = _make_stage_fn(method.upper(), tm, threads, max_blocks)
 
     kernel_fn = jax.jit(lambda h, l: dd_pallas_call(h, l, method, tm,
                                                     interpret=interpret))
