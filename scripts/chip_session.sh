@@ -27,29 +27,37 @@ step() {  # step <name> <artifact...> -- <cmd...>
     fi
     shift
     echo "=== chip_session: $name ==="
-    if "$@"; then
-        # add per artifact, and commit only the ones that exist: one
-        # missing path must block neither the add nor the commit of the
-        # artifacts that were produced
-        local a
-        local have=()
-        for a in "${arts[@]}"; do
-            if [ ! -e "$a" ]; then
-                echo "=== chip_session: $name: no artifact $a ==="
-            elif git add -- "$a"; then   # real add failures stay loud
-                have+=("$a")
-            fi
-        done
-        if [ ${#have[@]} -gt 0 ] \
-                && ! git diff --cached --quiet -- "${have[@]}"; then
-            # commit restricted to the produced artifacts: pre-existing
-            # staged work must never be swept into an artifact commit
-            git commit -q -m "On-chip artifacts: $name" -- "${have[@]}"
-        else
-            echo "=== chip_session: $name produced no new artifact ==="
+    local status=ok
+    if ! "$@"; then
+        status=FAILED
+        echo "=== chip_session: $name FAILED (continuing; committing any artifacts it DID produce) ==="
+        # a failing step can still have written real data (e.g. the HBM
+        # race writes tune_hbm.json with every row FAILED, then exits 1
+        # because no Pallas candidate passed — the exact hypothesis the
+        # step probes); losing it to a later wedge would defeat the
+        # script's commit-between-steps contract
+    fi
+    # add per artifact, and commit only the ones that exist: one
+    # missing path must block neither the add nor the commit of the
+    # artifacts that were produced
+    local a
+    local have=()
+    for a in "${arts[@]}"; do
+        if [ ! -e "$a" ]; then
+            echo "=== chip_session: $name: no artifact $a ==="
+        elif git add -- "$a"; then   # real add failures stay loud
+            have+=("$a")
         fi
+    done
+    if [ ${#have[@]} -gt 0 ] \
+            && ! git diff --cached --quiet -- "${have[@]}"; then
+        # commit restricted to the produced artifacts: pre-existing
+        # staged work must never be swept into an artifact commit
+        local msg="On-chip artifacts: $name"
+        [ "$status" = FAILED ] && msg="$msg (step FAILED; partial artifacts)"
+        git commit -q -m "$msg" -- "${have[@]}"
     else
-        echo "=== chip_session: $name FAILED (continuing; earlier steps are committed) ==="
+        echo "=== chip_session: $name produced no new artifact ==="
     fi
 }
 

@@ -150,3 +150,39 @@ def test_q8_single_encode_error_within_half_step(vals):
     q = np.clip(np.round(x / s), -127, 127).astype(np.int8)
     err = np.abs(q.astype(np.float64) * s - x.astype(np.float64)).max()
     assert err <= s / 2 + 1e-12
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.integers(min_value=1, max_value=1 << 13),
+       st.sampled_from(["SUM", "MIN", "MAX"]),
+       st.sampled_from([8, 32, 64]),
+       st.floats(min_value=-280.0, max_value=280.0))
+def test_dd_device_finish_matches_host_finish(n, method, threads,
+                                              log2_scale):
+    """The all-device pair-tree finish (dd_reduce.device_finish_pairs)
+    must agree with the host finish it replaces across geometries,
+    payload signs and the full f64 exponent range: MIN/MAX bit-exactly
+    (both are exact selections), SUM within the shared ~2^-48 pair
+    error budget."""
+    import numpy as np
+
+    from tpu_reductions.ops.dd_reduce import (decode_pair_scalar,
+                                              dd_pallas_call,
+                                              device_finish_pairs,
+                                              host_finish_pairs,
+                                              stage_split_padded)
+
+    rng = np.random.default_rng(n * 31 + threads)
+    x = rng.uniform(-1.0, 1.0, n) * float(2.0 ** log2_scale)
+    hi2d, lo2d, (tm, _, _), s = stage_split_padded(x, method, threads, 8)
+    import jax.numpy as jnp
+    acc_hi, acc_lo = dd_pallas_call(jnp.asarray(hi2d), jnp.asarray(lo2d),
+                                    method, tm)
+    host = float(host_finish_pairs(acc_hi, acc_lo, method, scale_exp=s))
+    s_hi, s_lo = device_finish_pairs(acc_hi, acc_lo, method)
+    dev = float(decode_pair_scalar(s_hi, s_lo, method, scale_exp=s))
+    if method == "SUM":
+        tol = 2.0 ** -40 * max(abs(host), float(np.abs(x).max()))
+        assert abs(dev - host) <= tol
+    else:
+        assert dev == host
