@@ -144,6 +144,11 @@ def main(argv=None) -> int:
     from tpu_reductions.config import _apply_platform
     _apply_platform(ns)
 
+    # a candidate race hung on a mid-run relay death reports nothing;
+    # the watchdog exits promptly instead (utils/watchdog.py)
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()
+
     from tpu_reductions.bench.driver import run_benchmark_batch
     from tpu_reductions.config import ReduceConfig
     from tpu_reductions.utils.logging import BenchLogger

@@ -17,6 +17,40 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
+# Quick relay gate (no JAX import, ~instant): on the tunneled box a
+# dead relay can never come back in-session (CLAUDE.md), so starting —
+# or continuing to — any on-chip step would either hang at device
+# discovery or silently run the wrong platform. Non-tunneled hosts
+# (no relay by construction) always pass.
+# Inline socket probe, NOT an import of tpu_reductions.utils.watchdog:
+# the package __init__ pulls in jax (~2 s, and the axon plugin is the
+# machinery a dead relay hangs) — this gate must stay genuinely
+# JAX-free. Semantics mirror watchdog.tunneled_environment/relay_alive
+# (marker file; any port connecting, or an inconclusive local error,
+# counts as alive).
+relay_ok() {
+    # -S: skip site initialization (~2 s in this venv) — stdlib only
+    python -S -c '
+import os, socket, sys
+if not os.path.exists("/root/.relay.py"):
+    sys.exit(0)      # untunneled host: no relay by construction
+inconclusive = False
+for port in (8082, 8083):
+    try:
+        socket.create_connection(("127.0.0.1", port), timeout=2).close()
+        sys.exit(0)
+    except (ConnectionRefusedError, ConnectionResetError, TimeoutError):
+        continue
+    except OSError:
+        inconclusive = True
+sys.exit(0 if inconclusive else 3)'
+}
+
+if ! relay_ok; then
+    echo "=== chip_session: relay is dead before the session started; nothing on-chip can run — aborting (rc=3) ==="
+    exit 3
+fi
+
 step() {  # step <name> <artifact...> -- <cmd...>
     local name=$1; shift
     local arts=()
@@ -27,10 +61,19 @@ step() {  # step <name> <artifact...> -- <cmd...>
     fi
     shift
     echo "=== chip_session: $name ==="
-    local status=ok
-    if ! "$@"; then
+    if ! relay_ok; then
+        # a step that exited 1 for its own reasons (e.g. bench.py's
+        # stale-snapshot outage contract) does not carry the rc=3
+        # signal — this probe catches a relay that died between steps
+        # regardless of how the previous step reported it
+        echo "=== chip_session: ABORT — relay died before step '$name'; remaining steps skipped ==="
+        exit 3
+    fi
+    local status=ok rc=0
+    "$@" || rc=$?   # no set -e here; `if ! cmd` would negate $?
+    if [ "$rc" -ne 0 ]; then
         status=FAILED
-        echo "=== chip_session: $name FAILED (continuing; committing any artifacts it DID produce) ==="
+        echo "=== chip_session: $name FAILED rc=$rc (committing any artifacts it DID produce) ==="
         # a failing step can still have written real data (e.g. the HBM
         # race writes tune_hbm.json with every row FAILED, then exits 1
         # because no Pallas candidate passed — the exact hypothesis the
@@ -58,6 +101,17 @@ step() {  # step <name> <artifact...> -- <cmd...>
         git commit -q -m "$msg" -- "${have[@]}"
     else
         echo "=== chip_session: $name produced no new artifact ==="
+    fi
+    if [ "$rc" -eq 3 ]; then
+        # exit code 3 = accelerator unavailable (run_tpu_experiment's
+        # device probe / utils/watchdog.py relay death; bench.py's
+        # outage contract is exit 1 + stale snapshot, which the
+        # per-step relay_ok probe above covers instead): the relay
+        # cannot come back in-session (CLAUDE.md), so every later
+        # on-chip step could only hang — stop here with the artifacts
+        # committed
+        echo "=== chip_session: ABORT — accelerator gone (rc=3); remaining steps skipped ==="
+        exit 3
     fi
 }
 

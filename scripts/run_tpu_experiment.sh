@@ -54,6 +54,11 @@ else:
         "this is the on-chip experiment; run scripts/run_experiment.sh "
         "out/ --platform cpu for the host pipeline (or DRYRUN=1 to "
         "rehearse this script on CPU)")
+    # both round-2 windows ended hung on a dead relay mid-batch; the
+    # watchdog exits promptly instead (per-curve persistence below
+    # bounds the loss to one curve)
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()
 
 from tpu_reductions.bench.plot import plot_vs_n
 from tpu_reductions.bench.report import generate_report

@@ -1,0 +1,284 @@
+"""Relay-liveness watchdog (utils/watchdog.py): both round-2 live
+windows ended with the benchmark process hung forever on a dead tunnel
+relay; the watchdog turns that into a prompt, artifact-preserving exit.
+"""
+
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from tpu_reductions.utils.watchdog import (WATCHDOG_EXIT_CODE,
+                                           relay_alive,
+                                           start_relay_watchdog)
+
+
+def _listener():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    s.listen(1)
+    return s, s.getsockname()[1]
+
+
+def test_relay_alive_probes_real_sockets():
+    s, port = _listener()
+    try:
+        assert relay_alive(ports=(port,))
+        # any-port semantics: one dead port does not mean dead
+        assert relay_alive(ports=(1, port))
+    finally:
+        s.close()
+    assert not relay_alive(ports=(port,), timeout_s=0.2)
+
+
+def test_watchdog_refuses_to_arm_without_a_relay():
+    """A CPU run / DRYRUN box has no relay; arming there would make the
+    watchdog itself the outage."""
+    assert start_relay_watchdog(ports=(1,)) is None
+
+
+def test_watchdog_counts_grace_and_fires_injected_exit():
+    s, port = _listener()
+    fired = threading.Event()
+    codes = []
+
+    def fake_exit(code):
+        codes.append(code)
+        fired.set()
+
+    try:
+        stop = start_relay_watchdog(interval_s=0.05, grace=2,
+                                    ports=(port,), _exit=fake_exit)
+        assert stop is not None
+        # alive: several intervals pass without firing
+        time.sleep(0.3)
+        assert not fired.is_set()
+        s.close()                       # relay "dies"
+        assert fired.wait(timeout=5.0)  # grace*interval later it fires
+        assert codes == [WATCHDOG_EXIT_CODE]
+    finally:
+        s.close()
+        if stop is not None:
+            stop.set()
+
+
+def test_watchdog_survives_transient_blips():
+    """grace exists because a single slow probe is not a death: the
+    consecutive-failure counter must reset when the relay answers
+    again. Scripted probe sequence: blip, recover, blip, blip — never
+    `grace` consecutive failures, so the watchdog must stay silent —
+    then three straight failures fire it."""
+    fired = threading.Event()
+    script = [True,            # arming probe
+              False, True,     # blip, recover (counter resets)
+              False, False,    # two failures — still below grace=3
+              True,            # recover again
+              False, False, False]  # three straight -> fire
+    calls = []
+
+    def probe():
+        calls.append(None)
+        i = len(calls) - 1
+        return script[i] if i < len(script) else False
+
+    fired_at = []
+
+    def fake_exit(code):
+        # snapshot the probe count at fire time: the fake exit does not
+        # stop the loop (unlike the real os._exit), so len(calls) keeps
+        # growing afterwards
+        fired_at.append(len(calls))
+        fired.set()
+
+    stop = start_relay_watchdog(interval_s=0.02, grace=3,
+                                _probe=probe, _exit=fake_exit)
+    try:
+        assert stop is not None
+        assert fired.wait(timeout=5.0)
+        # fired exactly at the end of the scripted 3-run — i.e. the
+        # earlier blips never accumulated across recoveries
+        assert fired_at[0] == len(script)
+    finally:
+        stop.set()
+
+
+def test_watchdog_hard_exits_a_wedged_process():
+    """End-to-end: a subprocess whose main thread blocks forever (the
+    dead-relay hang) is terminated by the watchdog with the documented
+    exit code instead of hanging its caller."""
+    code = (
+        "import socket, threading, time, sys\n"
+        "from tpu_reductions.utils.watchdog import start_relay_watchdog\n"
+        "s = socket.socket(); s.bind(('127.0.0.1', 0)); s.listen(1)\n"
+        "port = s.getsockname()[1]\n"
+        "stop = start_relay_watchdog(interval_s=0.05, grace=2,\n"
+        "                            ports=(port,))\n"
+        "assert stop is not None\n"
+        "s.close()\n"               # relay dies; main thread wedges:
+        "time.sleep(600)\n"
+    )
+    t0 = time.monotonic()
+    r = subprocess.run([sys.executable, "-c", code], timeout=60)
+    assert r.returncode == WATCHDOG_EXIT_CODE
+    assert time.monotonic() - t0 < 30
+
+
+def test_maybe_arm_noop_off_tpu():
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+
+    # CPU test platform: must neither arm nor exit
+    assert maybe_arm_for_tpu(_exit=lambda c: (_ for _ in ()).throw(
+        AssertionError("exited off-TPU"))) is None
+
+
+def test_maybe_arm_exits_when_relay_already_dead(monkeypatch):
+    """On the TPU backend a dead arming probe means every device wait
+    ahead hangs forever — maybe_arm_for_tpu must exit with the watchdog
+    code, not decline protection."""
+    import jax
+
+    import tpu_reductions.utils.watchdog as wd
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(wd, "tunneled_environment", lambda *a: True)
+    monkeypatch.setattr(wd, "relay_alive", lambda *a, **k: False)
+    codes = []
+    slept = []
+    out = wd.maybe_arm_for_tpu(_exit=lambda c: codes.append(c),
+                               _sleep=lambda s: slept.append(s))
+    assert out is None
+    assert codes == [wd.WATCHDOG_EXIT_CODE]
+    assert len(slept) == 1  # it re-probed before giving up
+
+
+def test_maybe_arm_noop_on_untunneled_tpu_host(monkeypatch):
+    """A real pod/local TPU host has no relay BY CONSTRUCTION (no
+    relay script) — the watchdog must stay out of its way entirely,
+    never exit-3 it at startup (docs/MULTIHOST.md hosts)."""
+    import jax
+
+    import tpu_reductions.utils.watchdog as wd
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(wd, "tunneled_environment", lambda *a: False)
+    out = wd.maybe_arm_for_tpu(
+        _exit=lambda c: (_ for _ in ()).throw(
+            AssertionError("killed an untunneled TPU host")))
+    assert out is None
+
+
+def test_relay_alive_inconclusive_on_local_resource_errors(monkeypatch):
+    """EMFILE-style local failures say nothing about the tunnel: the
+    probe must report alive (firing os._exit against a live tunnel
+    with work in flight is the wedge hazard CLAUDE.md warns about)."""
+    import socket as socket_mod
+
+    import tpu_reductions.utils.watchdog as wd
+
+    def raise_emfile(*a, **k):
+        raise OSError(24, "Too many open files")
+
+    monkeypatch.setattr(wd.socket, "create_connection", raise_emfile)
+    assert wd.relay_alive(ports=(1,)) is True
+
+    def refused(*a, **k):
+        raise ConnectionRefusedError()
+
+    monkeypatch.setattr(wd.socket, "create_connection", refused)
+    assert wd.relay_alive(ports=(1,)) is False
+
+
+def test_maybe_arm_arms_when_relay_alive(monkeypatch):
+    import jax
+
+    import tpu_reductions.utils.watchdog as wd
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(wd, "tunneled_environment", lambda *a: True)
+    s, port = _listener()
+    monkeypatch.setattr(wd, "RELAY_PORTS", (port,))
+    try:
+        stop = wd.maybe_arm_for_tpu(
+            _exit=lambda c: (_ for _ in ()).throw(
+                AssertionError("exited with relay alive")))
+        assert stop is not None
+        stop.set()
+    finally:
+        s.close()
+
+
+def test_chip_session_aborts_on_accelerator_gone(tmp_path):
+    """step() must stop the session (exit 3) after committing when a
+    step reports accelerator-unavailable — every later on-chip step
+    could only hang on the dead relay."""
+    import subprocess
+
+    # extract step() into a scratch git repo and drive all the branches
+    # (relay_ok is stubbed alive: this test exercises the rc=3 path)
+    lines = open("scripts/chip_session.sh").read()
+    body = lines[lines.index("step()"):lines.index("\n# pipefail")]
+    script = (
+        "set -uo pipefail\nrelay_ok() { return 0; }\n" + body +
+        "step 'gone' g.json -- bash -c 'echo {} > g.json; exit 3'\n"
+        "echo SHOULD_NOT_REACH\n")
+    repo = tmp_path / "r"
+    repo.mkdir()
+    subprocess.run(["git", "init", "-q", "."], cwd=repo, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "commit", "-q", "--allow-empty", "-m", "init"],
+                   cwd=repo, check=True)
+    r = subprocess.run(["bash", "-c", script], cwd=repo,
+                       capture_output=True, text=True,
+                       env={"PATH": "/usr/bin:/bin",
+                            "GIT_AUTHOR_NAME": "t",
+                            "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+    assert r.returncode == 3, r.stderr
+    assert "SHOULD_NOT_REACH" not in r.stdout
+    assert "ABORT" in r.stdout
+    log = subprocess.run(["git", "log", "--oneline"], cwd=repo,
+                         capture_output=True, text=True).stdout
+    # the artifact the dying step produced was committed before aborting
+    assert "On-chip artifacts: gone" in log
+
+
+def test_chip_session_aborts_when_relay_dies_between_steps(tmp_path):
+    """A step can exit 1 for its own reasons (bench.py's outage
+    contract) without carrying the rc=3 signal — the per-step relay_ok
+    probe must still stop the session before launching the next
+    on-chip step at a dead relay."""
+    import subprocess
+
+    lines = open("scripts/chip_session.sh").read()
+    body = lines[lines.index("step()"):lines.index("\n# pipefail")]
+    script = (
+        "set -uo pipefail\n"
+        # relay alive for the first step, dead afterwards
+        "N=0\nrelay_ok() { N=$((N+1)); [ $N -le 1 ]; }\n" + body +
+        "step 'first' a.json -- bash -c 'echo {} > a.json; exit 1'\n"
+        "step 'second' b.json -- bash -c 'echo {} > b.json'\n"
+        "echo SHOULD_NOT_REACH\n")
+    repo = tmp_path / "r"
+    repo.mkdir()
+    subprocess.run(["git", "init", "-q", "."], cwd=repo, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "commit", "-q", "--allow-empty", "-m", "init"],
+                   cwd=repo, check=True)
+    r = subprocess.run(["bash", "-c", script], cwd=repo,
+                       capture_output=True, text=True,
+                       env={"PATH": "/usr/bin:/bin",
+                            "GIT_AUTHOR_NAME": "t",
+                            "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+    assert r.returncode == 3, r.stderr
+    assert "SHOULD_NOT_REACH" not in r.stdout
+    assert "relay died before step 'second'" in r.stdout
+    log = subprocess.run(["git", "log", "--oneline"], cwd=repo,
+                         capture_output=True, text=True).stdout
+    # step 1's artifact (exit-1 partial data) was still committed;
+    # step 2 never ran
+    assert "On-chip artifacts: first (step FAILED" in log
+    assert "second" not in log

@@ -156,6 +156,8 @@ def main(argv=None) -> int:
                         iterations=ns.iterations, warmup=ns.warmup,
                         stat=ns.stat, timing=ns.timing,
                         chain_reps=ns.chain_reps, log_file=None)
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()  # a race hung on a dead relay loses its ranking
     logger = BenchLogger(None, None, console=sys.stderr)
     pairs = autotune(base, grid=GRIDS[ns.grid], logger=logger,
                      comparator=ns.comparator)

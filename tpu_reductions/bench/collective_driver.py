@@ -427,6 +427,10 @@ def main(argv=None) -> int:
     logger = BenchLogger(None, None,
                          console=open(os.devnull, "w")
                          if (cfg.qatest or not reporting) else None)
+    # a collective hung on a mid-run relay death reports nothing; exit
+    # promptly instead (utils/watchdog.py; no-op off-TPU)
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()
     try:
         results = run_collective_benchmark(cfg, logger=logger)
     except Exception as e:  # fail-fast with the QA protocol intact

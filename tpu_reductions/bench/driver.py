@@ -477,6 +477,10 @@ def main(argv=None) -> int:
     name = "tpu_reductions"
     qa_start(name, list(argv) if argv else sys.argv[1:])
     cfg, shmoo = parse_single_chip(argv)
+    # a run that hangs on a mid-benchmark relay death reports nothing;
+    # exit promptly instead (utils/watchdog.py; no-op off-TPU)
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()
     logger = _make_logger(cfg)
 
     if shmoo:

@@ -229,6 +229,8 @@ def main(argv=None) -> int:
     ns = p.parse_args(argv)
     from tpu_reductions.config import _apply_platform
     _apply_platform(ns)
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()  # no-op off-TPU; exits 3 on a dead relay
     if ns.ladder:
         rungs = [calibrate(n=ns.n, dtype=ns.dtype, iters=ns.iters,
                            reps=ns.reps, chain_span=ns.chain_span),

@@ -1,0 +1,150 @@
+"""Relay-liveness watchdog for long on-chip batches.
+
+Both round-2 live windows ended the same way: the axon tunnel's relay
+process died mid-batch and the benchmark process blocked forever inside
+a device wait, holding its unpersisted results (see
+examples/tpu_run/RECOVERY.md — window 2's curve survived only because
+the session log could be re-parsed). A dead relay is unrecoverable from
+inside the session (CLAUDE.md), so a process stuck on one can never
+make progress; the only useful move is to exit promptly so the step
+harness regains control and the per-curve persisted artifacts
+(scripts/run_tpu_experiment.sh) are all that's at stake.
+
+The watchdog is a daemon thread probing the relay's TCP ports every
+`interval_s`; after `grace` consecutive dead probes it writes a
+diagnostic to stderr and hard-exits the process (os._exit — the main
+thread is wedged in a foreign blocking call and cannot run Python
+cleanup). The reference has no analog — its fail-fast layer is the
+per-call CUDA error check (cutil_inline_runtime.h:34-44); this is the
+same fail-fast idea applied to the transport this platform actually
+fails through.
+
+Exit-safety: CLAUDE.md warns never to tear down a process with a large
+unfinished device queue because the remote lease can wedge the chip.
+That hazard assumes a LIVE tunnel; the watchdog only ever fires when
+the relay is gone, at which point nothing this process does can reach
+the chip and the lease is orphaned either way.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+from typing import Optional, Sequence
+
+RELAY_PORTS = (8082, 8083)
+WATCHDOG_EXIT_CODE = 3
+# presence of the relay script marks the tunneled environment — the
+# only kind of TPU host where "no relay" means "no device"; a real
+# (pod/local) TPU host has no relay and must never be watchdogged
+RELAY_MARKER = "/root/.relay.py"
+
+
+def tunneled_environment(marker: str = RELAY_MARKER) -> bool:
+    """True on the tunneled dev box (relay script present)."""
+    return os.path.exists(marker)
+
+
+def relay_alive(ports: Optional[Sequence[int]] = None,
+                host: str = "127.0.0.1",
+                timeout_s: float = 2.0) -> bool:
+    """True if ANY relay port accepts a TCP connection. `ports=None`
+    resolves the module's RELAY_PORTS at CALL time (so tests and
+    deployments can repoint it).
+
+    Error classification is deliberately asymmetric: a refused
+    connection or a timeout is evidence the RELAY is gone; any other
+    OSError (EMFILE, ephemeral-port exhaustion, ...) is evidence THIS
+    PROCESS is degraded, which says nothing about the tunnel — report
+    alive, because a false 'dead' verdict fires os._exit against a
+    live tunnel with work in flight (the one teardown CLAUDE.md says
+    can wedge the remote chip)."""
+    inconclusive = False
+    for port in (RELAY_PORTS if ports is None else ports):
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=timeout_s):
+                return True
+        except (ConnectionRefusedError, ConnectionResetError,
+                socket.timeout, TimeoutError):
+            continue
+        except OSError:
+            inconclusive = True
+    return inconclusive
+
+
+def start_relay_watchdog(interval_s: float = 60.0, grace: int = 3,
+                         ports: Optional[Sequence[int]] = None,
+                         host: str = "127.0.0.1",
+                         _exit=os._exit,
+                         _probe=None) -> Optional[threading.Event]:
+    """Arm the watchdog; returns a stop Event, or None when not armed.
+
+    Arms only when the relay is reachable RIGHT NOW: a CPU run, a
+    DRYRUN rehearsal, or a box with no tunnel at all has no relay, and
+    killing those after `grace` probes would turn the watchdog into the
+    outage. `_exit` and `_probe` are injectable for tests."""
+    probe = _probe or (lambda: relay_alive(ports, host))
+    if not probe():
+        return None
+    stop = threading.Event()
+
+    def watch():
+        dead = 0
+        while not stop.wait(interval_s):
+            if probe():
+                dead = 0
+                continue
+            dead += 1
+            print(f"relay watchdog: ports "
+                  f"{tuple(RELAY_PORTS if ports is None else ports)} dead "
+                  f"({dead}/{grace})", file=sys.stderr, flush=True)
+            if dead >= grace:
+                print("relay watchdog: relay is gone (unrecoverable "
+                      "in-session, CLAUDE.md); exiting so the step "
+                      "harness keeps the artifacts persisted so far",
+                      file=sys.stderr, flush=True)
+                _exit(WATCHDOG_EXIT_CODE)
+
+    threading.Thread(target=watch, name="relay-watchdog",
+                     daemon=True).start()
+    return stop
+
+
+def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
+                      _exit=os._exit,
+                      _sleep=None) -> Optional[threading.Event]:
+    """Arm the watchdog iff the current JAX backend is TPU AND the
+    environment is the tunneled dev box (relay script present —
+    tunneled_environment). A real pod/local TPU host has no relay by
+    construction and must run unwatched; CPU runs and DRYRUN
+    rehearsals are no-ops via the backend check. Call AFTER backend
+    resolution (and after any jax.distributed bring-up).
+
+    In the tunneled environment a failed arming probe is not a reason
+    to decline protection — it means the relay is ALREADY dead and any
+    device work ahead will hang forever, which is precisely the outcome
+    this module prevents: confirm with a second probe, then exit with
+    the watchdog code instead of proceeding unwatched."""
+    import time
+
+    import jax
+
+    if jax.default_backend() != "tpu" or not tunneled_environment():
+        return None
+    stop = start_relay_watchdog(interval_s=interval_s, grace=grace,
+                                _exit=_exit)
+    if stop is not None:
+        return stop
+    (_sleep or time.sleep)(2.0)
+    stop = start_relay_watchdog(interval_s=interval_s, grace=grace,
+                                _exit=_exit)
+    if stop is not None:
+        return stop
+    print("relay watchdog: tunneled TPU but the relay is already dead "
+          "(two probes); refusing to start device work that can only "
+          "hang", file=sys.stderr, flush=True)
+    _exit(WATCHDOG_EXIT_CODE)
+    return None  # unreachable except under an injected _exit (tests)
