@@ -336,12 +336,21 @@ def _make_stage_fn(method: str, tm: int, threads: int, max_blocks: int):
     ride-along scale int (untimed staging metadata, like the padding
     geometry)."""
 
+    def put(plane2d):
+        # already identity-padded on host; bound per-message transfer
+        # size for multi-GiB planes (utils/staging.py relay hazard)
+        from tpu_reductions.utils.staging import maybe_chunked_stage
+        staged = maybe_chunked_stage(plane2d.ravel(), plane2d.shape[0],
+                                     plane2d.shape[1],
+                                     plane2d.dtype.type(0))
+        return jnp.asarray(plane2d) if staged is None else staged
+
     def stage_fn(x_np):
         hi2d, lo2d, (tm2, _, _), s = stage_split_padded(
             np.asarray(x_np, dtype=np.float64), method, threads,
             max_blocks)
         assert tm2 == tm
-        return jnp.asarray(hi2d), jnp.asarray(lo2d), s
+        return put(hi2d), put(lo2d), s
 
     return stage_fn
 

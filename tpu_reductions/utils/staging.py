@@ -1,0 +1,106 @@
+"""Chunked host->device staging for multi-GiB payloads.
+
+Both round-2 live windows died inside a single bulk host->device
+transfer of a 4 GiB payload (the int32 n=2^30 shmoo cell —
+examples/tpu_run/RECOVERY.md, ROUND2.md chip-time log): the tunnel
+relay exited mid-message and the process hung. Cells at 2 GiB and
+below streamed through the same relay without incident, so bounding
+the per-message size is the available mitigation (the watchdog,
+utils/watchdog.py, bounds the damage when it happens anyway).
+
+`device_put_chunked` re-creates the one-shot staging step of the
+reference (the H2D cudaMemcpy before the timed loop,
+reduction.cpp:721-726) as a sequence of bounded transfers into an
+identity-initialized device buffer:
+
+  buf = full((rows, lanes), identity)        # device alloc, no host copy
+  for each <= chunk_bytes row-block of the flat payload:
+      buf = jit(dynamic_update_slice, donate buf)(buf, block, row_index)
+  (+ one identity-padded last row for the ragged tail)
+
+Because the buffer starts at the op's monoid identity, the padding the
+kernels need (ops/pallas_reduce.stage_padded) comes free — no host-side
+pad copy of a multi-GiB array, and the device never holds payload + a
+second padded allocation (donation updates in place). Staging is
+untimed on every path (the reference also stages outside its timers),
+so the chunk loop costs wall-clock only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Per-message bound. 2 GiB messages survived the tunnel, 4 GiB killed
+# it twice; 256 MiB keeps a wide margin while adding only ~16 messages
+# per surviving GiB.
+STAGE_CHUNK_BYTES = 256 << 20
+
+# Payloads at or under this stage in ONE message (the plain jnp.asarray
+# path — no reason to multiply round-trips for the common case).
+CHUNK_THRESHOLD_BYTES = 512 << 20
+
+
+@functools.lru_cache(maxsize=2)
+def _insert_fn(donate: bool):
+    """Module-cached jitted row-block insert (one per donate setting):
+    a per-call lambda would defeat the jit cache and pay an XLA compile
+    — a tunnel round-trip — on every staging call."""
+    def insert(buf, chunk, row):
+        return jax.lax.dynamic_update_slice(buf, chunk,
+                                            (row, jnp.int32(0)))
+
+    return jax.jit(insert, donate_argnums=(0,) if donate else ())
+
+
+def device_put_chunked(flat: np.ndarray, rows: int, lanes: int,
+                       identity, *,
+                       chunk_bytes: int = STAGE_CHUNK_BYTES) -> jax.Array:
+    """Stage a flat host payload as an identity-padded (rows, lanes)
+    device array, transferring at most ~`chunk_bytes` per message.
+
+    flat.size <= rows*lanes; the tail [flat.size, rows*lanes) holds
+    `identity` (the op's monoid identity — the padding contract of
+    stage_padded). Offsets are ROW indices into the 2-D buffer, so they
+    stay far below the int32 ceiling for any physically possible
+    payload (a flat element offset would overflow jnp.int32 past 2^31
+    elements — and x64 can never be enabled on this platform)."""
+    flat = np.ravel(flat)
+    if flat.size > rows * lanes:
+        raise ValueError(f"payload {flat.size} > staged shape "
+                         f"{rows}x{lanes}")
+    buf = jnp.full((rows, lanes), identity, dtype=flat.dtype)
+
+    # donate the buffer so each insert updates in place — the device
+    # never holds two copies of a multi-GiB payload. The CPU backend
+    # ignores donation (with a warning), so only ask for it on TPU.
+    insert = _insert_fn(jax.default_backend() == "tpu")
+
+    full_rows = flat.size // lanes
+    row_step = max(1, chunk_bytes // (lanes * flat.dtype.itemsize))
+    for r in range(0, full_rows, row_step):
+        k = min(row_step, full_rows - r)
+        chunk = np.ascontiguousarray(
+            flat[r * lanes:(r + k) * lanes]).reshape(k, lanes)
+        buf = insert(buf, jax.device_put(chunk), jnp.int32(r))
+    tail = flat[full_rows * lanes:]
+    if tail.size:
+        last = np.full((1, lanes), identity, dtype=flat.dtype)
+        last[0, :tail.size] = tail
+        buf = insert(buf, jax.device_put(last), jnp.int32(full_rows))
+    return buf
+
+
+def maybe_chunked_stage(flat: np.ndarray, rows: int, lanes: int,
+                        identity, *,
+                        threshold_bytes: int = CHUNK_THRESHOLD_BYTES,
+                        chunk_bytes: int = STAGE_CHUNK_BYTES):
+    """Chunked staging for big host payloads, None for small ones (the
+    caller keeps its plain single-message path)."""
+    if not isinstance(flat, np.ndarray) or flat.nbytes <= threshold_bytes:
+        return None
+    return device_put_chunked(flat, rows, lanes, identity,
+                              chunk_bytes=chunk_bytes)
