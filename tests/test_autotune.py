@@ -100,3 +100,58 @@ def test_autotune_cli_comparator_races_xla(capsys, tmp_path):
     # first (on CPU the XLA row routinely wins the race)
     assert data["best"]["backend"] == "pallas"
     assert data["best"]["status"] == "PASSED"
+
+
+def test_chained_race_persists_per_candidate(tmp_path):
+    """In chained mode candidates run one at a time and on_result fires
+    after EACH (mid-race persistence: a race that dies at candidate k
+    keeps candidates 1..k-1). The --out file is written incrementally
+    with complete=false, then finalized with complete=true and best."""
+    import json
+
+    from tpu_reductions.bench import autotune as at
+    from tpu_reductions.config import KERNEL_SINGLE_PASS, ReduceConfig
+
+    grid = ((KERNEL_SINGLE_PASS, 16, 8), (KERNEL_SINGLE_PASS, 32, 8))
+    base = ReduceConfig(method="SUM", dtype="int32", n=4096,
+                        iterations=4, timing="chained", chain_reps=2,
+                        log_file=None)
+    seen = []
+    snapshots = []
+    out = tmp_path / "race.json"
+
+    meta = {"method": "SUM", "dtype": "int32", "n": 4096}
+
+    def spy(cfg, res):
+        seen.append((cfg.kernel, cfg.threads, res.status.name))
+        # mimic main()'s persist, snapshotting the file state after
+        # each candidate the way a mid-race death would find it
+        at._write_out(str(out), meta,
+                      [at._row(cfg, res)], best=None, complete=False)
+        snapshots.append(json.loads(out.read_text()))
+
+    pairs = at.autotune(base, grid=grid, on_result=spy)
+    assert len(seen) == 2 == len(pairs)
+    assert [s[:2] for s in seen] == [(KERNEL_SINGLE_PASS, 16),
+                                     (KERNEL_SINGLE_PASS, 32)]
+    # every mid-race snapshot was valid, complete=false JSON
+    assert all(s["complete"] is False for s in snapshots)
+
+
+def test_cli_out_file_marks_completion(tmp_path):
+    """End-to-end through main(): the final --out file carries
+    complete=true and a pallas best; the schema includes the
+    incremental-persistence fields."""
+    import json
+
+    from tpu_reductions.bench import autotune as at
+
+    out = tmp_path / "t.json"
+    rc = at.main(["--method=SUM", "--type=int", "--n=4096",
+                  "--iterations=4", "--timing=chained", "--chainreps=2",
+                  "--grid=fine", "--platform=cpu", f"--out={out}"])
+    data = json.loads(out.read_text())
+    assert data["complete"] is True
+    if rc == 0:
+        assert data["best"]["backend"] == "pallas"
+    assert len(data["ranked"]) == len(at.FINE_GRID)

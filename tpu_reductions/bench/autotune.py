@@ -98,18 +98,67 @@ def autotune(base: ReduceConfig,
              grid: Sequence[Tuple[int, int, int]] = DEFAULT_GRID,
              logger: Optional[BenchLogger] = None,
              comparator: bool = False,
+             on_result=None,
              ) -> List[Tuple[ReduceConfig, BenchResult]]:
     """Race the grid; return (config, result) pairs sorted fastest-first
     with verified (PASSED) candidates ranked strictly above the rest.
 
     Replaces getNumBlocksAndThreads' static clamping of user-picked knobs
-    (reduction.cpp:272-291) with measurement (SURVEY.md §7 step 3)."""
+    (reduction.cpp:272-291) with measurement (SURVEY.md §7 step 3).
+
+    `on_result(cfg, result)` fires as each candidate completes. In
+    chained mode candidates run (and therefore can PERSIST) one at a
+    time — chained timing is regime-immune (driver.run_benchmark_batch
+    docstring), so per-candidate runs measure identically to a batch,
+    and a race that dies at candidate k keeps candidates 1..k-1 (the
+    live-window lesson of examples/tpu_run/RECOVERY.md). Legacy timing
+    modes keep the batch path: their comparability NEEDS the shared
+    pre-fetch sync regime, so their on_result only fires at batch
+    finalize."""
     logger = logger or BenchLogger(None, None)
     cfgs = candidate_configs(base, grid, comparator=comparator)
-    results = run_benchmark_batch(cfgs, logger=logger)
+    if base.timing == "chained":
+        from tpu_reductions.bench.driver import run_benchmark
+        results = []
+        for cfg in cfgs:
+            res = run_benchmark(cfg, logger=logger)
+            if on_result is not None:
+                on_result(cfg, res)
+            results.append(res)
+    else:
+        results = run_benchmark_batch(cfgs, logger=logger,
+                                      on_result=on_result)
     pairs = list(zip(cfgs, results))
     pairs.sort(key=lambda cr: (not cr[1].passed, -cr[1].gbps))
     return pairs
+
+
+def _row(cfg: ReduceConfig, res: BenchResult) -> dict:
+    """One serialized ranking row. The XLA comparator ignores the
+    geometry knobs entirely — a serialized kernel/threads value there
+    would read as "the geometry XLA was measured at"; record null."""
+    xla = cfg.backend == "xla"
+    return {"backend": cfg.backend,
+            "kernel": None if xla else cfg.kernel,
+            "threads": None if xla else cfg.threads,
+            "max_blocks": None if xla else cfg.max_blocks,
+            "gbps": round(res.gbps, 4),
+            "status": res.status.name}
+
+
+def _write_out(path: str, meta: dict, rows: List[dict], *,
+               best, complete: bool) -> None:
+    """Atomic dump of the race state via temp+rename (the sweep cache's
+    pattern, sweep.py): the relay watchdog can os._exit at ANY instant,
+    and an in-place truncating write it interrupts would destroy the
+    previously persisted candidates — the exact loss the
+    `complete=False` mid-race snapshots exist to prevent."""
+    import os
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump({**meta, "complete": complete, "best": best,
+                   "ranked": rows}, f, indent=1)
+    os.replace(tmp, path)
 
 
 def main(argv=None) -> int:
@@ -159,21 +208,29 @@ def main(argv=None) -> int:
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
     maybe_arm_for_tpu()  # a race hung on a dead relay loses its ranking
     logger = BenchLogger(None, None, console=sys.stderr)
+
+    meta = {"method": ns.method.upper(),
+            "dtype": DTYPE_ALIASES[ns.dtype], "n": ns.n}
+    live_rows: List[dict] = []
+
+    def persist(cfg, res):
+        # ranked-so-far after EVERY candidate, flagged incomplete: a
+        # relay death mid-race keeps the measured candidates on disk
+        live_rows.append(_row(cfg, res))
+        if ns.out:
+            _write_out(ns.out, meta,
+                       sorted(live_rows,
+                              key=lambda r: (r["status"] != "PASSED",
+                                             -r["gbps"])),
+                       best=None, complete=False)
+
     pairs = autotune(base, grid=GRIDS[ns.grid], logger=logger,
-                     comparator=ns.comparator)
+                     comparator=ns.comparator, on_result=persist)
     rows = []
     for cfg, res in pairs:
-        # the XLA comparator ignores the geometry knobs entirely — a
-        # serialized kernel/threads value there would read as "the
-        # geometry XLA was measured at"; record null instead
-        xla = cfg.backend == "xla"
-        rows.append({"backend": cfg.backend,
-                     "kernel": None if xla else cfg.kernel,
-                     "threads": None if xla else cfg.threads,
-                     "max_blocks": None if xla else cfg.max_blocks,
-                     "gbps": round(res.gbps, 4),
-                     "status": res.status.name})
-        geom = ("(geometry n/a)          " if xla else
+        row = _row(cfg, res)
+        rows.append(row)
+        geom = ("(geometry n/a)          " if row["kernel"] is None else
                 f"kernel={cfg.kernel} threads={cfg.threads:>5} "
                 f"maxblocks={cfg.max_blocks:>4}")
         print(f"{cfg.backend:>6} {geom}  {res.gbps:10.2f} GB/s "
@@ -189,10 +246,7 @@ def main(argv=None) -> int:
               f"threads={best['threads']} "
               f"maxblocks={best['max_blocks']} -> {best['gbps']} GB/s")
     if ns.out:
-        with open(ns.out, "w") as f:
-            json.dump({"method": ns.method.upper(),
-                       "dtype": DTYPE_ALIASES[ns.dtype], "n": ns.n,
-                       "best": best, "ranked": rows}, f, indent=1)
+        _write_out(ns.out, meta, rows, best=best, complete=True)
         print(f"wrote {ns.out}")
     return 0 if best else 1
 
