@@ -1,8 +1,9 @@
 """Multi-host (DCN-analog) path: initialize_distributed unit tests with
-a mocked jax.distributed, real chip-granularity CO mode, and a REAL
-two-process gloo collective run — the coverage the reference never had
-for its mpirun tier (it validated multi-node by running on Blue Gene,
-SURVEY.md §4 "real cluster only")."""
+a mocked jax.distributed, real chip-granularity CO mode, and REAL two-
+and four-process gloo collective runs (the four-process one on the f64
+key-pair path) — the coverage the reference never had for its mpirun
+tier (it validated multi-node by running on Blue Gene, SURVEY.md §4
+"real cluster only")."""
 
 from __future__ import annotations
 
@@ -109,16 +110,21 @@ def test_co_mode_cpu_simulation_halves():
 
 # --------------------------- real two-process run ------------------------
 
-def _spawn(port: int, pid: int, *extra: str) -> subprocess.Popen:
+def _spawn(port: int, pid: int, *extra: str, method: str = "SUM",
+           dtype: str = "int", n: int = 65536, retries: int = 2,
+           devices: int = 4, num_processes: int = 2,
+           env_extra: dict | None = None) -> subprocess.Popen:
     return subprocess.Popen(
         [sys.executable, "-m", "tpu_reductions.bench.collective_driver",
-         "--method=SUM", "--type=int", "--n=65536", "--retries=2",
-         "--platform=cpu", "--devices=4",
-         f"--coordinator=127.0.0.1:{port}",
-         "--num-processes=2", f"--process-id={pid}", *extra],
+         f"--method={method}", f"--type={dtype}", f"--n={n}",
+         f"--retries={retries}", "--platform=cpu",
+         f"--devices={devices}", f"--coordinator=127.0.0.1:{port}",
+         f"--num-processes={num_processes}", f"--process-id={pid}",
+         *extra],
         cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True,
-        env={**os.environ, "XLA_FLAGS": ""})   # drop conftest's 8-dev flag
+        env={**os.environ, "XLA_FLAGS": "",    # drop conftest's 8-dev flag
+             **(env_extra or {})})
 
 
 def test_two_process_collective_cli():
@@ -158,6 +164,33 @@ def test_two_process_interleaved_scatter_verifies():
     assert p0.returncode == 0, (out0, err0)
     assert p1.returncode == 0, (out1, err1)
     assert "&&&& tpu_reductions.collective PASSED" in out0
+
+
+def test_four_process_f64_pair_collective():
+    """Four OS processes over gloo — the rank-count scaling axis the
+    reference swept on Blue Gene (submit_all.sh:3-4) — running the f64
+    key-pair MIN collective (TPU_REDUCTIONS_FORCE_DD=1 runs the TPU
+    wire encoding on the CPU mesh): the exact-selection pair path must
+    verify when its planes are scattered across four separate
+    processes, and only rank 0 reports."""
+    port = 20000 + ((os.getpid() + 2) % 10000)
+    force = {"TPU_REDUCTIONS_FORCE_DD": "1"}
+    procs = [_spawn(port, pid, method="MIN", dtype="double", n=16384,
+                    retries=1, devices=8, num_processes=4,
+                    env_extra=force)
+             for pid in range(4)]
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, (out, err)
+    out0 = outs[0][0]
+    assert "&&&& tpu_reductions.collective PASSED" in out0
+    rows = [ln for ln in out0.splitlines()
+            if ln.startswith("DOUBLE MIN 8 ")]
+    assert rows, out0
+    for out, _ in outs[1:]:
+        ours = [ln for ln in out.splitlines()
+                if ln.strip() and not ln.startswith("[Gloo]")]
+        assert ours == [], out
 
 
 def test_indivisible_devices_per_process_rejected():

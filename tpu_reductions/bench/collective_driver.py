@@ -86,9 +86,25 @@ def run_collective_benchmark(cfg: CollectiveConfig,
     # this function (results are host numpy), so the restore cannot
     # strand an in-flight f64 computation.
     with preserve_x64():
-        if cfg.dtype == "float64" and jax.default_backend() != "tpu":
+        if cfg.dtype == "float64" and not _use_dd_planes(cfg.dtype):
+            # off-TPU native-f64 path needs x64; the dd pair path must
+            # NOT get it — its whole point (and the FORCE_DD rehearsal
+            # hook's) is running the 32-bit TPU numerics regime, where
+            # x64 promotion semantics can never exist
             jax.config.update("jax_enable_x64", True)
         return _run_collective_benchmark(cfg, logger)
+
+
+def _use_dd_planes(dtype: str) -> bool:
+    """Whether f64 travels as 32-bit plane pairs: always on the TPU (no
+    device f64 there), and anywhere under TPU_REDUCTIONS_FORCE_DD=1 —
+    the rehearsal/test hook that runs the TPU wire encoding on the CPU
+    mesh (tests/test_mesh_distributed.py's four-process run)."""
+    import jax
+
+    return dtype == "float64" and (
+        jax.default_backend() == "tpu"
+        or os.environ.get("TPU_REDUCTIONS_FORCE_DD") == "1")
 
 
 def _run_collective_benchmark(cfg: CollectiveConfig,
@@ -113,8 +129,10 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
     method = cfg.method
     # f64 on TPU travels as 32-bit plane pairs (8 B/element on the wire,
     # same as native f64): dd f32 planes for SUM, exact order-key i32
-    # planes for MIN/MAX (see parallel.collectives docstrings).
-    dd_planes = dtype == "float64" and jax.default_backend() == "tpu"
+    # planes for MIN/MAX (see parallel.collectives docstrings); the
+    # shared predicate also gates the x64 enable above so the forced
+    # rehearsal keeps pure 32-bit TPU numerics (_use_dd_planes).
+    dd_planes = _use_dd_planes(dtype)
     x_np = _build_payload(cfg, k)
     rooted = cfg.rooted
     per_rank = cfg.n // k
