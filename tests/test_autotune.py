@@ -17,7 +17,7 @@ def test_candidate_grid_shapes():
     cfgs = candidate_configs(_base())
     assert all(c.backend == "pallas" for c in cfgs)
     kernels = {c.kernel for c in cfgs}
-    assert kernels == {6, 7, 8, 9}
+    assert kernels == {6, 7, 8, 9, 10}
     # two-pass candidates vary max_blocks; single-pass pin it to 64
     assert {c.max_blocks for c in cfgs if c.kernel == 7} == {64, 256}
     assert {c.max_blocks for c in cfgs if c.kernel != 7} == {64}
@@ -155,3 +155,34 @@ def test_cli_out_file_marks_completion(tmp_path):
     if rc == 0:
         assert data["best"]["backend"] == "pallas"
     assert len(data["ranked"]) == len(at.FINE_GRID)
+
+
+def test_chained_race_survives_a_crashing_candidate(monkeypatch):
+    """A candidate whose kernel cannot even compile (a Mosaic lowering
+    gap the interpret path does not have) must record FAILED and leave
+    the rest of the race intact - a live chip session cannot afford a
+    process-killing candidate."""
+    from tpu_reductions.bench import autotune as at
+    from tpu_reductions.bench import driver as drv
+    from tpu_reductions.config import KERNEL_SINGLE_PASS, ReduceConfig
+
+    real = drv.run_benchmark
+
+    def sabotaged(cfg, **kw):
+        if cfg.threads == 16:
+            raise RuntimeError("synthetic lowering failure")
+        return real(cfg, **kw)
+
+    monkeypatch.setattr(drv, "run_benchmark", sabotaged)
+    base = ReduceConfig(method="SUM", dtype="int32", n=4096,
+                        iterations=4, timing="chained", chain_reps=2,
+                        log_file=None)
+    grid = ((KERNEL_SINGLE_PASS, 16, 8), (KERNEL_SINGLE_PASS, 32, 8))
+    pairs = at.autotune(base, grid=grid)
+    assert len(pairs) == 2
+    by_threads = {cfg.threads: res for cfg, res in pairs}
+    assert by_threads[16].status.name == "FAILED"
+    assert "synthetic lowering failure" in by_threads[16].waived_reason
+    # the healthy candidate may noise-WAIVE on a loaded host (tiny
+    # chained payload); what matters here is the crash never spread
+    assert by_threads[32].status.name in ("PASSED", "WAIVED")

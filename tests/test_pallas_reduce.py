@@ -33,7 +33,7 @@ def _tol(method, dtype, n):
 
 @pytest.mark.parametrize("dtype", ["int32", "float32", "float64"])
 @pytest.mark.parametrize("method", ["SUM", "MIN", "MAX"])
-@pytest.mark.parametrize("kernel", [6, 7, 8])
+@pytest.mark.parametrize("kernel", [6, 7, 8, 10])
 def test_pallas_matches_oracle(method, dtype, kernel):
     n = 10_000  # non-pow2, non-multiple of the tile
     x = host_data(n, dtype, rank=0)

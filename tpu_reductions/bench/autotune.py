@@ -33,8 +33,8 @@ from typing import List, Optional, Sequence, Tuple
 from tpu_reductions.bench.driver import BenchResult, run_benchmark_batch
 from tpu_reductions.config import (DTYPE_ALIASES, KERNEL_ELEMENTWISE,
                                    KERNEL_MXU, KERNEL_SINGLE_PASS,
-                                   KERNEL_TWO_PASS, METHODS, ReduceConfig,
-                                   _apply_platform)
+                                   KERNEL_STREAM, KERNEL_TWO_PASS, METHODS,
+                                   ReduceConfig, _apply_platform)
 from tpu_reductions.utils.logging import BenchLogger
 
 # (kernel, threads, max_blocks) candidate grid. Threads sweeps the VMEM
@@ -49,6 +49,8 @@ DEFAULT_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
     # MXU matmul SUM (kernel 9): participates in float races; int/MIN/
     # MAX configs WAIVE it (driver gate), ranking below every PASSED row
     + [(KERNEL_MXU, t, 64) for t in (256, 512, 1024)]
+    # manual deep-DMA streaming accumulator (kernel 10)
+    + [(KERNEL_STREAM, t, 64) for t in (256, 512)]
 )
 
 # Finer race around the round-2 winners (tune_r02.json: kernel 6
@@ -70,6 +72,8 @@ HBM_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
     [(KERNEL_SINGLE_PASS, t, 64) for t in (512, 1024, 2048)]
     + [(KERNEL_TWO_PASS, t, mb) for t in (256, 384, 512)
        for mb in (64, 128)]
+    # the manual deep-DMA pipeline (kernel 10) exists FOR this regime
+    + [(KERNEL_STREAM, t, 64) for t in (256, 512, 1024)]
 )
 
 GRIDS = {"default": DEFAULT_GRID, "fine": FINE_GRID, "hbm": HBM_GRID}
@@ -118,10 +122,18 @@ def autotune(base: ReduceConfig,
     logger = logger or BenchLogger(None, None)
     cfgs = candidate_configs(base, grid, comparator=comparator)
     if base.timing == "chained":
-        from tpu_reductions.bench.driver import run_benchmark
+        from tpu_reductions.bench.driver import crash_result, run_benchmark
         results = []
         for cfg in cfgs:
-            res = run_benchmark(cfg, logger=logger)
+            try:
+                res = run_benchmark(cfg, logger=logger)
+            except Exception as e:
+                # one candidate that cannot even compile (e.g. a Mosaic
+                # lowering gap on the real chip for a kernel the
+                # interpret path accepts) must not kill a live race —
+                # the batch path contains crashes the same way
+                # (driver.crash_result)
+                res = crash_result(cfg, e, logger)
             if on_result is not None:
                 on_result(cfg, res)
             results.append(res)

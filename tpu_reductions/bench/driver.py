@@ -24,8 +24,8 @@ from typing import Optional
 
 import numpy as np
 
-from tpu_reductions.config import (KERNEL_MXU, LIVE_KERNELS,
-                                   ReduceConfig)
+from tpu_reductions.config import (KERNEL_MXU, KERNEL_SINGLE_PASS,
+                                   LIVE_KERNELS, ReduceConfig)
 from tpu_reductions.ops import oracle as oracle_mod
 from tpu_reductions.ops.registry import tolerance
 from tpu_reductions.utils.logging import BenchLogger, throughput_line
@@ -326,15 +326,46 @@ def run_benchmark_batch(cfgs, logger: Optional[BenchLogger] = None,
     # materialized — the reason deferred run_benchmark calls pass
     # restore=False and the batch owns the restore (utils/x64.py).
     with preserve_x64():
-        pendings = [run_benchmark(cfg, logger=logger, defer=True)
-                    for cfg in cfgs]
+        pendings = []
+        for cfg in cfgs:
+            try:
+                pendings.append(run_benchmark(cfg, logger=logger,
+                                              defer=True))
+            except Exception as e:  # crash contained to the config:
+                # one kernel that cannot compile (e.g. a Mosaic
+                # lowering gap) must not take the rest of a batch/race
+                # with it — cutil's per-call fail-fast
+                # (cutil_inline_runtime.h:34-44) scoped to the config
+                pendings.append(crash_result(cfg, e, logger))
         results = []
         for cfg, p in zip(cfgs, pendings):
-            res = p.finalize() if isinstance(p, _PendingResult) else p
+            try:
+                res = p.finalize() if isinstance(p, _PendingResult) else p
+            except Exception as e:
+                res = crash_result(cfg, e, logger)
             if on_result is not None:
                 on_result(cfg, res)
             results.append(res)
         return results
+
+
+def crash_result(cfg: ReduceConfig, exc: Exception,
+                 logger: Optional[BenchLogger] = None) -> BenchResult:
+    """A FAILED row for a config whose run RAISED (compile error,
+    lowering gap, staging failure): the error is logged and recorded in
+    the row's reason field so races and sweeps keep their remaining
+    candidates instead of dying with the process — the per-call
+    fail-fast of cutil (cutil_inline_runtime.h:34-44) scoped to one
+    config instead of exiting (__cudaSafeCallNoSync:267 exits)."""
+    if logger is not None:
+        logger.log(f"config kernel={cfg.kernel} threads={cfg.threads} "
+                   f"raised {type(exc).__name__}: {exc}")
+    return BenchResult(cfg.method, cfg.dtype, cfg.n, cfg.backend,
+                       cfg.kernel, 0.0, 0.0, 0, QAStatus.FAILED,
+                       float("nan"), float("nan"), float("nan"),
+                       waived_reason=(f"{type(exc).__name__}: "
+                                      f"{exc}")[:200],
+                       timing=cfg.timing)
 
 
 def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
@@ -368,6 +399,24 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
                            float("nan"), float("nan"), float("nan"),
                            waived_reason="kernel 9 (MXU) is SUM over "
                                          "float dtypes only",
+                           timing=cfg.timing)
+
+    if (cfg.dtype == "float64" and cfg.backend != "xla"
+            and cfg.kernel != KERNEL_SINGLE_PASS
+            and jax.default_backend() == "tpu"):
+        # f64 on the real chip always runs the dd pair path, whose
+        # sequential pair-accumulator structure is the kernel-6 analog
+        # and which ignores --kernel entirely: a row labeled kernel
+        # 7/8/9/10 there would be a mislabeled dd measurement — WAIVE
+        # (same reasoning as the MXU gate above), never mislabel.
+        return BenchResult(cfg.method, cfg.dtype, cfg.n, cfg.backend,
+                           cfg.kernel, 0.0, 0.0, 0, QAStatus.WAIVED,
+                           float("nan"), float("nan"), float("nan"),
+                           waived_reason="f64 on TPU runs the dd pair "
+                                         "path (kernel-6 structure); "
+                                         f"a kernel-{cfg.kernel} label "
+                                         "would be a mislabeled dd "
+                                         "measurement",
                            timing=cfg.timing)
 
     backend = _resolve_backend(cfg)

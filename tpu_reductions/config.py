@@ -15,8 +15,9 @@ Flag-name parity with the reference CLI (reduction.cpp:31-40):
                               analog, default 256 (reduction.cpp:666)
   --kernel=<int>              kernel id; 6 (single-pass accumulator),
                               7 (two-pass partials), 8 (elementwise
-                              accumulator) and 9 (MXU matmul SUM, float
-                              dtypes) are live; 0-5 are WAIVED,
+                              accumulator), 9 (MXU matmul SUM, float
+                              dtypes) and 10 (streaming deep-DMA
+                              accumulator) are live; 0-5 are WAIVED,
                               mirroring the intentionally-emptied dispatch
                               cases (reduction_kernel.cu:278-289)
   --maxblocks=<int>           grid clamp, default 64 (reduction.cpp:668)
@@ -59,14 +60,17 @@ BACKENDS = ("auto", "pallas", "xla")
 
 # Kernel ids: the reference kept only kernel 6 live and emptied 0-5
 # (reduction_kernel.cu:278-289). We map 6 -> single-pass fold-accumulator
-# Pallas kernel, 7 -> two-pass partials Pallas kernel, 8 -> single-pass
-# elementwise accumulator (extension), and WAIVE 0-5.
-LIVE_KERNELS = (6, 7, 8, 9)
+# Pallas kernel, 7 -> two-pass partials Pallas kernel, 8-10 ->
+# extensions (elementwise / MXU / streaming accumulators), and WAIVE 0-5.
+LIVE_KERNELS = (6, 7, 8, 9, 10)
 KERNEL_SINGLE_PASS = 6
 KERNEL_TWO_PASS = 7
 KERNEL_ELEMENTWISE = 8
 KERNEL_MXU = 9          # SUM over float dtypes: ones-row matmul on the
                         # MXU (arXiv:1811.09736 / 2001.05585 technique)
+KERNEL_STREAM = 10      # manual deep DMA pipeline (default depth 4 vs
+                        # Mosaic's automatic double-buffering) — the
+                        # HBM-regime candidate (docs/PERF_NOTES.md)
 
 
 @dataclasses.dataclass
@@ -228,7 +232,8 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
                    help="6=single-pass fold accumulator, 7=two-pass "
                         "partials, 8=single-pass elementwise accumulator, "
                         "9=MXU matmul SUM (float dtypes; other combos "
-                        "WAIVE); 0-5 WAIVED (reference emptied them)")
+                        "WAIVE), 10=streaming deep-DMA accumulator; "
+                        "0-5 WAIVED (reference emptied them)")
     p.add_argument("--maxblocks", dest="max_blocks", type=int, default=64,
                    help="Grid clamp (maxblocks analog)")
     p.add_argument("--cpufinal", dest="cpu_final", action="store_true",

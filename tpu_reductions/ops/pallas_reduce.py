@@ -40,6 +40,13 @@ Kernel ids (config.KERNEL_*):
      elementwise, no sublane relayout); larger final finish. An
      extension beyond the reference's numbering, kept to let the
      benchmark race the two accumulation structures.
+  9  MXU matmul SUM (float dtypes): ones-row matmul turns the tile fold
+     into a systolic-array op (arXiv:1811.09736 / 2001.05585 technique,
+     rebuilt TPU-native); MIN/MAX and int combos WAIVE.
+  10 streaming accumulator: input stays in HBM; the kernel runs its own
+     STREAM_BUFFERS-deep async-DMA pipeline (vs Mosaic's automatic
+     depth-2 BlockSpec pipeline) and folds chunks elementwise — the
+     HBM-regime candidate (docs/PERF_NOTES.md hypotheses).
 
 float64: XLA-on-TPU emulates f64 but Mosaic/Pallas does not support it;
 pallas_reduce transparently uses a double-double (two-float32) kernel for
@@ -251,6 +258,92 @@ def mxu_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
                              acc_rows=MXU_ACC_ROWS, interpret=interpret)
 
 
+STREAM_BUFFERS = 4   # kernel-10 DMA pipeline depth (Mosaic's automatic
+                     # BlockSpec pipeline is depth 2; deeper lookahead
+                     # is the one streaming knob it does not expose)
+
+
+def _stream_kernel(op: ReduceOpSpec, tm: int, n_buffers: int,
+                   num_chunks: int):
+    """Kernel 10: hand-rolled DMA pipeline. The input stays in HBM
+    (memory_space=ANY); the kernel runs its own `n_buffers`-deep
+    async-copy pipeline — start the DMA for chunk i+depth-1, wait on
+    chunk i, fold it elementwise into a resident (TM, 128) accumulator.
+
+    Same grid-stride-accumulate semantics as kernels 6/8
+    (reduction_kernel.cu:88-98), but the HBM->VMEM traffic is scheduled
+    explicitly instead of by Mosaic's automatic double-buffered
+    BlockSpec pipeline: at HBM-bound sizes the only thing that matters
+    is keeping the DMA engine saturated, and a deeper pipeline rides
+    out per-chunk scheduling jitter the depth-2 auto-pipeline cannot
+    (the docs/PERF_NOTES.md hypothesis that k6 gives up 5-8% to XLA in
+    the HBM regime for exactly this reason)."""
+
+    def kernel(x_hbm_ref, acc_ref):
+        def body(scratch, sems):
+            def dma(slot, idx):
+                return pltpu.make_async_copy(
+                    x_hbm_ref.at[pl.ds(idx * tm, tm)],
+                    scratch.at[slot], sems.at[slot])
+
+            # warm-up: fill the lookahead window (static bounds —
+            # unrolled at trace time)
+            for s in range(min(n_buffers - 1, num_chunks)):
+                dma(s, s).start()
+
+            acc_ref[:] = jnp.full_like(
+                acc_ref, op.identity(acc_ref.dtype))
+
+            def loop_body(i, _):
+                slot = i % n_buffers
+
+                @pl.when(i + n_buffers - 1 < num_chunks)
+                def _():
+                    dma((i + n_buffers - 1) % n_buffers,
+                        i + n_buffers - 1).start()
+
+                dma(slot, i).wait()
+                acc_ref[:] = op.jnp_combine(
+                    acc_ref[:], scratch[slot].astype(acc_ref.dtype))
+                return 0
+
+            jax.lax.fori_loop(0, num_chunks, loop_body, 0)
+
+        pl.run_scoped(
+            body,
+            scratch=pltpu.VMEM((n_buffers, tm, LANES),
+                               x_hbm_ref.dtype),
+            sems=pltpu.SemaphoreType.DMA((n_buffers,)))
+
+    return kernel
+
+
+def stream_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
+                interpret: Optional[bool] = None,
+                n_buffers: int = STREAM_BUFFERS) -> jax.Array:
+    """Kernel 10 entry: the grid-stride accumulate
+    (reduction_kernel.cu:88-98) with an explicit deep DMA pipeline
+    (_stream_kernel). Returns the (TM, 128) accumulator (the standard
+    `finish` folds it, exactly as for kernel 8)."""
+    rows = x2d.shape[0]
+    if rows % tm:
+        # staged inputs (stage_padded: p*t*tm rows) are always aligned;
+        # anything else would silently drop the ragged tail from the
+        # chunk count below — refuse instead of reducing wrongly
+        raise ValueError(f"stream_call needs rows % tm == 0, got "
+                         f"{rows} rows with tm={tm}")
+    interpret = _interpret_default() if interpret is None else interpret
+    num_chunks = rows // tm
+    return pl.pallas_call(
+        _stream_kernel(op, tm, n_buffers, num_chunks),
+        out_shape=jax.ShapeDtypeStruct((tm, LANES),
+                                       _acc_dtype(x2d.dtype, op)),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x2d)
+
+
 def _two_pass_kernel(op: ReduceOpSpec, tm: int):
     """Kernel 7: grid (P, T); block i accumulates T tiles into partial
     sublane block i — the numBlocks-partials structure (reduction.cpp:323
@@ -369,6 +462,16 @@ def f64_strategy() -> str:
     return "native" if jax.default_backend() != "tpu" else "dd"
 
 
+# one pallas_call per reduce, dispatched by kernel id; kernel 7 (the
+# multi-pass partials chain) is the only structure outside this map.
+# Membership here IS the "is it a single-invocation kernel" question —
+# one registry for both entry points (pallas_reduce/_make_staged_parts)
+SINGLE_INVOCATION_CALLS = {6: single_pass_call,
+                           8: elementwise_call,
+                           9: mxu_call,
+                           10: stream_call}
+
+
 def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
                   max_blocks: int = 64, kernel: int = 6,
                   cpu_final: bool = False, cpu_thresh: int = 1,
@@ -397,10 +500,9 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
     tm, p, t = choose_tiling(x.size, threads, max_blocks, x.dtype)
     x2d = stage_padded(x, tm, p, t, op)
 
-    if kernel in (6, 8, 9):
-        call = {6: single_pass_call, 8: elementwise_call,
-                9: mxu_call}[kernel]
-        acc = call(x2d, op, tm, interpret=interpret)
+    if kernel in SINGLE_INVOCATION_CALLS:
+        acc = SINGLE_INVOCATION_CALLS[kernel](x2d, op, tm,
+                                              interpret=interpret)
         if cpu_final:
             return host_finish(acc, op)
         return finish(acc, op)
@@ -413,7 +515,7 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
             return host_finish(partials, op)
         return finish(partials, op)
 
-    raise ValueError(f"kernel {kernel} is not live; only 6, 7, 8 and 9 "
+    raise ValueError(f"kernel {kernel} is not live; only 6-10 "
                      "(0-5 are WAIVED, mirroring reduction_kernel.cu:278-289)")
 
 
@@ -431,9 +533,8 @@ def _make_staged_parts(method: str, n: int, dtype, *, threads: int = 256,
     def stage_fn(x):
         return stage_padded(x, tm, p, t, op)
 
-    if kernel in (6, 8, 9):
-        call = {6: single_pass_call, 8: elementwise_call,
-                9: mxu_call}[kernel]
+    if kernel in SINGLE_INVOCATION_CALLS:
+        call = SINGLE_INVOCATION_CALLS[kernel]
 
         def device_fn(x2d):
             return call(x2d, op, tm, interpret=interpret)
