@@ -184,6 +184,7 @@ roof_lines = summarize(ann)
 (out / "roofline.json").write_text(json.dumps(ann, indent=1))
 paths = generate_report({}, single_chip=sc, figures=figures,
                         out_dir=out, platform=jax.default_backend(),
-                        calibration=cal, roofline=roof_lines)
+                        calibration=cal, roofline=roof_lines,
+                        annotated_rows=ann)
 print("report:", paths["md"], paths["tex"])
 PY

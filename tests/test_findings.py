@@ -1,0 +1,126 @@
+"""Mechanical findings (bench/findings.py): the writeup.tex:19
+narrative derived from measured rows instead of written by hand."""
+
+from tpu_reductions.bench.findings import (collective_crossover,
+                                           derive_findings,
+                                           half_power_points,
+                                           reference_multiples,
+                                           vmem_cliff)
+
+
+def _row(dtype, method, n, gbps, regime=None):
+    r = {"dtype": dtype, "method": method, "n": n, "gbps": gbps}
+    if regime:
+        r["regime"] = regime
+    return r
+
+
+def test_half_power_point_found():
+    rows = [_row("int32", "SUM", 1 << p, g)
+            for p, g in [(10, 2.0), (14, 80.0), (18, 400.0),
+                         (22, 700.0), (26, 730.0)]]
+    lines = half_power_points(rows)
+    assert len(lines) == 1
+    # no regime tags: asymptote = largest-N rate (730); half = 365;
+    # first n reaching it is 2^18 (400 GB/s)
+    assert "N_1/2 = 2^18" in lines[0]
+
+
+def test_half_power_uses_hbm_asymptote_not_vmem_peak():
+    """On a curve spanning the VMEM->HBM cliff the reference rate must
+    be the HBM plateau, NOT the VMEM peak — half-of-peak would call
+    bandwidth-bound HBM rows 'dispatch-bound'."""
+    rows = [_row("int32", "SUM", 1 << 10, 2.0, "vmem_resident"),
+            _row("int32", "SUM", 1 << 18, 190.0, "vmem_resident"),
+            _row("int32", "SUM", 1 << 19, 500.0, "vmem_resident"),
+            _row("int32", "SUM", 1 << 23, 7754.0, "vmem_resident"),
+            _row("int32", "SUM", 1 << 25, 680.0, "hbm_bound"),
+            _row("int32", "SUM", 1 << 26, 715.0, "hbm_bound"),
+            _row("int32", "SUM", 1 << 28, 736.0, "hbm_bound")]
+    lines = half_power_points(rows)
+    # asymptote = median(680, 715, 736) = 715; half = 357.5 -> 2^19
+    assert "N_1/2 = 2^19" in lines[0]
+    assert "715 GB/s large-N rate" in lines[0]
+
+
+def test_half_power_skips_short_or_degenerate_curves():
+    assert half_power_points([_row("a", "SUM", 1, 1.0)]) == []
+    rows = [_row("a", "SUM", 1 << p, 0.0) for p in (10, 12, 14)]
+    assert half_power_points(rows) == []
+
+
+def test_vmem_cliff_detected():
+    rows = [_row("int32", "SUM", 1 << 23, 7754.8, "vmem_resident"),
+            _row("int32", "SUM", 1 << 24, 5839.3, "vmem_resident"),
+            _row("int32", "SUM", 1 << 25, 680.6, "hbm_bound"),
+            _row("int32", "SUM", 1 << 26, 715.8, "hbm_bound")]
+    lines = vmem_cliff(rows)
+    assert len(lines) == 1
+    assert "between 2^24 and 2^25" in lines[0]
+    assert "8.6x drop" in lines[0]
+
+
+def test_vmem_cliff_absent_without_both_regimes():
+    rows = [_row("int32", "SUM", 1 << 25, 700.0, "hbm_bound")]
+    assert vmem_cliff(rows) == []
+
+
+def test_reference_multiples_and_below_flag():
+    sc = {("INT", "SUM"): 6497.2, ("DOUBLE", "SUM"): 0.87}
+    ref = {("INT", "SUM"): 90.8413, ("DOUBLE", "SUM"): 92.7729}
+    lines = reference_multiples(sc, ref)
+    assert any("72x" in ln and "INT SUM" in ln for ln in lines)
+    assert any("BELOW the reference on: DOUBLE SUM" in ln
+               for ln in lines)
+    # nothing below -> no BELOW line
+    lines2 = reference_multiples({("INT", "SUM"): 6497.2},
+                                 {("INT", "SUM"): 90.8413})
+    assert len(lines2) == 1
+
+
+def test_collective_crossover_both_ways():
+    sc = {("INT", "SUM"): 100.0}
+    coll = {("INT", "SUM", 64): 9.1, ("INT", "SUM", 256): 38.6,
+            ("INT", "SUM", 1024): 146.8}
+    lines = collective_crossover(coll, sc)
+    assert len(lines) == 1 and "overtakes one chip at 1024 ranks" in lines[0]
+    lines2 = collective_crossover({("INT", "SUM", 64): 9.1}, sc)
+    assert "no crossover up to 64 ranks" in lines2[0]
+
+
+def test_derive_findings_composes_available_data():
+    ann = [_row("int32", "SUM", 1 << p, g, reg)
+           for p, g, reg in [(10, 2.0, "vmem_resident"),
+                             (22, 700.0, "vmem_resident"),
+                             (26, 650.0, "hbm_bound")]]
+    lines = derive_findings(rows=ann,
+                            single_chip={("INT", "SUM"): 6497.2},
+                            coll_avgs={("INT", "SUM", 8): 3.0},
+                            reference={("INT", "SUM"): 90.8413})
+    text = "\n".join(lines)
+    assert "N_1/2" in text and "cliff" in text
+    assert "72x" in text and "no crossover" in text
+
+
+def test_report_includes_findings_section(tmp_path):
+    from tpu_reductions.bench.report import generate_report
+
+    paths = generate_report({}, single_chip={("INT", "SUM"): 100.0},
+                            out_dir=tmp_path,
+                            findings=["int32 SUM: N_1/2 = 2^18 ..."])
+    md = paths["md"].read_text()
+    assert "## Findings" in md and "- int32 SUM: N_1/2" in md
+    tex = paths["tex"].read_text()
+    assert "\\section{Findings}" in tex
+    # the ^ in power-of-two notation must be escaped or the promised
+    # compilable LaTeX breaks ('Missing $ inserted')
+    assert "2^18" not in tex and "textasciicircum" in tex
+    # when no findings override is given, generate_report DERIVES them
+    # from the data it already has — no pipeline ships without analysis
+    paths2 = generate_report({}, single_chip={("INT", "SUM"): 100.0},
+                             out_dir=tmp_path / "b")
+    md2 = paths2["md"].read_text()
+    assert "## Findings" in md2 and "1.1x" in md2
+    # and with NO data at all, no empty section appears
+    paths3 = generate_report({}, out_dir=tmp_path / "c")
+    assert "## Findings" not in paths3["md"].read_text()

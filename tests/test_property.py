@@ -32,7 +32,7 @@ geometry = st.fixed_dictionaries({
     "n": st.integers(min_value=1, max_value=1 << 14),
     "method": st.sampled_from(["SUM", "MIN", "MAX"]),
     "dtype": st.sampled_from(["int32", "float32", "bfloat16"]),
-    "kernel": st.sampled_from([6, 7, 8]),
+    "kernel": st.sampled_from([6, 7, 8, 10]),
     "threads": st.sampled_from([8, 16, 64, 100, 256, 512]),
     "max_blocks": st.sampled_from([1, 2, 7, 64]),
     "seed": st.integers(min_value=0, max_value=3),
@@ -73,6 +73,12 @@ EDGE_GEOMETRIES = [
     # max_blocks=1 serial chain
     dict(n=1 << 13, method="MAX", dtype="int32", kernel=7, threads=8,
          max_blocks=1),
+    # kernel 10's DMA-pipeline edges: fewer chunks than pipeline depth
+    # (n fits one tile), and a long chunk chain at the minimum tile
+    dict(n=100, method="SUM", dtype="float32", kernel=10, threads=256,
+         max_blocks=64),
+    dict(n=1 << 14, method="MIN", dtype="bfloat16", kernel=10, threads=16,
+         max_blocks=64),
 ]
 
 

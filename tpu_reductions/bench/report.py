@@ -62,17 +62,32 @@ def generate_report(avgs: Dict[Key, float],
                     out_dir: str | Path = ".",
                     platform: str = "tpu",
                     calibration: Optional[dict] = None,
-                    roofline: Optional[Sequence[str]] = None
+                    roofline: Optional[Sequence[str]] = None,
+                    annotated_rows: Optional[Sequence[dict]] = None,
+                    findings: Optional[Sequence[str]] = None
                     ) -> Dict[str, Path]:
     """Render report.md + report.tex from averaged collective results
     (aggregate.average output) and optional single-chip numbers
     {(DATATYPE, OP): GB/s}. `calibration` (a
     utils.calibrate.TimingCalibration.to_dict()) documents whether the
     platform's sync primitive could be trusted and which timing
-    discipline produced the numbers. Returns {"md": path, "tex": path}."""
+    discipline produced the numbers. Returns {"md": path, "tex": path}.
+
+    The Findings section (bench.findings — writeup.tex:19's narrative,
+    derived not written) is computed HERE from the data every caller
+    already passes (avgs, single_chip, optional roofline-annotated
+    shmoo rows), so no pipeline can ship curves without the analysis;
+    `findings` overrides the derivation (tests)."""
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     date = datetime.date.today().isoformat()
+
+    if findings is None:
+        from tpu_reductions.bench.findings import derive_findings
+        findings = derive_findings(rows=annotated_rows,
+                                   single_chip=single_chip,
+                                   coll_avgs=avgs,
+                                   reference=REFERENCE_SINGLE_GPU) or None
 
     # ---- tables ----------------------------------------------------------
     coll_rows = [(dt, op, ranks, f"{gbps:.3f}")
@@ -103,6 +118,12 @@ def generate_report(avgs: Dict[Key, float],
                + "\n".join(f"- {ln}" for ln in roofline) + "\n"
                ) if roofline else ""
 
+    # mechanical findings (bench.findings) — the writeup.tex:19
+    # narrative derived from the data instead of written by hand
+    find_md = ("\n## Findings\n\n"
+               + "\n".join(f"- {ln}" for ln in findings) + "\n"
+               ) if findings else ""
+
     md = f"""# TPU Reduction Benchmarks — generated report
 
 *Generated {date} by tpu_reductions.bench.report (the writeup.tex analog).*
@@ -114,7 +135,7 @@ The reference measured a single CC≥1.3 GPU at n=2^24 elements
 kernel path at the same n.
 
 {sc_tbl}
-{coll_md}{roof_md}
+{coll_md}{roof_md}{find_md}
 {fig_md}
 
 ## Notes
@@ -129,14 +150,15 @@ kernel path at the same n.
     md_path.write_text(md)
 
     tex = _to_tex(sc_rows, coll_rows, figures, date,
-                  calibration=calibration, roofline=roofline)
+                  calibration=calibration, roofline=roofline,
+                  findings=findings)
     tex_path = out / "report.tex"
     tex_path.write_text(tex)
     return {"md": md_path, "tex": tex_path}
 
 
 def _to_tex(sc_rows, coll_rows, figures, date, calibration=None,
-            roofline=None) -> str:
+            roofline=None, findings=None) -> str:
     def tabular(rows, cols, header):
         lines = ["\\begin{tabular}{" + "l" * cols + "}",
                  " & ".join(header) + " \\\\ \\hline"]
@@ -157,6 +179,11 @@ def _to_tex(sc_rows, coll_rows, figures, date, calibration=None,
                              for ln in roofline)
                 + "\n\\end{itemize}"
                 if roofline else "")
+    find_tex = ("\\section{Findings}\n\\begin{itemize}\n"
+                + "\n".join(f"\\item {_tex_escape(ln)}"
+                             for ln in findings)
+                + "\n\\end{itemize}"
+                if findings else "")
     return f"""\\documentclass{{article}}
 \\usepackage{{graphicx}}
 \\title{{TPU Reduction Benchmarks}}
@@ -167,6 +194,7 @@ def _to_tex(sc_rows, coll_rows, figures, date, calibration=None,
 {tabular(sc_rows, 5, ["dtype", "op", "ref GPU", "TPU", "ratio"])}
 {coll_tex}
 {roof_tex}
+{find_tex}
 \\section{{Figures}}
 {figs}
 \\section{{Methodology}}
@@ -177,8 +205,13 @@ def _to_tex(sc_rows, coll_rows, figures, date, calibration=None,
 
 
 def _tex_escape(s: str) -> str:
+    # '^' appears in every power-of-two finding/roofline line (2^24);
+    # bare it breaks compilation ('Missing $ inserted') — the module
+    # promises a COMPILABLE LaTeX source
     return (s.replace("&", "\\&").replace("%", "\\%")
-             .replace("#", "\\#").replace("_", "\\_"))
+             .replace("#", "\\#").replace("_", "\\_")
+             .replace("^", "\\textasciicircum{}")
+             .replace("->", "$\\rightarrow$"))
 
 
 def main(argv=None) -> int:
@@ -247,13 +280,16 @@ def main(argv=None) -> int:
 
     figures = sorted(out.glob("*.eps")) + sorted(out.glob("*.png"))
     roof_lines = None
+    ann = None
     roof_path = out / "roofline.json"
     if roof_path.exists():
         from tpu_reductions.bench.roofline import summarize
-        roof_lines = summarize(json.loads(roof_path.read_text()))
+        ann = json.loads(roof_path.read_text())
+        roof_lines = summarize(ann)
     paths = generate_report(avgs, single_chip=sc or None, figures=figures,
                             out_dir=out, platform=ns.platform,
-                            calibration=cal, roofline=roof_lines)
+                            calibration=cal, roofline=roof_lines,
+                            annotated_rows=ann)
     print(f"report: {paths['md']} {paths['tex']}")
     return 0
 

@@ -20,6 +20,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from tpu_reductions.bench.findings import pow2_label
+
 # Per device-kind memory model: HBM roof (B/s) and the VMEM-residency
 # bound for chained working sets. v5e values measured in this repo;
 # others are public spec sheets (fractions against them are labeled
@@ -82,13 +84,13 @@ def summarize(annotated: Sequence[dict]) -> List[str]:
             lines.append(
                 f"{dtype} {method}: HBM-bound peak {best['gbps']:.1f} "
                 f"GB/s = {100 * best['hbm_fraction']:.0f}% of the roof "
-                f"(n=2^{int(best['n']).bit_length() - 1})")
+                f"(n={pow2_label(best['n'])})")
         if vmem:
             bestv = max(vmem, key=lambda r: r["gbps"])
             lines.append(
                 f"{dtype} {method}: VMEM-resident peak "
                 f"{bestv['gbps']:.1f} GB/s "
-                f"(n=2^{int(bestv['n']).bit_length() - 1}; above the "
+                f"(n={pow2_label(bestv['n'])}; above the "
                 "HBM roof by design — the working set stays on-chip)")
     # rows whose oracle check never ran (e.g. timing recovered from a
     # session log after a relay death) must not be presented as
