@@ -163,3 +163,16 @@ def test_mxu_kernel_driver_passes_float():
                        iterations=3, log_file=None)
     res = run_benchmark(cfg)
     assert res.passed, res.waived_reason
+
+
+def test_f64_strategy_reports_platform_route():
+    """f64_strategy answers SURVEY.md §7's 'decide early' hard part:
+    on non-TPU backends f64 is native; on the TPU it is the
+    double-double path (dd_reduce.py) — pinned so the public answer
+    tracks the actual routing in driver._make_device_fn."""
+    import jax
+
+    from tpu_reductions.ops.pallas_reduce import f64_strategy
+
+    assert f64_strategy() == ("dd" if jax.default_backend() == "tpu"
+                              else "native")
