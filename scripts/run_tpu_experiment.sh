@@ -135,7 +135,11 @@ sc = {k: sum(v) / len(v) for k, v in sc.items()}
 # wants to skip them entirely.
 hazard_pow = 30
 hazard = os.environ.get("HAZARD_CELLS", "1") == "1" and not dryrun
-curves = (("bfloat16", 14 if dryrun else hazard_pow - 1),
+# bf16 runs its full curve to 2^30 inline: at 2 B/element that cell is
+# a 2 GiB transfer, the message class that always survived the relay
+# (and staging now chunks to 256 MiB regardless) — only the 4 GiB
+# int32 cell is the demonstrated killer and waits for the hazard tail
+curves = (("bfloat16", 14 if dryrun else hazard_pow),
           ("float64", 13 if dryrun else 28),
           ("int32", 14 if dryrun else hazard_pow - 1))
 shmoo_rows = []
@@ -162,14 +166,13 @@ for dtype, max_pow in curves:
     shmoo_rows += [r.to_dict() for r in res if r.passed]
     figures = persist(shmoo_rows)
 if hazard:
-    for dtype in ("int32", "bfloat16"):
-        log.log(f"hazard cell: {dtype} n=2^{hazard_pow} "
-                "(4 GiB-class staging killed the relay in both "
-                "round-2 windows; running it last, alone)")
-        res = run_shmoo(shmoo_cfg(dtype), min_pow=hazard_pow,
-                        max_pow=hazard_pow, logger=log)
-        shmoo_rows += [r.to_dict() for r in res if r.passed]
-        figures = persist(shmoo_rows)
+    log.log(f"hazard cell: int32 n=2^{hazard_pow} (the 4 GiB cell "
+            "that killed the relay in both round-2 windows; running "
+            "it last, alone, chunk-staged)")
+    res = run_shmoo(shmoo_cfg("int32"), min_pow=hazard_pow,
+                    max_pow=hazard_pow, logger=log)
+    shmoo_rows += [r.to_dict() for r in res if r.passed]
+    figures = persist(shmoo_rows)
 
 # 4) report: single-chip tables + curves + the calibration note + the
 # mechanical roofline analysis (VERDICT r1 item 2: "state the TPU
