@@ -80,16 +80,50 @@ CANDIDATES = (
 )
 
 
+# Written by a SUCCESSFUL fresh run (main) and read back by the outage
+# fallback — the mid-round "measure early, snapshot immediately"
+# discipline as a mechanical side effect instead of a hand-kept file.
+SNAPSHOT_BASENAME = "BENCH_snapshot.json"
+
+
+def _write_snapshot(payload: dict, per_candidate: dict) -> None:
+    """Persist a fresh verified measurement next to this file, with the
+    capture time and the per-candidate rows as provenance. Atomic
+    (temp+rename) and best-effort: snapshot failure must never fail the
+    bench run that produced the value."""
+    import datetime
+    import os
+    snap = {**payload,
+            "captured": datetime.datetime.now(datetime.timezone.utc)
+                        .strftime("%Y-%m-%dT%H:%M:%SZ (fresh bench.py run)"),
+            "timing": ("chained slope (ops/chain.py), median, every "
+                       "PASSED row verified vs the host oracle"),
+            "provenance": {"candidates": per_candidate}}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        SNAPSHOT_BASENAME)
+    try:
+        with open(path + ".tmp", "w") as f:
+            json.dump(snap, f, indent=1)
+        os.replace(path + ".tmp", path)
+    except OSError as e:
+        print(f"# snapshot write failed (non-fatal): {e}",
+              file=sys.stderr)
+
+
 def _snapshot_fallback(outage: str, snap: str | None = None) -> dict:
     """On an accelerator outage, surface the round's committed verified
     measurement (captured and snapshotted mid-round per VERDICT r1 item
     1's 'measure early' discipline) instead of a bare 0.0 — clearly
     labeled as the snapshot, never passed off as a fresh run.
-    `snap` overrides the snapshot path (tests)."""
+    `snap` overrides the snapshot path (tests). Default resolution
+    prefers the freshest mechanical snapshot (SNAPSHOT_BASENAME, written
+    by the last successful run) over the hand-kept round-2 one."""
     import os
     if snap is None:
-        snap = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_r02_snapshot.json")
+        here = os.path.dirname(os.path.abspath(__file__))
+        snap = os.path.join(here, SNAPSHOT_BASENAME)
+        if not os.path.exists(snap):
+            snap = os.path.join(here, "BENCH_r02_snapshot.json")
     try:
         with open(snap) as f:
             s = json.load(f)
@@ -173,12 +207,32 @@ def main(argv=None) -> int:
     value = max((r.gbps for r in passed), default=0.0)
     label = (f"2^{ns.n.bit_length() - 1}" if ns.n & (ns.n - 1) == 0
              else str(ns.n))
-    print(json.dumps({
+    payload = {
         "metric": f"single-chip int32 SUM reduction bandwidth, n={label}",
         "value": round(value, 4),
         "unit": "GB/s",
         "vs_baseline": round(value / BASELINE_GBPS, 4),
-    }))
+    }
+    import jax
+    if (passed and jax.default_backend() == "tpu"
+            and ns.n == 1 << 24):
+        # fresh verified on-chip value AT THE FLAGSHIP CONFIG: snapshot
+        # it immediately, so a later outage in the same round reports
+        # THIS measurement. Gated on the actual backend (not the flag —
+        # a CPU-default box must never clobber the snapshot with a
+        # host-speed number) and on the headline n (a --n smoke run is
+        # not the flagship metric).
+        import math
+        _write_snapshot(payload, {
+            f"{cfg.backend} k{cfg.kernel} threads={cfg.threads}":
+                # crash/WAIVE rows carry nan gbps: serialize null, not
+                # the non-RFC-8259 NaN literal (same guard as
+                # autotune._row / BenchResult.to_dict)
+                {"gbps": (round(res.gbps, 1)
+                          if math.isfinite(res.gbps) else None),
+                 "status": res.status.name}
+            for cfg, res in zip(cfgs, results)})
+    print(json.dumps(payload))
     return 0 if passed else 1
 
 

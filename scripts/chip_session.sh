@@ -117,33 +117,86 @@ step() {  # step <name> <artifact...> -- <cmd...>
 
 # pipefail INSIDE each bash -c: the child shell does not inherit the
 # outer setting, and without it a crashed python is masked by tee/tail
-step "headline bench" BENCH_live.json -- \
+#
+# Round-3 ordering = the round-2 VERDICT's "Next round: do this" list:
+#   1. fresh BENCH row (item 4; also the 7-rep k7/384 average, item 7)
+#   2. DOUBLE scoreboard (item 1 — THE gap: beat 92.77 GB/s on-chip)
+#   3. calibration ladder (trust gate for everything after)
+#   4+5. HBM-regime races at 2^26 and the 2^27 weak point (item 2;
+#        kernel 10 races its pipeline depth 2/4/8)
+#   6. int op-parity probe (item 5: MIN vs SUM vs MAX, same geometry)
+#   7+8. kernel-9 MXU races, f32 + bf16 (item 6; bf16 evidence, item 9)
+#   9. fine tile race (item 7's repeat confirmation at 5+ reps)
+#   10. flagship experiment (item 3: re-verified int curve + bf16/f64
+#       curves + the 2^30 hazard cells last; DOUBLE rows land in the
+#       report's flagship table via sweep_all)
+step "headline bench" BENCH_live.json BENCH_snapshot.json -- \
     bash -c 'set -o pipefail; python bench.py | tee BENCH_live.json'
+
+# all-device f64 (ops/dd_reduce.device_finish_pairs): the DOUBLE
+# SUM/MIN/MAX scoreboard — expected near the INT roof fraction instead
+# of the transfer-bound 0.9 GB/s round 2 measured through the tunnel
+step "double scoreboard" double_spot.json -- \
+    python -m tpu_reductions.bench.spot --type=double \
+        --methods=SUM,MIN,MAX --n=16777216 --iterations=256 \
+        --chainreps=7 --out=double_spot.json
 
 step "calibration ladder" calibration_live.json -- \
     bash -c 'set -o pipefail; \
              python -m tpu_reductions.utils.calibrate --ladder \
                  --chainspan 256 --reps 7 | tail -1 > calibration_live.json'
 
-# all-device f64 (ops/dd_reduce.device_finish_pairs): first on-chip
-# chained DOUBLE number — expected near the INT roof fraction instead
-# of the old transfer-bound 0.9 GB/s (docs/PERF_NOTES.md hypothesis 4)
-step "f64 chained spot" f64_chained_spot.txt -- \
-    bash -c 'set -o pipefail; \
-             python -m tpu_reductions --method=SUM --type=double \
-                 --n=16777216 --iterations=256 --timing=chained \
-                 --stat=median \
-                 --logfile=/tmp/f64spot.txt | tee f64_chained_spot.txt'
-
-# does k7 pipelining survive HBM streaming, and does any Pallas
-# geometry close the 5-8% gap to XLA at 2^26? (hypothesis 1)
-step "hbm regime race" tune_hbm.json -- \
+# does any Pallas geometry close the 5-8% gap to XLA in the HBM regime?
+# kernel 10 races its DMA pipeline depth — the knob it exists for
+step "hbm regime race 2^26" tune_hbm.json -- \
     python -m tpu_reductions.bench.autotune --method=SUM --type=int \
         --n=67108864 --grid=hbm --comparator --out=tune_hbm.json
 
+# 2^27 was round 2's weakest HBM point (621 vs 779 GB/s)
+step "hbm regime race 2^27" tune_hbm27.json -- \
+    python -m tpu_reductions.bench.autotune --method=SUM --type=int \
+        --n=134217728 --grid=hbm --comparator --out=tune_hbm27.json
+
+# MIN trailed SUM by 23% in round 2 (5002.6 vs 6497.2 GB/s) with no
+# recorded cause: measure all three ops at the two winning geometries
+# rc accumulates across the two probes: a crash of the first must not
+# be masked by a clean second (the same masking the pipefail note above
+# guards against, at the command level)
+step "int op parity probe" int_op_spot_k7.json int_op_spot_k6.json -- \
+    bash -c 'rc=0; \
+             python -m tpu_reductions.bench.spot --type=int \
+                 --methods=SUM,MIN,MAX --n=16777216 --kernel=7 \
+                 --threads=384 --iterations=256 --chainreps=5 \
+                 --out=int_op_spot_k7.json || rc=$?; \
+             python -m tpu_reductions.bench.spot --type=int \
+                 --methods=SUM,MIN,MAX --n=16777216 --kernel=6 \
+                 --threads=512 --iterations=256 --chainreps=5 \
+                 --out=int_op_spot_k6.json || rc=$?; \
+             exit $rc'
+
+# kernel 9 (MXU) has never lowered on-chip; rank it against the VPU
+# winners in both regimes (2^24 VMEM-resident, 2^26 HBM-bound)
+step "mxu race f32" tune_mxu_f32.json tune_mxu_f32_hbm.json -- \
+    bash -c 'rc=0; \
+             python -m tpu_reductions.bench.autotune --method=SUM \
+                 --type=float --n=16777216 --iterations=256 --grid=mxu \
+                 --comparator --out=tune_mxu_f32.json || rc=$?; \
+             python -m tpu_reductions.bench.autotune --method=SUM \
+                 --type=float --n=67108864 --grid=mxu \
+                 --comparator --out=tune_mxu_f32_hbm.json || rc=$?; \
+             exit $rc'
+
+step "mxu race bf16" tune_mxu_bf16.json -- \
+    python -m tpu_reductions.bench.autotune --method=SUM --type=bfloat16 \
+        --n=16777216 --iterations=256 --grid=mxu --comparator \
+        --out=tune_mxu_bf16.json
+
+# 5+ slope reps so the round-2 single-rep 22.7 TB/s k7/384 claim gets a
+# quotable repeat-averaged confirmation (or a retraction)
 step "fine tile race" tune_fine.json -- \
     python -m tpu_reductions.bench.autotune --method=SUM --type=int \
-        --n=16777216 --iterations=256 --grid=fine --out=tune_fine.json
+        --n=16777216 --iterations=256 --chainreps=7 --grid=fine \
+        --out=tune_fine.json
 
 step "flagship experiment" examples/tpu_run -- \
     bash scripts/run_tpu_experiment.sh examples/tpu_run

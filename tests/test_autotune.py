@@ -186,3 +186,47 @@ def test_chained_race_survives_a_crashing_candidate(monkeypatch):
     # the healthy candidate may noise-WAIVE on a loaded host (tiny
     # chained payload); what matters here is the crash never spread
     assert by_threads[32].status.name in ("PASSED", "WAIVED")
+
+
+def test_hbm_grid_races_stream_depth():
+    """The hbm grid's kernel-10 rows race the DMA pipeline depth (2 =
+    Mosaic-equivalent, 4 = default, 8 = deep) — the knob the streaming
+    kernel exists for (round-2 VERDICT weak #7: maxblocks is
+    structurally dead for single-pass kernels; depth is not)."""
+    from tpu_reductions.bench.autotune import HBM_GRID, candidate_configs
+    from tpu_reductions.config import KERNEL_STREAM, ReduceConfig
+
+    depths = {g[3] for g in HBM_GRID if g[0] == KERNEL_STREAM}
+    assert depths == {2, 4, 8}
+    base = ReduceConfig(method="SUM", dtype="int32", n=1 << 14,
+                        log_file=None)
+    cfgs = candidate_configs(base, HBM_GRID)
+    k10 = [c for c in cfgs if c.kernel == KERNEL_STREAM]
+    assert {c.stream_buffers for c in k10} == {2, 4, 8}
+    # 3-tuple rows inherit base's depth untouched
+    assert all(c.stream_buffers == base.stream_buffers
+               for c in cfgs if c.kernel != KERNEL_STREAM)
+
+
+def test_mxu_grid_registered_and_races_float(tmp_path):
+    """--grid=mxu: the kernel-9 race preset (float SUM) ranks the MXU
+    kernel against the established VPU winners; rows record the k10
+    depth so the artifact is self-describing."""
+    import json
+
+    from tpu_reductions.bench import autotune as at
+    from tpu_reductions.config import KERNEL_MXU, KERNEL_STREAM
+
+    assert at.GRIDS["mxu"] is at.MXU_GRID
+    assert sum(g[0] == KERNEL_MXU for g in at.MXU_GRID) == 3
+    out = tmp_path / "mxu.json"
+    rc = at.main(["--method=SUM", "--type=float", "--n=8192",
+                  "--iterations=3", "--timing=fetch", "--grid=mxu",
+                  "--comparator", "--platform=cpu", f"--out={out}"])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    kernels = {r["kernel"] for r in data["ranked"]}
+    assert KERNEL_MXU in kernels and None in kernels  # + comparator row
+    k10_rows = [r for r in data["ranked"]
+                if r["kernel"] == KERNEL_STREAM]
+    assert all(r["stream_buffers"] == 4 for r in k10_rows)

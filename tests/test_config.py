@@ -64,3 +64,18 @@ def test_collective_cli():
                              "--devices=8", "--mode=co", "--rooted"])
     assert ccfg.num_devices == 8 and ccfg.mode == "co" and ccfg.rooted
     assert ccfg.retries == 5  # RETRY_COUNT analog (constants.h:5)
+
+
+def test_streambuffers_flag():
+    """--streambuffers: the kernel-10 DMA pipeline depth knob (the hbm
+    race's 4th grid element); validated positive."""
+    import pytest
+
+    from tpu_reductions.config import ReduceConfig, parse_single_chip
+
+    cfg, _ = parse_single_chip(["--method=SUM", "--kernel=10",
+                                "--streambuffers=8"])
+    assert cfg.stream_buffers == 8
+    assert ReduceConfig(method="SUM").stream_buffers == 4
+    with pytest.raises(ValueError):
+        ReduceConfig(method="SUM", stream_buffers=0)

@@ -189,3 +189,24 @@ def test_f64_dd_path_is_chained_on_tpu_backend(monkeypatch):
     assert resolved_timing(cfg2) == "fetch"
     res2 = run_benchmark(cfg2, logger=BenchLogger(None, None))
     assert res2.status == QAStatus.PASSED and res2.timing == "fetch"
+
+
+def test_benchresult_to_dict_serializes_nonfinite_as_null():
+    """WAIVED/FAILED rows carry nan oracle fields (and a degenerate
+    fetch-mode run reports inf gbps); their JSON form must be RFC-8259
+    null, never the NaN/Infinity literals strict parsers reject
+    (round-2 ADVICE 4)."""
+    import json
+
+    from tpu_reductions.bench.driver import BenchResult
+    from tpu_reductions.utils.qa import QAStatus
+
+    r = BenchResult("SUM", "int32", 64, "pallas", 6, float("inf"),
+                    0.0, 0, QAStatus.WAIVED, float("nan"), float("nan"),
+                    float("nan"))
+    d = r.to_dict()
+    assert d["gbps"] is None and d["device_result"] is None
+    json.loads(json.dumps(d))  # strict round-trip
+    ok = BenchResult("SUM", "int32", 64, "pallas", 6, 12.5, 1e-6, 4,
+                     QAStatus.PASSED, 1.0, 1.0, 0.0)
+    assert ok.to_dict()["gbps"] == 12.5

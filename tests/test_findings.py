@@ -124,3 +124,23 @@ def test_report_includes_findings_section(tmp_path):
     # and with NO data at all, no empty section appears
     paths3 = generate_report({}, out_dir=tmp_path / "c")
     assert "## Findings" not in paths3["md"].read_text()
+
+
+def test_derive_findings_flags_unverified_rows():
+    """Timing-only recoveries (status RECOVERED / verified false) must
+    carry their caveat INSIDE the findings lines — a report built
+    without the roofline section still shows it (round-2 ADVICE 2)."""
+    from tpu_reductions.bench.findings import derive_findings
+
+    rows = [{"dtype": "int32", "method": "SUM", "n": 1 << p,
+             "gbps": g, "status": "RECOVERED", "verified": False}
+            for p, g in ((10, 10.0), (14, 100.0), (20, 400.0),
+                         (24, 410.0))]
+    lines = derive_findings(rows=rows)
+    caveats = [ln for ln in lines if ln.startswith("CAVEAT")]
+    assert len(caveats) == 1
+    assert "4 of 4" in caveats[0] and "RECOVERED" in caveats[0]
+    # fully verified rows: no caveat
+    ok = [dict(r, status="PASSED", verified=True) for r in rows]
+    assert not [ln for ln in derive_findings(rows=ok)
+                if ln.startswith("CAVEAT")]

@@ -176,3 +176,39 @@ def test_f64_strategy_reports_platform_route():
 
     assert f64_strategy() == ("dd" if jax.default_backend() == "tpu"
                               else "native")
+
+
+def test_stream_kernel_depth_knob_is_correct_at_every_depth():
+    """Kernel 10's DMA pipeline depth is a performance knob, never a
+    correctness knob: depths 1/2/4/8 must all reduce exactly (the hbm
+    autotune grid races depths 2/4/8 on-chip — a depth that changed
+    results would make that race meaningless)."""
+    import numpy as np
+
+    from tpu_reductions.ops.pallas_reduce import pallas_reduce
+
+    rng = np.random.default_rng(7)
+    x = rng.integers(-1000, 1000, size=5000, dtype=np.int32)
+    want = int(x.sum(dtype=np.int64) & 0xFFFFFFFF)
+    for depth in (1, 2, 4, 8):
+        got = int(np.asarray(pallas_reduce(x, "SUM", kernel=10,
+                                           stream_buffers=depth,
+                                           threads=64)))
+        assert (got & 0xFFFFFFFF) == want, depth
+
+
+def test_stream_depth_reaches_driver_from_config():
+    """--streambuffers flows config -> driver -> kernel for both the
+    verification reduce and the chained timing fn."""
+    from tpu_reductions.bench.driver import run_benchmark
+    from tpu_reductions.config import ReduceConfig
+
+    for depth in (2, 8):
+        cfg = ReduceConfig(method="SUM", dtype="int32", n=1 << 12,
+                           kernel=10, threads=64, stream_buffers=depth,
+                           iterations=4, timing="chained", chain_reps=2,
+                           log_file=None)
+        res = run_benchmark(cfg)
+        assert res.status.name in ("PASSED", "WAIVED")
+        if res.passed:
+            assert res.abs_diff == 0.0

@@ -1,0 +1,72 @@
+"""Fixed-geometry spot checks (bench/spot.py): the DOUBLE-scoreboard /
+op-parity instrument — several methods at one geometry, one atomic JSON
+artifact, rows persisted as they land (the live-window discipline)."""
+
+import json
+
+from tpu_reductions.bench.spot import main, run_spots
+from tpu_reductions.config import ReduceConfig
+
+
+def _base(**kw):
+    kw.setdefault("method", "SUM")
+    kw.setdefault("dtype", "int32")
+    kw.setdefault("n", 1 << 12)
+    kw.setdefault("iterations", 8)
+    kw.setdefault("timing", "chained")
+    kw.setdefault("chain_reps", 2)
+    kw.setdefault("log_file", None)
+    return ReduceConfig(**kw)
+
+
+def test_run_spots_covers_all_methods_and_persists_incrementally():
+    seen = []
+    rows = run_spots(_base(), ["SUM", "MIN", "MAX"],
+                     on_result=lambda r: seen.append(r["method"]))
+    assert [r["method"] for r in rows] == ["SUM", "MIN", "MAX"]
+    assert seen == ["SUM", "MIN", "MAX"]  # fired per row, in order
+    assert all(r["status"] in ("PASSED", "WAIVED") for r in rows)
+    assert all(r["threads"] == 256 and r["chain_reps"] == 2 for r in rows)
+
+
+def test_run_spots_contains_a_crashing_method(monkeypatch):
+    """One method whose kernel raises must record FAILED and leave the
+    other methods' rows intact — a live DOUBLE scoreboard cannot afford
+    a process-killing MIN."""
+    from tpu_reductions.bench import driver as drv
+
+    real = drv.run_benchmark
+
+    def sabotaged(cfg, **kw):
+        if cfg.method == "MIN":
+            raise RuntimeError("synthetic dd lowering failure")
+        return real(cfg, **kw)
+
+    monkeypatch.setattr(drv, "run_benchmark", sabotaged)
+    rows = run_spots(_base(), ["SUM", "MIN", "MAX"])
+    by = {r["method"]: r for r in rows}
+    assert by["MIN"]["status"] == "FAILED"
+    assert by["SUM"]["status"] in ("PASSED", "WAIVED")
+    assert by["MAX"]["status"] in ("PASSED", "WAIVED")
+
+
+def test_spot_cli_double_writes_artifact(tmp_path, capsys):
+    """The chip session's 'double scoreboard' invocation shape, scaled
+    down: f64 rows via the dd path, all oracle-verified, artifact
+    complete=true."""
+    out = tmp_path / "double_spot.json"
+    rc = main(["--type=double", "--methods=SUM,MIN,MAX", "--n=16384",
+               "--iterations=8", "--chainreps=2", f"--out={out}"])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert data["complete"] is True
+    assert data["dtype"] == "float64"
+    assert [r["method"] for r in data["rows"]] == ["SUM", "MIN", "MAX"]
+    assert all(r["status"] == "PASSED" for r in data["rows"])
+    assert "wrote" in capsys.readouterr().out
+
+
+def test_spot_cli_validates_methods():
+    import pytest
+    with pytest.raises(SystemExit):
+        main(["--methods=SUM,NOPE", "--n=64"])

@@ -133,16 +133,17 @@ def test_maybe_arm_noop_off_tpu():
 
 
 def test_maybe_arm_exits_when_relay_already_dead(monkeypatch):
-    """On the TPU backend a dead arming probe means every device wait
-    ahead hangs forever — maybe_arm_for_tpu must exit with the watchdog
-    code, not decline protection."""
-    import jax
-
+    """On a tunneled box with an unforced platform (the on-chip run), a
+    dead relay means jax backend init ITSELF would hang —
+    maybe_arm_for_tpu must exit with the watchdog code BEFORE the first
+    jax call, not decline protection (round-2 ADVICE: autotune/calibrate
+    armed the watchdog through jax.default_backend and could hang before
+    the watchdog existed)."""
     import tpu_reductions.utils.watchdog as wd
 
-    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     monkeypatch.setattr(wd, "tunneled_environment", lambda *a: True)
     monkeypatch.setattr(wd, "relay_alive", lambda *a, **k: False)
+    monkeypatch.setattr(wd, "_forced_platforms", lambda: "")  # unforced
     codes = []
     slept = []
     out = wd.maybe_arm_for_tpu(_exit=lambda c: codes.append(c),
@@ -150,6 +151,22 @@ def test_maybe_arm_exits_when_relay_already_dead(monkeypatch):
     assert out is None
     assert codes == [wd.WATCHDOG_EXIT_CODE]
     assert len(slept) == 1  # it re-probed before giving up
+
+
+def test_maybe_arm_passes_dead_relay_when_forced_off_tpu(monkeypatch):
+    """--platform=cpu on the tunneled box: device work never crosses
+    the tunnel, so a dead relay must not exit the run (bench.py's CPU
+    smoke path and the test suite itself run exactly this way)."""
+    import tpu_reductions.utils.watchdog as wd
+
+    monkeypatch.setattr(wd, "tunneled_environment", lambda *a: True)
+    monkeypatch.setattr(wd, "relay_alive", lambda *a, **k: False)
+    monkeypatch.setattr(wd, "_forced_platforms", lambda: "cpu")
+    out = wd.maybe_arm_for_tpu(
+        _exit=lambda c: (_ for _ in ()).throw(
+            AssertionError("exited a forced-cpu run")),
+        _sleep=lambda s: None)
+    assert out is None
 
 
 def test_maybe_arm_noop_on_untunneled_tpu_host(monkeypatch):

@@ -37,12 +37,16 @@ from tpu_reductions.config import (DTYPE_ALIASES, KERNEL_ELEMENTWISE,
                                    ReduceConfig, _apply_platform)
 from tpu_reductions.utils.logging import BenchLogger
 
-# (kernel, threads, max_blocks) candidate grid. Threads sweeps the VMEM
-# tile height across its useful range (8 rows = one sublane tile, 2048 =
-# the choose_tiling clamp); max_blocks only matters for the two-pass
-# kernel's partial count, so the single-pass kernels pin it to the
-# reference default of 64 (reduction.cpp:668).
-DEFAULT_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
+# (kernel, threads, max_blocks[, stream_buffers]) candidate grid.
+# Threads sweeps the VMEM tile height across its useful range (8 rows =
+# one sublane tile, 2048 = the choose_tiling clamp); max_blocks only
+# matters for the two-pass kernel's partial count, so the single-pass
+# kernels pin it to the reference default of 64 (reduction.cpp:668). A
+# 4th element, where present, is the kernel-10 DMA pipeline depth — the
+# knob that actually matters for the streaming kernel (the maxblocks
+# knob is structurally dead for single-pass kernels; racing the depth
+# instead is the round-2 VERDICT's weak-#7 fix).
+DEFAULT_GRID: Tuple[Tuple[int, ...], ...] = tuple(
     [(KERNEL_SINGLE_PASS, t, 64) for t in (64, 128, 256, 512, 1024, 2048)]
     + [(KERNEL_ELEMENTWISE, t, 64) for t in (64, 128, 256, 512, 1024, 2048)]
     + [(KERNEL_TWO_PASS, t, mb) for t in (256, 1024) for mb in (64, 256)]
@@ -56,7 +60,7 @@ DEFAULT_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
 # Finer race around the round-2 winners (tune_r02.json: kernel 6
 # threads=512 at 6238 GB/s, kernel 7 threads=256 at 5075) — the
 # second-pass grid for squeezing past a coarse optimum.
-FINE_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
+FINE_GRID: Tuple[Tuple[int, ...], ...] = tuple(
     [(KERNEL_SINGLE_PASS, t, 64) for t in (320, 384, 448, 512, 640, 768)]
     + [(KERNEL_TWO_PASS, t, mb) for t in (128, 192, 256, 384, 512)
        for mb in (32, 64, 128)]
@@ -66,31 +70,49 @@ FINE_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
 # VMEM): big tiles for deep DMA on the single-pass kernels, and the
 # fine race's two-pass winner geometry (k7 t=384, tune_fine.json)
 # bracketed — the docs/PERF_NOTES.md next-window hypotheses 1 and 4.
+# Kernel 10 races its pipeline depth (2 = Mosaic-equivalent baseline,
+# 4 = default, 8 = deep lookahead) — the knob this kernel exists for.
 # Use --comparator to append the XLA row (the 779 GB/s = 95%-of-roof
 # rate calibration measured at 2^26; the gap to close).
-HBM_GRID: Tuple[Tuple[int, int, int], ...] = tuple(
+HBM_GRID: Tuple[Tuple[int, ...], ...] = tuple(
     [(KERNEL_SINGLE_PASS, t, 64) for t in (512, 1024, 2048)]
-    + [(KERNEL_TWO_PASS, t, mb) for t in (256, 384, 512)
-       for mb in (64, 128)]
-    # the manual deep-DMA pipeline (kernel 10) exists FOR this regime
-    + [(KERNEL_STREAM, t, 64) for t in (256, 512, 1024)]
+    + [(KERNEL_TWO_PASS, 384, mb) for mb in (64, 128)]
+    + [(KERNEL_TWO_PASS, 512, 64)]
+    + [(KERNEL_STREAM, t, 64, d) for t in (512, 1024)
+       for d in (2, 4, 8)]
+    + [(KERNEL_STREAM, 256, 64, 4)]
 )
 
-GRIDS = {"default": DEFAULT_GRID, "fine": FINE_GRID, "hbm": HBM_GRID}
+# Kernel-9 (MXU) race: float dtypes only (--type=float/bfloat16, SUM).
+# k9 against the established VPU winners and the streaming kernel, so
+# one race ranks the systolic-array reduction in both regimes
+# (docs/PERF_NOTES.md hypothesis 5 — k9 has never lowered on-chip).
+MXU_GRID: Tuple[Tuple[int, ...], ...] = tuple(
+    [(KERNEL_MXU, t, 64) for t in (256, 512, 1024)]
+    + [(KERNEL_SINGLE_PASS, 512, 64), (KERNEL_TWO_PASS, 384, 64),
+       (KERNEL_STREAM, 512, 64, 4)]
+)
+
+GRIDS = {"default": DEFAULT_GRID, "fine": FINE_GRID, "hbm": HBM_GRID,
+         "mxu": MXU_GRID}
 
 
 def candidate_configs(base: ReduceConfig,
-                      grid: Sequence[Tuple[int, int, int]] = DEFAULT_GRID,
+                      grid: Sequence[Tuple[int, ...]] = DEFAULT_GRID,
                       comparator: bool = False) -> List[ReduceConfig]:
-    """Expand the (kernel, threads, max_blocks) grid into benchmark
-    configs sharing `base`'s op/dtype/n/timing discipline — the candidate
-    space the reference leaves to hand-set --threads/--maxblocks knobs
-    (reduction.cpp:666-668). `comparator` appends one XLA-backend config
-    so the race records the always-correct baseline it must beat
-    (SURVEY.md §7 L2b) in the same run, same discipline."""
-    cfgs = [dataclasses.replace(base, backend="pallas", kernel=k,
-                                threads=t, max_blocks=mb)
-            for k, t, mb in grid]
+    """Expand the (kernel, threads, max_blocks[, stream_buffers]) grid
+    into benchmark configs sharing `base`'s op/dtype/n/timing discipline
+    — the candidate space the reference leaves to hand-set
+    --threads/--maxblocks knobs (reduction.cpp:666-668). The optional
+    4th element sets the kernel-10 DMA pipeline depth (base's value
+    otherwise). `comparator` appends one XLA-backend config so the race
+    records the always-correct baseline it must beat (SURVEY.md §7 L2b)
+    in the same run, same discipline."""
+    cfgs = [dataclasses.replace(base, backend="pallas", kernel=g[0],
+                                threads=g[1], max_blocks=g[2],
+                                stream_buffers=(g[3] if len(g) > 3
+                                                else base.stream_buffers))
+            for g in grid]
     if comparator:
         cfgs.append(dataclasses.replace(base, backend="xla",
                                         kernel=KERNEL_SINGLE_PASS,
@@ -148,14 +170,22 @@ def autotune(base: ReduceConfig,
 def _row(cfg: ReduceConfig, res: BenchResult) -> dict:
     """One serialized ranking row. The XLA comparator ignores the
     geometry knobs entirely — a serialized kernel/threads value there
-    would read as "the geometry XLA was measured at"; record null."""
+    would read as "the geometry XLA was measured at"; record null.
+    Non-finite gbps (a fetch-mode avg_s <= 0 reports inf; crashed rows
+    carry nan) serializes as null — json.dump's Infinity/NaN literals
+    are not RFC-8259 JSON and break strict parsers."""
+    import math
     xla = cfg.backend == "xla"
-    return {"backend": cfg.backend,
-            "kernel": None if xla else cfg.kernel,
-            "threads": None if xla else cfg.threads,
-            "max_blocks": None if xla else cfg.max_blocks,
-            "gbps": round(res.gbps, 4),
-            "status": res.status.name}
+    row = {"backend": cfg.backend,
+           "kernel": None if xla else cfg.kernel,
+           "threads": None if xla else cfg.threads,
+           "max_blocks": None if xla else cfg.max_blocks,
+           "gbps": (round(res.gbps, 4) if math.isfinite(res.gbps)
+                    else None),
+           "status": res.status.name}
+    if not xla and cfg.kernel == KERNEL_STREAM:
+        row["stream_buffers"] = cfg.stream_buffers
+    return row
 
 
 def _write_out(path: str, meta: dict, rows: List[dict], *,
@@ -233,7 +263,7 @@ def main(argv=None) -> int:
             _write_out(ns.out, meta,
                        sorted(live_rows,
                               key=lambda r: (r["status"] != "PASSED",
-                                             -r["gbps"])),
+                                             -(r["gbps"] or 0.0))),
                        best=None, complete=False)
 
     pairs = autotune(base, grid=GRIDS[ns.grid], logger=logger,
@@ -242,9 +272,14 @@ def main(argv=None) -> int:
     for cfg, res in pairs:
         row = _row(cfg, res)
         rows.append(row)
+        # kernel-10 rows differ ONLY in depth in the hbm grid — the
+        # console record (what survives a mid-race wedge in scrollback)
+        # must state it, not just the JSON
+        depth = (f" depth={cfg.stream_buffers}"
+                 if row.get("stream_buffers") is not None else "")
         geom = ("(geometry n/a)          " if row["kernel"] is None else
                 f"kernel={cfg.kernel} threads={cfg.threads:>5} "
-                f"maxblocks={cfg.max_blocks:>4}")
+                f"maxblocks={cfg.max_blocks:>4}{depth}")
         print(f"{cfg.backend:>6} {geom}  {res.gbps:10.2f} GB/s "
               f"[{res.status.name}]")
     # best = the fastest VERIFIED **tunable** (pallas) candidate: the
@@ -254,9 +289,12 @@ def main(argv=None) -> int:
     best = next((r for r, (cfg, res) in zip(rows, pairs)
                  if res.passed and cfg.backend == "pallas"), None)
     if best:
+        bdepth = (f" depth={best['stream_buffers']}"
+                  if best.get("stream_buffers") is not None else "")
         print(f"best: {best['backend']} kernel={best['kernel']} "
               f"threads={best['threads']} "
-              f"maxblocks={best['max_blocks']} -> {best['gbps']} GB/s")
+              f"maxblocks={best['max_blocks']}{bdepth} "
+              f"-> {best['gbps']} GB/s")
     if ns.out:
         _write_out(ns.out, meta, rows, best=best, complete=True)
         print(f"wrote {ns.out}")

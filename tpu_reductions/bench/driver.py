@@ -64,6 +64,14 @@ class BenchResult:
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["status"] = self.status.name
+        # non-finite floats (nan oracle fields on WAIVED/FAILED rows, inf
+        # gbps when a fetch-mode avg_s <= 0) must serialize as null:
+        # json.dump would emit NaN/Infinity literals, which are not
+        # RFC-8259 JSON and break strict parsers of sweep/shmoo files
+        import math
+        for k, v in d.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                d[k] = None
         return d
 
 
@@ -128,7 +136,8 @@ def _make_device_fn(cfg: ReduceConfig, backend: str):
     stage_fn, reduce_fn = pr.make_staged_reduce(
         cfg.method, cfg.n, cfg.dtype, threads=cfg.threads,
         max_blocks=cfg.max_blocks, kernel=cfg.kernel,
-        cpu_final=cfg.cpu_final, cpu_thresh=cfg.cpu_thresh)
+        cpu_final=cfg.cpu_final, cpu_thresh=cfg.cpu_thresh,
+        stream_buffers=cfg.stream_buffers)
     return stage_fn, reduce_fn
 
 
@@ -187,7 +196,7 @@ def _make_chained_fn(cfg: ReduceConfig, backend: str):
     op, _stage, core = make_staged_core(
         cfg.method, cfg.n, cfg.dtype, threads=cfg.threads,
         max_blocks=cfg.max_blocks, kernel=cfg.kernel,
-        cpu_thresh=cfg.cpu_thresh)
+        cpu_thresh=cfg.cpu_thresh, stream_buffers=cfg.stream_buffers)
     return make_chained_reduce(core, op)
 
 

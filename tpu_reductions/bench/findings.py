@@ -196,6 +196,20 @@ def derive_findings(rows: Optional[Sequence[dict]] = None,
     if rows:
         lines += half_power_points(rows)
         lines += vmem_cliff(rows)
+        # Rows that never passed the oracle (recovered timing-only rows,
+        # examples/tpu_run/RECOVERY.md) must not present as verified:
+        # the caveat is emitted HERE so it travels with the findings —
+        # a report built without the roofline section (whose summarize
+        # also flags this) still carries it.
+        unverified = [r for r in rows
+                      if r.get("status") == "RECOVERED"
+                      or r.get("verified") is False]
+        if unverified:
+            lines.append(
+                f"CAVEAT: {len(unverified)} of {len(rows)} curve rows "
+                "are timing-only recoveries (status RECOVERED — the "
+                "oracle never ran on them); curve-derived findings "
+                "above rest partly on unverified timings.")
     if single_chip and reference:
         lines += reference_multiples(single_chip, reference)
     if coll_avgs and single_chip:

@@ -1,0 +1,155 @@
+"""L5: fixed-geometry spot checks — several methods, one JSON artifact.
+
+Two round-2 VERDICT items need the same shape of measurement: a short,
+oracle-verified, chained-slope run of SEVERAL methods at ONE fixed
+kernel geometry, persisted as a machine-readable artifact the moment
+each row lands:
+
+  * the DOUBLE scoreboard (VERDICT item 1): f64 SUM/MIN/MAX at n=2^24
+    through the all-device dd path, the rows that must beat the
+    reference's best numbers (92.7729/92.6014/92.7552 GB/s,
+    mpi/CUdata.txt:2-4 — its doubles, not its ints, are its headline);
+  * the int32 MIN-deficit probe (VERDICT item 5): MIN vs SUM vs MAX at
+    identical geometry, so an op-dependent gap (5002.6 vs 6497.2 GB/s
+    in round 2) is measured as an op effect, not a tuning artifact.
+
+This is the runTest-per-op fan-out of the reference driver
+(reduction.cpp:161-200 dispatches {Sum,Min,Max} x dtype) reduced to a
+focused instrument: same self-verifying benchmark core (bench.driver),
+same chained timing discipline, one row per method.
+
+CLI:
+    python -m tpu_reductions.bench.spot --type=double \
+        --methods=SUM,MIN,MAX --n=16777216 [--kernel=6 --threads=512] \
+        [--platform=cpu] --out=double_spot.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import List, Optional
+
+from tpu_reductions.config import (DTYPE_ALIASES, METHODS, ReduceConfig,
+                                   _apply_platform)
+from tpu_reductions.utils.logging import BenchLogger
+
+
+def _row(cfg: ReduceConfig, res) -> dict:
+    """One serialized spot row: the BenchResult fields plus the geometry
+    knobs a reader needs to reproduce it (threads is not in BenchResult;
+    non-finite floats serialize as null — RFC-8259)."""
+    row = res.to_dict()
+    row["threads"] = cfg.threads
+    row["max_blocks"] = cfg.max_blocks
+    row["chain_reps"] = cfg.chain_reps
+    return row
+
+
+def run_spots(base: ReduceConfig, methods: List[str],
+              logger: Optional[BenchLogger] = None,
+              on_result=None) -> List[dict]:
+    """Run `methods` sequentially at base's geometry; each method's row
+    is passed to on_result as soon as it verifies (the persist-per-step
+    discipline every live-window lesson demands). Crashes are contained
+    per method (driver.crash_result) so one lowering failure cannot
+    take the remaining methods' rows with it."""
+    import dataclasses
+
+    from tpu_reductions.bench.driver import crash_result, run_benchmark
+
+    logger = logger or BenchLogger(None, None)
+    rows = []
+    for method in methods:
+        cfg = dataclasses.replace(base, method=method)
+        try:
+            res = run_benchmark(cfg, logger=logger)
+        except Exception as e:
+            res = crash_result(cfg, e, logger)
+        row = _row(cfg, res)
+        rows.append(row)
+        if on_result is not None:
+            on_result(row)
+    return rows
+
+
+def _write(path: str, meta: dict, rows: List[dict], complete: bool) -> None:
+    """Atomic temp+rename dump (the autotune/sweep pattern): a watchdog
+    os._exit mid-write must never destroy already-persisted rows."""
+    import os
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump({**meta, "complete": complete, "rows": rows}, f,
+                  indent=1)
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.bench.spot",
+        description="Oracle-verified chained spot check: several methods "
+                    "at one fixed kernel geometry, one JSON artifact",
+    )
+    p.add_argument("--methods", type=str, default="SUM,MIN,MAX",
+                   help="Comma-separated list (reference op order is "
+                        "MAX,MIN,SUM — reduce.c:73)")
+    p.add_argument("--type", dest="dtype", type=str, default="int")
+    p.add_argument("--n", type=int, default=1 << 24)
+    p.add_argument("--kernel", type=int, default=6)
+    p.add_argument("--threads", type=int, default=512)
+    p.add_argument("--maxblocks", dest="max_blocks", type=int, default=64)
+    p.add_argument("--streambuffers", dest="stream_buffers", type=int,
+                   default=4)
+    p.add_argument("--iterations", type=int, default=256,
+                   help="Chained span (k_hi = 1 + iterations)")
+    p.add_argument("--chainreps", dest="chain_reps", type=int, default=7)
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"))
+    p.add_argument("--out", type=str, default=None)
+    ns = p.parse_args(argv)
+    methods = [m.strip().upper() for m in ns.methods.split(",") if m.strip()]
+    if not methods or any(m not in METHODS for m in methods):
+        p.error(f"--methods must name only {METHODS}, got {ns.methods!r}")
+    if ns.dtype not in DTYPE_ALIASES:
+        p.error(f"unknown --type {ns.dtype!r}")
+    if ns.n <= 0:
+        p.error("--n must be positive")
+    _apply_platform(ns)
+
+    base = ReduceConfig(method=methods[0], dtype=ns.dtype, n=ns.n,
+                        kernel=ns.kernel, threads=ns.threads,
+                        max_blocks=ns.max_blocks,
+                        stream_buffers=ns.stream_buffers,
+                        iterations=ns.iterations, warmup=2,
+                        timing="chained", chain_reps=ns.chain_reps,
+                        stat="median", log_file=None)
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()   # a spot hung on a dead relay reports nothing
+    logger = BenchLogger(None, None, console=sys.stderr)
+
+    meta = {"dtype": DTYPE_ALIASES[ns.dtype], "n": ns.n,
+            "kernel": ns.kernel, "threads": ns.threads,
+            "timing": "chained", "stat": "median"}
+    live: List[dict] = []
+
+    def persist(row):
+        live.append(row)
+        if ns.out:
+            _write(ns.out, meta, live, complete=False)
+
+    rows = run_spots(base, methods, logger=logger, on_result=persist)
+    for r in rows:
+        gbps = r["gbps"]
+        print(f"{r['dtype']:>9} {r['method']:>4} n={r['n']:>10} "
+              f"{'n/a' if gbps is None or not math.isfinite(gbps or 0.0) else format(gbps, '10.2f')} GB/s "
+              f"[{r['status']}]")
+    if ns.out:
+        _write(ns.out, meta, rows, complete=True)
+        print(f"wrote {ns.out}")
+    return 0 if all(r["status"] == "PASSED" for r in rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,10 @@ class ReduceConfig:
     max_blocks: int = 64             # grid clamp (reduction.cpp:668)
     cpu_final: bool = False          # --cpufinal (reduction.cpp:328-340)
     cpu_thresh: int = 1              # --cputhresh (reduction.cpp:667)
+    stream_buffers: int = 4          # kernel-10 DMA pipeline depth (the
+                                     # one streaming knob Mosaic's
+                                     # automatic depth-2 pipeline does
+                                     # not expose; other kernels ignore)
     backend: str = "auto"
     iterations: int = 100            # timed iters (reduction.cpp:731)
     warmup: int = 1                  # warm-up launches (reduction.cpp:729)
@@ -116,6 +120,8 @@ class ReduceConfig:
             raise ValueError("n must be positive")
         if self.threads <= 0 or self.max_blocks <= 0:
             raise ValueError("threads/max_blocks must be positive")
+        if self.stream_buffers <= 0:
+            raise ValueError("stream_buffers must be positive")
         if self.timing not in ("periter", "bulk", "fetch", "chained"):
             raise ValueError(f"timing must be periter|bulk|fetch|chained, "
                              f"got {self.timing!r}")
@@ -236,6 +242,11 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
                         "0-5 WAIVED (reference emptied them)")
     p.add_argument("--maxblocks", dest="max_blocks", type=int, default=64,
                    help="Grid clamp (maxblocks analog)")
+    p.add_argument("--streambuffers", dest="stream_buffers", type=int,
+                   default=4,
+                   help="Kernel-10 async-DMA pipeline depth (default 4; "
+                        "Mosaic's automatic BlockSpec pipeline is depth "
+                        "2). Other kernels ignore this knob")
     p.add_argument("--cpufinal", dest="cpu_final", action="store_true",
                    help="Finish partial reduction on host")
     p.add_argument("--cputhresh", dest="cpu_thresh", type=int, default=1,
@@ -304,7 +315,8 @@ def parse_single_chip(argv=None):
     cfg = ReduceConfig(
         method=ns.method, dtype=ns.dtype, n=ns.n, threads=ns.threads,
         kernel=ns.kernel, max_blocks=ns.max_blocks, cpu_final=ns.cpu_final,
-        cpu_thresh=ns.cpu_thresh, backend=ns.backend,
+        cpu_thresh=ns.cpu_thresh, stream_buffers=ns.stream_buffers,
+        backend=ns.backend,
         iterations=(ns.iterations if ns.iterations is not None else 100),
         iterations_explicit=ns.iterations is not None,
         warmup=ns.warmup, seed=ns.seed,

@@ -472,9 +472,21 @@ SINGLE_INVOCATION_CALLS = {6: single_pass_call,
                            10: stream_call}
 
 
+def _single_invocation_call(kernel: int, stream_buffers: int):
+    """Registry lookup with the kernel-10 depth knob bound — the ONE
+    place the knob meets the dispatch, shared by both entry points so
+    they can never diverge on depth."""
+    call = SINGLE_INVOCATION_CALLS[kernel]
+    if kernel == 10:
+        import functools
+        call = functools.partial(call, n_buffers=stream_buffers)
+    return call
+
+
 def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
                   max_blocks: int = 64, kernel: int = 6,
                   cpu_final: bool = False, cpu_thresh: int = 1,
+                  stream_buffers: int = STREAM_BUFFERS,
                   interpret: Optional[bool] = None):
     """Reduce a flat array to a scalar with the Pallas kernels.
 
@@ -501,8 +513,8 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
     x2d = stage_padded(x, tm, p, t, op)
 
     if kernel in SINGLE_INVOCATION_CALLS:
-        acc = SINGLE_INVOCATION_CALLS[kernel](x2d, op, tm,
-                                              interpret=interpret)
+        acc = _single_invocation_call(kernel, stream_buffers)(
+            x2d, op, tm, interpret=interpret)
         if cpu_final:
             return host_finish(acc, op)
         return finish(acc, op)
@@ -522,6 +534,7 @@ def pallas_reduce(x: jax.Array, method: str, *, threads: int = 256,
 def _make_staged_parts(method: str, n: int, dtype, *, threads: int = 256,
                        max_blocks: int = 64, kernel: int = 6,
                        cpu_thresh: int = 1,
+                       stream_buffers: int = STREAM_BUFFERS,
                        interpret: Optional[bool] = None):
     """(op, stage_fn, device_fn): the staging closure and the un-jitted
     device-only partials function shared by make_staged_reduce (which
@@ -534,7 +547,7 @@ def _make_staged_parts(method: str, n: int, dtype, *, threads: int = 256,
         return stage_padded(x, tm, p, t, op)
 
     if kernel in SINGLE_INVOCATION_CALLS:
-        call = SINGLE_INVOCATION_CALLS[kernel]
+        call = _single_invocation_call(kernel, stream_buffers)
 
         def device_fn(x2d):
             return call(x2d, op, tm, interpret=interpret)
@@ -550,6 +563,7 @@ def _make_staged_parts(method: str, n: int, dtype, *, threads: int = 256,
 def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
                        max_blocks: int = 64, kernel: int = 6,
                        cpu_final: bool = False, cpu_thresh: int = 1,
+                       stream_buffers: int = STREAM_BUFFERS,
                        interpret: Optional[bool] = None):
     """Build (stage_fn, reduce_fn) for benchmarking: `stage_fn` pads/
     reshapes host data once (outside the timed loop); `reduce_fn` takes
@@ -562,7 +576,8 @@ def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
     (as --cpufinal does)."""
     op, stage_fn, device_fn = _make_staged_parts(
         method, n, dtype, threads=threads, max_blocks=max_blocks,
-        kernel=kernel, cpu_thresh=cpu_thresh, interpret=interpret)
+        kernel=kernel, cpu_thresh=cpu_thresh,
+        stream_buffers=stream_buffers, interpret=interpret)
 
     if cpu_final:
         jit_device = jax.jit(device_fn)
@@ -578,13 +593,15 @@ def make_staged_reduce(method: str, n: int, dtype, *, threads: int = 256,
 def make_staged_core(method: str, n: int, dtype, *, threads: int = 256,
                      max_blocks: int = 64, kernel: int = 6,
                      cpu_thresh: int = 1,
+                     stream_buffers: int = STREAM_BUFFERS,
                      interpret: Optional[bool] = None):
     """Build (op, stage_fn, core) with `core(x2d) -> scalar` entirely
     on-device (no host finish) — the chainable form consumed by
     ops/chain.make_chained_reduce for honest slope timing."""
     op, stage_fn, device_fn = _make_staged_parts(
         method, n, dtype, threads=threads, max_blocks=max_blocks,
-        kernel=kernel, cpu_thresh=cpu_thresh, interpret=interpret)
+        kernel=kernel, cpu_thresh=cpu_thresh,
+        stream_buffers=stream_buffers, interpret=interpret)
 
     def core(x2d):
         return finish(device_fn(x2d), op)

@@ -113,6 +113,15 @@ def start_relay_watchdog(interval_s: float = 60.0, grace: int = 3,
     return stop
 
 
+def _forced_platforms() -> str:
+    """The jax_platforms config string ('' when unforced). Reading the
+    config does NOT initialize backends, so this is safe to call while
+    the tunnel may be dead; a separate function so tests can inject the
+    unforced case without re-pointing the process's real platform."""
+    import jax
+    return jax.config.jax_platforms or ""
+
+
 def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
                       _exit=os._exit,
                       _sleep=None) -> Optional[threading.Event]:
@@ -129,6 +138,27 @@ def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
     this module prevents: confirm with a second probe, then exit with
     the watchdog code instead of proceeding unwatched."""
     import time
+
+    # Pre-JAX gate, pure sockets: on the tunneled box with an already-
+    # dead relay, jax.default_backend() itself initializes the axon
+    # plugin and hangs forever — the arming call would become the hang
+    # it exists to prevent. Probe the relay BEFORE the first jax
+    # backend touch; only a run explicitly forced off-TPU
+    # (jax_platforms set and excluding tpu, e.g. the CLIs' --platform
+    # =cpu) may proceed past a dead relay, because its device work
+    # never crosses the tunnel.
+    if tunneled_environment() and not relay_alive():
+        (_sleep or time.sleep)(2.0)
+        if not relay_alive():
+            platforms = _forced_platforms()
+            if platforms and "tpu" not in platforms:
+                return None
+            print("relay watchdog: tunneled box but the relay is "
+                  "already dead (pre-JAX probe); device discovery "
+                  "itself would hang — exiting before the first jax "
+                  "call", file=sys.stderr, flush=True)
+            _exit(WATCHDOG_EXIT_CODE)
+            return None  # unreachable except under an injected _exit
 
     import jax
 
