@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Poll the tunnel relay; the moment it answers, run the value-ordered
+# live-chip session (scripts/chip_session.sh), teeing to a session log
+# (round-2 lesson: the log enabled curve recovery after a mid-run relay
+# death — examples/tpu_run/RECOVERY.md).
+#
+# The probe demands a REAL connect (unlike watchdog.relay_alive's
+# inconclusive-counts-as-alive semantics): a watcher that fires the
+# session on an EMFILE would burn the window's first minutes failing at
+# device discovery. Untunneled hosts (no relay marker) exit immediately
+# — there is no window to await.
+#
+# Usage: bash scripts/await_window.sh [poll_seconds=20] [max_hours=11]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+POLL=${1:-20}
+MAX_HOURS=${2:-11}
+
+if [ ! -e /root/.relay.py ]; then
+    echo "await_window: untunneled host (no relay marker); nothing to await"
+    exit 0
+fi
+
+probe() {
+    # -S skips site init (~2 s in this venv); stdlib sockets only
+    python -S -c '
+import socket, sys
+for port in (8082, 8083):
+    try:
+        socket.create_connection(("127.0.0.1", port), timeout=2).close()
+        sys.exit(0)
+    except OSError:
+        continue
+sys.exit(1)'
+}
+
+deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+echo "await_window: polling relay every ${POLL}s (giving up after ${MAX_HOURS}h)"
+while true; do
+    if probe; then
+        echo "await_window: relay ALIVE at $(date -u +%FT%TZ); starting chip session"
+        bash scripts/chip_session.sh 2>&1 | tee -a chip_session_r03.log
+        rc=${PIPESTATUS[0]}
+        echo "await_window: chip session exited rc=$rc at $(date -u +%FT%TZ)"
+        exit "$rc"
+    fi
+    if [ "$(date +%s)" -ge "$deadline" ]; then
+        echo "await_window: no window opened within ${MAX_HOURS}h; giving up"
+        exit 4
+    fi
+    sleep "$POLL"
+done
