@@ -91,4 +91,10 @@ paths = generate_report(avgs, single_chip=sc, figures=figures,
                         out_dir=out, platform=jax.default_backend(),
                         calibration=cal)
 print("report:", paths["md"], paths["tex"])
+
+# 6) the compiled writeup (writeup.pdf analog; no TeX stack in this
+# image, so bench.pdf authors the PDF directly via matplotlib)
+from tpu_reductions.bench.pdf import generate_pdf
+
+print("writeup:", generate_pdf(out, platform=jax.default_backend()))
 PY

@@ -190,4 +190,10 @@ paths = generate_report({}, single_chip=sc, figures=figures,
                         calibration=cal, roofline=roof_lines,
                         annotated_rows=ann)
 print("report:", paths["md"], paths["tex"])
+
+# 6) the compiled writeup (writeup.pdf analog; no TeX stack in this
+# image, so bench.pdf authors the PDF directly via matplotlib)
+from tpu_reductions.bench.pdf import generate_pdf
+
+print("writeup:", generate_pdf(out, platform=jax.default_backend()))
 PY

@@ -295,3 +295,52 @@ def test_report_includes_roofline_section(tmp_path):
     paths2 = generate_report({}, single_chip={("INT", "SUM"): 100.0},
                              out_dir=tmp_path / "b")
     assert "## Roofline" not in paths2["md"].read_text()
+
+
+def test_pdf_writeup_compiles_from_experiment_dir(tmp_path):
+    """bench.pdf authors the compiled writeup (the reference ships
+    writeup.pdf, not just writeup.tex) straight from an experiment
+    out_dir — no TeX stack exists in this image. Uses the committed
+    cpu_demo artifacts read-only."""
+    from tpu_reductions.bench.pdf import main
+
+    out = tmp_path / "writeup.pdf"
+    rc = main(["examples/cpu_demo", f"--out={out}", "--platform=cpu"])
+    assert rc == 0
+    data = out.read_bytes()
+    assert data[:5] == b"%PDF-"
+    assert data.count(b"/Type /Page ") >= 2  # title page + >=1 figure
+
+
+def test_load_experiment_shared_by_report_and_pdf(tmp_path):
+    """report.load_experiment is the single data-assembly path for the
+    md/tex regenerator and the PDF compiler; a missing experiment dir
+    raises instead of fabricating an empty report."""
+    import pytest
+
+    from tpu_reductions.bench.report import load_experiment
+
+    data = load_experiment("examples/cpu_demo")
+    assert data["avgs"] and data["single_chip"]
+    assert any(str(f).endswith(".png") for f in data["figures"])
+    with pytest.raises(FileNotFoundError):
+        load_experiment(tmp_path / "nope")
+
+
+def test_pdf_text_page_paginates_instead_of_dropping(tmp_path):
+    """A long table must spill onto '(continued)' pages — never
+    silently eat the blocks after it (the Methodology note carries the
+    sync-trust disclaimer the whole timing story rests on)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    from matplotlib.backends.backend_pdf import PdfPages
+
+    from tpu_reductions.bench.pdf import _text_page
+
+    out = tmp_path / "p.pdf"
+    with PdfPages(str(out)) as pdf:
+        _text_page(pdf, "T",
+                   [("big table", [f"row {i}" for i in range(120)]),
+                    ("methodology", ["the disclaimer line"])])
+        n_pages = pdf.get_pagecount()
+    assert n_pages >= 2  # paginated, not clipped

@@ -1,0 +1,217 @@
+"""L5: the compiled writeup artifact — writeup.pdf, without a TeX stack.
+
+The reference ships its terminal artifact twice: the LaTeX source
+(writeup.tex:1-31) and the COMPILED writeup.pdf. bench.report covers the
+source half (report.md + compilable report.tex); this module covers the
+compiled half. No TeX toolchain exists in this image (no
+pdflatex/latexmk/tectonic), so the PDF is authored directly with
+matplotlib's PdfPages backend — a real, committed, reproducibly-built
+PDF with the measured tables, the mechanical findings, and the rendered
+bandwidth figures embedded (writeup.tex:21-28 embeds its two EPS
+figures the same way).
+
+Pages:
+  1  title, the single-chip comparison table vs the reference GPU
+     (mpi/CUdata.txt:2-8), methodology/calibration notes
+  2  roofline accounting + mechanical findings (bench.findings — the
+     writeup.tex:19 narrative, derived not written)
+  3+ one page per PNG bandwidth figure (bench.plot output)
+  (+ the collective rank-sweep table when the out_dir has one)
+
+CLI:
+    python -m tpu_reductions.bench.pdf examples/tpu_run \
+        [--out writeup.pdf] [--platform tpu]
+"""
+
+from __future__ import annotations
+
+import datetime
+import textwrap
+from pathlib import Path
+from typing import Dict, Optional, Sequence
+
+from tpu_reductions.bench.report import (REFERENCE_SINGLE_GPU,
+                                         _calibration_note,
+                                         build_coll_rows, build_sc_rows,
+                                         load_experiment)
+
+PAGE = (8.5, 11.0)   # US letter, matching the reference's article class
+MARGIN = 0.07        # figure-fraction page margin
+
+
+def _wrap(lines: Sequence[str], width: int = 88) -> list[str]:
+    out: list[str] = []
+    for ln in lines:
+        out += textwrap.wrap(ln, width=width,
+                             subsequent_indent="    ") or [""]
+    return out
+
+
+LINE_H = 0.0155      # page-fraction height of one monospace body line
+
+
+def _text_page(pdf, title: str, blocks: Sequence[tuple[str, Sequence[str]]],
+               footer: Optional[str] = None) -> None:
+    """Render (section heading, monospace lines) blocks, PAGINATING when
+    a block runs past the bottom margin — content must spill onto
+    '(continued)' pages, never be dropped silently (a long collective
+    table must not eat the Methodology note that the timing story rests
+    on)."""
+    import matplotlib.pyplot as plt
+
+    def new_fig(cont: bool):
+        fig = plt.figure(figsize=PAGE)
+        fig.text(MARGIN, 1.0 - MARGIN,
+                 f"{title} (continued)" if cont else title,
+                 fontsize=16, fontweight="bold", va="top")
+        return fig, 1.0 - MARGIN - 0.045
+
+    def flush(fig):
+        if footer:
+            fig.text(MARGIN, MARGIN / 2, footer, fontsize=7,
+                     color="0.35")
+        pdf.savefig(fig)
+        plt.close(fig)
+
+    fig, y = new_fig(cont=False)
+    for heading, lines in blocks:
+        wrapped = _wrap(lines)
+        i = 0
+        while i < len(wrapped):
+            # lines that fit above the bottom margin, after the heading
+            fit = int((y - MARGIN - 0.03) // LINE_H) - 2
+            if fit < 4 and y < 1.0 - MARGIN - 0.05:
+                flush(fig)                 # page full: continue on a
+                fig, y = new_fig(cont=True)  # fresh page, same title
+                continue
+            chunk = wrapped[i:i + max(fit, 4)]
+            fig.text(MARGIN, y,
+                     heading if i == 0 else f"{heading} (cont.)",
+                     fontsize=12, fontweight="bold", va="top")
+            y -= 0.03
+            fig.text(MARGIN, y, "\n".join(chunk), fontsize=8.2,
+                     family="monospace", va="top", linespacing=1.45)
+            y -= LINE_H * len(chunk) + 0.03
+            i += len(chunk)
+    flush(fig)
+
+
+def _figure_page(pdf, png: Path) -> None:
+    import matplotlib.image as mpimg
+    import matplotlib.pyplot as plt
+
+    fig = plt.figure(figsize=PAGE)
+    ax = fig.add_axes((MARGIN, 0.2, 1 - 2 * MARGIN, 0.62))
+    ax.imshow(mpimg.imread(str(png)))
+    ax.set_axis_off()
+    fig.text(MARGIN, 0.86, f"Figure: {png.stem}", fontsize=12,
+             fontweight="bold")
+    pdf.savefig(fig)
+    plt.close(fig)
+
+
+def _single_chip_lines(single_chip: Optional[Dict[tuple, float]],
+                       platform: str) -> list[str]:
+    """Format the SHARED row assembly (report.build_sc_rows — same
+    rows, order, and missing-cell placeholder as report.md/report.tex)
+    as monospace table lines."""
+    lines = [f"{'dtype':<8} {'op':<4} {'reference GPU':>14} "
+             f"{'this framework (' + platform + ')':>26} {'ratio':>8}"]
+    for dt, op, ref, ours in build_sc_rows(single_chip):
+        lines.append(
+            f"{dt:<8} {op:<4} {ref:>14.4f} "
+            f"{format(ours, '26.4f') if ours else '—':>26} "
+            f"{format(ours / ref, '.2f') + 'x' if ours else '—':>8}")
+    return lines
+
+
+def generate_pdf(out_dir: str | Path, pdf_path: str | Path | None = None,
+                 platform: str = "tpu") -> Path:
+    """Compile <out_dir>'s experiment data into writeup.pdf. Pure
+    analysis-side work (nothing is re-benchmarked); reuses the exact
+    data assembly of the md/tex report so the three artifacts can never
+    disagree."""
+    import matplotlib
+    matplotlib.use("Agg")
+    from matplotlib.backends.backend_pdf import PdfPages
+
+    out = Path(out_dir)
+    data = load_experiment(out)
+    pdf_path = Path(pdf_path) if pdf_path else out / "writeup.pdf"
+    date = datetime.date.today().isoformat()
+
+    with PdfPages(str(pdf_path)) as pdf:
+        blocks = [
+            ("Single-chip reductions vs the reference GPU (n=2^24)",
+             _single_chip_lines(data["single_chip"], platform)),
+        ]
+        if data["avgs"]:
+            coll = [f"{'dtype':<8} {'op':<4} {'ranks':>6} {'GB/s':>10}"]
+            coll += [f"{dt:<8} {op:<4} {ranks:>6} {gbps:>10.3f}"
+                     for dt, op, ranks, gbps
+                     in build_coll_rows(data["avgs"])]
+            blocks.append(("Collective reductions vs rank count", coll))
+        notes = ["Every single-chip number is oracle-checked (Kahan "
+                 "host reference; exact for ints and the f64 key "
+                 "paths). float64 uses the 32-bit double-double / "
+                 "order-key pair paths — wire bytes per element match "
+                 "native f64."]
+        cal_note = _calibration_note(data["calibration"]).strip("- \n")
+        if cal_note:
+            notes.append(cal_note)
+        blocks.append(("Methodology", notes))
+        _text_page(pdf, "TPU Reduction Benchmarks", blocks,
+                   footer=f"Generated {date} by tpu_reductions.bench.pdf "
+                          "(the compiled writeup.pdf analog; source twin: "
+                          "report.md / report.tex)")
+
+        second = []
+        if data["roofline"]:
+            second.append(("Roofline", list(data["roofline"])))
+        if data["annotated_rows"] or data["single_chip"]:
+            from tpu_reductions.bench.findings import derive_findings
+            finds = derive_findings(rows=data["annotated_rows"],
+                                    single_chip=data["single_chip"],
+                                    coll_avgs=data["avgs"],
+                                    reference=REFERENCE_SINGLE_GPU)
+            if finds:
+                second.append(("Findings (derived mechanically from "
+                               "the measured rows)", finds))
+        if second:
+            _text_page(pdf, "Analysis", second)
+
+        for png in [f for f in data["figures"]
+                    if str(f).endswith(".png")]:
+            _figure_page(pdf, Path(png))
+
+        meta = pdf.infodict()
+        meta["Title"] = "TPU Reduction Benchmarks"
+        meta["Subject"] = ("Generated writeup: single-chip + collective "
+                           "reduction bandwidth vs the reference")
+        meta["Creator"] = "tpu_reductions.bench.pdf"
+    return pdf_path
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.bench.pdf",
+        description="Compile an experiment out_dir into writeup.pdf "
+                    "(no TeX needed; nothing is re-benchmarked)")
+    p.add_argument("out_dir")
+    p.add_argument("--out", type=str, default=None,
+                   help="PDF path (default <out_dir>/writeup.pdf)")
+    p.add_argument("--platform", type=str, default="tpu")
+    ns = p.parse_args(argv)
+    try:
+        path = generate_pdf(ns.out_dir, pdf_path=ns.out,
+                            platform=ns.platform)
+    except FileNotFoundError as e:
+        p.error(str(e))
+    print(f"writeup: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,6 +49,25 @@ def _calibration_note(cal: Optional[dict]) -> str:
             .format(cal.get("platform", "?"), how))
 
 
+def build_sc_rows(single_chip: Optional[Dict[tuple, float]]
+                  ) -> list[tuple[str, str, float, Optional[float]]]:
+    """(dtype, op, reference_gbps, ours_gbps|None) in the canonical
+    order — the ONE single-chip row assembly shared by the md/tex
+    renderer (generate_report) and the PDF compiler (bench.pdf), so the
+    three artifacts can never disagree on rows, ordering, or missing
+    cells."""
+    return [(dt, op, ref, (single_chip or {}).get((dt, op)))
+            for (dt, op), ref in sorted(REFERENCE_SINGLE_GPU.items())]
+
+
+def build_coll_rows(avgs: Dict[Key, float]
+                    ) -> list[tuple[str, str, int, float]]:
+    """(dtype, op, ranks, gbps) in the canonical order — the shared
+    collective row assembly (same contract as build_sc_rows)."""
+    return [(dt, op, ranks, gbps)
+            for (dt, op, ranks), gbps in sorted(avgs.items())]
+
+
 def _table(rows: Sequence[Sequence[str]], header: Sequence[str]) -> str:
     out = ["| " + " | ".join(header) + " |",
            "|" + "|".join("---" for _ in header) + "|"]
@@ -89,14 +108,13 @@ def generate_report(avgs: Dict[Key, float],
                                    coll_avgs=avgs,
                                    reference=REFERENCE_SINGLE_GPU) or None
 
-    # ---- tables ----------------------------------------------------------
+    # ---- tables (rows built by the shared builders) ----------------------
     coll_rows = [(dt, op, ranks, f"{gbps:.3f}")
-                 for (dt, op, ranks), gbps in sorted(avgs.items())]
+                 for dt, op, ranks, gbps in build_coll_rows(avgs)]
     coll_tbl = _table(coll_rows, ["dtype", "op", "ranks", "GB/s"])
 
     sc_rows = []
-    for (dt, op), ref in sorted(REFERENCE_SINGLE_GPU.items()):
-        ours = (single_chip or {}).get((dt, op))
+    for dt, op, ref, ours in build_sc_rows(single_chip):
         ratio = f"{ours / ref:.2f}x" if ours else "—"
         sc_rows.append((dt, op, f"{ref:.4f}",
                         f"{ours:.4f}" if ours else "—", ratio))
@@ -214,18 +232,75 @@ def _tex_escape(s: str) -> str:
              .replace("->", "$\\rightarrow$"))
 
 
+def load_experiment(out_dir: str | Path,
+                    calibration: Optional[str] = None) -> dict:
+    """Reload everything a report needs from an experiment out_dir —
+    the analysis-side resumability of the reference's file pipeline
+    (raw_output -> collected.txt -> results/ -> writeup; SURVEY.md
+    §3.3). Returns {avgs, single_chip, calibration, figures, roofline,
+    annotated_rows}; shared by the md/tex regenerator (main) and the
+    PDF compiler (bench.pdf). Raises FileNotFoundError when the out_dir
+    holds no experiment at all."""
+    import json
+
+    from tpu_reductions.bench.aggregate import average, collect
+
+    out = Path(out_dir)
+    raw = out / "raw_output"
+    sc_raw = out / "single_chip" / "raw_output"
+    if raw.is_dir():
+        avgs = average(collect(raw))
+    elif sc_raw.is_dir():
+        # single-chip-only out dirs (run_tpu_experiment.sh on one
+        # physical chip) have no collective rank sweep — regenerate
+        # with an empty collective section rather than refusing
+        avgs = {}
+    else:
+        raise FileNotFoundError(
+            f"neither {raw} nor {sc_raw} found — run the experiment "
+            "pipeline first")
+
+    # single-chip overlay numbers from the sweep's cached cells — the
+    # same reconstruction run_experiment.sh does from live results
+    sc: dict = {}
+    if sc_raw.is_dir():
+        for f in sorted(sc_raw.glob("*.json")):
+            for line in f.read_text().splitlines():
+                if not line.strip():
+                    continue
+                r = json.loads(line)
+                if r.get("status") != "PASSED":
+                    continue
+                dt = {"int32": "INT", "float64": "DOUBLE"}.get(
+                    r["dtype"], r["dtype"].upper())
+                sc.setdefault((dt, r["method"]), []).append(r["gbps"])
+        sc = {k: sum(v) / len(v) for k, v in sc.items()}
+
+    cal_path = Path(calibration) if calibration \
+        else out / "calibration.json"
+    if calibration and not cal_path.exists():
+        raise FileNotFoundError(f"{cal_path} not found")
+    cal = json.loads(cal_path.read_text()) if cal_path.exists() else None
+
+    roof_lines = None
+    ann = None
+    roof_path = out / "roofline.json"
+    if roof_path.exists():
+        from tpu_reductions.bench.roofline import summarize
+        ann = json.loads(roof_path.read_text())
+        roof_lines = summarize(ann)
+    return {"avgs": avgs, "single_chip": sc or None, "calibration": cal,
+            "figures": sorted(out.glob("*.eps")) + sorted(out.glob("*.png")),
+            "roofline": roof_lines, "annotated_rows": ann}
+
+
 def main(argv=None) -> int:
-    """Regenerate the report offline from an experiment out_dir — the
-    analysis-side resumability the reference's file-based pipeline had
-    (raw_output -> collected.txt -> results/ -> writeup; SURVEY.md §3.3):
-    re-running the writeup never re-runs the cluster.
+    """Regenerate the report offline from an experiment out_dir — no
+    benchmarks are re-run.
 
         python -m tpu_reductions.bench.report out/ [--calibration cal.json]
     """
     import argparse
-    import json
-
-    from tpu_reductions.bench.aggregate import average, collect
 
     p = argparse.ArgumentParser(
         prog="tpu_reductions.bench.report",
@@ -241,55 +316,16 @@ def main(argv=None) -> int:
                    help="Platform label for the comparison table")
     ns = p.parse_args(argv)
 
-    out = Path(ns.out_dir)
-    raw = out / "raw_output"
-    sc_raw_probe = out / "single_chip" / "raw_output"
-    if raw.is_dir():
-        avgs = average(collect(raw))
-    elif sc_raw_probe.is_dir():
-        # single-chip-only out dirs (run_tpu_experiment.sh on one
-        # physical chip) have no collective rank sweep — regenerate
-        # with an empty collective section rather than refusing
-        avgs = {}
-    else:
-        p.error(f"neither {raw} nor {sc_raw_probe} found — run the "
-                "experiment pipeline first")
-
-    # single-chip overlay numbers from the sweep's cached cells — the
-    # same reconstruction run_experiment.sh does from live results
-    sc: dict = {}
-    sc_raw = out / "single_chip" / "raw_output"
-    if sc_raw.is_dir():
-        for f in sorted(sc_raw.glob("*.json")):
-            for line in f.read_text().splitlines():
-                if not line.strip():
-                    continue
-                r = json.loads(line)
-                if r.get("status") != "PASSED":
-                    continue
-                dt = {"int32": "INT", "float64": "DOUBLE"}.get(
-                    r["dtype"], r["dtype"].upper())
-                sc.setdefault((dt, r["method"]), []).append(r["gbps"])
-        sc = {k: sum(v) / len(v) for k, v in sc.items()}
-
-    cal_path = Path(ns.calibration) if ns.calibration \
-        else out / "calibration.json"
-    cal = json.loads(cal_path.read_text()) if cal_path.exists() else None
-    if ns.calibration and cal is None:
-        p.error(f"{cal_path} not found")
-
-    figures = sorted(out.glob("*.eps")) + sorted(out.glob("*.png"))
-    roof_lines = None
-    ann = None
-    roof_path = out / "roofline.json"
-    if roof_path.exists():
-        from tpu_reductions.bench.roofline import summarize
-        ann = json.loads(roof_path.read_text())
-        roof_lines = summarize(ann)
-    paths = generate_report(avgs, single_chip=sc or None, figures=figures,
-                            out_dir=out, platform=ns.platform,
-                            calibration=cal, roofline=roof_lines,
-                            annotated_rows=ann)
+    try:
+        data = load_experiment(ns.out_dir, calibration=ns.calibration)
+    except FileNotFoundError as e:
+        p.error(str(e))
+    paths = generate_report(data["avgs"], single_chip=data["single_chip"],
+                            figures=data["figures"], out_dir=ns.out_dir,
+                            platform=ns.platform,
+                            calibration=data["calibration"],
+                            roofline=data["roofline"],
+                            annotated_rows=data["annotated_rows"])
     print(f"report: {paths['md']} {paths['tex']}")
     return 0
 
