@@ -96,5 +96,9 @@ print("report:", paths["md"], paths["tex"])
 # image, so bench.pdf authors the PDF directly via matplotlib)
 from tpu_reductions.bench.pdf import generate_pdf
 
-print("writeup:", generate_pdf(out, platform=jax.default_backend()))
+pdf_data = {"avgs": avgs, "single_chip": sc or None, "calibration": cal,
+            "figures": list(figures), "roofline": None,
+            "annotated_rows": None}
+print("writeup:", generate_pdf(out, platform=jax.default_backend(),
+                               data=pdf_data))
 PY

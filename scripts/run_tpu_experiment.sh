@@ -192,8 +192,15 @@ paths = generate_report({}, single_chip=sc, figures=figures,
 print("report:", paths["md"], paths["tex"])
 
 # 6) the compiled writeup (writeup.pdf analog; no TeX stack in this
-# image, so bench.pdf authors the PDF directly via matplotlib)
+# image, so bench.pdf authors the PDF directly via matplotlib). The
+# IN-MEMORY data is passed through so the PDF renders exactly what
+# generate_report just rendered — never a disk re-parse (this out_dir's
+# raw_output/ holds a recovered session log, not collective rows).
 from tpu_reductions.bench.pdf import generate_pdf
 
-print("writeup:", generate_pdf(out, platform=jax.default_backend()))
+pdf_data = {"avgs": {}, "single_chip": sc or None, "calibration": cal,
+            "figures": list(figures), "roofline": roof_lines,
+            "annotated_rows": ann}
+print("writeup:", generate_pdf(out, platform=jax.default_backend(),
+                               data=pdf_data))
 PY

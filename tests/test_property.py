@@ -192,3 +192,63 @@ def test_dd_device_finish_matches_host_finish(n, method, threads,
         assert abs(dev - host) <= tol
     else:
         assert dev == host
+
+
+@settings(max_examples=25, deadline=None)
+@given(n=st.integers(min_value=64, max_value=1 << 14),
+       seed=st.integers(min_value=0, max_value=7),
+       kernel=st.sampled_from([6, 7, 8, 10]))
+def test_bf16_tolerance_model_is_sound(n, seed, kernel):
+    """The bf16 SUM acceptance band (registry.tolerance: 1e-2*n) must
+    hold for ANY benchmark payload and kernel structure, with real
+    margin: the f32-accumulator design keeps the end-to-end error at
+    bf16 INPUT-rounding scale (~2^-8 relative per element), far inside
+    the band — so an on-chip bf16 row that needs the whole band would
+    itself be suspect (VERDICT r2 item 9: pin the model off-chip)."""
+    x = host_data(n, "bfloat16", rank=0, seed=seed)
+    got = float(np.asarray(pallas_reduce(x, "SUM", kernel=kernel,
+                                         threads=64)))
+    exact = float(np.sum(np.asarray(x, dtype=np.float64)))
+    from tpu_reductions.ops.registry import tolerance
+    tol = tolerance("SUM", "bfloat16", n)
+    err = abs(got - exact)
+    assert err <= tol
+    # the margin claim: payload values are O(1) (byte/RAND_MAX scale),
+    # so input-rounding error is O(n * 2^-8 * 1) — at least 2x inside
+    # the band, not scraping it
+    assert err <= tol / 2
+
+
+def test_bf16_streams_2_bytes_and_accumulates_f32():
+    """The bf16 bandwidth claim (2 B/element on the HBM stream, ~2x
+    int32 elements/s — docs/PERF_NOTES.md hypothesis 3) rests on two
+    staging facts pinned here: the staged device array IS bf16 (2-byte
+    itemsize — the kernel reads half the bytes per element), and the
+    kernel accumulator is f32 (accum_dtype), so precision comes from
+    the accumulator, not from widening the stream."""
+    import jax.numpy as jnp
+
+    from tpu_reductions.ops.pallas_reduce import (_acc_dtype,
+                                                  choose_tiling,
+                                                  make_staged_reduce,
+                                                  stage_padded,
+                                                  sublanes_for)
+    from tpu_reductions.ops.registry import get_op
+
+    n = 1 << 12
+    op = get_op("SUM")
+    tm, p, t = choose_tiling(n, threads=64, dtype="bfloat16")
+    x2d = stage_padded(host_data(n, "bfloat16", rank=0), tm, p, t, op)
+    assert x2d.dtype == jnp.bfloat16
+    assert x2d.dtype.itemsize == 2          # the 2 B/element stream
+    assert tm % sublanes_for(jnp.bfloat16) == 0   # 16-row sublane tile
+    assert _acc_dtype(jnp.bfloat16, op) == jnp.float32
+    # and the staged benchmark path really consumes the bf16 array
+    stage_fn, reduce_fn = make_staged_reduce("SUM", n, "bfloat16",
+                                             threads=64)
+    staged = stage_fn(host_data(n, "bfloat16", rank=0))
+    assert staged.dtype == jnp.bfloat16
+    got = float(np.asarray(reduce_fn(staged)))
+    exact = float(np.sum(np.asarray(host_data(n, "bfloat16", rank=0),
+                                    dtype=np.float64)))
+    assert abs(got - exact) <= 1e-2 * n

@@ -302,10 +302,13 @@ def test_pdf_writeup_compiles_from_experiment_dir(tmp_path):
     writeup.pdf, not just writeup.tex) straight from an experiment
     out_dir — no TeX stack exists in this image. Uses the committed
     cpu_demo artifacts read-only."""
+    from pathlib import Path
+
     from tpu_reductions.bench.pdf import main
 
+    demo = Path(__file__).resolve().parent.parent / "examples/cpu_demo"
     out = tmp_path / "writeup.pdf"
-    rc = main(["examples/cpu_demo", f"--out={out}", "--platform=cpu"])
+    rc = main([str(demo), f"--out={out}", "--platform=cpu"])
     assert rc == 0
     data = out.read_bytes()
     assert data[:5] == b"%PDF-"
@@ -316,11 +319,14 @@ def test_load_experiment_shared_by_report_and_pdf(tmp_path):
     """report.load_experiment is the single data-assembly path for the
     md/tex regenerator and the PDF compiler; a missing experiment dir
     raises instead of fabricating an empty report."""
+    from pathlib import Path
+
     import pytest
 
     from tpu_reductions.bench.report import load_experiment
 
-    data = load_experiment("examples/cpu_demo")
+    demo = Path(__file__).resolve().parent.parent / "examples/cpu_demo"
+    data = load_experiment(demo)
     assert data["avgs"] and data["single_chip"]
     assert any(str(f).endswith(".png") for f in data["figures"])
     with pytest.raises(FileNotFoundError):
@@ -344,3 +350,22 @@ def test_pdf_text_page_paginates_instead_of_dropping(tmp_path):
                     ("methodology", ["the disclaimer line"])])
         n_pages = pdf.get_pagecount()
     assert n_pages >= 2  # paginated, not clipped
+
+
+def test_collect_rejects_nonnumeric_rate_rows(tmp_path):
+    """A free-form session log dropped into raw_output/ (the tpu_run
+    recovery layout) must neither fabricate collective rows nor crash
+    average() on a non-numeric 4th token — only strict
+    DATATYPE OP NODES GB/sec rows count."""
+    from tpu_reductions.bench.aggregate import average, collect
+
+    raw = tmp_path / "raw_output"
+    raw.mkdir()
+    (raw / "session.log").write_text(
+        "=== step 3 done\n"
+        "chip session step 4 failed\n"     # 4 tokens, non-digit ranks
+        "wrote tune 42 done\n"             # digit ranks, bad rate
+        "INT SUM 8 90.841\n")              # a REAL row keeps working
+    rows = collect(raw)
+    assert rows == ["INT SUM 8 90.841"]
+    assert average(rows) == {("INT", "SUM", 8): 90.841}

@@ -47,7 +47,16 @@ def collect(raw_dir: str | Path, out_file: str | Path | None = None
         else:
             for line in f.read_text().splitlines():
                 parts = line.split()
+                # the full row grammar, strictly: DATATYPE OP NODES
+                # GB/sec with integer NODES and a PARSEABLE rate. A
+                # free-form session log dropped into raw_output/ (the
+                # tpu_run recovery layout) must not fabricate rows or
+                # crash average() on float('done') at pipeline end.
                 if len(parts) == 4 and parts[2].isdigit():
+                    try:
+                        float(parts[3])
+                    except ValueError:
+                        continue
                     rows.append(line.strip())
     if out_file:
         Path(out_file).write_text("\n".join(rows) + "\n")

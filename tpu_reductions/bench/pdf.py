@@ -31,9 +31,8 @@ from pathlib import Path
 from typing import Dict, Optional, Sequence
 
 from tpu_reductions.bench.report import (REFERENCE_SINGLE_GPU,
-                                         _calibration_note,
-                                         build_coll_rows, build_sc_rows,
-                                         load_experiment)
+                                         build_coll_rows, build_notes,
+                                         build_sc_rows, load_experiment)
 
 PAGE = (8.5, 11.0)   # US letter, matching the reference's article class
 MARGIN = 0.07        # figure-fraction page margin
@@ -126,17 +125,26 @@ def _single_chip_lines(single_chip: Optional[Dict[tuple, float]],
 
 
 def generate_pdf(out_dir: str | Path, pdf_path: str | Path | None = None,
-                 platform: str = "tpu") -> Path:
+                 platform: str = "tpu",
+                 data: Optional[dict] = None) -> Path:
     """Compile <out_dir>'s experiment data into writeup.pdf. Pure
-    analysis-side work (nothing is re-benchmarked); reuses the exact
-    data assembly of the md/tex report so the three artifacts can never
-    disagree."""
+    analysis-side work (nothing is re-benchmarked); row/notes assembly
+    is shared with the md/tex report (report.build_*) so the three
+    artifacts can never disagree.
+
+    `data` (a load_experiment-shaped dict) lets a live pipeline pass
+    its IN-MEMORY results — the experiment scripts do this so the PDF
+    is built from exactly what generate_report just rendered, never
+    from a disk re-parse that could diverge (an out_dir whose
+    raw_output/ holds a recovered session log is not collective data).
+    Without it, the offline CLI path loads from disk."""
     import matplotlib
     matplotlib.use("Agg")
     from matplotlib.backends.backend_pdf import PdfPages
 
     out = Path(out_dir)
-    data = load_experiment(out)
+    if data is None:
+        data = load_experiment(out)
     pdf_path = Path(pdf_path) if pdf_path else out / "writeup.pdf"
     date = datetime.date.today().isoformat()
 
@@ -151,15 +159,7 @@ def generate_pdf(out_dir: str | Path, pdf_path: str | Path | None = None,
                      for dt, op, ranks, gbps
                      in build_coll_rows(data["avgs"])]
             blocks.append(("Collective reductions vs rank count", coll))
-        notes = ["Every single-chip number is oracle-checked (Kahan "
-                 "host reference; exact for ints and the f64 key "
-                 "paths). float64 uses the 32-bit double-double / "
-                 "order-key pair paths — wire bytes per element match "
-                 "native f64."]
-        cal_note = _calibration_note(data["calibration"]).strip("- \n")
-        if cal_note:
-            notes.append(cal_note)
-        blocks.append(("Methodology", notes))
+        blocks.append(("Methodology", build_notes(data["calibration"])))
         _text_page(pdf, "TPU Reduction Benchmarks", blocks,
                    footer=f"Generated {date} by tpu_reductions.bench.pdf "
                           "(the compiled writeup.pdf analog; source twin: "

@@ -55,7 +55,8 @@ def build_sc_rows(single_chip: Optional[Dict[tuple, float]]
     order — the ONE single-chip row assembly shared by the md/tex
     renderer (generate_report) and the PDF compiler (bench.pdf), so the
     three artifacts can never disagree on rows, ordering, or missing
-    cells."""
+    cells. Re-creates the writeup's CUDA comparison rows
+    (mpi/CUdata.txt:2-8; overlay constants makePlots.gp:17-19,31-33)."""
     return [(dt, op, ref, (single_chip or {}).get((dt, op)))
             for (dt, op), ref in sorted(REFERENCE_SINGLE_GPU.items())]
 
@@ -63,9 +64,34 @@ def build_sc_rows(single_chip: Optional[Dict[tuple, float]]
 def build_coll_rows(avgs: Dict[Key, float]
                     ) -> list[tuple[str, str, int, float]]:
     """(dtype, op, ranks, gbps) in the canonical order — the shared
-    collective row assembly (same contract as build_sc_rows)."""
+    collective row assembly (same contract as build_sc_rows).
+    Re-creates the averaged `DATATYPE OP NODES GB/sec` rows of
+    mpi/results/*.txt (getAvgs.sh:8-14)."""
     return [(dt, op, ranks, gbps)
             for (dt, op, ranks), gbps in sorted(avgs.items())]
+
+
+def build_notes(calibration: Optional[dict]) -> list[str]:
+    """The methodology notes, shared by report.md's Notes section and
+    the PDF's Methodology block (same sharing contract as the row
+    builders). Re-creates the verification story of the reference
+    driver (oracle check reduction.cpp:748-780) plus this framework's
+    f64-pair and timing-calibration notes."""
+    notes = [
+        "Verification: every single-chip number is oracle-checked "
+        "(Kahan host reference); collective numbers are checked "
+        "against an elementwise host oracle. Failed runs report 0 "
+        "and are excluded.",
+        "float64 on TPU uses the double-double / order-key 32-bit-"
+        "pair paths; wire bytes per element are identical to native "
+        "f64.",
+    ]
+    cal_note = _calibration_note(calibration).strip()
+    if cal_note.startswith("- "):
+        cal_note = cal_note[2:]
+    if cal_note:
+        notes.append(cal_note)
+    return notes
 
 
 def _table(rows: Sequence[Sequence[str]], header: Sequence[str]) -> str:
@@ -158,12 +184,8 @@ kernel path at the same n.
 
 ## Notes
 
-- Verification: every single-chip number is oracle-checked (Kahan host
-  reference); collective numbers are checked against an elementwise host
-  oracle. Failed runs report 0 and are excluded.
-- float64 on TPU uses the double-double / order-key 32-bit-pair paths;
-  wire bytes per element are identical to native f64.
-{_calibration_note(calibration)}"""
+{chr(10).join("- " + n for n in build_notes(calibration))}
+"""
     md_path = out / "report.md"
     md_path.write_text(md)
 
