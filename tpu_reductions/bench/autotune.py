@@ -76,6 +76,10 @@ FINE_GRID: Tuple[Tuple[int, ...], ...] = tuple(
 # rate calibration measured at 2^26; the gap to close).
 HBM_GRID: Tuple[Tuple[int, ...], ...] = tuple(
     [(KERNEL_SINGLE_PASS, t, 64) for t in (512, 1024, 2048)]
+    # kernel 8 skips the per-step sublane relayout entirely (pure
+    # elementwise combine into a (TM,128) accumulator) — if k6's 5-8%
+    # HBM deficit is fold latency between DMA waits, k8 shows it
+    + [(KERNEL_ELEMENTWISE, t, 64) for t in (1024, 2048)]
     + [(KERNEL_TWO_PASS, 384, mb) for mb in (64, 128)]
     + [(KERNEL_TWO_PASS, 512, 64)]
     + [(KERNEL_STREAM, t, 64, d) for t in (512, 1024)
