@@ -79,12 +79,35 @@ sweep_collective(rank_counts=ranks, n=1 << 20, retries=3, timing=timing,
 pipeline(out / "raw_output", out)
 avgs = average(collect(out / "raw_output"))
 
+# 3b) node-mode comparison sweep (the virtual_node_interesting.eps
+# analog): the same INT SUM sweep in CO mode — one rank per CHIP
+# (ccni_vn.sh:6's -mode VN|CO) — overlaid on the VN curve below. CO
+# capacity is DERIVED from the real chip granularity: per-core device
+# generations (and the CPU simulation) halve, single-device-per-chip
+# generations (v4/v5e) do not (parallel/mesh.coarsen_to_chips).
+from tpu_reductions.parallel.mesh import coarsen_to_chips
+co_capacity = len(coarsen_to_chips(jax.devices()))
+co_ranks = [k for k in ranks if k <= co_capacity]
+co_avgs = {}
+if co_ranks:
+    sweep_collective(rank_counts=co_ranks, methods=("SUM",),
+                     dtypes=("int32",), n=1 << 20, retries=3,
+                     timing=timing, mode="co", out_dir=str(out / "co"),
+                     logger=log)
+    co_avgs = average(collect(out / "co" / "raw_output"))
+
 # 4) plots (makePlots.gp analog) with single-chip overlays
 figures = []
 for dt in sorted({k[0] for k in avgs}):
     lines = {f"single-chip {op}": g for (d, op), g in sc.items() if d == dt}
     figures += plot_vs_ranks(avgs, dt, out / dt.lower(),
                              single_chip_lines=lines or None)
+if co_avgs:
+    from tpu_reductions.bench.plot import plot_vn_vs_co
+    figures += plot_vn_vs_co(
+        {"VN (every device a rank)": avgs,
+         "CO (one rank per chip)": co_avgs},
+        "INT", "SUM", out / "vn_vs_co")
 
 # 5) report (writeup.tex analog)
 paths = generate_report(avgs, single_chip=sc, figures=figures,

@@ -369,3 +369,18 @@ def test_collect_rejects_nonnumeric_rate_rows(tmp_path):
     rows = collect(raw)
     assert rows == ["INT SUM 8 90.841"]
     assert average(rows) == {("INT", "SUM", 8): 90.841}
+
+
+def test_plot_vn_vs_co_modes(tmp_path):
+    """The virtual_node_interesting.eps analog: one curve per node mode
+    for a (dtype, op); missing series skip; empty input plots nothing."""
+    from tpu_reductions.bench.plot import plot_vn_vs_co
+
+    vn = {("INT", "SUM", 2): 10.0, ("INT", "SUM", 4): 18.0}
+    co = {("INT", "SUM", 2): 12.0}
+    outs = plot_vn_vs_co({"VN": vn, "CO": co}, "INT", "SUM",
+                         tmp_path / "vn_vs_co")
+    assert sorted(p.suffix for p in outs) == [".eps", ".png"]
+    assert all(p.exists() and p.stat().st_size > 0 for p in outs)
+    assert plot_vn_vs_co({"CO": co}, "DOUBLE", "MIN",
+                         tmp_path / "none") == []

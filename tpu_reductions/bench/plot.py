@@ -20,6 +20,41 @@ from typing import Dict, Optional, Sequence
 from tpu_reductions.bench.aggregate import Key
 
 
+def _mpl():
+    """matplotlib.pyplot on the Agg backend, or None when matplotlib is
+    unavailable — callers fall back to a gnuplot/.dat artifact (module
+    docstring promise: the pipeline always produces something
+    plottable)."""
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        return plt
+    except Exception:
+        return None
+
+
+def _finish_and_save(plt, fig, ax, *, xlabel: str, title: str,
+                     out_base: Path) -> list:
+    """Shared figure grammar + emission for every plotter: the
+    makePlots.gp axes (:12-13), log2 x, legend, grid, then PNG + EPS
+    (the reference's format, makePlots.gp:1) — one copy, so styling
+    cannot drift between the three figures."""
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel("Bandwidth (GB/sec)")          # makePlots.gp:13
+    ax.set_xscale("log", base=2)
+    ax.legend()
+    ax.set_title(title)
+    ax.grid(True, alpha=0.3)
+    outs = []
+    for ext in ("png", "eps"):                   # reference emits EPS
+        p = out_base.with_suffix(f".{ext}")
+        fig.savefig(p, bbox_inches="tight")
+        outs.append(p)
+    plt.close(fig)
+    return outs
+
+
 def plot_vs_ranks(avgs: Dict[Key, float], dtype_name: str,
                   out_base: str | Path,
                   single_chip_lines: Optional[Dict[str, float]] = None,
@@ -34,11 +69,8 @@ def plot_vs_ranks(avgs: Dict[Key, float], dtype_name: str,
         if dt == dtype_name:
             series[(dt, op)].append((ranks, gbps))
     out_base = Path(out_base)
-    try:
-        import matplotlib
-        matplotlib.use("Agg")
-        import matplotlib.pyplot as plt
-    except Exception:
+    plt = _mpl()
+    if plt is None:
         return [_emit_gnuplot(series, dtype_name, out_base,
                               single_chip_lines)]
 
@@ -49,19 +81,10 @@ def plot_vs_ranks(avgs: Dict[Key, float], dtype_name: str,
     if single_chip_lines:
         for label, gbps in single_chip_lines.items():
             ax.axhline(gbps, linestyle="--", linewidth=1, label=label)
-    ax.set_xlabel("Number of Mesh Ranks")        # makePlots.gp:12 analog
-    ax.set_ylabel("Bandwidth (GB/sec)")          # makePlots.gp:13
-    ax.set_xscale("log", base=2)
-    ax.legend()
-    ax.set_title(title or f"{dtype_name} collective reduction bandwidth")
-    ax.grid(True, alpha=0.3)
-    outs = []
-    for ext in ("png", "eps"):                   # reference emits EPS
-        p = out_base.with_suffix(f".{ext}")
-        fig.savefig(p, bbox_inches="tight")
-        outs.append(p)
-    plt.close(fig)
-    return outs
+    return _finish_and_save(
+        plt, fig, ax, xlabel="Number of Mesh Ranks",  # makePlots.gp:12
+        title=title or f"{dtype_name} collective reduction bandwidth",
+        out_base=out_base)
 
 
 def plot_vs_n(shmoo_rows: Sequence[dict], out_base: str | Path,
@@ -77,11 +100,8 @@ def plot_vs_n(shmoo_rows: Sequence[dict], out_base: str | Path,
     (f(x)=90.8413, makePlots.gp:17-19), used here for the reference
     baseline and the chip's HBM roofline."""
     out_base = Path(out_base)
-    try:
-        import matplotlib
-        matplotlib.use("Agg")
-        import matplotlib.pyplot as plt
-    except Exception:
+    plt = _mpl()
+    if plt is None:
         lines = [f"{r['dtype']} {r['method']} {r['n']} {r['gbps']:.3f}"
                  for r in shmoo_rows]
         lines += [f"# hline {label} {v:.3f}"
@@ -104,19 +124,8 @@ def plot_vs_n(shmoo_rows: Sequence[dict], out_base: str | Path,
         ax.annotate(label, xy=(1, v), xycoords=("axes fraction", "data"),
                     xytext=(-4, 3), textcoords="offset points",
                     ha="right", fontsize=8)
-    ax.set_xlabel("Elements (N)")
-    ax.set_ylabel("Bandwidth (GB/sec)")
-    ax.set_xscale("log", base=2)
-    ax.legend()
-    ax.set_title(title)
-    ax.grid(True, alpha=0.3)
-    outs = []
-    for ext in ("png", "eps"):
-        p = out_base.with_suffix(f".{ext}")
-        fig.savefig(p, bbox_inches="tight")
-        outs.append(p)
-    plt.close(fig)
-    return outs
+    return _finish_and_save(plt, fig, ax, xlabel="Elements (N)",
+                            title=title, out_base=out_base)
 
 
 def _emit_gnuplot(series, dtype_name, out_base: Path,
@@ -141,3 +150,43 @@ def _emit_gnuplot(series, dtype_name, out_base: Path,
     path = out_base.with_suffix(".gp")
     path.write_text("\n".join(gp) + "\n")
     return path
+
+
+def plot_vn_vs_co(avgs_by_mode: Dict[str, Dict[Key, float]],
+                  dtype_name: str, method: str, out_base: str | Path,
+                  title: Optional[str] = None) -> Sequence[Path]:
+    """The virtual_node_interesting.eps analog: one (dtype, op) curve
+    per node mode — VN (every addressable device is a rank) vs CO (one
+    rank per chip) — the BG/L node-mode comparison the reference
+    collected as stdout-vn-* vs stdout-co-* raw files
+    (mpi/vn_co_collected.txt; modes set in ccni_vn.sh:6).
+
+    avgs_by_mode: {mode_label: aggregate.average() dict}. Modes missing
+    the requested (dtype, method) series are skipped; returns [] when
+    nothing can be plotted (e.g. too few devices for a CO sweep)."""
+    series = {}
+    for label, avgs in avgs_by_mode.items():
+        pts = [(ranks, gbps) for (dt, op, ranks), gbps
+               in sorted(avgs.items())
+               if dt == dtype_name and op == method]
+        if pts:
+            series[label] = pts
+    if not series:
+        return []
+    out_base = Path(out_base)
+    plt = _mpl()
+    if plt is None:
+        lines = [f"# {label}\n" + "\n".join(f"{r} {g}" for r, g in pts)
+                 for label, pts in sorted(series.items())]
+        p = out_base.with_suffix(".dat")
+        p.write_text("\n\n".join(lines) + "\n")
+        return [p]
+
+    fig, ax = plt.subplots(figsize=(7, 5))
+    for label, pts in sorted(series.items()):
+        xs, ys = zip(*pts)
+        ax.plot(xs, ys, marker="o", label=label)
+    return _finish_and_save(
+        plt, fig, ax, xlabel="Number of Mesh Ranks",
+        title=title or f"{dtype_name} {method}: VN vs CO node mode",
+        out_base=out_base)
