@@ -162,7 +162,8 @@ step "hbm regime race 2^27" tune_hbm27.json -- \
 # rc accumulates across the two probes: a crash of the first must not
 # be masked by a clean second (the same masking the pipefail note above
 # guards against, at the command level)
-step "int op parity probe" int_op_spot_k7.json int_op_spot_k6.json -- \
+step "int op parity probe" \
+        int_op_spot_k7.json int_op_spot_k6.json int_op_spot_xla.json -- \
     bash -c 'rc=0; \
              python -m tpu_reductions.bench.spot --type=int \
                  --methods=SUM,MIN,MAX --n=16777216 --kernel=7 \
@@ -172,6 +173,10 @@ step "int op parity probe" int_op_spot_k7.json int_op_spot_k6.json -- \
                  --methods=SUM,MIN,MAX --n=16777216 --kernel=6 \
                  --threads=512 --iterations=256 --chainreps=5 \
                  --out=int_op_spot_k6.json || rc=$?; \
+             python -m tpu_reductions.bench.spot --type=int \
+                 --methods=SUM,MIN,MAX --n=16777216 --backend=xla \
+                 --iterations=256 --chainreps=5 \
+                 --out=int_op_spot_xla.json || rc=$?; \
              exit $rc'
 
 # kernel 9 (MXU) has never lowered on-chip; rank it against the VPU

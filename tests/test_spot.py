@@ -70,3 +70,16 @@ def test_spot_cli_validates_methods():
     import pytest
     with pytest.raises(SystemExit):
         main(["--methods=SUM,NOPE", "--n=64"])
+
+
+def test_spot_cli_xla_backend(tmp_path):
+    """--backend=xla: the comparator at the same spot discipline (the
+    'is the MIN deficit ours or the VPU's' instrument)."""
+    out = tmp_path / "x.json"
+    rc = main(["--type=int", "--methods=SUM,MIN", "--n=16384",
+               "--iterations=8", "--chainreps=2", "--backend=xla",
+               f"--out={out}"])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert all(r["backend"] == "xla" for r in data["rows"])
+    assert all(r["status"] == "PASSED" for r in data["rows"])

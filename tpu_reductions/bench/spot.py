@@ -102,6 +102,12 @@ def main(argv=None) -> int:
     p.add_argument("--maxblocks", dest="max_blocks", type=int, default=64)
     p.add_argument("--streambuffers", dest="stream_buffers", type=int,
                    default=4)
+    p.add_argument("--backend", type=str, default="auto",
+                   choices=("auto", "pallas", "xla"),
+                   help="Kernel backend; xla = the always-correct "
+                        "comparator at the same discipline (useful for "
+                        "op-parity questions: is a MIN deficit ours or "
+                        "the VPU's?)")
     p.add_argument("--iterations", type=int, default=256,
                    help="Chained span (k_hi = 1 + iterations)")
     p.add_argument("--chainreps", dest="chain_reps", type=int, default=7)
@@ -119,6 +125,7 @@ def main(argv=None) -> int:
     _apply_platform(ns)
 
     base = ReduceConfig(method=methods[0], dtype=ns.dtype, n=ns.n,
+                        backend=ns.backend,
                         kernel=ns.kernel, threads=ns.threads,
                         max_blocks=ns.max_blocks,
                         stream_buffers=ns.stream_buffers,
