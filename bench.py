@@ -93,6 +93,8 @@ def _write_snapshot(payload: dict, per_candidate: dict) -> None:
     bench run that produced the value."""
     import datetime
     import os
+
+    from tpu_reductions.utils.jsonio import atomic_json_dump
     snap = {**payload,
             "captured": datetime.datetime.now(datetime.timezone.utc)
                         .strftime("%Y-%m-%dT%H:%M:%SZ (fresh bench.py run)"),
@@ -102,9 +104,7 @@ def _write_snapshot(payload: dict, per_candidate: dict) -> None:
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         SNAPSHOT_BASENAME)
     try:
-        with open(path + ".tmp", "w") as f:
-            json.dump(snap, f, indent=1)
-        os.replace(path + ".tmp", path)
+        atomic_json_dump(path, snap)
     except OSError as e:
         print(f"# snapshot write failed (non-fatal): {e}",
               file=sys.stderr)
@@ -223,6 +223,8 @@ def main(argv=None) -> int:
         # host-speed number) and on the headline n (a --n smoke run is
         # not the flagship metric).
         import math
+        # (math stays local: bench.py's import-light preamble is what
+        # lets the device probe run before any heavy import)
         _write_snapshot(payload, {
             f"{cfg.backend} k{cfg.kernel} threads={cfg.threads}":
                 # crash/WAIVE rows carry nan gbps: serialize null, not

@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import math
 import sys
 from typing import List, Optional, Sequence, Tuple
 
@@ -178,7 +179,6 @@ def _row(cfg: ReduceConfig, res: BenchResult) -> dict:
     Non-finite gbps (a fetch-mode avg_s <= 0 reports inf; crashed rows
     carry nan) serializes as null — json.dump's Infinity/NaN literals
     are not RFC-8259 JSON and break strict parsers."""
-    import math
     xla = cfg.backend == "xla"
     row = {"backend": cfg.backend,
            "kernel": None if xla else cfg.kernel,
@@ -194,17 +194,12 @@ def _row(cfg: ReduceConfig, res: BenchResult) -> dict:
 
 def _write_out(path: str, meta: dict, rows: List[dict], *,
                best, complete: bool) -> None:
-    """Atomic dump of the race state via temp+rename (the sweep cache's
-    pattern, sweep.py): the relay watchdog can os._exit at ANY instant,
-    and an in-place truncating write it interrupts would destroy the
-    previously persisted candidates — the exact loss the
-    `complete=False` mid-race snapshots exist to prevent."""
-    import os
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
-        json.dump({**meta, "complete": complete, "best": best,
-                   "ranked": rows}, f, indent=1)
-    os.replace(tmp, path)
+    """Atomic dump of the race state (utils/jsonio.py — the relay
+    watchdog can os._exit at ANY instant; `complete=False` marks
+    mid-race snapshots)."""
+    from tpu_reductions.utils.jsonio import atomic_json_dump
+    atomic_json_dump(path, {**meta, "complete": complete, "best": best,
+                            "ranked": rows})
 
 
 def main(argv=None) -> int:

@@ -18,6 +18,7 @@ reference's `+=` bug (reduction.cpp:426-429,516-521; SURVEY.md §2.2).
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 import sys
 from typing import Optional
@@ -68,7 +69,6 @@ class BenchResult:
         # gbps when a fetch-mode avg_s <= 0) must serialize as null:
         # json.dump would emit NaN/Infinity literals, which are not
         # RFC-8259 JSON and break strict parsers of sweep/shmoo files
-        import math
         for k, v in d.items():
             if isinstance(v, float) and not math.isfinite(v):
                 d[k] = None

@@ -76,14 +76,10 @@ def run_spots(base: ReduceConfig, methods: List[str],
 
 
 def _write(path: str, meta: dict, rows: List[dict], complete: bool) -> None:
-    """Atomic temp+rename dump (the autotune/sweep pattern): a watchdog
-    os._exit mid-write must never destroy already-persisted rows."""
-    import os
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
-        json.dump({**meta, "complete": complete, "rows": rows}, f,
-                  indent=1)
-    os.replace(tmp, path)
+    """Atomic dump (utils/jsonio.py): a watchdog os._exit mid-write
+    must never destroy already-persisted rows."""
+    from tpu_reductions.utils.jsonio import atomic_json_dump
+    atomic_json_dump(path, {**meta, "complete": complete, "rows": rows})
 
 
 def main(argv=None) -> int:

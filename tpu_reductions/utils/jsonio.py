@@ -1,0 +1,26 @@
+"""Atomic JSON artifact writes, shared by every persisting tool.
+
+The races, spots, and the bench snapshot all persist mid-run artifacts
+that a relay-watchdog os._exit (utils/watchdog.py) can interrupt at ANY
+instant; an in-place truncating write would destroy the rows persisted
+so far — the exact loss the mid-run snapshots exist to prevent. One
+temp+rename helper instead of a per-module copy (the cutil pattern of
+one shared error-checked write path, cutil_inline_runtime.h:34-44, at
+the file layer)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def atomic_json_dump(path: str | os.PathLike, obj, *, indent: int = 1
+                     ) -> None:
+    """Serialize `obj` to `path` via temp file + os.replace (atomic on
+    POSIX): readers see either the previous complete artifact or the
+    new one, never a truncation."""
+    path = os.fspath(path)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent)
+    os.replace(tmp, path)
