@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Collate a live-chip session's JSON artifacts into one markdown
+summary — the post-window bookkeeping (BASELINE.md "Measured TPU
+results" refresh, PERF_NOTES hypothesis verdicts) reduced to a read.
+
+Purely offline: reads the artifacts `scripts/chip_session.sh` commits
+(BENCH_live/snapshot, double_spot, tune_hbm*, int_op_spot_*,
+tune_mxu_*, tune_fine, examples/tpu_run averages) and prints what
+landed, what PASSED, and how each row compares to the reference
+scoreboard (mpi/CUdata.txt:2-8). Missing artifacts print as absent —
+a half-window is summarized honestly, not padded.
+
+Usage: python scripts/summarize_window.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REF = {("DOUBLE", "SUM"): 92.7729, ("DOUBLE", "MIN"): 92.6014,
+       ("DOUBLE", "MAX"): 92.7552, ("INT", "SUM"): 90.8413,
+       ("INT", "MIN"): 90.7905, ("INT", "MAX"): 90.7969}
+V5E_ROOF = 819.0
+
+
+def _load(path: Path):
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt_gbps(g):
+    return "n/a" if g is None else f"{g:.1f}"
+
+
+def _spot_lines(data, ref_dtype) -> list[str]:
+    out = []
+    for r in data.get("rows", []):
+        ref = REF.get((ref_dtype, r["method"]))
+        ratio = (f" = {r['gbps'] / ref:.1f}x ref" if ref and r.get("gbps")
+                 else "")
+        out.append(f"  {ref_dtype} {r['method']:>4} "
+                   f"k{r.get('kernel')}/{r.get('threads')}: "
+                   f"{_fmt_gbps(r.get('gbps'))} GB/s "
+                   f"[{r['status']}]{ratio}")
+    if not data.get("complete", True):
+        out.append("  (artifact INCOMPLETE — session died mid-step)")
+    return out
+
+
+def _race_lines(data, label) -> list[str]:
+    rows = data.get("ranked", [])
+    out = []
+    xla = next((r for r in rows if r.get("backend") == "xla"), None)
+    for r in rows[:5]:
+        depth = (f" depth={r['stream_buffers']}"
+                 if r.get("stream_buffers") is not None else "")
+        geom = ("(xla)" if r.get("backend") == "xla"
+                else f"k{r.get('kernel')}/{r.get('threads')}{depth}")
+        frac = (f" = {r['gbps'] / V5E_ROOF:.0%} roof"
+                if r.get("gbps") and "hbm" in label else "")
+        out.append(f"  {geom:>18}: {_fmt_gbps(r.get('gbps'))} GB/s "
+                   f"[{r['status']}]{frac}")
+    best = data.get("best")
+    if best and xla and best.get("gbps") and xla.get("gbps"):
+        rel = best["gbps"] / xla["gbps"]
+        out.append(f"  best pallas vs XLA comparator: {rel:.2f}x "
+                   f"({'WIN' if rel >= 1 else 'LOSS'})")
+    if not data.get("complete", True):
+        out.append("  (artifact INCOMPLETE — race died mid-run)")
+    return out
+
+
+def main(argv=None) -> int:
+    root = Path((argv or sys.argv[1:] or ["."])[0])
+    sections = []
+
+    bench = _load(root / "BENCH_live.json") or _load(
+        root / "BENCH_snapshot.json")
+    if bench:
+        stale = " (STALE snapshot fallback)" if bench.get("stale") else ""
+        sections.append(
+            ["## Headline",
+             f"  {bench['metric']}: {bench['value']} {bench['unit']} "
+             f"= {bench.get('vs_baseline')}x reference{stale}"])
+
+    for name, dtype, title in (("double_spot.json", "DOUBLE",
+                                "## DOUBLE scoreboard (VERDICT item 1)"),
+                               ("int_op_spot_k7.json", "INT",
+                                "## int op parity k7/384 (item 5)"),
+                               ("int_op_spot_k6.json", "INT",
+                                "## int op parity k6/512"),
+                               ("int_op_spot_xla.json", "INT",
+                                "## int op parity XLA comparator")):
+        d = _load(root / name)
+        if d:
+            sections.append([title] + _spot_lines(d, dtype))
+
+    for name, title in (("tune_hbm.json", "## hbm race 2^26 (item 2)"),
+                        ("tune_hbm27.json", "## hbm race 2^27"),
+                        ("tune_mxu_f32.json", "## MXU race f32 2^24 (item 6)"),
+                        ("tune_mxu_f32_hbm.json", "## MXU race f32 2^26"),
+                        ("tune_mxu_bf16.json", "## MXU race bf16 2^24"),
+                        ("tune_fine.json", "## fine race 7-rep (item 7)")):
+        d = _load(root / name)
+        if d:
+            sections.append([title] + _race_lines(d, title))
+
+    avgs = _load(root / "examples/tpu_run/single_chip/averages.json")
+    if avgs:
+        lines = ["## flagship grid averages (examples/tpu_run)"]
+        for key, gbps in sorted(avgs.items()):
+            dt, op = key.split()
+            ref = REF.get((dt, op))
+            ratio = f" = {gbps / ref:.1f}x ref" if ref else ""
+            lines.append(f"  {key}: {gbps:.1f} GB/s{ratio}")
+        sections.append(lines)
+
+    cal = _load(root / "calibration_live.json")
+    if cal:
+        # --ladder output: the verdict comes from the deciding rung
+        # (utils/calibrate.py); a plain calibration carries honest_gbps
+        # at top level
+        hg = cal.get("honest_gbps")
+        if hg is None:
+            deciding = cal.get("deciding_n")
+            for rung in cal.get("rungs", []):
+                if rung.get("n") == deciding or hg is None:
+                    hg = rung.get("honest_gbps", hg)
+        sections.append(
+            ["## calibration",
+             f"  block_awaits_execution="
+             f"{cal.get('block_awaits_execution', '?')} "
+             f"honest_gbps={_fmt_gbps(hg)}"])
+
+    if not sections:
+        print("no window artifacts found under", root)
+        return 1
+    for s in sections:
+        print("\n".join(s))
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -384,3 +384,42 @@ def test_plot_vn_vs_co_modes(tmp_path):
     assert all(p.exists() and p.stat().st_size > 0 for p in outs)
     assert plot_vn_vs_co({"CO": co}, "DOUBLE", "MIN",
                          tmp_path / "none") == []
+
+
+def test_summarize_window_collates_artifacts(tmp_path):
+    """scripts/summarize_window.py: the post-window bookkeeping read —
+    collates whatever artifacts landed, flags incomplete ones, and
+    reports absence honestly (exit 1 on an empty dir)."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = (Path(__file__).resolve().parent.parent
+              / "scripts/summarize_window.py")
+    r = subprocess.run([sys.executable, str(script), str(tmp_path)],
+                       capture_output=True, text=True)
+    assert r.returncode == 1 and "no window artifacts" in r.stdout
+
+    (tmp_path / "BENCH_live.json").write_text(json.dumps(
+        {"metric": "m", "value": 6497.2, "unit": "GB/s",
+         "vs_baseline": 71.5}))
+    (tmp_path / "double_spot.json").write_text(json.dumps(
+        {"complete": False, "rows": [
+            {"method": "SUM", "kernel": 6, "threads": 512,
+             "gbps": 700.0, "status": "PASSED"}]}))
+    (tmp_path / "tune_hbm.json").write_text(json.dumps(
+        {"complete": True,
+         "best": {"backend": "pallas", "gbps": 800.0},
+         "ranked": [
+             {"backend": "pallas", "kernel": 10, "threads": 512,
+              "stream_buffers": 8, "gbps": 800.0, "status": "PASSED"},
+             {"backend": "xla", "kernel": None, "threads": None,
+              "gbps": 779.0, "status": "PASSED"}]}))
+    r = subprocess.run([sys.executable, str(script), str(tmp_path)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0
+    assert "7.5x ref" in r.stdout            # 700 / 92.77 DOUBLE SUM
+    assert "INCOMPLETE" in r.stdout          # the dead-mid-step flag
+    assert "depth=8" in r.stdout             # k10 depth in the ranking
+    assert "1.03x (WIN)" in r.stdout         # pallas vs XLA comparator
