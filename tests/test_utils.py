@@ -162,3 +162,18 @@ def test_calibrate_ladder_cli_json_shape(capsys):
     assert d["deciding_n"] == d["rungs"][-1]["n"] == 65536 * 4
     assert d["block_awaits_execution"] == \
         d["rungs"][-1]["block_awaits_execution"]
+
+
+def test_atomic_json_dump_replaces_never_truncates(tmp_path):
+    """utils/jsonio: readers see the old artifact or the new one, never
+    a truncation — the contract every mid-run persister relies on."""
+    import json
+
+    from tpu_reductions.utils.jsonio import atomic_json_dump
+
+    p = tmp_path / "a.json"
+    atomic_json_dump(p, {"v": 1})
+    assert json.loads(p.read_text()) == {"v": 1}
+    atomic_json_dump(p, {"v": 2, "rows": [1, 2, 3]})
+    assert json.loads(p.read_text())["v"] == 2
+    assert not (tmp_path / "a.json.tmp").exists()  # temp cleaned up
