@@ -28,6 +28,8 @@ from tpu_reductions.utils.logging import BenchLogger
 
 
 def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
+              skip_ns: Optional[set] = None,
+              on_result=None,
               logger: Optional[BenchLogger] = None) -> List[BenchResult]:
     """Size sweep 2^min_pow..2^max_pow for cfg's (method, dtype).
 
@@ -35,11 +37,28 @@ def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
     dead code) with fewer, denser points and the same per-size
     benchmark+verify discipline. Iteration count shrinks for huge sizes to
     keep wall time bounded, like the SDK's testIterations scaling.
+
+    `skip_ns`: sizes to omit entirely (cross-window resume: the caller
+    already holds verified rows for them). `on_result(cfg, result)`
+    fires as each cell completes. In chained mode cells run (and can
+    therefore PERSIST) one at a time with per-cell crash containment —
+    chained timing is regime-immune, so per-cell runs measure
+    identically to a batch, and a curve that dies at cell k keeps cells
+    1..k-1 (round 2 lost a whole in-memory curve to a mid-batch relay
+    death and had to recover it from logs —
+    examples/tpu_run/RECOVERY.md). Legacy timing modes keep the batch
+    path: their comparability NEEDS the shared pre-fetch sync regime,
+    so their on_result only fires at batch finalize
+    (driver.run_benchmark_batch).
     """
     logger = logger or BenchLogger(cfg.log_file, cfg.master_log)
     cfgs = []
     for p in range(min_pow, max_pow + 1):
         n = 1 << p
+        if skip_ns and n in skip_ns:
+            logger.log(f"shmoo n={n}: skipped (caller holds a verified "
+                       "row — cross-window resume)")
+            continue
         if cfg.timing == "chained":
             # iterations IS the slope span in chained mode: size it per
             # payload (enough signal to clear tunnel jitter at small N,
@@ -57,13 +76,37 @@ def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
         else:
             iters = max(3, min(cfg.iterations, (1 << 28) // n))
         cfgs.append(dataclasses.replace(cfg, n=n, iterations=iters))
-    # batch: legacy timing modes are timed before any result is
-    # materialized so every size runs in the same sync regime; chained
-    # configs are regime-immune (driver.run_benchmark_batch)
-    results = run_benchmark_batch(cfgs, logger=logger)
-    for sub, res in zip(cfgs, results):
+
+    def log_row(sub, res):
         logger.log(f"shmoo {cfg.method} {cfg.dtype} n={sub.n} "
                    f"-> {res.gbps:.4f} GB/s [{res.status.name}]")
+
+    # key on the RESOLVED discipline, never the ask (driver.py
+    # resolved_timing): a chained request that falls back to fetch
+    # (--cpufinal) is regime-SENSITIVE and must keep the shared-batch
+    # sync regime below
+    if resolved_timing(cfg) == "chained":
+        from tpu_reductions.bench.driver import crash_result, run_benchmark
+        results = []
+        for sub in cfgs:
+            try:
+                res = run_benchmark(sub, logger=logger)
+            except Exception as e:
+                # one size that cannot stage/compile (e.g. the 4 GiB
+                # hazard cell) must not take the measured cells with it
+                res = crash_result(sub, e, logger)
+            log_row(sub, res)
+            if on_result is not None:
+                on_result(sub, res)
+            results.append(res)
+        return results
+
+    # batch: legacy timing modes are timed before any result is
+    # materialized so every size runs in the same sync regime
+    results = run_benchmark_batch(cfgs, logger=logger,
+                                  on_result=on_result)
+    for sub, res in zip(cfgs, results):
+        log_row(sub, res)
     return results
 
 
