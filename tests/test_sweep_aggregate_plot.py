@@ -461,3 +461,41 @@ def test_run_shmoo_chained_per_cell_persistence_and_skip():
     # payloads); what matters is the crash never spread
     assert by_n[1 << 10].status.name in ("PASSED", "WAIVED")
     assert by_n[1 << 12].status.name in ("PASSED", "WAIVED")
+
+
+def test_sweep_all_chained_caches_cells_before_a_late_crash(tmp_path):
+    """Chained sweep cells run one at a time: cells completed BEFORE a
+    crashing cell are already cached on disk (a mid-grid relay death
+    keeps them), and the crash lands as a contained FAILED row."""
+    from unittest import mock
+
+    from tpu_reductions.bench import driver as drv
+    from tpu_reductions.bench.sweep import sweep_all
+
+    real = drv.run_benchmark
+    calls = []
+    raws_at_crash = []
+
+    def sabotage(cfg, **kw):
+        calls.append(cfg.method)
+        if cfg.method == "MAX":
+            raws_at_crash.append(
+                len(list((tmp_path / "raw_output").glob("*.json"))))
+            raise RuntimeError("synthetic mid-grid death")
+        return real(cfg, **kw)
+
+    with mock.patch.object(drv, "run_benchmark", sabotage):
+        rows = sweep_all(methods=("SUM", "MIN", "MAX"),
+                         dtypes=("int32",), n=4096, repeats=1,
+                         iterations=4, timing="chained", chain_reps=2,
+                         out_dir=str(tmp_path),
+                         logger=BenchLogger(None, None))
+    assert calls == ["SUM", "MIN", "MAX"]
+    by = {r["method"]: r for r in rows}
+    assert by["MAX"]["status"] == "FAILED"
+    assert by["SUM"]["status"] in ("PASSED", "WAIVED")
+    # the per-cell contract: every cell that PASSED before the crash
+    # was ALREADY cached when the crash hit (only PASSED rows cache)
+    passed_before = sum(by[m]["status"] == "PASSED"
+                        for m in ("SUM", "MIN"))
+    assert raws_at_crash == [passed_before]

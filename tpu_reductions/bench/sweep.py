@@ -86,20 +86,7 @@ def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
     # (--cpufinal) is regime-SENSITIVE and must keep the shared-batch
     # sync regime below
     if resolved_timing(cfg) == "chained":
-        from tpu_reductions.bench.driver import crash_result, run_benchmark
-        results = []
-        for sub in cfgs:
-            try:
-                res = run_benchmark(sub, logger=logger)
-            except Exception as e:
-                # one size that cannot stage/compile (e.g. the 4 GiB
-                # hazard cell) must not take the measured cells with it
-                res = crash_result(sub, e, logger)
-            log_row(sub, res)
-            if on_result is not None:
-                on_result(sub, res)
-            results.append(res)
-        return results
+        return _run_cells(cfgs, logger, on_result, log_row=log_row)
 
     # batch: legacy timing modes are timed before any result is
     # materialized so every size runs in the same sync regime
@@ -107,6 +94,32 @@ def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
                                   on_result=on_result)
     for sub, res in zip(cfgs, results):
         log_row(sub, res)
+    return results
+
+
+def _run_cells(cfgs, logger, on_result, log_row=None):
+    """One cell at a time with per-cell crash containment — the
+    discipline for CHAINED grids (chained timing is regime-immune, so
+    per-cell runs measure identically to a batch; driver.
+    run_benchmark_batch docstring). One cell that cannot stage/compile
+    (e.g. a 4 GiB hazard cell, a Mosaic lowering gap) becomes a FAILED
+    row instead of taking the completed cells with it, and on_result
+    fires — and can therefore PERSIST — after every cell, so a
+    mid-grid relay death keeps cells 1..k-1 (the round-2 loss mode,
+    examples/tpu_run/RECOVERY.md). Shared by run_shmoo and sweep_all;
+    regime-SENSITIVE legacy disciplines must keep their shared batch."""
+    from tpu_reductions.bench.driver import crash_result, run_benchmark
+    results = []
+    for sub in cfgs:
+        try:
+            res = run_benchmark(sub, logger=logger)
+        except Exception as e:
+            res = crash_result(sub, e, logger)
+        if log_row is not None:
+            log_row(sub, res)
+        if on_result is not None:
+            on_result(sub, res)
+        results.append(res)
     return results
 
 
@@ -194,10 +207,14 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
     honest extent of checkpoint/resume in this framework (and one step
     beyond the reference, where only the offline *analysis* was resumable
     via its accumulated files — SURVEY.md §5 "checkpoint/resume").
-    Cache files land during the finalize phase, after ALL cells have been
-    timed (the deferral keeps every legacy-mode cell in the same
-    pre-fetch sync regime — driver.run_benchmark_batch); an interrupt
-    during timing re-measures the un-cached cells on the next run."""
+    Cache-file timing depends on the resolved discipline: an all-chained
+    grid runs AND caches one cell at a time (_run_cells — chained timing
+    is regime-immune, so a mid-grid death keeps every completed cell);
+    legacy disciplines time the whole queue before materializing
+    anything (the deferral keeps every cell in the same pre-fetch sync
+    regime — driver.run_benchmark_batch), so their cache files land only
+    at finalize and an interrupt during timing re-measures the un-cached
+    cells on the next run."""
     logger = logger or BenchLogger(None, None)
     raw_dir = Path(out_dir) / "raw_output" if out_dir else None
     if raw_dir:
@@ -281,6 +298,11 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
             tmp.write_text(json.dumps(row) + "\n")
             tmp.replace(fname)
 
-    run_benchmark_batch([cfg for _, _, _, cfg in queued], logger=logger,
-                        on_result=on_result)
+    queued_cfgs = [cfg for _, _, _, cfg in queued]
+    if queued_cfgs and all(resolved_timing(c) == "chained"
+                           for c in queued_cfgs):
+        _run_cells(queued_cfgs, logger, on_result)
+    else:
+        run_benchmark_batch(queued_cfgs, logger=logger,
+                            on_result=on_result)
     return rows
