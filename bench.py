@@ -214,8 +214,11 @@ def main(argv=None) -> int:
         "vs_baseline": round(value / BASELINE_GBPS, 4),
     }
     import jax
-    if (passed and jax.default_backend() == "tpu"
-            and ns.n == 1 << 24):
+    # the flagship run: fresh verified value, real chip, headline n —
+    # the one gate both the snapshot and the doubles scoreboard key on
+    flagship = (bool(passed) and jax.default_backend() == "tpu"
+                and ns.n == 1 << 24)
+    if flagship:
         # fresh verified on-chip value AT THE FLAGSHIP CONFIG: snapshot
         # it immediately, so a later outage in the same round reports
         # THIS measurement. Gated on the actual backend (not the flag —
@@ -234,8 +237,67 @@ def main(argv=None) -> int:
                           if math.isfinite(res.gbps) else None),
                  "status": res.status.name}
             for cfg, res in zip(cfgs, results)})
-    print(json.dumps(payload))
+    print(json.dumps(payload), flush=True)
+    if flagship:
+        # Opportunistic DOUBLE scoreboard (round-2 VERDICT item 1, the
+        # round's #1 gap): the driver's end-of-round bench.py may be
+        # the ONLY chip contact a round gets, so capture f64
+        # SUM/MIN/MAX here too — AFTER the headline line is printed
+        # and flushed (the one-JSON-line stdout contract is already
+        # satisfied; everything below is stderr + artifact files), and
+        # strictly best-effort: a doubles failure can neither change
+        # the exit code nor un-print the headline. BENCH_DOUBLES=0
+        # skips it (a window that wants the fastest possible bench).
+        _maybe_double_spots()
     return 0 if passed else 1
+
+
+def _maybe_double_spots(n: int = 1 << 24, iterations: int = 128,
+                        reps: int = 3, path: str | None = None) -> None:
+    """Best-effort f64 SUM/MIN/MAX chained spots at the flagship n ->
+    BENCH_doubles.json next to this file. All-device dd path (pair-tree
+    finish), oracle-verified, median of `reps` slope reps — the rows
+    that must beat the reference's own headline doubles
+    (92.7729/92.6014/92.7552 GB/s, mpi/CUdata.txt:2-4). The size/path
+    parameters exist for tests; main() always calls with defaults."""
+    import os
+    if os.environ.get("BENCH_DOUBLES", "1") != "1":
+        return
+    try:
+        from tpu_reductions.bench.spot import _write, run_spots
+        from tpu_reductions.config import ReduceConfig
+        from tpu_reductions.utils.logging import BenchLogger
+
+        print("# doubles: f64 SUM/MIN/MAX chained spots (dd path)",
+              file=sys.stderr)
+        base = ReduceConfig(method="SUM", dtype="float64", n=n,
+                            threads=512, iterations=iterations, warmup=2,
+                            timing="chained", chain_reps=reps,
+                            stat="median", log_file=None)
+        if path is None:
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_doubles.json")
+        meta = {"n": base.n, "timing": "chained", "stat": "median",
+                "reference": {"SUM": 92.7729, "MIN": 92.6014,
+                              "MAX": 92.7552}}
+        rows: list = []
+
+        def persist(row):
+            rows.append(row)
+            print(f"# doubles: {row['method']} "
+                  f"{row['gbps'] if row['gbps'] is not None else 'n/a'}"
+                  f" GB/s [{row['status']}]", file=sys.stderr)
+            _write(path, meta, rows, complete=False)
+
+        run_spots(base, ["SUM", "MIN", "MAX"],
+                  logger=BenchLogger(None, None, console=sys.stderr),
+                  on_result=persist)
+        _write(path, meta, rows, complete=True)
+        print(f"# doubles: wrote {path}", file=sys.stderr)
+    except Exception as e:  # best-effort by contract
+        print(f"# doubles spot failed (non-fatal): "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -130,7 +130,7 @@ step() {  # step <name> <artifact...> -- <cmd...>
 #   10. flagship experiment (item 3: re-verified int curve + bf16/f64
 #       curves + the 2^30 hazard cells last; DOUBLE rows land in the
 #       report's flagship table via sweep_all)
-step "headline bench" BENCH_live.json BENCH_snapshot.json -- \
+step "headline bench" BENCH_live.json BENCH_snapshot.json BENCH_doubles.json -- \
     bash -c 'set -o pipefail; python bench.py | tee BENCH_live.json'
 
 # all-device f64 (ops/dd_reduce.device_finish_pairs): the DOUBLE
