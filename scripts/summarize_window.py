@@ -127,9 +127,14 @@ def main(argv=None) -> int:
         hg = cal.get("honest_gbps")
         if hg is None:
             deciding = cal.get("deciding_n")
-            for rung in cal.get("rungs", []):
-                if rung.get("n") == deciding or hg is None:
-                    hg = rung.get("honest_gbps", hg)
+            rungs = cal.get("rungs", [])
+            match = [r for r in rungs if r.get("n") == deciding]
+            if not match and rungs:
+                # no deciding_n recorded: per CLAUDE.md the HBM (last)
+                # rung is the one that decides, not the first
+                match = [rungs[-1]]
+            if match:
+                hg = match[-1].get("honest_gbps")
         sections.append(
             ["## calibration",
              f"  block_awaits_execution="

@@ -83,3 +83,31 @@ def test_spot_cli_xla_backend(tmp_path):
     data = json.loads(out.read_text())
     assert all(r["backend"] == "xla" for r in data["rows"])
     assert all(r["status"] == "PASSED" for r in data["rows"])
+
+
+def test_spot_cli_waived_rows_exit_zero(monkeypatch, tmp_path):
+    """Exit contract mirrors the single-chip shmoo: a by-design waiver
+    (e.g. --backend=xla --type=double on TPU) is PASSED-or-WAIVED = 0;
+    any FAILED row = 1 (round-3 advisor finding)."""
+    from tpu_reductions.bench import spot as spot_mod
+
+    def fake_rows(statuses):
+        return [{"method": m, "dtype": "float64", "n": 16384,
+                 "kernel": None, "threads": 256, "chain_reps": 2,
+                 "gbps": None, "status": s, "backend": "xla"}
+                for m, s in zip(["SUM", "MIN", "MAX"], statuses)]
+
+    def patched(base, methods, logger=None, on_result=None):
+        rows = fake_rows(patched.statuses)
+        if on_result:
+            for r in rows:
+                on_result(r)
+        return rows
+
+    monkeypatch.setattr(spot_mod, "run_spots", patched)
+    patched.statuses = ["WAIVED", "WAIVED", "WAIVED"]
+    assert spot_mod.main(["--type=double", "--methods=SUM,MIN,MAX",
+                          "--n=16384"]) == 0
+    patched.statuses = ["PASSED", "WAIVED", "FAILED"]
+    assert spot_mod.main(["--type=double", "--methods=SUM,MIN,MAX",
+                          "--n=16384"]) == 1

@@ -499,3 +499,66 @@ def test_sweep_all_chained_caches_cells_before_a_late_crash(tmp_path):
     passed_before = sum(by[m]["status"] == "PASSED"
                         for m in ("SUM", "MIN"))
     assert raws_at_crash == [passed_before]
+
+
+def test_collect_rejects_nonfinite_rates(tmp_path):
+    """'nan'/'inf'/'Infinity' parse as floats (and Python's json.loads
+    accepts NaN/Infinity tokens) but must not reach average() — one
+    poisoned row would turn a whole dtype/op curve non-finite
+    (round-3 advisor finding)."""
+    import json as _json
+
+    from tpu_reductions.bench.aggregate import average, collect
+
+    raw = tmp_path / "raw_output"
+    raw.mkdir()
+    (raw / "rows.txt").write_text(
+        "INT SUM 8 nan\n"
+        "INT SUM 8 inf\n"
+        "INT SUM 8 Infinity\n"
+        "INT SUM 8 90.841\n")
+    (raw / "sweep.json").write_text(
+        _json.dumps({"dtype": "int32", "method": "SUM", "ranks": 8,
+                     "gbps": float("nan"), "status": "PASSED"}) + "\n" +
+        '{"dtype": "int32", "method": "SUM", "ranks": 8, '
+        '"gbps": Infinity, "status": "PASSED"}\n' +
+        _json.dumps({"dtype": "int32", "method": "SUM", "ranks": 8,
+                     "gbps": 91.159, "status": "PASSED"}) + "\n")
+    rows = collect(raw)
+    assert rows == ["INT SUM 8 90.841", "INT SUM 8 91.159"]
+    assert average(rows) == {("INT", "SUM", 8): 91.0}
+
+
+def test_pdf_degrades_without_matplotlib(tmp_path, monkeypatch, capsys):
+    """generate_pdf mirrors plot._mpl's degradation: on a
+    matplotlib-less host the pipeline's FINAL step must skip with a
+    note, not raise after reports/figures are already written
+    (round-3 advisor finding)."""
+    import sys
+
+    from tpu_reductions.bench.pdf import generate_pdf
+
+    monkeypatch.setitem(sys.modules, "matplotlib", None)
+    assert generate_pdf(tmp_path) is None
+    assert "writeup skipped (no matplotlib)" in capsys.readouterr().out
+
+
+def test_summarize_window_ladder_fallback_uses_last_rung(tmp_path):
+    """Ladder summaries without a deciding_n must report the HBM (last)
+    rung's honest_gbps — per CLAUDE.md the HBM rung decides, not the
+    first (round-3 advisor finding)."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = (Path(__file__).resolve().parent.parent
+              / "scripts/summarize_window.py")
+    (tmp_path / "calibration_live.json").write_text(json.dumps(
+        {"block_awaits_execution": False,
+         "rungs": [{"n": 1 << 24, "honest_gbps": 2800.0},
+                   {"n": 1 << 26, "honest_gbps": 717.3}]}))
+    r = subprocess.run([sys.executable, str(script), str(tmp_path)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0
+    assert "717.3" in r.stdout and "2800" not in r.stdout

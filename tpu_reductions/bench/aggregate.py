@@ -13,6 +13,7 @@ tooling would still parse our files.
 from __future__ import annotations
 
 import json
+import math
 import statistics
 from collections import defaultdict
 from pathlib import Path
@@ -43,6 +44,10 @@ def collect(raw_dir: str | Path, out_file: str | Path | None = None
                 ranks = d.get("ranks", 1)
                 dt = _DTYPE_NAMES.get(d["dtype"], d["dtype"].upper())
                 gbps = d.get("reference_gbps", d.get("gbps"))
+                if gbps is None or not math.isfinite(gbps):
+                    # Python's json.loads accepts NaN/Infinity tokens;
+                    # a non-finite rate must not poison the averages
+                    continue
                 rows.append(f"{dt} {d['method']} {ranks} {gbps:.3f}")
         else:
             for line in f.read_text().splitlines():
@@ -54,8 +59,12 @@ def collect(raw_dir: str | Path, out_file: str | Path | None = None
                 # crash average() on float('done') at pipeline end.
                 if len(parts) == 4 and parts[2].isdigit():
                     try:
-                        float(parts[3])
+                        rate = float(parts[3])
                     except ValueError:
+                        continue
+                    if not math.isfinite(rate):
+                        # 'nan'/'inf'/'Infinity' parse as floats but
+                        # would propagate into average() and the tables
                         continue
                     rows.append(line.strip())
     if out_file:

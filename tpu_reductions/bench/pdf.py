@@ -126,7 +126,7 @@ def _single_chip_lines(single_chip: Optional[Dict[tuple, float]],
 
 def generate_pdf(out_dir: str | Path, pdf_path: str | Path | None = None,
                  platform: str = "tpu",
-                 data: Optional[dict] = None) -> Path:
+                 data: Optional[dict] = None) -> Optional[Path]:
     """Compile <out_dir>'s experiment data into writeup.pdf. Pure
     analysis-side work (nothing is re-benchmarked); row/notes assembly
     is shared with the md/tex report (report.build_*) so the three
@@ -137,8 +137,18 @@ def generate_pdf(out_dir: str | Path, pdf_path: str | Path | None = None,
     is built from exactly what generate_report just rendered, never
     from a disk re-parse that could diverge (an out_dir whose
     raw_output/ holds a recovered session log is not collective data).
-    Without it, the offline CLI path loads from disk."""
-    import matplotlib
+    Without it, the offline CLI path loads from disk.
+
+    Degrades like plot._mpl when matplotlib is absent: both experiment
+    scripts end by calling this, and the pipeline's final step must not
+    turn an already-written report/figure set into a nonzero exit on a
+    matplotlib-less host — returns None after a skip note instead."""
+    try:
+        import matplotlib
+    except ImportError:
+        print("writeup skipped (no matplotlib): writeup.pdf not built; "
+              "report.md / report.tex carry the same rows")
+        return None
     matplotlib.use("Agg")
     from matplotlib.backends.backend_pdf import PdfPages
 
@@ -209,7 +219,8 @@ def main(argv=None) -> int:
                             platform=ns.platform)
     except FileNotFoundError as e:
         p.error(str(e))
-    print(f"writeup: {path}")
+    if path is not None:
+        print(f"writeup: {path}")
     return 0
 
 
