@@ -10,12 +10,21 @@
 # device discovery. Untunneled hosts (no relay marker) exit immediately
 # — there is no window to await.
 #
-# Usage: bash scripts/await_window.sh [poll_seconds=20] [max_hours=11]
+# Round-long invariant (round-3 verdict item 8): the watcher RE-ARMS.
+# A chip session that aborts mid-window (relay re-wedge, rc=3) puts the
+# watcher back into polling — a second window resumes the remaining
+# value; only a session that runs to completion (rc=0) retires it. The
+# default horizon (13 h) outlasts a round, and a heartbeat line lands
+# in the log every ~10 min so "armed" is verifiable afterwards.
+#
+# Usage: bash scripts/await_window.sh [poll_seconds=20] [max_hours=13]
+#   CHIP_LOG=chip_session_rNN.log overrides the session log name.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 POLL=${1:-20}
-MAX_HOURS=${2:-11}
+MAX_HOURS=${2:-13}
+LOG=${CHIP_LOG:-chip_session_r04.log}
 
 if [ ! -e /root/.relay.py ]; then
     echo "await_window: untunneled host (no relay marker); nothing to await"
@@ -36,17 +45,30 @@ sys.exit(1)'
 }
 
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
-echo "await_window: polling relay every ${POLL}s (giving up after ${MAX_HOURS}h)"
+# ~10-min heartbeat, derived from the poll interval
+beat_every=$(( (600 + POLL - 1) / POLL )); [ "$beat_every" -lt 1 ] && beat_every=1
+probes=0
+echo "await_window: polling relay every ${POLL}s (horizon ${MAX_HOURS}h," \
+     "session log ${LOG}, re-arming after aborted sessions)"
 while true; do
     if probe; then
         echo "await_window: relay ALIVE at $(date -u +%FT%TZ); starting chip session"
-        bash scripts/chip_session.sh 2>&1 | tee -a chip_session_r03.log
+        bash scripts/chip_session.sh 2>&1 | tee -a "$LOG"
         rc=${PIPESTATUS[0]}
         echo "await_window: chip session exited rc=$rc at $(date -u +%FT%TZ)"
-        exit "$rc"
+        if [ "$rc" -eq 0 ]; then
+            exit 0
+        fi
+        # aborted session: the window closed early — re-arm for the next
+        echo "await_window: re-arming (session rc=$rc; remaining value" \
+             "can land in a later window)"
+    fi
+    probes=$(( probes + 1 ))
+    if [ $(( probes % beat_every )) -eq 0 ]; then
+        echo "await_window: still armed at $(date -u +%FT%TZ) (${probes} probes, relay dead)"
     fi
     if [ "$(date +%s)" -ge "$deadline" ]; then
-        echo "await_window: no window opened within ${MAX_HOURS}h; giving up"
+        echo "await_window: no completed session within ${MAX_HOURS}h; giving up"
         exit 4
     fi
     sleep "$POLL"
