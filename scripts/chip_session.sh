@@ -2,18 +2,12 @@
 # One command for a live-chip session, ordered by value-per-minute so a
 # tunnel that re-wedges mid-run still leaves the most important
 # artifacts committed (round-1 VERDICT: "measure early, snapshot
-# mid-round, re-verify at the end"; window-2 targets in
-# docs/PERF_NOTES.md):
-#   1. bench.py           headline metric        (~2 min)
-#   2. calibrate --ladder two-regime trust gate  (~2 min)
-#   3. f64 chained spot   all-device dd check    (~2 min)
-#   4. autotune hbm grid  HBM-regime race @2^26  (~5 min)
-#   5. autotune fine grid second-pass tile race  (~5 min)
-#   6. run_tpu_experiment full curves            (the long tail;
-#      never-measured curves first, 4 GiB hazard cells last)
-# Each step git-commits ONLY its own artifacts before the next starts.
-# The drivers drain their device queues (results materialize on host),
-# so interrupting BETWEEN steps cannot strand in-flight work.
+# mid-round, re-verify at the end"; step list + budgets below at the
+# step invocations). Each step git-commits ONLY its own artifacts
+# before the next starts, and runs under a wall-clock budget (timeout
+# -s INT) so a slow-but-alive stall cannot consume the window. The
+# drivers drain their device queues (results materialize on host), so
+# interrupting BETWEEN steps cannot strand in-flight work.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -51,8 +45,8 @@ if ! relay_ok; then
     exit 3
 fi
 
-step() {  # step <name> <artifact...> -- <cmd...>
-    local name=$1; shift
+step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
+    local name=$1 budget=$2; shift 2
     local arts=()
     while [ $# -gt 0 ] && [ "$1" != "--" ]; do arts+=("$1"); shift; done
     if [ $# -eq 0 ]; then
@@ -60,7 +54,7 @@ step() {  # step <name> <artifact...> -- <cmd...>
         return 1
     fi
     shift
-    echo "=== chip_session: $name ==="
+    echo "=== chip_session: $name (budget ${budget}s) ==="
     if ! relay_ok; then
         # a step that exited 1 for its own reasons (e.g. bench.py's
         # stale-snapshot outage contract) does not carry the rc=3
@@ -70,8 +64,19 @@ step() {  # step <name> <artifact...> -- <cmd...>
         exit 3
     fi
     local status=ok rc=0
-    "$@" || rc=$?   # no set -e here; `if ! cmd` would negate $?
-    if [ "$rc" -ne 0 ]; then
+    # Per-step wall-clock budget (round-3 verdict, weak #2): a
+    # slow-but-alive stall — a Mosaic lowering pileup, a multi-minute
+    # tunnel stall — must not consume the whole window; the next step
+    # gets its chance. SIGINT first so python raises KeyboardInterrupt
+    # and the drivers' per-row persistence + queue drain run (CLAUDE.md:
+    # a SIGKILLed process with in-flight device work can wedge the
+    # chip); the 120 s kill-after is the backstop for a process too
+    # wedged to honor the interrupt.
+    timeout --signal=INT --kill-after=120 "$budget" "$@" || rc=$?
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        status=FAILED
+        echo "=== chip_session: $name TIMED OUT after ${budget}s (committing any artifacts it DID produce) ==="
+    elif [ "$rc" -ne 0 ]; then
         status=FAILED
         echo "=== chip_session: $name FAILED rc=$rc (committing any artifacts it DID produce) ==="
         # a failing step can still have written real data (e.g. the HBM
@@ -118,42 +123,53 @@ step() {  # step <name> <artifact...> -- <cmd...>
 # pipefail INSIDE each bash -c: the child shell does not inherit the
 # outer setting, and without it a crashed python is masked by tee/tail
 #
-# Round-3 ordering = the round-2 VERDICT's "Next round: do this" list:
-#   1. fresh BENCH row (item 4; also the 7-rep k7/384 average, item 7)
-#   2. DOUBLE scoreboard (item 1 — THE gap: beat 92.77 GB/s on-chip)
-#   3. calibration ladder (trust gate for everything after)
-#   4+5. HBM-regime races at 2^26 and the 2^27 weak point (item 2;
-#        kernel 10 races its pipeline depth 2/4/8)
-#   6. int op-parity probe (item 5: MIN vs SUM vs MAX, same geometry)
-#   7+8. kernel-9 MXU races, f32 + bf16 (item 6; bf16 evidence, item 9)
-#   9. fine tile race (item 7's repeat confirmation at 5+ reps)
-#   10. flagship experiment (item 3: re-verified int curve + bf16/f64
+# Round-4 ordering = the round-3 VERDICT's "Next round: do this" list
+# (items 1-2 unchanged in value order; the lowering smoke is item 2's
+# new front-loading step). Every step carries a wall-clock budget sized
+# so steps 1-3 land inside ~10 minutes even if each exhausts it:
+#   1. fresh BENCH row (240 s)
+#   2. DOUBLE scoreboard (300 s — THE gap: beat 92.77 GB/s on-chip)
+#   3. calibration ladder (240 s; trust gate for everything after)
+#   4. lowering smoke (420 s): tiny-n compile+run of k9, k10@{2,4,8},
+#      big-tile k8, dd pair paths — a systematic Mosaic failure costs
+#      seconds here instead of the window's middle (verdict weak #3)
+#   5+6. HBM-regime races at 2^26 and the 2^27 weak point
+#   7. int op-parity probe (MIN vs SUM vs MAX, same geometry)
+#   8+9. kernel-9 MXU races, f32 + bf16
+#   10. fine tile race (7-rep repeat confirmation)
+#   11. flagship experiment (3 h; re-verified int curve + bf16/f64
 #       curves + the 2^30 hazard cells last; DOUBLE rows land in the
 #       report's flagship table via sweep_all)
-step "headline bench" BENCH_live.json BENCH_snapshot.json BENCH_doubles.json -- \
+step "headline bench" 240 BENCH_live.json BENCH_snapshot.json BENCH_doubles.json -- \
     bash -c 'set -o pipefail; python bench.py | tee BENCH_live.json'
 
 # all-device f64 (ops/dd_reduce.device_finish_pairs): the DOUBLE
 # SUM/MIN/MAX scoreboard — expected near the INT roof fraction instead
 # of the transfer-bound 0.9 GB/s round 2 measured through the tunnel
-step "double scoreboard" double_spot.json -- \
+step "double scoreboard" 300 double_spot.json -- \
     python -m tpu_reductions.bench.spot --type=double \
         --methods=SUM,MIN,MAX --n=16777216 --iterations=256 \
         --chainreps=7 --out=double_spot.json
 
-step "calibration ladder" calibration_live.json -- \
+step "calibration ladder" 240 calibration_live.json -- \
     bash -c 'set -o pipefail; \
              python -m tpu_reductions.utils.calibrate --ladder \
                  --chainspan 256 --reps 7 | tail -1 > calibration_live.json'
 
+# every never-lowered kernel surface compiles+runs once at tiny n
+# BEFORE the races that depend on it; the manifest (committed even on
+# failure) tells the session log which race rows are live
+step "lowering smoke" 420 smoke.json -- \
+    python -m tpu_reductions.bench.smoke --out=smoke.json
+
 # does any Pallas geometry close the 5-8% gap to XLA in the HBM regime?
 # kernel 10 races its DMA pipeline depth — the knob it exists for
-step "hbm regime race 2^26" tune_hbm.json -- \
+step "hbm regime race 2^26" 420 tune_hbm.json -- \
     python -m tpu_reductions.bench.autotune --method=SUM --type=int \
         --n=67108864 --grid=hbm --comparator --out=tune_hbm.json
 
 # 2^27 was round 2's weakest HBM point (621 vs 779 GB/s)
-step "hbm regime race 2^27" tune_hbm27.json -- \
+step "hbm regime race 2^27" 420 tune_hbm27.json -- \
     python -m tpu_reductions.bench.autotune --method=SUM --type=int \
         --n=134217728 --grid=hbm --comparator --out=tune_hbm27.json
 
@@ -162,7 +178,7 @@ step "hbm regime race 2^27" tune_hbm27.json -- \
 # rc accumulates across the two probes: a crash of the first must not
 # be masked by a clean second (the same masking the pipefail note above
 # guards against, at the command level)
-step "int op parity probe" \
+step "int op parity probe" 420 \
         int_op_spot_k7.json int_op_spot_k6.json int_op_spot_xla.json -- \
     bash -c 'rc=0; \
              python -m tpu_reductions.bench.spot --type=int \
@@ -181,7 +197,7 @@ step "int op parity probe" \
 
 # kernel 9 (MXU) has never lowered on-chip; rank it against the VPU
 # winners in both regimes (2^24 VMEM-resident, 2^26 HBM-bound)
-step "mxu race f32" tune_mxu_f32.json tune_mxu_f32_hbm.json -- \
+step "mxu race f32" 420 tune_mxu_f32.json tune_mxu_f32_hbm.json -- \
     bash -c 'rc=0; \
              python -m tpu_reductions.bench.autotune --method=SUM \
                  --type=float --n=16777216 --iterations=256 --grid=mxu \
@@ -191,19 +207,21 @@ step "mxu race f32" tune_mxu_f32.json tune_mxu_f32_hbm.json -- \
                  --comparator --out=tune_mxu_f32_hbm.json || rc=$?; \
              exit $rc'
 
-step "mxu race bf16" tune_mxu_bf16.json -- \
+step "mxu race bf16" 300 tune_mxu_bf16.json -- \
     python -m tpu_reductions.bench.autotune --method=SUM --type=bfloat16 \
         --n=16777216 --iterations=256 --grid=mxu --comparator \
         --out=tune_mxu_bf16.json
 
 # 5+ slope reps so the round-2 single-rep 22.7 TB/s k7/384 claim gets a
 # quotable repeat-averaged confirmation (or a retraction)
-step "fine tile race" tune_fine.json -- \
+step "fine tile race" 420 tune_fine.json -- \
     python -m tpu_reductions.bench.autotune --method=SUM --type=int \
         --n=16777216 --iterations=256 --chainreps=7 --grid=fine \
         --out=tune_fine.json
 
-step "flagship experiment" examples/tpu_run -- \
+# 3 h: the long tail, and the watcher re-arms on abort — a flagship
+# that wedges slow-but-alive must not pin the watcher past the round
+step "flagship experiment" 10800 examples/tpu_run -- \
     bash scripts/run_tpu_experiment.sh examples/tpu_run
 
 echo "=== chip_session: done ==="

@@ -1,0 +1,142 @@
+"""Lowering smoke test: tiny-n compile+run of every never-lowered kernel.
+
+The reference never needed this — its kernels had all executed on the
+target GPU by the time any number was published (reduction.cpp:161-200
+instantiates all nine before the first timed loop). On this bench the
+situation is inverted: kernels 9 (MXU) and 10 (deep-DMA streaming), the
+big-tile kernel-8 geometry, and the all-device f64 pair paths are
+interpret-tested only, and interpret mode does not exercise Mosaic
+lowering. A live window that discovers a systematic lowering failure
+mid-race burns its middle on 20-40 s tunnel compiles that were doomed
+(round-3 verdict, weak #3).
+
+This module front-loads that discovery: each case compiles and runs ONE
+verified reduction at tiny n (compile time dominates; execution is
+microseconds), and the manifest records pass/fail per case so the
+session log shows in seconds which race rows are live before any race
+starts. Crashes are contained per case — the manifest is the product,
+and a FAILED case is exactly the information the step exists to buy.
+
+CLI:
+    python -m tpu_reductions.bench.smoke [--platform=cpu] \
+        [--n=1048576] [--out=smoke.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from tpu_reductions.config import (KERNEL_ELEMENTWISE, KERNEL_MXU,
+                                   KERNEL_STREAM, ReduceConfig,
+                                   _apply_platform)
+from tpu_reductions.utils.logging import BenchLogger
+
+# (name, dtype, method, kernel, threads, stream_buffers) — every
+# surface the next window would otherwise lower for the first time
+# inside a race (docs/PERF_NOTES.md hypotheses 1/4/5). The dd pair
+# cases carry kernel=None: f64 dispatch picks its own pair path, and
+# SUM (two_sum tree) vs MIN (order-preserving key pair) are distinct
+# lowerings.
+CASES: Tuple[Tuple[str, str, str, Optional[int], int, int], ...] = (
+    ("k10 stream depth=2", "int32", "SUM", KERNEL_STREAM, 512, 2),
+    ("k10 stream depth=4", "int32", "SUM", KERNEL_STREAM, 512, 4),
+    ("k10 stream depth=8", "int32", "SUM", KERNEL_STREAM, 512, 8),
+    ("k9 mxu f32", "float32", "SUM", KERNEL_MXU, 256, 4),
+    ("k9 mxu bf16", "bfloat16", "SUM", KERNEL_MXU, 256, 4),
+    ("k8 big-tile t=2048", "int32", "SUM", KERNEL_ELEMENTWISE, 2048, 4),
+    ("dd f64 sum pair-tree", "float64", "SUM", None, 256, 4),
+    ("dd f64 min key-pair", "float64", "MIN", None, 256, 4),
+)
+
+
+def run_smoke(n: int = 1 << 20, logger: Optional[BenchLogger] = None,
+              on_result=None) -> List[dict]:
+    """Compile+run each case once at tiny n; return manifest rows.
+
+    Rows persist via on_result as they land (the live-window
+    discipline): a relay death after case k keeps cases 1..k — and the
+    partial manifest still says which kernels lowered."""
+    from tpu_reductions.bench.driver import run_benchmark
+
+    logger = logger or BenchLogger(None, None)
+    rows: List[dict] = []
+    for name, dtype, method, kernel, threads, depth in CASES:
+        kw = dict(method=method, dtype=dtype, n=n, threads=threads,
+                  stream_buffers=depth, iterations=8, warmup=1,
+                  timing="chained", chain_reps=2, stat="median",
+                  verify=True, log_file=None)
+        if kernel is not None:
+            kw["backend"] = "pallas"
+            kw["kernel"] = kernel
+        cfg = ReduceConfig(**kw)
+        t0 = time.perf_counter()
+        try:
+            res = run_benchmark(cfg, logger=logger)
+            row = {"name": name, "status": res.status.name,
+                   "ok": res.status.name in ("PASSED", "WAIVED"),
+                   "seconds": round(time.perf_counter() - t0, 2),
+                   "error": None}
+        except Exception as e:   # the manifest IS the product
+            row = {"name": name, "status": "FAILED", "ok": False,
+                   "seconds": round(time.perf_counter() - t0, 2),
+                   "error": f"{type(e).__name__}: {e}"[:500]}
+        rows.append(row)
+        if on_result is not None:
+            on_result(row)
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.bench.smoke",
+        description="Tiny-n compile+run of every never-lowered kernel "
+                    "surface; writes a pass/fail manifest")
+    p.add_argument("--n", type=int, default=1 << 20,
+                   help="Elements per case (tiny: compile dominates)")
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"))
+    p.add_argument("--out", type=str, default=None,
+                   help="Manifest JSON path (persisted per case)")
+    ns = p.parse_args(argv)
+    if ns.n <= 0:
+        p.error("--n must be positive")
+    # k10's deepest case needs threads*128*depth elements in flight
+    if ns.n < 512 * 128 * 8:
+        p.error(f"--n must be >= {512 * 128 * 8} so the deepest k10 "
+                "pipeline has a full working set")
+    _apply_platform(ns)
+
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()   # a smoke hung on a dead relay reports nothing
+    logger = BenchLogger(None, None, console=sys.stderr)
+
+    live: List[dict] = []
+
+    def persist(row):
+        live.append(row)
+        print(f"  smoke {row['name']:<22} {row['status']:<7} "
+              f"{row['seconds']:6.1f}s"
+              + (f"  {row['error']}" if row["error"] else ""))
+        if ns.out:
+            from tpu_reductions.utils.jsonio import atomic_json_dump
+            atomic_json_dump(ns.out, {"n": ns.n,
+                                      "complete": False, "cases": live})
+
+    rows = run_smoke(n=ns.n, logger=logger, on_result=persist)
+    ok = sum(r["ok"] for r in rows)
+    print(f"smoke: {ok}/{len(rows)} cases lowered and verified")
+    if ns.out:
+        from tpu_reductions.utils.jsonio import atomic_json_dump
+        atomic_json_dump(ns.out, {"n": ns.n, "complete": True,
+                                  "cases": rows})
+        print(f"wrote {ns.out}")
+    # >=1 pass proves the device path is sane; all-fail means the races
+    # are doomed and the session log should say so loudly
+    return 0 if rows and ok > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
