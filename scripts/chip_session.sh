@@ -40,11 +40,6 @@ for port in (8082, 8083):
 sys.exit(0 if inconclusive else 3)'
 }
 
-if ! relay_ok; then
-    echo "=== chip_session: relay is dead before the session started; nothing on-chip can run — aborting (rc=3) ==="
-    exit 3
-fi
-
 step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
     local name=$1 budget=$2; shift 2
     local arts=()
@@ -119,6 +114,20 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
         exit 3
     fi
 }
+
+# Sourceable-lib mode: `CHIP_SESSION_LIB=1 source scripts/chip_session.sh`
+# stops here with relay_ok/step defined — the rehearsal tests
+# (tests/test_chip_session.py) drive the step machinery against toy
+# commands in a temp repo, so a bash bug is found off-chip, not in a
+# live window.
+if [ "${CHIP_SESSION_LIB:-0}" = 1 ]; then
+    return 0 2>/dev/null || exit 0
+fi
+
+if ! relay_ok; then
+    echo "=== chip_session: relay is dead before the session started; nothing on-chip can run — aborting (rc=3) ==="
+    exit 3
+fi
 
 # pipefail INSIDE each bash -c: the child shell does not inherit the
 # outer setting, and without it a crashed python is masked by tee/tail

@@ -1,0 +1,102 @@
+"""Chip-session step machinery rehearsal (scripts/chip_session.sh in
+CHIP_SESSION_LIB mode): the commit-per-step, per-step budget, and
+abort-on-rc-3 contracts are what a live window depends on — a bash bug
+there must be found off-chip, not mid-window (round-3 verdict, weak
+#2/#3)."""
+
+import subprocess
+from pathlib import Path
+
+SCRIPT = Path(__file__).resolve().parent.parent / "scripts/chip_session.sh"
+
+
+def _drive(tmp_path, body):
+    """Source the step machinery into a fresh throwaway git repo and run
+    `body` (bash) there. relay_ok is overridden to pass: these tests
+    rehearse the step contract, not the probe."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    script = (
+        "set -u\n"
+        f"export CHIP_SESSION_LIB=1\n"
+        f"source '{SCRIPT}'\n"
+        f"cd '{repo}'\n"
+        "git init -q . && git config user.email t@t && git config user.name t\n"
+        "git commit -q --allow-empty -m root\n"
+        "relay_ok() { return 0; }\n" + body)
+    return repo, subprocess.run(["bash", "-c", script],
+                                capture_output=True, text=True,
+                                timeout=120)
+
+
+def _log(repo):
+    return subprocess.run(["git", "-C", str(repo), "log", "--oneline"],
+                          capture_output=True, text=True).stdout
+
+
+def test_step_commits_only_its_artifact(tmp_path):
+    repo, r = _drive(tmp_path,
+                     "echo stray > untracked.txt\n"
+                     "step 'toy pass' 30 art.json -- "
+                     "bash -c 'echo data > art.json'\n")
+    assert r.returncode == 0, r.stdout + r.stderr
+    log = _log(repo)
+    assert "On-chip artifacts: toy pass" in log
+    # the stray file must NOT be swept into the artifact commit
+    show = subprocess.run(["git", "-C", str(repo), "show",
+                           "--stat", "--name-only", "HEAD"],
+                          capture_output=True, text=True).stdout
+    assert "art.json" in show and "untracked.txt" not in show
+
+
+def test_failed_step_commits_partial_artifacts_and_continues(tmp_path):
+    repo, r = _drive(tmp_path,
+                     "step 'toy fail' 30 part.json -- "
+                     "bash -c 'echo partial > part.json; exit 1'\n"
+                     "step 'after' 30 after.json -- "
+                     "bash -c 'echo ok > after.json'\n")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "toy fail FAILED rc=1" in r.stdout
+    log = _log(repo)
+    assert "toy fail (step FAILED; partial artifacts)" in log
+    assert "On-chip artifacts: after" in log      # session continued
+
+
+def test_step_budget_times_out_and_continues(tmp_path):
+    """A slow-but-alive step is cut at its budget (SIGINT via timeout)
+    and whatever it persisted before the cut is committed; the NEXT
+    step still runs — the round-3 weak-#2 contract."""
+    repo, r = _drive(tmp_path,
+                     "step 'toy stall' 1 stall.json -- "
+                     "bash -c 'echo early > stall.json; sleep 30'\n"
+                     "step 'after' 30 after.json -- "
+                     "bash -c 'echo ok > after.json'\n")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "toy stall TIMED OUT after 1s" in r.stdout
+    log = _log(repo)
+    assert "toy stall (step FAILED; partial artifacts)" in log
+    assert "On-chip artifacts: after" in log
+
+
+def test_step_rc3_aborts_session_with_artifacts_committed(tmp_path):
+    repo, r = _drive(tmp_path,
+                     "step 'toy outage' 30 out.json -- "
+                     "bash -c 'echo partial > out.json; exit 3'\n"
+                     "step 'never' 30 never.json -- "
+                     "bash -c 'echo no > never.json'\n")
+    assert r.returncode == 3
+    assert "accelerator gone (rc=3)" in r.stdout
+    log = _log(repo)
+    assert "toy outage" in log
+    assert "never" not in log
+    assert not (repo / "never.json").exists()
+
+
+def test_dead_relay_between_steps_aborts(tmp_path):
+    repo, r = _drive(tmp_path,
+                     "step 'first' 30 a.json -- bash -c 'echo 1 > a.json'\n"
+                     "relay_ok() { return 3; }\n"
+                     "step 'second' 30 b.json -- bash -c 'echo 2 > b.json'\n")
+    assert r.returncode == 3
+    assert "relay died before step 'second'" in r.stdout
+    assert "On-chip artifacts: first" in _log(repo)
