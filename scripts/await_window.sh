@@ -56,6 +56,14 @@ while true; do
         bash scripts/chip_session.sh 2>&1 | tee -a "$LOG"
         rc=${PIPESTATUS[0]}
         echo "await_window: chip session exited rc=$rc at $(date -u +%FT%TZ)"
+        # commit the session log itself: round 2's curve recovery came
+        # FROM this log (examples/tpu_run/RECOVERY.md) — it must survive
+        # even if nobody is attending when the watcher fires
+        if [ -s "$LOG" ] && git add -- "$LOG" \
+                && ! git diff --cached --quiet -- "$LOG"; then
+            git commit -q -m "Chip session log ($(date -u +%FT%TZ), rc=$rc)" \
+                -- "$LOG" || true
+        fi
         if [ "$rc" -eq 0 ]; then
             exit 0
         fi

@@ -50,6 +50,7 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
     fi
     shift
     echo "=== chip_session: $name (budget ${budget}s) ==="
+    SESSION_RAN=1
     if ! relay_ok; then
         # a step that exited 1 for its own reasons (e.g. bench.py's
         # stale-snapshot outage contract) does not carry the rc=3
@@ -115,14 +116,34 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
     fi
 }
 
+# However the session ends — completed, budget-cut, relay abort — it
+# leaves a collated WINDOW_SUMMARY.md committed: the post-window
+# bookkeeping must not depend on anyone being present when the watcher
+# fires (summarize_window.py is pure offline collation; no relay gate
+# applies to it).
+SESSION_RAN=0   # set by step(): an abort BEFORE any step must not
+                # collate a "window summary" out of stale artifacts
+summarize_on_exit() {
+    [ "$SESSION_RAN" = 1 ] || return 0
+    python scripts/summarize_window.py . > WINDOW_SUMMARY.md 2>/dev/null \
+        || true
+    if [ -s WINDOW_SUMMARY.md ] && git add -- WINDOW_SUMMARY.md \
+            && ! git diff --cached --quiet -- WINDOW_SUMMARY.md; then
+        git commit -q -m "Window summary (auto-collated at session exit)" \
+            -- WINDOW_SUMMARY.md || true
+    fi
+}
+
 # Sourceable-lib mode: `CHIP_SESSION_LIB=1 source scripts/chip_session.sh`
-# stops here with relay_ok/step defined — the rehearsal tests
-# (tests/test_chip_session.py) drive the step machinery against toy
-# commands in a temp repo, so a bash bug is found off-chip, not in a
-# live window.
+# stops here with relay_ok/step/summarize_on_exit defined — the
+# rehearsal tests (tests/test_chip_session.py) drive the step machinery
+# against toy commands in a temp repo, so a bash bug is found off-chip,
+# not in a live window.
 if [ "${CHIP_SESSION_LIB:-0}" = 1 ]; then
     return 0 2>/dev/null || exit 0
 fi
+
+trap summarize_on_exit EXIT
 
 if ! relay_ok; then
     echo "=== chip_session: relay is dead before the session started; nothing on-chip can run — aborting (rc=3) ==="

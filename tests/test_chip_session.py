@@ -100,3 +100,30 @@ def test_dead_relay_between_steps_aborts(tmp_path):
     assert r.returncode == 3
     assert "relay died before step 'second'" in r.stdout
     assert "On-chip artifacts: first" in _log(repo)
+
+
+def test_summarize_on_exit_requires_a_step_and_commits_summary(tmp_path):
+    """The EXIT trap's guard: an abort BEFORE any step ran must not
+    collate stale artifacts into a 'window summary'; after a step ran,
+    the summary is written and committed even though the session is
+    exiting."""
+    import json
+
+    summarizer = SCRIPT.parent / "summarize_window.py"
+    bench_row = json.dumps({"metric": "m", "value": 6497.2,
+                            "unit": "GB/s", "vs_baseline": 71.5})
+    body = (
+        "mkdir -p scripts\n"
+        f"cp '{summarizer}' scripts/\n"
+        f"printf '%s' '{bench_row}' > BENCH_live.json\n"
+        # no step ran: the trap must be a no-op
+        "summarize_on_exit\n"
+        "test ! -e WINDOW_SUMMARY.md || exit 97\n"
+        # a step runs; now the trap collates and commits
+        "step 'toy' 30 art.json -- bash -c 'echo d > art.json'\n"
+        "summarize_on_exit\n")
+    repo, r = _drive(tmp_path, body)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert (repo / "WINDOW_SUMMARY.md").is_file()
+    assert "6497.2" in (repo / "WINDOW_SUMMARY.md").read_text()
+    assert "Window summary (auto-collated at session exit)" in _log(repo)
