@@ -1,8 +1,8 @@
 #!/usr/bin/env bash
-# Rank-scaling experiment: the bandwidth-vs-ranks axis pushed to the
-# reference's SMALLEST measured scale (64 ranks — mpi/submit_all.sh:3-4
-# sweeps sbatch --nodes {32,128,512} with VN doubling; results rows at
-# 64/256/1024 ranks, mpi/results/INT_SUM.txt:2-4).
+# Rank-scaling experiment: the bandwidth-vs-ranks axis at EVERY rank
+# count the reference published (64/256/1024 — mpi/submit_all.sh:3-4
+# sweeps sbatch --nodes {32,128,512} with VN doubling; results rows in
+# mpi/results/INT_SUM.txt:2-4), plus the full doubling curve below 64.
 #
 # One physical chip cannot host a rank sweep, so this runs the REAL
 # ring/halving shard_map implementations over virtual CPU devices
@@ -17,7 +17,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 OUT=${1:-examples/rank_scaling}
-MAX_RANKS=${MAX_RANKS:-64}
+MAX_RANKS=${MAX_RANKS:-1024}
 
 python - "$OUT" "$MAX_RANKS" <<'PY'
 import json
@@ -39,7 +39,8 @@ from tpu_reductions.bench.sweep import sweep_collective
 from tpu_reductions.utils.logging import BenchLogger
 
 log = BenchLogger(None, None)
-ranks = [k for k in (2, 4, 8, 16, 32, 64, 128) if k <= max_ranks]
+ranks = [k for k in (2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+         if k <= max_ranks]
 log.log(f"rank-scaling sweep over {ranks} virtual CPU devices")
 
 # reference op order (MAX, MIN, SUM — reduce.c:73), both headline

@@ -225,77 +225,9 @@ def test_maybe_arm_arms_when_relay_alive(monkeypatch):
         s.close()
 
 
-def test_chip_session_aborts_on_accelerator_gone(tmp_path):
-    """step() must stop the session (exit 3) after committing when a
-    step reports accelerator-unavailable — every later on-chip step
-    could only hang on the dead relay."""
-    import subprocess
-
-    # extract step() into a scratch git repo and drive all the branches
-    # (relay_ok is stubbed alive: this test exercises the rc=3 path)
-    lines = open("scripts/chip_session.sh").read()
-    body = lines[lines.index("step()"):lines.index("\n# pipefail")]
-    script = (
-        "set -uo pipefail\nrelay_ok() { return 0; }\n" + body +
-        "step 'gone' g.json -- bash -c 'echo {} > g.json; exit 3'\n"
-        "echo SHOULD_NOT_REACH\n")
-    repo = tmp_path / "r"
-    repo.mkdir()
-    subprocess.run(["git", "init", "-q", "."], cwd=repo, check=True)
-    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
-                    "commit", "-q", "--allow-empty", "-m", "init"],
-                   cwd=repo, check=True)
-    r = subprocess.run(["bash", "-c", script], cwd=repo,
-                       capture_output=True, text=True,
-                       env={"PATH": "/usr/bin:/bin",
-                            "GIT_AUTHOR_NAME": "t",
-                            "GIT_AUTHOR_EMAIL": "t@t",
-                            "GIT_COMMITTER_NAME": "t",
-                            "GIT_COMMITTER_EMAIL": "t@t"})
-    assert r.returncode == 3, r.stderr
-    assert "SHOULD_NOT_REACH" not in r.stdout
-    assert "ABORT" in r.stdout
-    log = subprocess.run(["git", "log", "--oneline"], cwd=repo,
-                         capture_output=True, text=True).stdout
-    # the artifact the dying step produced was committed before aborting
-    assert "On-chip artifacts: gone" in log
-
-
-def test_chip_session_aborts_when_relay_dies_between_steps(tmp_path):
-    """A step can exit 1 for its own reasons (bench.py's outage
-    contract) without carrying the rc=3 signal — the per-step relay_ok
-    probe must still stop the session before launching the next
-    on-chip step at a dead relay."""
-    import subprocess
-
-    lines = open("scripts/chip_session.sh").read()
-    body = lines[lines.index("step()"):lines.index("\n# pipefail")]
-    script = (
-        "set -uo pipefail\n"
-        # relay alive for the first step, dead afterwards
-        "N=0\nrelay_ok() { N=$((N+1)); [ $N -le 1 ]; }\n" + body +
-        "step 'first' a.json -- bash -c 'echo {} > a.json; exit 1'\n"
-        "step 'second' b.json -- bash -c 'echo {} > b.json'\n"
-        "echo SHOULD_NOT_REACH\n")
-    repo = tmp_path / "r"
-    repo.mkdir()
-    subprocess.run(["git", "init", "-q", "."], cwd=repo, check=True)
-    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
-                    "commit", "-q", "--allow-empty", "-m", "init"],
-                   cwd=repo, check=True)
-    r = subprocess.run(["bash", "-c", script], cwd=repo,
-                       capture_output=True, text=True,
-                       env={"PATH": "/usr/bin:/bin",
-                            "GIT_AUTHOR_NAME": "t",
-                            "GIT_AUTHOR_EMAIL": "t@t",
-                            "GIT_COMMITTER_NAME": "t",
-                            "GIT_COMMITTER_EMAIL": "t@t"})
-    assert r.returncode == 3, r.stderr
-    assert "SHOULD_NOT_REACH" not in r.stdout
-    assert "relay died before step 'second'" in r.stdout
-    log = subprocess.run(["git", "log", "--oneline"], cwd=repo,
-                         capture_output=True, text=True).stdout
-    # step 1's artifact (exit-1 partial data) was still committed;
-    # step 2 never ran
-    assert "On-chip artifacts: first (step FAILED" in log
-    assert "second" not in log
+# The chip-session step-machinery contracts (rc=3 abort with
+# artifacts committed, relay-death-between-steps, budgets, the
+# window-summary trap) are rehearsed in tests/test_chip_session.py
+# via the script's sourceable CHIP_SESSION_LIB mode — the former
+# text-slicing extraction of step() lived here and broke whenever
+# the script's layout moved.
