@@ -87,6 +87,19 @@ def main(argv=None) -> int:
              f"  {bench['metric']}: {bench['value']} {bench['unit']} "
              f"= {bench.get('vs_baseline')}x reference{stale}"])
 
+    smoke = _load(root / "smoke.json")
+    if smoke:
+        lines = ["## lowering smoke (pre-race manifest)"]
+        for c in smoke.get("cases", []):
+            err = f" — {c['error']}" if c.get("error") else ""
+            lines.append(f"  {c['name']:<22} {c['status']:<7} "
+                         f"{c.get('seconds', 0):.1f}s{err}")
+        ok = sum(1 for c in smoke.get("cases", []) if c.get("ok"))
+        lines.append(f"  {ok}/{len(smoke.get('cases', []))} lowered")
+        if not smoke.get("complete", True):
+            lines.append("  (artifact INCOMPLETE — smoke died mid-case)")
+        sections.append(lines)
+
     for name, dtype, title in (("double_spot.json", "DOUBLE",
                                 "## DOUBLE scoreboard (VERDICT item 1)"),
                                ("int_op_spot_k7.json", "INT",

@@ -562,3 +562,29 @@ def test_summarize_window_ladder_fallback_uses_last_rung(tmp_path):
                        capture_output=True, text=True)
     assert r.returncode == 0
     assert "717.3" in r.stdout and "2800" not in r.stdout
+
+
+def test_summarize_window_reports_smoke_manifest(tmp_path):
+    """The pre-race lowering manifest (bench/smoke.py) lands in the
+    auto-collated window summary — which kernel surfaces lowered is
+    the first question after any window."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = (Path(__file__).resolve().parent.parent
+              / "scripts/summarize_window.py")
+    (tmp_path / "smoke.json").write_text(json.dumps(
+        {"n": 1 << 20, "complete": False, "cases": [
+            {"name": "k9 mxu f32", "status": "PASSED", "ok": True,
+             "seconds": 31.2, "error": None},
+            {"name": "k10 stream depth=8", "status": "FAILED",
+             "ok": False, "seconds": 24.0,
+             "error": "MosaicError: no lowering"}]}))
+    r = subprocess.run([sys.executable, str(script), str(tmp_path)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0
+    assert "1/2 lowered" in r.stdout
+    assert "MosaicError: no lowering" in r.stdout
+    assert "INCOMPLETE — smoke died mid-case" in r.stdout
