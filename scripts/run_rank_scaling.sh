@@ -59,6 +59,25 @@ figures = []
 for dt in sorted({k[0] for k in avgs}):
     figures += plot_vs_ranks(avgs, dt, out / dt.lower())
 
+# normalized shape figure: ours next to the reference's published
+# 64/256/1024 rows (shapes comparable; absolute GB/s are not)
+from tpu_reductions.bench.plot import plot_scaling_shape
+
+REFERENCE_ROWS = {"INT SUM": [(64, 9.182), (256, 38.6484),
+                              (1024, 146.818)],
+                  "DOUBLE SUM": [(64, 3.8102), (256, 15.3126),
+                                 (1024, 60.9754)]}
+shape_series = {}
+for op_dt in ("INT SUM", "DOUBLE SUM"):
+    dt, op = op_dt.split()
+    pts = [(k, g) for (d, o, k), g in sorted(avgs.items())
+           if d == dt and o == op]
+    if pts:
+        shape_series[f"{op_dt} (this framework, serialized "
+                     "virtual mesh)"] = pts
+    shape_series[f"{op_dt} (reference torus)"] = REFERENCE_ROWS[op_dt]
+figures += plot_scaling_shape(shape_series, out / "scaling_shape")
+
 # payload-amortization probe at the largest rank count: if the
 # high-rank droop were pure fixed dispatch overhead, bandwidth would
 # recover fully with payload; the residual gap is the ring's O(k)
@@ -87,10 +106,8 @@ for (dt, op, k), g in sorted(avgs.items()):
     {"ranks": ranks, "series": shape,
      "amortization_probe_ranks": max_ranks,
      "amortization_probe": probe,
-     "reference_rows": {"INT SUM": [[64, 9.182], [256, 38.6484],
-                                    [1024, 146.818]],
-                        "DOUBLE SUM": [[64, 3.8102], [256, 15.3126],
-                                       [1024, 60.9754]]},
+     "reference_rows": {k: [list(p) for p in v]
+                        for k, v in REFERENCE_ROWS.items()},
      "note": "virtual-CPU mesh on one core: absolute GB/s meaningless; "
              "the curve SHAPE (aggregate bandwidth vs ranks) is the "
              "product"}, indent=1) + "\n")

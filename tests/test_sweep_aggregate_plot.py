@@ -588,3 +588,32 @@ def test_summarize_window_reports_smoke_manifest(tmp_path):
     assert "1/2 lowered" in r.stdout
     assert "MosaicError: no lowering" in r.stdout
     assert "INCOMPLETE — smoke died mid-case" in r.stdout
+
+
+def test_plot_scaling_shape_normalizes_each_series(tmp_path, monkeypatch):
+    """The rank-scaling comparison figure: every curve divided by its
+    own smallest-rank value (absolute GB/s of a serialized virtual
+    mesh and the reference torus are not comparable; shapes are).
+    The numbers are asserted via the matplotlib-free .dat fallback —
+    the same normalized series the figure draws."""
+    from tpu_reductions.bench import plot as plot_mod
+    from tpu_reductions.bench.plot import plot_scaling_shape
+
+    series = {"ours": [(64, 1.0), (8, 2.0), (2, 4.0)],  # unsorted input
+              "reference torus": [(64, 9.182), (256, 38.6484),
+                                  (1024, 146.818)],
+              "empty": [], "zero-lead": [(2, 0.0), (4, 1.0)]}
+    outs = plot_scaling_shape(series, tmp_path / "shape")
+    assert sorted(p.suffix for p in outs) == [".eps", ".png"]
+    assert all(p.exists() and p.stat().st_size > 0 for p in outs)
+
+    monkeypatch.setattr(plot_mod, "_mpl", lambda: None)
+    dat, = plot_scaling_shape(series, tmp_path / "shape2")
+    text = dat.read_text()
+    # each curve normalized to ITS OWN smallest-rank value...
+    assert "2 1.000000\n8 0.500000\n64 0.250000" in text
+    # ...including the reference torus (146.818 / 9.182)
+    assert "64 1.000000\n256 4.209148\n1024 15.989763" in text
+    # empty and zero-lead series are skipped, not plotted as garbage
+    assert "empty" not in text and "zero-lead" not in text
+    assert plot_scaling_shape({"empty": []}, tmp_path / "none") == []

@@ -35,13 +35,16 @@ def _mpl():
 
 
 def _finish_and_save(plt, fig, ax, *, xlabel: str, title: str,
-                     out_base: Path) -> list:
+                     out_base: Path,
+                     ylabel: str = "Bandwidth (GB/sec)") -> list:
     """Shared figure grammar + emission for every plotter: the
     makePlots.gp axes (:12-13), log2 x, legend, grid, then PNG + EPS
     (the reference's format, makePlots.gp:1) — one copy, so styling
-    cannot drift between the three figures."""
+    cannot drift between the figures. ylabel defaults to the
+    makePlots.gp:13 label; the shape plot overrides it (its y axis is
+    a normalized ratio, not GB/s)."""
     ax.set_xlabel(xlabel)
-    ax.set_ylabel("Bandwidth (GB/sec)")          # makePlots.gp:13
+    ax.set_ylabel(ylabel)                        # makePlots.gp:13
     ax.set_xscale("log", base=2)
     ax.legend()
     ax.set_title(title)
@@ -150,6 +153,52 @@ def _emit_gnuplot(series, dtype_name, out_base: Path,
     path = out_base.with_suffix(".gp")
     path.write_text("\n".join(gp) + "\n")
     return path
+
+
+def plot_scaling_shape(series: Dict[str, Sequence[tuple]],
+                       out_base: str | Path,
+                       title: Optional[str] = None) -> Sequence[Path]:
+    """Normalized scaling-shape comparison: every series divided by its
+    own smallest-rank value, log-log — the only honest way to put a
+    serialized virtual-mesh curve next to the reference's torus curves
+    (mpi/results/*_SUM.txt rows at 64/256/1024 ranks), whose absolute
+    GB/s differ by orders of magnitude and by meaning. A rising
+    normalized curve = aggregate bandwidth grows with ranks (the
+    reference's hardware story); a falling one = per-rank costs
+    dominate (the 1-core serialization story, examples/rank_scaling).
+
+    series: {label: [(ranks, gbps), ...]}; empty/zero-lead series are
+    skipped. Returns [] when nothing is plottable."""
+    norm = {}
+    for label, pts in series.items():
+        pts = sorted(pts)
+        if pts and pts[0][1] > 0:
+            base = pts[0][1]
+            norm[label] = [(r, g / base) for r, g in pts]
+    if not norm:
+        return []
+    out_base = Path(out_base)
+    plt = _mpl()
+    if plt is None:
+        lines = [f"# {label} (normalized to ranks={pts[0][0]})\n"
+                 + "\n".join(f"{r} {g:.6f}" for r, g in pts)
+                 for label, pts in sorted(norm.items())]
+        p = out_base.with_suffix(".dat")
+        p.write_text("\n\n".join(lines) + "\n")
+        return [p]
+
+    fig, ax = plt.subplots(figsize=(7, 5))
+    for label, pts in sorted(norm.items()):
+        xs, ys = zip(*pts)
+        ax.plot(xs, ys, marker="o", label=label)
+    ax.set_yscale("log")
+    ax.axhline(1.0, linestyle=":", linewidth=1, color="0.5")
+    return _finish_and_save(
+        plt, fig, ax, xlabel="Number of Mesh Ranks",
+        title=title or "Aggregate-bandwidth scaling shape "
+                       "(normalized to each curve's smallest rank count)",
+        out_base=out_base,
+        ylabel="Bandwidth / bandwidth at smallest rank count")
 
 
 def plot_vn_vs_co(avgs_by_mode: Dict[str, Dict[Key, float]],
