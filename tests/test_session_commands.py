@@ -1,0 +1,152 @@
+"""Live-session command-surface rehearsal: every `python -m` invocation
+in scripts/chip_session.sh is (a) pinned verbatim against this manifest
+— the inverse test extracts each full invocation from the script and
+requires set-equality, so editing any flag without updating the
+rehearsal fails here — and (b) actually executed at scaled-down
+geometry through the same argparse + driver path. A typo'd flag or
+renamed module in a session step must surface in this suite, not in
+the first minutes of a live window (the same off-chip-rehearsal
+discipline as tests/test_chip_session.py, applied to the commands
+instead of the step machinery)."""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+SCRIPT = Path(__file__).resolve().parent.parent / "scripts/chip_session.sh"
+
+
+def _script_invocations() -> set:
+    """Every `python -m tpu_reductions...` invocation in the script,
+    whitespace-normalized, cut at shell plumbing (`|| rc=$?`, pipes,
+    closing quotes) — the full flag surface of each live command."""
+    joined = SCRIPT.read_text().replace("\\\n", " ")
+    out = set()
+    for line in joined.splitlines():
+        if line.lstrip().startswith("#"):
+            continue   # a commented-out step is NOT a live invocation
+        # a bash -c block carries SEVERAL invocations on one joined
+        # line — split on the marker so none hides behind the first
+        for piece in re.split(r"(?=python -m tpu_reductions)", line)[1:]:
+            cmd = re.split(r" \|\| | \| |'|;", piece)[0]
+            out.add(re.sub(r"\s+", " ", cmd).strip())
+    return out
+
+
+# (live invocation exactly as chip_session.sh runs it,
+#  module main to call, scaled-down argv, artifact filename or None)
+STEPS = [
+    ("python -m tpu_reductions.bench.spot --type=double "
+     "--methods=SUM,MIN,MAX --n=16777216 --iterations=256 "
+     "--chainreps=7 --out=double_spot.json",
+     "tpu_reductions.bench.spot",
+     ["--type=double", "--methods=SUM,MIN,MAX", "--n=16384",
+      "--iterations=8", "--chainreps=2", "--out=double_spot.json"],
+     "double_spot.json"),
+    ("python -m tpu_reductions.utils.calibrate --ladder "
+     "--chainspan 256 --reps 7",
+     "tpu_reductions.utils.calibrate",
+     ["--ladder", "--chainspan", "8", "--reps", "2", "--n", "16384"],
+     None),
+    ("python -m tpu_reductions.bench.smoke --out=smoke.json",
+     "tpu_reductions.bench.smoke",
+     ["--out=smoke.json"],
+     "smoke.json"),
+    ("python -m tpu_reductions.bench.autotune --method=SUM --type=int "
+     "--n=67108864 --grid=hbm --comparator --out=tune_hbm.json",
+     "tpu_reductions.bench.autotune",
+     ["--method=SUM", "--type=int", "--n=65536", "--iterations=4",
+      "--chainreps=2", "--grid=hbm", "--comparator",
+      "--out=tune_hbm.json"],
+     "tune_hbm.json"),
+    ("python -m tpu_reductions.bench.autotune --method=SUM --type=int "
+     "--n=134217728 --grid=hbm --comparator --out=tune_hbm27.json",
+     "tpu_reductions.bench.autotune",
+     ["--method=SUM", "--type=int", "--n=65536", "--iterations=4",
+      "--chainreps=2", "--grid=hbm", "--comparator",
+      "--out=tune_hbm27.json"],
+     "tune_hbm27.json"),
+    ("python -m tpu_reductions.bench.spot --type=int "
+     "--methods=SUM,MIN,MAX --n=16777216 --kernel=7 --threads=384 "
+     "--iterations=256 --chainreps=5 --out=int_op_spot_k7.json",
+     "tpu_reductions.bench.spot",
+     ["--type=int", "--methods=SUM,MIN,MAX", "--n=16384", "--kernel=7",
+      "--threads=384", "--iterations=8", "--chainreps=2",
+      "--out=int_op_spot_k7.json"],
+     "int_op_spot_k7.json"),
+    ("python -m tpu_reductions.bench.spot --type=int "
+     "--methods=SUM,MIN,MAX --n=16777216 --kernel=6 --threads=512 "
+     "--iterations=256 --chainreps=5 --out=int_op_spot_k6.json",
+     "tpu_reductions.bench.spot",
+     ["--type=int", "--methods=SUM,MIN,MAX", "--n=16384", "--kernel=6",
+      "--threads=512", "--iterations=8", "--chainreps=2",
+      "--out=int_op_spot_k6.json"],
+     "int_op_spot_k6.json"),
+    ("python -m tpu_reductions.bench.spot --type=int "
+     "--methods=SUM,MIN,MAX --n=16777216 --backend=xla "
+     "--iterations=256 --chainreps=5 --out=int_op_spot_xla.json",
+     "tpu_reductions.bench.spot",
+     ["--type=int", "--methods=SUM,MIN,MAX", "--n=16384",
+      "--backend=xla", "--iterations=8", "--chainreps=2",
+      "--out=int_op_spot_xla.json"],
+     "int_op_spot_xla.json"),
+    ("python -m tpu_reductions.bench.autotune --method=SUM "
+     "--type=float --n=16777216 --iterations=256 --grid=mxu "
+     "--comparator --out=tune_mxu_f32.json",
+     "tpu_reductions.bench.autotune",
+     ["--method=SUM", "--type=float", "--n=65536", "--iterations=4",
+      "--chainreps=2", "--grid=mxu", "--comparator",
+      "--out=tune_mxu_f32.json"],
+     "tune_mxu_f32.json"),
+    ("python -m tpu_reductions.bench.autotune --method=SUM "
+     "--type=float --n=67108864 --grid=mxu --comparator "
+     "--out=tune_mxu_f32_hbm.json",
+     "tpu_reductions.bench.autotune",
+     ["--method=SUM", "--type=float", "--n=65536", "--iterations=4",
+      "--chainreps=2", "--grid=mxu", "--comparator",
+      "--out=tune_mxu_f32_hbm.json"],
+     "tune_mxu_f32_hbm.json"),
+    ("python -m tpu_reductions.bench.autotune --method=SUM "
+     "--type=bfloat16 --n=16777216 --iterations=256 --grid=mxu "
+     "--comparator --out=tune_mxu_bf16.json",
+     "tpu_reductions.bench.autotune",
+     ["--method=SUM", "--type=bfloat16", "--n=65536", "--iterations=4",
+      "--chainreps=2", "--grid=mxu", "--comparator",
+      "--out=tune_mxu_bf16.json"],
+     "tune_mxu_bf16.json"),
+    ("python -m tpu_reductions.bench.autotune --method=SUM --type=int "
+     "--n=16777216 --iterations=256 --chainreps=7 --grid=fine "
+     "--out=tune_fine.json",
+     "tpu_reductions.bench.autotune",
+     ["--method=SUM", "--type=int", "--n=65536", "--iterations=4",
+      "--chainreps=2", "--grid=fine", "--out=tune_fine.json"],
+     "tune_fine.json"),
+]
+
+
+def test_manifest_matches_script_invocation_for_invocation():
+    """Exact set equality between the script's invocations and the
+    manifest: a flag edit, a new command, or a stale manifest row all
+    fail loudly — module-name granularity would let a typo in one of
+    several same-module probes slip through."""
+    assert _script_invocations() == {s[0] for s in STEPS}
+
+
+@pytest.mark.parametrize("fragment,module,argv,artifact",
+                         STEPS, ids=[s[1].rsplit(".", 1)[-1] + ":" +
+                                     (s[3] or "ladder") for s in STEPS])
+def test_session_command_rehearses_green(fragment, module, argv,
+                                         artifact, tmp_path,
+                                         monkeypatch):
+    import importlib
+    mod = importlib.import_module(module)
+    monkeypatch.chdir(tmp_path)
+    rc = mod.main(argv)
+    assert rc == 0, f"{module} {argv} -> rc={rc}"
+    if artifact:
+        # strict index: a writer that drops/renames the completeness
+        # key must fail here, not default to "complete"
+        data = json.loads((tmp_path / artifact).read_text())
+        assert data["complete"] is True
