@@ -127,3 +127,27 @@ def test_summarize_on_exit_requires_a_step_and_commits_summary(tmp_path):
     assert (repo / "WINDOW_SUMMARY.md").is_file()
     assert "6497.2" in (repo / "WINDOW_SUMMARY.md").read_text()
     assert "Window summary (auto-collated at session exit)" in _log(repo)
+
+
+def test_session_budgets_keep_the_first_steps_inside_a_short_window():
+    """The round-3 weak-#2 contract, pinned: every step carries a
+    numeric wall-clock budget, and the first three (headline bench,
+    DOUBLE scoreboard, calibration ladder) sum to at most 13 minutes —
+    the worst case when every one exhausts its budget; typical runs
+    land well inside 10."""
+    import re
+
+    text = SCRIPT.read_text().replace("\\\n", " ")
+    budgets = [int(m.group(1)) for m in
+               re.finditer(r"^\s*step ['\"][^'\"]+['\"] (\d+) ",
+                           text, re.M)]
+    steps = len(re.findall(r"^\s*step ['\"]", text, re.M))
+    assert len(budgets) == steps, "a step is missing its budget"
+    assert len(budgets) >= 10          # the full value-ordered session
+    assert sum(budgets[:3]) <= 13 * 60, (
+        f"first three budgets sum to {sum(budgets[:3])}s — a short "
+        "window is no longer guaranteed the BENCH row + DOUBLE "
+        "scoreboard + trust gate")
+    # the flagship long tail must still be bounded (watcher re-arm
+    # depends on the session eventually exiting)
+    assert max(budgets) <= 4 * 3600
