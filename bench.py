@@ -128,7 +128,7 @@ def _snapshot_fallback(outage: str, snap: str | None = None) -> dict:
         with open(snap) as f:
             s = json.load(f)
         best = float(s["value"])
-        return {
+        out = {
             "metric": s["metric"],
             "value": best,
             "unit": s["unit"],
@@ -142,6 +142,14 @@ def _snapshot_fallback(outage: str, snap: str | None = None) -> dict:
                      f"(captured {s['captured']}, chained slope, "
                      "oracle-checked) — not a fresh run"),
         }
+        if s.get("partial"):
+            # the snapshotted race died before its runner-ups ran
+            # (flapping relay): the value is verified but only the
+            # leading candidate(s) raced — say so, machine-readably
+            out["partial"] = True
+            out["note"] += (" (partial race: the window died before "
+                            "the runner-up candidates ran)")
+        return out
     except (OSError, ValueError, KeyError, TypeError):
         return {
             "metric": "single-chip int32 SUM reduction bandwidth, n=2^24",
@@ -150,6 +158,17 @@ def _snapshot_fallback(outage: str, snap: str | None = None) -> dict:
             "vs_baseline": 0.0,
             "note": f"accelerator unavailable: {outage}",
         }
+
+
+def _on_flagship_geometry(n: int) -> bool:
+    """Real chip at the headline n: the gate for snapshot writes and
+    the opportunistic doubles. Checks the ACTUAL backend (not a flag —
+    a CPU-default box must never clobber the snapshot with a host-speed
+    number) and the headline n (a --n smoke run is not the flagship
+    metric). A function so the off-chip tests can pin the incremental
+    persistence order without a chip."""
+    import jax
+    return jax.default_backend() == "tpu" and n == 1 << 24
 
 
 def main(argv=None) -> int:
@@ -199,36 +218,24 @@ def main(argv=None) -> int:
     cfgs = [dataclasses.replace(base, backend=b, kernel=k, threads=t)
             for b, k, t in CANDIDATES]
     logger = BenchLogger(None, None, console=sys.stderr)
-    results = run_benchmark_batch(cfgs, logger=logger)
-    for cfg, res in zip(cfgs, results):
-        print(f"# {cfg.backend} k{cfg.kernel} threads={cfg.threads}: "
-              f"{res.gbps:.1f} GB/s [{res.status.name}]", file=sys.stderr)
-    passed = [r for r in results if r.passed]
-    value = max((r.gbps for r in passed), default=0.0)
+
+    import math
+    flagship_geom = _on_flagship_geometry(ns.n)
     label = (f"2^{ns.n.bit_length() - 1}" if ns.n & (ns.n - 1) == 0
              else str(ns.n))
-    payload = {
-        "metric": f"single-chip int32 SUM reduction bandwidth, n={label}",
-        "value": round(value, 4),
-        "unit": "GB/s",
-        "vs_baseline": round(value / BASELINE_GBPS, 4),
-    }
-    import jax
-    # the flagship run: fresh verified value, real chip, headline n —
-    # the one gate both the snapshot and the doubles scoreboard key on
-    flagship = (bool(passed) and jax.default_backend() == "tpu"
-                and ns.n == 1 << 24)
-    if flagship:
-        # fresh verified on-chip value AT THE FLAGSHIP CONFIG: snapshot
-        # it immediately, so a later outage in the same round reports
-        # THIS measurement. Gated on the actual backend (not the flag —
-        # a CPU-default box must never clobber the snapshot with a
-        # host-speed number) and on the headline n (a --n smoke run is
-        # not the flagship metric).
-        import math
-        # (math stays local: bench.py's import-light preamble is what
-        # lets the device probe run before any heavy import)
-        _write_snapshot(payload, {
+
+    def _payload(rs):
+        best = max((r.gbps for r in rs if r.passed), default=0.0)
+        return {
+            "metric": f"single-chip int32 SUM reduction bandwidth, "
+                      f"n={label}",
+            "value": round(best, 4),
+            "unit": "GB/s",
+            "vs_baseline": round(best / BASELINE_GBPS, 4),
+        }
+
+    def _provenance(done):
+        return {
             f"{cfg.backend} k{cfg.kernel} threads={cfg.threads}":
                 # crash/WAIVE rows carry nan gbps: serialize null, not
                 # the non-RFC-8259 NaN literal (same guard as
@@ -236,19 +243,59 @@ def main(argv=None) -> int:
                 {"gbps": (round(res.gbps, 1)
                           if math.isfinite(res.gbps) else None),
                  "status": res.status.name}
-            for cfg, res in zip(cfgs, results)})
-    print(json.dumps(payload), flush=True)
-    if flagship:
-        # Opportunistic DOUBLE scoreboard (round-2 VERDICT item 1, the
-        # round's #1 gap): the driver's end-of-round bench.py may be
-        # the ONLY chip contact a round gets, so capture f64
-        # SUM/MIN/MAX here too — AFTER the headline line is printed
-        # and flushed (the one-JSON-line stdout contract is already
-        # satisfied; everything below is stderr + artifact files), and
-        # strictly best-effort: a doubles failure can neither change
-        # the exit code nor un-print the headline. BENCH_DOUBLES=0
-        # skips it (a window that wants the fastest possible bench).
-        _maybe_double_spots()
+            for cfg, res in done}
+
+    # Candidates run ONE AT A TIME, best-known-first, persisting after
+    # each: the tunnel relay FLAPS (round 4 observed a ~6-minute window
+    # die mid-step after two rounds of none), and chained timing does
+    # its device work at dispatch — a 4-candidate batch would persist
+    # nothing until all four had run. Value order inside the window:
+    # candidate 0 (the round-2/round-3 crowned winner) -> partial
+    # snapshot on disk -> headline stdout line -> the f64 DOUBLE
+    # scoreboard (the verdict's #1 gap for three rounds) -> runner-ups
+    # -> final snapshot. On flagship geometry the ONE stdout JSON line
+    # prints as soon as a candidate verifies — before the doubles —
+    # so a death later in the run cannot lose it; the candidates are
+    # ranked by the committed races, so first-verified is best-known
+    # (an upset by a runner-up still lands in the final snapshot's
+    # provenance). Off-chip runs keep the end-of-race print: there the
+    # metric is "best of the full race", and there is no window to
+    # die on.
+    results = []
+    printed = False
+
+    def _print_headline_once():
+        nonlocal printed
+        if not printed:
+            print(json.dumps(_payload(results)), flush=True)
+            printed = True
+
+    for i, cfg in enumerate(cfgs):
+        res = run_benchmark_batch([cfg], logger=logger)[0]
+        results.append(res)
+        print(f"# {cfg.backend} k{cfg.kernel} threads={cfg.threads}: "
+              f"{res.gbps:.1f} GB/s [{res.status.name}]", file=sys.stderr)
+        if flagship_geom and any(r.passed for r in results):
+            # fresh verified on-chip value AT THE FLAGSHIP CONFIG:
+            # snapshot immediately so a relay death between candidates
+            # (or a later outage in the round) reports THIS measurement
+            snap = _payload(results)
+            if i < len(cfgs) - 1:
+                snap["partial"] = True   # race still in flight
+            _write_snapshot(snap, _provenance(zip(cfgs, results)))
+            _print_headline_once()
+        if flagship_geom and i == 0:
+            # Opportunistic DOUBLE scoreboard (VERDICT item 1, the
+            # round's #1 gap) directly after the first candidate:
+            # stderr + artifact files only, strictly best-effort (a
+            # doubles failure can neither change the exit code nor
+            # block the runner-ups), and NOT gated on candidate 0
+            # passing — the dd path is independent of the int race.
+            # BENCH_DOUBLES=0 skips it (a window that wants the
+            # fastest possible bench).
+            _maybe_double_spots()
+    passed = [r for r in results if r.passed]
+    _print_headline_once()
     return 0 if passed else 1
 
 
