@@ -79,3 +79,30 @@ def test_streambuffers_flag():
     assert ReduceConfig(method="SUM").stream_buffers == 4
     with pytest.raises(ValueError):
         ReduceConfig(method="SUM", stream_buffers=0)
+
+
+def test_compile_cache_hook(monkeypatch, tmp_path):
+    """enable_compile_cache (called by every entry point via
+    _apply_platform) points the persistent XLA cache at a repo-local
+    dir — the flapping-relay countermeasure that makes a 20-40 s tunnel
+    compile paid in one window free in the next. Pins: the config lands
+    where requested, the default is the repo's untracked .jax_cache,
+    and the kill switch disables it."""
+    import os
+
+    import jax
+
+    from tpu_reductions.config import enable_compile_cache
+
+    enable_compile_cache(str(tmp_path / "jc"))
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / "jc")
+
+    enable_compile_cache()   # default: <repo>/.jax_cache
+    assert jax.config.jax_compilation_cache_dir.endswith(".jax_cache")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert jax.config.jax_compilation_cache_dir == os.path.join(
+        repo, ".jax_cache")
+
+    monkeypatch.setenv("TPU_REDUCTIONS_NO_COMPILE_CACHE", "1")
+    enable_compile_cache(str(tmp_path / "nope"))
+    assert jax.config.jax_compilation_cache_dir != str(tmp_path / "nope")
