@@ -186,7 +186,14 @@ def main(argv=None) -> int:
     if ns.n <= 0:
         p.error("--n must be positive")
 
+    import os
+    # BENCH_SKIP_PROBE=1: the caller (chip_session.sh) verified the
+    # relay seconds ago; the probe subprocess would re-pay a full jax
+    # init (~30-40 s of a window that may only be minutes long) to
+    # learn the same thing. The rare wedged-but-ports-open tunnel the
+    # probe guards against is bounded by the session's step budget.
     outage = (None if ns.platform == "cpu"
+              or os.environ.get("BENCH_SKIP_PROBE") == "1"
               else _device_probe(platform=ns.platform))
     if outage is not None:
         print(f"accelerator unavailable: {outage}; reporting the outage "

@@ -166,3 +166,23 @@ def test_bench_persists_incrementally_on_flagship_geometry(monkeypatch,
     assert "partial" not in snaps[-1][1]         # final = complete
     assert snaps[-1][2] == len(bench.CANDIDATES)
     assert snaps[-1][1]["value"] >= snaps[0][1]["value"]
+
+
+def test_bench_skip_probe_env(monkeypatch, capsys):
+    """BENCH_SKIP_PROBE=1 (set by chip_session.sh, which verified the
+    relay seconds earlier) must skip the ~30-40 s device-probe
+    subprocess entirely — the probe would re-pay a full jax init out
+    of a window that may only be minutes long."""
+    bench = _load_bench()
+
+    def boom(platform=None):
+        raise AssertionError("probe ran despite BENCH_SKIP_PROBE=1")
+
+    monkeypatch.setattr(bench, "_device_probe", boom)
+    monkeypatch.setenv("BENCH_SKIP_PROBE", "1")
+    # no --platform: exactly the flagship invocation shape (conftest
+    # has already pinned the backend to cpu for the test process)
+    rc = bench.main(["--n", "65536", "--iterations", "8"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(out)["value"] > 0

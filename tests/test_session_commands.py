@@ -46,10 +46,11 @@ STEPS = [
       "--iterations=8", "--chainreps=2", "--out=double_spot.json"],
      "double_spot.json"),
     ("python -m tpu_reductions.utils.calibrate --ladder "
-     "--chainspan 256 --reps 7",
+     "--chainspan 256 --reps 7 --out=calibration_live.json",
      "tpu_reductions.utils.calibrate",
-     ["--ladder", "--chainspan", "8", "--reps", "2", "--n", "16384"],
-     None),
+     ["--ladder", "--chainspan", "8", "--reps", "2", "--n", "16384",
+      "--out=calibration_live.json"],
+     "calibration_live.json"),
     ("python -m tpu_reductions.bench.smoke --out=smoke.json",
      "tpu_reductions.bench.smoke",
      ["--out=smoke.json"],

@@ -177,3 +177,47 @@ def test_atomic_json_dump_replaces_never_truncates(tmp_path):
     atomic_json_dump(p, {"v": 2, "rows": [1, 2, 3]})
     assert json.loads(p.read_text())["v"] == 2
     assert not (tmp_path / "a.json.tmp").exists()  # temp cleaned up
+
+
+def test_calibrate_ladder_persists_per_rung(tmp_path, monkeypatch,
+                                            capsys):
+    """--out persists after EVERY rung (flapping-relay discipline): a
+    ladder that dies before the deciding HBM rung leaves a complete:
+    false file carrying the VMEM rung and NO verdict fields — a
+    partial file must never be mistaken for a decided one."""
+    import json
+
+    from tpu_reductions.utils import calibrate as cal_mod
+
+    out = tmp_path / "cal.json"
+    rc = cal_mod.main(["--n", "65536", "--iters", "4", "--reps", "2",
+                       "--chainspan", "8", "--ladder",
+                       "--out", str(out)])
+    assert rc == 0
+    d = json.loads(out.read_text())
+    assert d["complete"] is True and len(d["rungs"]) == 2
+    assert d["deciding_n"] == 65536 * 4
+    capsys.readouterr()
+
+    # kill the ladder after the first rung: the persisted file must be
+    # the partial one
+    real = cal_mod.calibrate
+    calls = []
+
+    def dies_on_second(**kw):
+        if calls:
+            raise RuntimeError("synthetic relay death")
+        calls.append(kw)
+        return real(**kw)
+
+    monkeypatch.setattr(cal_mod, "calibrate", dies_on_second)
+    out2 = tmp_path / "cal2.json"
+    try:
+        cal_mod.main(["--n", "65536", "--iters", "4", "--reps", "2",
+                      "--chainspan", "8", "--ladder",
+                      "--out", str(out2)])
+    except RuntimeError:
+        pass
+    d2 = json.loads(out2.read_text())
+    assert d2["complete"] is False and len(d2["rungs"]) == 1
+    assert "deciding_n" not in d2 and "block_awaits_execution" not in d2

@@ -219,6 +219,11 @@ def main(argv=None) -> int:
     p.add_argument("--chainspan", dest="chain_span", type=int, default=16)
     p.add_argument("--platform", type=str, default=None,
                    choices=("cpu", "tpu"))
+    p.add_argument("--out", type=str, default=None,
+                   help="Persist the JSON verdict to this file as rungs "
+                        "complete (partial: true until the deciding rung "
+                        "lands) — the flapping-relay discipline: a window "
+                        "that dies mid-ladder keeps the first rung")
     p.add_argument("--ladder", action="store_true",
                    help="Run the two-regime ladder instead of one size: "
                         "a VMEM-resident size (--n) and an HBM-bound one "
@@ -231,25 +236,47 @@ def main(argv=None) -> int:
     _apply_platform(ns)
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
     maybe_arm_for_tpu()  # no-op off-TPU; exits 3 on a dead relay
+    def _persist(payload: dict) -> None:
+        if ns.out is None:
+            return
+        from tpu_reductions.utils.jsonio import atomic_json_dump
+        atomic_json_dump(ns.out, payload)
+
     if ns.ladder:
-        rungs = [calibrate(n=ns.n, dtype=ns.dtype, iters=ns.iters,
-                           reps=ns.reps, chain_span=ns.chain_span),
-                 calibrate(n=ns.n * 4, dtype=ns.dtype, iters=ns.iters,
-                           reps=ns.reps,
-                           chain_span=max(8, ns.chain_span // 4))]
-        for cal in rungs:
-            print(cal.describe())
-        verdict = rungs[-1]   # the HBM-bound rung decides
-        print(json.dumps({
-            "rungs": [c.to_dict() for c in rungs],
-            "block_awaits_execution": verdict.block_awaits_execution,
-            "indeterminate": verdict.indeterminate,
-            "deciding_n": verdict.n,
-        }))
+        # rungs run (and persist) one at a time: a window that dies
+        # between rungs keeps the VMEM rung's data instead of nothing
+        rungs = []
+        specs = [(ns.n, ns.chain_span),
+                 (ns.n * 4, max(8, ns.chain_span // 4))]
+        for i, (n, span) in enumerate(specs):
+            cal = calibrate(n=n, dtype=ns.dtype, iters=ns.iters,
+                            reps=ns.reps, chain_span=span)
+            rungs.append(cal)
+            print(cal.describe(), flush=True)
+            if i < len(specs) - 1:
+                # no verdict fields yet: the HBM (last) rung decides,
+                # and it has not run — a partial file must never be
+                # mistaken for a decided one (same completeness key as
+                # spot/smoke artifacts)
+                payload = {"rungs": [c.to_dict() for c in rungs],
+                           "complete": False}
+            else:
+                verdict = rungs[-1]   # the HBM-bound (last) rung decides
+                payload = {
+                    "rungs": [c.to_dict() for c in rungs],
+                    "complete": True,
+                    "block_awaits_execution":
+                        verdict.block_awaits_execution,
+                    "indeterminate": verdict.indeterminate,
+                    "deciding_n": verdict.n,
+                }
+            _persist(payload)
+        print(json.dumps(payload))
         return 0
     cal = calibrate(n=ns.n, dtype=ns.dtype, iters=ns.iters, reps=ns.reps,
                     chain_span=ns.chain_span)
     print(cal.describe())
+    _persist({**cal.to_dict(), "complete": True})
     print(json.dumps(cal.to_dict()))
     return 0
 
