@@ -104,8 +104,13 @@ if cal.get("indeterminate") and not dryrun:
 # 2) the tuned flagship grid at the reference's n=2^24
 # (reduction.cpp:665): kernel 6 threads=512 won the committed tile race
 # (tune_r02.json) at 6238 GB/s
+# float64 FIRST: the report's DOUBLE rows are the committed story's
+# weakest numbers (0.868-0.896 GB/s vs the reference's 92.77-class,
+# VERDICT r3 item 1) — if a flapping-relay window cuts this grid, the
+# rows that replace them must be the ones already on disk
 sc_rows = sweep_all(n=1 << (18 if dryrun else 24),
                     repeats=2 if dryrun else 3, iterations=256,
+                    dtypes=("float64", "int32"),
                     backend="pallas", kernel=6, threads=512,
                     timing="chained",
                     out_dir=str(out / "single_chip"), logger=log)
