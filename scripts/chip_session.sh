@@ -123,8 +123,37 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
 # applies to it).
 SESSION_RAN=0   # set by step(): an abort BEFORE any step must not
                 # collate a "window summary" out of stale artifacts
+# the last commit touching the flagship example BEFORE this session:
+# the trap regenerates the report when this moves (step 11 commits its
+# own artifacts, so worktree dirtiness alone misses them)
+TPU_RUN_HEAD=$(git log -1 --format=%H -- examples/tpu_run 2>/dev/null \
+               || echo none)
 summarize_on_exit() {
     [ "$SESSION_RAN" = 1 ] || return 0
+    # Offline evidence collation FIRST (pure disk work — safe after the
+    # relay dies, which is exactly when this trap usually runs): spot
+    # rows measured at the flagship contract seed the grid cache, and
+    # if anything under examples/tpu_run changed this window (seeded
+    # cells, curve cells from a budget-cut flagship step whose own
+    # report regeneration never ran — step 11 COMMITS those cells
+    # itself, so the dirty-worktree test alone would miss them; the
+    # recorded pre-session commit hash catches the committed case) the
+    # report is re-collated from disk and committed. Both calls carry
+    # the same budget discipline as the steps: the trap usually runs
+    # with the relay dead, and an import stall here would pin the
+    # watcher instead of re-arming it.
+    timeout 300 python -m tpu_reductions.bench.seed_cache \
+        double_spot.json int_op_spot_k6.json \
+        --grid-dir examples/tpu_run/single_chip || true
+    if [ -n "$(git status --porcelain -- examples/tpu_run)" ] \
+            || [ "$(git log -1 --format=%H -- examples/tpu_run)" \
+                 != "$TPU_RUN_HEAD" ]; then
+        timeout 600 python -m tpu_reductions.bench.regen \
+            examples/tpu_run || true
+        git add -- examples/tpu_run \
+            && git commit -q -m "Window evidence collated into examples/tpu_run (offline regen)" \
+                -- examples/tpu_run || true
+    fi
     python scripts/summarize_window.py . > WINDOW_SUMMARY.md 2>/dev/null \
         || true
     if [ -s WINDOW_SUMMARY.md ] && git add -- WINDOW_SUMMARY.md \
@@ -180,10 +209,14 @@ step "headline bench" 240 BENCH_live.json BENCH_snapshot.json BENCH_doubles.json
 # all-device f64 (ops/dd_reduce.device_finish_pairs): the DOUBLE
 # SUM/MIN/MAX scoreboard — expected near the INT roof fraction instead
 # of the transfer-bound 0.9 GB/s round 2 measured through the tunnel
+# --chainreps=5 matches sweep.FLAGSHIP_GRID exactly, so these rows
+# seed the flagship grid's resume cache at session exit (seed_cache)
+# and replace the 0.87-0.90 GB/s legacy DOUBLE rows in the report even
+# when the window never reaches the 3 h flagship step
 step "double scoreboard" 300 double_spot.json -- \
     python -m tpu_reductions.bench.spot --type=double \
         --methods=SUM,MIN,MAX --n=16777216 --iterations=256 \
-        --chainreps=7 --out=double_spot.json
+        --chainreps=5 --out=double_spot.json
 
 # --out persists per rung (partial until the deciding HBM rung lands):
 # a budget cut or relay death mid-ladder keeps the VMEM rung

@@ -104,23 +104,25 @@ if cal.get("indeterminate") and not dryrun:
 # 2) the tuned flagship grid at the reference's n=2^24
 # (reduction.cpp:665): kernel 6 threads=512 won the committed tile race
 # (tune_r02.json) at 6238 GB/s
-# float64 FIRST: the report's DOUBLE rows are the committed story's
-# weakest numbers (0.868-0.896 GB/s vs the reference's 92.77-class,
-# VERDICT r3 item 1) — if a flapping-relay window cuts this grid, the
-# rows that replace them must be the ones already on disk
-sc_rows = sweep_all(n=1 << (18 if dryrun else 24),
-                    repeats=2 if dryrun else 3, iterations=256,
-                    dtypes=("float64", "int32"),
-                    backend="pallas", kernel=6, threads=512,
-                    timing="chained",
-                    out_dir=str(out / "single_chip"), logger=log)
-sc = {}
-for r in sc_rows:
-    if r and r["status"] == "PASSED":
-        dt = {"int32": "INT", "float64": "DOUBLE"}.get(
-            r["dtype"], r["dtype"].upper())
-        sc.setdefault((dt, r["method"]), []).append(r["gbps"])
-sc = {k: sum(v) / len(v) for k, v in sc.items()}
+# The grid contract lives in ONE place (sweep.FLAGSHIP_GRID — float64
+# FIRST: the report's DOUBLE rows are the committed story's weakest
+# numbers, VERDICT r3 item 1, and must land before a flapping-relay
+# window cuts the grid); averaging/plot constants are shared with the
+# offline regenerator (bench/regen.py) so a post-window regen can
+# never drift from what this live run renders.
+from tpu_reductions.bench.regen import collect_averages
+from tpu_reductions.bench.sweep import FLAGSHIP_GRID
+
+grid = dict(FLAGSHIP_GRID)
+if dryrun:
+    grid.update(n=1 << 18, repeats=2)
+sweep_all(**grid, out_dir=str(out / "single_chip"), logger=log)
+# averages from the on-disk cells sweep_all just wrote/resumed — the
+# same collection regen.py runs offline (dryrun cells differ from the
+# contract n, so the dryrun collects at its own geometry)
+dry_grid = grid if dryrun else None
+sc = collect_averages(out / "single_chip", grid=dry_grid,
+                      log=lambda m: log.log(m))
 (out / "single_chip" / "averages.json").write_text(
     json.dumps({f"{d} {m}": g for (d, m), g in sorted(sc.items())},
                indent=1))
@@ -187,10 +189,9 @@ def persist_json(_cfg=None, res=None):
 
 
 def make_plots():
+    from tpu_reductions.bench.regen import PLOT_HLINES, PLOT_TITLE
     return plot_vs_n(merged_rows(), out / "bandwidth_vs_n",
-                     title="TPU v5e single-chip reduction bandwidth vs N",
-                     hlines={"reference CUDA int SUM (90.8)": 90.8413,
-                             "v5e HBM roof (819)": 819.0})
+                     title=PLOT_TITLE, hlines=PLOT_HLINES)
 
 
 def shmoo_cfg(dtype):

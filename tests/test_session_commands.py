@@ -40,11 +40,20 @@ def _script_invocations() -> set:
 STEPS = [
     ("python -m tpu_reductions.bench.spot --type=double "
      "--methods=SUM,MIN,MAX --n=16777216 --iterations=256 "
-     "--chainreps=7 --out=double_spot.json",
+     "--chainreps=5 --out=double_spot.json",
      "tpu_reductions.bench.spot",
      ["--type=double", "--methods=SUM,MIN,MAX", "--n=16384",
       "--iterations=8", "--chainreps=2", "--out=double_spot.json"],
      "double_spot.json"),
+    ("python -m tpu_reductions.bench.seed_cache double_spot.json "
+     "int_op_spot_k6.json --grid-dir examples/tpu_run/single_chip",
+     "tpu_reductions.bench.seed_cache",
+     ["absent_spot.json", "--grid-dir", "grid"],
+     None),
+    ("python -m tpu_reductions.bench.regen examples/tpu_run",
+     "tpu_reductions.bench.regen",
+     ["examples/tpu_run"],
+     None),
     ("python -m tpu_reductions.utils.calibrate --ladder "
      "--chainspan 256 --reps 7 --out=calibration_live.json",
      "tpu_reductions.utils.calibrate",

@@ -1,0 +1,167 @@
+"""Offline regeneration of a flagship experiment's report artifacts.
+
+scripts/run_tpu_experiment.sh regenerates report.md/report.tex/
+writeup.pdf only at its OWN end — a budget cut or relay death mid-
+experiment leaves fresh raw cells and shmoo rows on disk with a stale
+report on top of them. And the spot->cache seeder (seed_cache.py) can
+land new flagship cells with no experiment run at all. This tool
+re-collates everything FROM DISK: averages from the grid's raw cells,
+curves from shmoo.json, roofline annotation, figures, report, pdf —
+the analysis layer of run_tpu_experiment.sh with the benchmarking
+stripped out (the same collected->averaged->plotted offline pipeline
+the reference ran as getAvgs.sh + makePlots.gp over accumulated
+stdout-* files).
+
+Offline by construction: never touches a device, safe after the relay
+dies. DOUBLE/INT averaging prefers rows measured under the current
+flagship contract (sweep.FLAGSHIP_GRID); for a (dtype, op) with no
+contract-matching rows it falls back to whatever PASSED rows exist
+(legacy cells from an older discipline), so a half-migrated cache
+still reports honestly rather than dropping the rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from tpu_reductions.bench.sweep import FLAGSHIP_GRID, cell_matches
+
+# the flagship plot constants (scripts/run_tpu_experiment.sh step 3)
+PLOT_TITLE = "TPU v5e single-chip reduction bandwidth vs N"
+PLOT_HLINES = {"reference CUDA int SUM (90.8)": 90.8413,
+               "v5e HBM roof (819)": 819.0}
+_DTYPE_LABEL = {"int32": "INT", "float64": "DOUBLE"}
+
+
+def collect_averages(grid_dir: Path, grid: dict | None = None,
+                     log=print) -> Dict[Tuple[str, str], float]:
+    """{(DATATYPE, OP): mean GB/s} from the grid's raw cells, contract-
+    matching rows first, legacy PASSED rows as the labeled fallback."""
+    grid = dict(grid or FLAGSHIP_GRID)
+    contract = {k: grid[k] for k in ("n", "backend", "kernel", "threads",
+                                     "iterations", "timing",
+                                     "chain_reps")}
+    matching: Dict[Tuple[str, str], List[float]] = {}
+    legacy: Dict[Tuple[str, str], List[float]] = {}
+    for f in sorted((grid_dir / "raw_output").glob("run-*.json")):
+        try:
+            row = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        method, dtype = row.get("method"), row.get("dtype")
+        gbps = row.get("gbps")
+        if (row.get("status") != "PASSED" or not method or not dtype
+                or not isinstance(gbps, (int, float))):
+            continue
+        key = (_DTYPE_LABEL.get(dtype, dtype.upper()), method)
+        if cell_matches(row, method=method, dtype=dtype, **contract):
+            matching.setdefault(key, []).append(float(gbps))
+        elif (row.get("n") == contract["n"]
+              and row.get("kernel") == contract["kernel"]):
+            # legacy fallback is for older-DISCIPLINE cells at the
+            # flagship geometry (e.g. round-2 f64 fetch rows) — a cell
+            # at a different n/kernel must never be averaged into the
+            # n=2^24 table, however it got into the cache
+            legacy.setdefault(key, []).append(float(gbps))
+    out = {}
+    for key in sorted(set(matching) | set(legacy)):
+        vals = matching.get(key) or legacy.get(key)
+        out[key] = sum(vals) / len(vals)
+        if key not in matching:
+            log(f"regen: {key[0]} {key[1]}: no contract-matching cells; "
+                f"averaging {len(vals)} legacy row(s)")
+    return out
+
+
+def regenerate(out_dir: str | Path, device_kind: str | None = None,
+               log=print) -> bool:
+    """Re-collate out_dir's report artifacts from disk. Returns False
+    (and does nothing) when out_dir has no experiment data."""
+    out = Path(out_dir)
+    grid_dir = out / "single_chip"
+    shmoo_file = out / "shmoo.json"
+    if not grid_dir.is_dir() and not shmoo_file.exists():
+        log(f"regen: {out}: no experiment data (no single_chip/, no "
+            "shmoo.json); nothing to do")
+        return False
+
+    from tpu_reductions.bench.pdf import generate_pdf
+    from tpu_reductions.bench.plot import plot_vs_n
+    from tpu_reductions.bench.report import generate_report
+    from tpu_reductions.bench.roofline import annotate, summarize
+
+    cal = None
+    cal_file = out / "calibration.json"
+    if cal_file.exists():
+        try:
+            cal = json.loads(cal_file.read_text())
+        except (OSError, ValueError):
+            cal = None
+    platform = (cal or {}).get("platform", "tpu")
+
+    sc = collect_averages(grid_dir, log=log) if grid_dir.is_dir() else {}
+    if sc:
+        (grid_dir / "averages.json").write_text(
+            json.dumps({f"{d} {m}": g for (d, m), g in sorted(sc.items())},
+                       indent=1))
+
+    shmoo_rows: List[dict] = []
+    if shmoo_file.exists():
+        try:
+            shmoo_rows = json.loads(shmoo_file.read_text())
+        except (OSError, ValueError):
+            shmoo_rows = []
+
+    figures = ()
+    if shmoo_rows:
+        figures = plot_vs_n(shmoo_rows, out / "bandwidth_vs_n",
+                            title=PLOT_TITLE, hlines=PLOT_HLINES)
+    if device_kind is None:
+        # reuse the kind the live run recorded (roofline.json) so an
+        # offline regen never relabels the hardware
+        try:
+            ann_prior = json.loads((out / "roofline.json").read_text())
+            device_kind = ann_prior[0]["device_kind"]
+        except (OSError, ValueError, LookupError, TypeError, KeyError):
+            device_kind = None
+    ann = annotate(shmoo_rows, device_kind=device_kind)
+    roof_lines = summarize(ann)
+    if ann:
+        (out / "roofline.json").write_text(json.dumps(ann, indent=1))
+
+    paths = generate_report({}, single_chip=sc, figures=figures,
+                            out_dir=out, platform=platform,
+                            calibration=cal, roofline=roof_lines,
+                            annotated_rows=ann)
+    log(f"regen: report: {paths['md']} {paths['tex']}")
+    pdf = generate_pdf(out, platform=platform,
+                       data={"avgs": {}, "single_chip": sc or None,
+                             "calibration": cal,
+                             "figures": list(figures),
+                             "roofline": roof_lines,
+                             "annotated_rows": ann})
+    log(f"regen: writeup: {pdf}")
+    return True
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.bench.regen",
+        description="Regenerate an experiment dir's report artifacts "
+                    "from its on-disk data (offline; no device)")
+    p.add_argument("out_dir")
+    p.add_argument("--device-kind", default=None,
+                   help="roofline hardware label override (default: "
+                        "whatever the live run recorded)")
+    ns = p.parse_args(argv)
+    regenerate(ns.out_dir, device_kind=ns.device_kind,
+               log=lambda m: print(m, file=sys.stderr))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,47 @@ from tpu_reductions.bench.driver import (BenchResult, _resolve_backend,
 from tpu_reductions.config import KERNEL_SINGLE_PASS, ReduceConfig
 from tpu_reductions.utils.logging import BenchLogger
 
+# The flagship single-chip grid contract (scripts/run_tpu_experiment.sh
+# step 2, the source of the report's INT/DOUBLE table): the reference's
+# n=2^24 headline config (reduction.cpp:665) at the crowned kernel-6
+# geometry (tune_r02.json), chained discipline. ONE definition shared
+# by the experiment script, the spot->cache seeder (seed_cache.py) and
+# the offline report regenerator (regen.py) so "does this row belong
+# to the flagship table" has exactly one answer. float64 leads: the
+# DOUBLE rows are the committed story's weakest numbers (VERDICT r3
+# item 1) and must land first when a window is cut short.
+FLAGSHIP_GRID = dict(
+    dtypes=("float64", "int32"), methods=("SUM", "MIN", "MAX"),
+    n=1 << 24, repeats=3, iterations=256, backend="pallas",
+    kernel=6, threads=512, timing="chained", chain_reps=5)
+
+
+def cell_matches(row: dict, *, method: str, dtype: str, n: int,
+                 backend: str, kernel: int, threads: int,
+                 iterations: int, timing: str, chain_reps: int) -> bool:
+    """Whether a cached raw cell is a verified measurement of EXACTLY
+    this sweep configuration — the sweep_all resume acceptance test,
+    shared with the seeder/regenerator. Cached rows store what actually
+    ran (the resolved backend, never "auto"; the resolved discipline,
+    e.g. the f64 dd path's deterministic chained->fetch fallback), so
+    the comparison resolves the probe config the same way. Pure: never
+    touches a device."""
+    probe = ReduceConfig(method=method, dtype=dtype, backend=backend,
+                         timing=timing, chain_reps=chain_reps,
+                         threads=threads, kernel=kernel)
+    want_timing = resolved_timing(probe)
+    return (row.get("status") == "PASSED"
+            and row.get("method", method) == method
+            and row.get("dtype", dtype) == dtype
+            and row.get("n") == n
+            and row.get("backend") == _resolve_backend(probe)
+            and row.get("kernel") == probe.kernel
+            and row.get("threads", 256) == threads
+            and row.get("iterations") == iterations
+            and row.get("timing", "periter") == want_timing
+            and (want_timing != "chained"
+                 or row.get("chain_reps") == chain_reps))
+
 
 def run_shmoo(cfg: ReduceConfig, *, min_pow: int = 10, max_pow: int = 24,
               skip_ns: Optional[set] = None,
@@ -236,25 +277,13 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                         row = {}  # truncated by an interrupted run: re-run
                     # only reuse a cached cell that (a) succeeded and
                     # (b) was measured under the SAME sweep parameters —
-                    # stale-config or failed cells are re-run. Cached rows
-                    # store what actually ran (the resolved backend, never
-                    # "auto"; the resolved discipline, e.g. the f64 dd
-                    # path's deterministic chained->fetch fallback), so
-                    # the keys compare against the same resolution.
-                    probe = ReduceConfig(method=method, dtype=dtype,
-                                         backend=backend, timing=timing,
-                                         chain_reps=chain_reps,
-                                         threads=threads, kernel=kernel)
-                    want_timing = resolved_timing(probe)
-                    if (row.get("status") == "PASSED"
-                            and row.get("n") == n
-                            and row.get("backend") == _resolve_backend(probe)
-                            and row.get("kernel") == probe.kernel
-                            and row.get("threads", 256) == threads
-                            and row.get("iterations") == iterations
-                            and row.get("timing", "periter") == want_timing
-                            and (want_timing != "chained"
-                                 or row.get("chain_reps") == chain_reps)):
+                    # stale-config or failed cells are re-run
+                    # (cell_matches, shared with seed_cache/regen)
+                    if cell_matches(row, method=method, dtype=dtype,
+                                    n=n, backend=backend, kernel=kernel,
+                                    threads=threads,
+                                    iterations=iterations, timing=timing,
+                                    chain_reps=chain_reps):
                         rows.append(row)
                         logger.log(f"sweep {dtype} {method} rep={rep} "
                                    f"-> resumed ({row['gbps']:.4f} GB/s "
