@@ -306,26 +306,37 @@ def main(argv=None) -> int:
     return 0 if passed else 1
 
 
-def _maybe_double_spots(n: int = 1 << 24, iterations: int = 128,
-                        reps: int = 3, path: str | None = None) -> None:
+def _maybe_double_spots(n: int | None = None, iterations: int | None = None,
+                        reps: int | None = None,
+                        path: str | None = None) -> None:
     """Best-effort f64 SUM/MIN/MAX chained spots at the flagship n ->
     BENCH_doubles.json next to this file. All-device dd path (pair-tree
-    finish), oracle-verified, median of `reps` slope reps — the rows
-    that must beat the reference's own headline doubles
-    (92.7729/92.6014/92.7552 GB/s, mpi/CUdata.txt:2-4). The size/path
+    finish), oracle-verified, median slope reps — the rows that must
+    beat the reference's own headline doubles (92.7729/92.6014/92.7552
+    GB/s, mpi/CUdata.txt:2-4). Defaults come from sweep.FLAGSHIP_GRID
+    so the rows are seedable into the flagship grid cache
+    (bench/seed_cache.py): even a window that dies before the session's
+    spot step then carries report-grade DOUBLE evidence. The size/path
     parameters exist for tests; main() always calls with defaults."""
     import os
     if os.environ.get("BENCH_DOUBLES", "1") != "1":
         return
     try:
         from tpu_reductions.bench.spot import _write, run_spots
+        from tpu_reductions.bench.sweep import FLAGSHIP_GRID
         from tpu_reductions.config import ReduceConfig
         from tpu_reductions.utils.logging import BenchLogger
 
-        print("# doubles: f64 SUM/MIN/MAX chained spots (dd path)",
-              file=sys.stderr)
+        n = FLAGSHIP_GRID["n"] if n is None else n
+        iterations = (FLAGSHIP_GRID["iterations"] if iterations is None
+                      else iterations)
+        reps = FLAGSHIP_GRID["chain_reps"] if reps is None else reps
+        print("# doubles: f64 SUM/MIN/MAX chained spots (dd path, "
+              "flagship-grid contract)", file=sys.stderr)
         base = ReduceConfig(method="SUM", dtype="float64", n=n,
-                            threads=512, iterations=iterations, warmup=2,
+                            threads=FLAGSHIP_GRID["threads"],
+                            kernel=FLAGSHIP_GRID["kernel"],
+                            iterations=iterations, warmup=2,
                             timing="chained", chain_reps=reps,
                             stat="median", log_file=None)
         if path is None:

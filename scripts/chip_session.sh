@@ -143,7 +143,7 @@ summarize_on_exit() {
     # with the relay dead, and an import stall here would pin the
     # watcher instead of re-arming it.
     timeout 300 python -m tpu_reductions.bench.seed_cache \
-        double_spot.json int_op_spot_k6.json \
+        double_spot.json int_op_spot_k6.json BENCH_doubles.json \
         --grid-dir examples/tpu_run/single_chip || true
     if [ -n "$(git status --porcelain -- examples/tpu_run)" ] \
             || [ "$(git log -1 --format=%H -- examples/tpu_run)" \

@@ -46,7 +46,8 @@ STEPS = [
       "--iterations=8", "--chainreps=2", "--out=double_spot.json"],
      "double_spot.json"),
     ("python -m tpu_reductions.bench.seed_cache double_spot.json "
-     "int_op_spot_k6.json --grid-dir examples/tpu_run/single_chip",
+     "int_op_spot_k6.json BENCH_doubles.json "
+     "--grid-dir examples/tpu_run/single_chip",
      "tpu_reductions.bench.seed_cache",
      ["absent_spot.json", "--grid-dir", "grid"],
      None),
