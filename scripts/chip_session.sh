@@ -50,6 +50,15 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
     fi
     shift
     echo "=== chip_session: $name (budget ${budget}s) ==="
+    if [ "$SESSION_RAN" = 0 ]; then
+        # the last commit touching the flagship example BEFORE the
+        # session's first step: the exit trap regenerates the report
+        # when this moves (step 11 commits its own artifacts, so
+        # worktree dirtiness alone would miss them). Recorded here —
+        # in the cwd the steps commit from — not at source time.
+        TPU_RUN_HEAD=$(git log -1 --format=%H -- examples/tpu_run \
+                       2>/dev/null || echo none)
+    fi
     SESSION_RAN=1
     if ! relay_ok; then
         # a step that exited 1 for its own reasons (e.g. bench.py's
@@ -123,11 +132,7 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
 # applies to it).
 SESSION_RAN=0   # set by step(): an abort BEFORE any step must not
                 # collate a "window summary" out of stale artifacts
-# the last commit touching the flagship example BEFORE this session:
-# the trap regenerates the report when this moves (step 11 commits its
-# own artifacts, so worktree dirtiness alone misses them)
-TPU_RUN_HEAD=$(git log -1 --format=%H -- examples/tpu_run 2>/dev/null \
-               || echo none)
+TPU_RUN_HEAD="" # recorded by the first step() call (see there)
 summarize_on_exit() {
     [ "$SESSION_RAN" = 1 ] || return 0
     # Offline evidence collation FIRST (pure disk work — safe after the
@@ -153,6 +158,10 @@ summarize_on_exit() {
         git add -- examples/tpu_run \
             && git commit -q -m "Window evidence collated into examples/tpu_run (offline regen)" \
                 -- examples/tpu_run || true
+        # our own commit moved the head: re-record it so a re-entrant
+        # trap (or a later manual call) doesn't re-collate a no-op
+        TPU_RUN_HEAD=$(git log -1 --format=%H -- examples/tpu_run \
+                       2>/dev/null || echo none)
     fi
     python scripts/summarize_window.py . > WINDOW_SUMMARY.md 2>/dev/null \
         || true

@@ -151,3 +151,64 @@ def test_session_budgets_keep_the_first_steps_inside_a_short_window():
     # the flagship long tail must still be bounded (watcher re-arm
     # depends on the session eventually exiting)
     assert max(budgets) <= 4 * 3600
+
+
+def _flagship_row():
+    import json
+    return json.dumps({
+        "method": "SUM", "dtype": "float64", "n": 1 << 24,
+        "backend": "pallas", "kernel": 6, "gbps": 150.0, "avg_s": 1e-3,
+        "iterations": 256, "status": "PASSED", "device_result": 1.0,
+        "oracle_result": 1.0, "abs_diff": 0.0, "waived_reason": None,
+        "timing": "chained", "threads": 512, "max_blocks": 64,
+        "chain_reps": 5})
+
+
+def test_exit_trap_collates_evidence_committed_by_a_step(tmp_path):
+    """The round-4 bridge, end to end in the step harness: a step
+    commits fresh flagship cells itself (the step-11 shape the
+    dirty-worktree test alone would miss); the exit trap must notice
+    the moved examples/tpu_run head, regenerate the report offline,
+    and commit it — and a second trap run with nothing new must NOT
+    commit again."""
+    import os
+
+    repo_root = str(SCRIPT.parent.parent)
+    raw = "examples/tpu_run/single_chip/raw_output"
+    body = (
+        f"export PYTHONPATH='{repo_root}'\n"
+        # pre-session flagship state, committed (the round-2 analog)
+        f"mkdir -p {raw}\n"
+        f"printf '%s' '{_flagship_row()}' > {raw}/run-float64-SUM-0.json\n"
+        "git add examples && git commit -q -m pre-session\n"
+        # the session: one step that writes AND commits a new cell
+        # (artifact = the directory, exactly like the flagship step)
+        "step 'toy flagship' 60 examples/tpu_run -- "
+        "bash -c 'echo \"[]\" > examples/tpu_run/shmoo.json'\n"
+        "summarize_on_exit\n"
+        "echo TRAP_DONE\n"
+        "summarize_on_exit\n"   # idempotency: nothing new now
+        "echo TRAP2_DONE\n")
+    repo, r = _drive(tmp_path, body)
+    assert "TRAP2_DONE" in r.stdout, r.stdout + r.stderr
+    log = _log(repo)
+    assert "On-chip artifacts: toy flagship" in log
+    assert log.count("Window evidence collated") == 1, log
+    # the regen really ran: report artifacts exist in the temp repo
+    assert (repo / "examples/tpu_run/report.md").is_file()
+    md = (repo / "examples/tpu_run/report.md").read_text()
+    assert "150.0" in md
+
+
+def test_exit_trap_skips_collation_when_nothing_changed(tmp_path):
+    repo_root = str(SCRIPT.parent.parent)
+    body = (
+        f"export PYTHONPATH='{repo_root}'\n"
+        "mkdir -p examples/tpu_run\n"
+        "echo x > examples/tpu_run/marker.txt\n"
+        "git add examples && git commit -q -m pre-session\n"
+        "step 'toy' 30 art.json -- bash -c 'echo d > art.json'\n"
+        "summarize_on_exit\n")
+    repo, r = _drive(tmp_path, body)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Window evidence collated" not in _log(repo)
