@@ -75,7 +75,13 @@ def test_hbm_grid_and_comparator_row():
     cfgs = candidate_configs(base, HBM_GRID, comparator=True)
     assert len(cfgs) == len(HBM_GRID) + 1
     assert [c.backend for c in cfgs].count("xla") == 1
-    assert all(c.backend == "pallas" for c in cfgs[:-1])
+    # the comparator leads the race: a budget-cut race keeps its
+    # yardstick row (round-4 flapping-relay discipline)
+    assert cfgs[0].backend == "xla"
+    assert all(c.backend == "pallas" for c in cfgs[1:])
+    # and the kernel-10 depth race leads the Pallas candidates
+    assert [c.kernel for c in cfgs[1:4]] == [10, 10, 10]
+    assert [c.stream_buffers for c in cfgs[1:4]] == [4, 8, 2]
 
 
 def test_autotune_cli_comparator_races_xla(capsys, tmp_path):

@@ -75,16 +75,23 @@ FINE_GRID: Tuple[Tuple[int, ...], ...] = tuple(
 # 4 = default, 8 = deep lookahead) — the knob this kernel exists for.
 # Use --comparator to append the XLA row (the 779 GB/s = 95%-of-roof
 # rate calibration measured at 2^26; the gap to close).
+# Value-ordered (round-4 flapping-relay discipline): chained races run
+# — and persist — one candidate at a time in LIST order, and a budget
+# cut keeps the measured prefix, so the hypothesis-bearing geometries
+# lead: kernel 10's depth race (the knob the kernel exists for), then
+# the two crowned VPU geometries, then the wider exploration tail.
 HBM_GRID: Tuple[Tuple[int, ...], ...] = tuple(
-    [(KERNEL_SINGLE_PASS, t, 64) for t in (512, 1024, 2048)]
+    [(KERNEL_STREAM, 512, 64, d) for d in (4, 8, 2)]
+    + [(KERNEL_TWO_PASS, 384, 64),       # fine-race winner (22.7 TB/s
+                                         # VMEM; does it hold in HBM?)
+       (KERNEL_SINGLE_PASS, 512, 64)]    # the committed HBM rows' cfg
+    + [(KERNEL_SINGLE_PASS, t, 64) for t in (1024, 2048)]
     # kernel 8 skips the per-step sublane relayout entirely (pure
     # elementwise combine into a (TM,128) accumulator) — if k6's 5-8%
     # HBM deficit is fold latency between DMA waits, k8 shows it
     + [(KERNEL_ELEMENTWISE, t, 64) for t in (1024, 2048)]
-    + [(KERNEL_TWO_PASS, 384, mb) for mb in (64, 128)]
-    + [(KERNEL_TWO_PASS, 512, 64)]
-    + [(KERNEL_STREAM, t, 64, d) for t in (512, 1024)
-       for d in (2, 4, 8)]
+    + [(KERNEL_TWO_PASS, 384, 128), (KERNEL_TWO_PASS, 512, 64)]
+    + [(KERNEL_STREAM, 1024, 64, d) for d in (2, 4, 8)]
     + [(KERNEL_STREAM, 256, 64, 4)]
 )
 
@@ -110,18 +117,21 @@ def candidate_configs(base: ReduceConfig,
     — the candidate space the reference leaves to hand-set
     --threads/--maxblocks knobs (reduction.cpp:666-668). The optional
     4th element sets the kernel-10 DMA pipeline depth (base's value
-    otherwise). `comparator` appends one XLA-backend config so the race
-    records the always-correct baseline it must beat (SURVEY.md §7 L2b)
-    in the same run, same discipline."""
+    otherwise). `comparator` PREPENDS one XLA-backend config so the
+    race records the always-correct baseline it must beat (SURVEY.md
+    §7 L2b) in the same run, same discipline — first, because chained
+    races run in list order and persist per candidate: a budget-cut
+    race must keep its yardstick row, not lose it behind the
+    exploration tail."""
     cfgs = [dataclasses.replace(base, backend="pallas", kernel=g[0],
                                 threads=g[1], max_blocks=g[2],
                                 stream_buffers=(g[3] if len(g) > 3
                                                 else base.stream_buffers))
             for g in grid]
     if comparator:
-        cfgs.append(dataclasses.replace(base, backend="xla",
-                                        kernel=KERNEL_SINGLE_PASS,
-                                        threads=256, max_blocks=64))
+        cfgs.insert(0, dataclasses.replace(base, backend="xla",
+                                           kernel=KERNEL_SINGLE_PASS,
+                                           threads=256, max_blocks=64))
     return cfgs
 
 
