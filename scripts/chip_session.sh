@@ -203,9 +203,10 @@ fi
 #      seconds here instead of the window's middle (verdict weak #3)
 #   5+6. HBM-regime races at 2^26 and the 2^27 weak point
 #   7. int op-parity probe (MIN vs SUM vs MAX, same geometry)
-#   8+9. kernel-9 MXU races, f32 + bf16
-#   10. fine tile race (7-rep repeat confirmation)
-#   11. flagship experiment (3 h; re-verified int curve + bf16/f64
+#   8. bf16 existence spot (weak #5: the dtype's first on-chip rows)
+#   9+10. kernel-9 MXU races, f32 + bf16
+#   11. fine tile race (7-rep repeat confirmation)
+#   12. flagship experiment (3 h; re-verified int curve + bf16/f64
 #       curves + the 2^30 hazard cells last; DOUBLE rows land in the
 #       report's flagship table via sweep_all)
 # BENCH_SKIP_PROBE: relay_ok just verified the relay seconds ago; the
@@ -271,6 +272,16 @@ step "int op parity probe" 420 \
                  --iterations=256 --chainreps=5 \
                  --out=int_op_spot_xla.json || rc=$?; \
              exit $rc'
+
+# bf16's FIRST on-chip rows (round-3 weak #5: an advertised dtype with
+# zero hardware evidence): one cheap fixed-geometry scoreboard well
+# before the k9/flagship steps that would otherwise carry it ~70 min
+# into a window. 2 B/element stream, f32 accumulator — the "~2x int32
+# elements/s" claim gets its measurement here.
+step "bf16 existence spot" 180 bf16_spot.json -- \
+    python -m tpu_reductions.bench.spot --type=bfloat16 \
+        --methods=SUM,MIN,MAX --n=16777216 --iterations=256 \
+        --chainreps=5 --out=bf16_spot.json
 
 # kernel 9 (MXU) has never lowered on-chip; rank it against the VPU
 # winners in both regimes (2^24 VMEM-resident, 2^26 HBM-bound)

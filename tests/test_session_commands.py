@@ -103,6 +103,13 @@ STEPS = [
       "--backend=xla", "--iterations=8", "--chainreps=2",
       "--out=int_op_spot_xla.json"],
      "int_op_spot_xla.json"),
+    ("python -m tpu_reductions.bench.spot --type=bfloat16 "
+     "--methods=SUM,MIN,MAX --n=16777216 --iterations=256 "
+     "--chainreps=5 --out=bf16_spot.json",
+     "tpu_reductions.bench.spot",
+     ["--type=bfloat16", "--methods=SUM,MIN,MAX", "--n=16384",
+      "--iterations=8", "--chainreps=2", "--out=bf16_spot.json"],
+     "bf16_spot.json"),
     ("python -m tpu_reductions.bench.autotune --method=SUM "
      "--type=float --n=16777216 --iterations=256 --grid=mxu "
      "--comparator --out=tune_mxu_f32.json",
