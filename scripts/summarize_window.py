@@ -102,12 +102,18 @@ def main(argv=None) -> int:
 
     for name, dtype, title in (("double_spot.json", "DOUBLE",
                                 "## DOUBLE scoreboard (VERDICT item 1)"),
+                               ("BENCH_doubles.json", "DOUBLE",
+                                "## DOUBLE opportunistic rows "
+                                "(bench.py, flagship-grid contract)"),
                                ("int_op_spot_k7.json", "INT",
                                 "## int op parity k7/384 (item 5)"),
                                ("int_op_spot_k6.json", "INT",
                                 "## int op parity k6/512"),
                                ("int_op_spot_xla.json", "INT",
-                                "## int op parity XLA comparator")):
+                                "## int op parity XLA comparator"),
+                               ("bf16_spot.json", "BFLOAT16",
+                                "## bf16 existence spot (weak #5: the "
+                                "dtype's first on-chip rows)")):
         d = _load(root / name)
         if d:
             sections.append([title] + _spot_lines(d, dtype))

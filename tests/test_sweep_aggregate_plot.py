@@ -416,6 +416,10 @@ def test_summarize_window_collates_artifacts(tmp_path):
               "stream_buffers": 8, "gbps": 800.0, "status": "PASSED"},
              {"backend": "xla", "kernel": None, "threads": None,
               "gbps": 779.0, "status": "PASSED"}]}))
+    (tmp_path / "bf16_spot.json").write_text(json.dumps(
+        {"complete": True, "rows": [
+            {"method": "SUM", "kernel": 6, "threads": 512,
+             "gbps": 1234.0, "status": "PASSED"}]}))
     r = subprocess.run([sys.executable, str(script), str(tmp_path)],
                        capture_output=True, text=True)
     assert r.returncode == 0
@@ -423,6 +427,8 @@ def test_summarize_window_collates_artifacts(tmp_path):
     assert "INCOMPLETE" in r.stdout          # the dead-mid-step flag
     assert "depth=8" in r.stdout             # k10 depth in the ranking
     assert "1.03x (WIN)" in r.stdout         # pallas vs XLA comparator
+    assert "BFLOAT16  SUM" in r.stdout       # weak-#5 rows collated
+    assert "1234.0" in r.stdout
 
 
 def test_run_shmoo_chained_per_cell_persistence_and_skip():
