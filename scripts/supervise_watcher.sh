@@ -1,0 +1,306 @@
+#!/usr/bin/env bash
+# Watcher supervisor: makes "armed" a process-level invariant instead of
+# a best-effort (round-4 verdict, weak #3 / do-this #2).
+#
+# await_window.sh alone is a single unsupervised process, and that cost
+# round 4 its only live window: the watcher had died with the driver
+# shell that spawned it, the 03:43Z relay flap was spotted by hand at
+# 03:46, and ~4 of ~6 live minutes were lost; separately its 12 h
+# horizon expired unattended at 15:41Z with nobody to re-arm it. This
+# supervisor closes both gaps:
+#   - watcher death (killed, crashed, horizon rc=4) -> respawned within
+#     RESPAWN_DELAY_S + CHECK_S (default 1+2 s, well under one 20 s poll
+#     interval), with a fresh horizon on every respawn so expiry can
+#     never strand the round;
+#   - a COMPLETED chip session (rc=0) retires the supervisor — the same
+#     only-completion-retires contract await_window.sh already has;
+#   - the watch log is committed every COMMIT_EVERY_S (default hourly,
+#     path-restricted so concurrent foreground staging is never swept
+#     in) so armed-ness is verifiable in git history afterwards, even if
+#     nobody is attending when the round ends.
+#
+# Process-group discipline (round-5 review findings): the watcher is
+# spawned as its own process group (set -m), and every kill is a GROUP
+# kill — a watcher bash that dies mid-chip-session leaves the session
+# subtree (chip_session.sh, tee, python) alive, and respawning a second
+# session against the same relay window is the documented machine-wide
+# chip-wedge hazard (CLAUDE.md: overlapping in-flight device work).
+# Group reaping is INT-first with a grace period so an in-flight python
+# raises KeyboardInterrupt and drains its device queue (the same
+# discipline as chip_session's per-step timeout), KILL only as backstop.
+# A flock single-instance guard makes "armed" a SINGULAR invariant —
+# two supervisors would fire two concurrent sessions at the same window.
+#
+# The supervisor itself is deliberately boring: pure bash + date + git,
+# no python, no JAX — nothing in it can hang on a dead relay. Launch it
+# DETACHED (setsid, </dev/null) so driver-session teardown — the thing
+# that killed round 4's watcher — cannot reach it:
+#
+#   setsid nohup bash scripts/supervise_watcher.sh \
+#       >> round5_watch.log 2>&1 < /dev/null &
+#
+# Usage: bash scripts/supervise_watcher.sh [poll_seconds=20] [arm_hours=13]
+#   Env: CHIP_LOG       chip-session log name (default chip_session_r05.log)
+#        WATCH_LOG      watcher output + supervisor notes (round5_watch.log)
+#        AWAIT_BIN      watcher script (tests substitute a fake)
+#        CHECK_S        liveness-check cadence  (default 2 s)
+#        RESPAWN_DELAY_S pause before a respawn (default 1 s)
+#        COMMIT_EVERY_S log-commit cadence, 0 disables (default 3600)
+#        SUP_HORIZON_H  supervisor self-horizon (default 20 h — outlasts
+#                       a round; bounded so a forgotten supervisor does
+#                       not commit into the next round forever)
+set -uo pipefail
+# SUP_ROOT: the rehearsal tests (tests/test_supervisor.py) point this at
+# a temp git repo so kill/retire/re-arm behavior is provable off-chip
+# without touching the real round log
+cd "${SUP_ROOT:-$(dirname "$0")/..}"
+
+POLL=${1:-20}
+ARM_HOURS=${2:-13}
+CHIP_LOG=${CHIP_LOG:-chip_session_r05.log}
+WATCH_LOG=${WATCH_LOG:-round5_watch.log}
+AWAIT_BIN=${AWAIT_BIN:-scripts/await_window.sh}
+CHECK_S=${CHECK_S:-2}
+RESPAWN_DELAY_S=${RESPAWN_DELAY_S:-1}
+COMMIT_EVERY_S=${COMMIT_EVERY_S:-3600}
+SUP_HORIZON_H=${SUP_HORIZON_H:-20}
+# INT-to-KILL grace for group reaps: generous, because the only process
+# that ever needs it is a python draining its device queue after
+# KeyboardInterrupt (idle watchers exit the instant INT lands, so the
+# grace costs nothing in the common case)
+GRACE_S=${GRACE_S:-60}
+# same untunneled-host marker await_window.sh keys off; overridable so
+# the rehearsal tests can run on any host
+RELAY_MARKER=${RELAY_MARKER:-/root/.relay.py}
+
+if [ ! -e "$RELAY_MARKER" ]; then
+    echo "supervisor: untunneled host (no $RELAY_MARKER); nothing to supervise" >&2
+    exit 0
+fi
+
+# single-instance guard: a second supervisor must refuse to arm, not
+# race this one to fire duplicate chip sessions at the same window.
+# -w 5, not -n: a SIGKILLed predecessor can leave the lock briefly held
+# by an orphaned foreground child (its in-flight `sleep` inherits fd 9
+# for up to CHECK_S seconds) — a replacement launched in that window
+# must wait the transient out, not be refused as a "double-arm"
+exec 9>"$WATCH_LOG.sup.lock"
+if ! flock -w "${FLOCK_WAIT_S:-5}" 9; then
+    echo "supervisor: another supervisor already holds $WATCH_LOG.sup.lock; refusing to double-arm" >&2
+    exit 1
+fi
+
+# job control: each background watcher becomes its OWN process group,
+# so group kills can reap its whole subtree without touching us
+set -m
+
+note() {
+    echo "supervisor: $* [$(date -u +%FT%TZ)]" >> "$WATCH_LOG"
+}
+
+commit_file() {  # commit_file <path> <message>
+    # path-restricted add+commit: a foreground build mid-staging must
+    # never have its index swept into a watcher-log commit; an
+    # index.lock collision just skips this beat (the next one catches up)
+    [ -s "$1" ] || return 0
+    git add -- "$1" 2>/dev/null || return 0
+    git diff --cached --quiet -- "$1" && return 0
+    git commit -q -m "$2" -- "$1" 2>/dev/null || true
+}
+
+commit_log() {
+    [ "$COMMIT_EVERY_S" -gt 0 ] || return 0
+    commit_file "$WATCH_LOG" \
+        "Round map: watcher log through $(date -u +%H:%MZ)"
+}
+
+child=
+armed_at=0
+PIDFILE="$WATCH_LOG.watcher.pid"
+spawn() {
+    # 9>&-: the child must NOT inherit the single-instance lock fd — a
+    # SIGKILLed supervisor would otherwise leave the lock held by the
+    # orphan subtree, refusing every replacement supervisor while zero
+    # supervision actually exists (review finding)
+    CHIP_LOG="$CHIP_LOG" bash "$AWAIT_BIN" "$POLL" "$ARM_HOURS" \
+        >> "$WATCH_LOG" 2>&1 < /dev/null 9>&- &
+    child=$!
+    armed_at=$(date +%s)
+    # recorded so a REPLACEMENT supervisor (after this one is
+    # SIGKILLed, skipping the EXIT trap) can find and reap the orphaned
+    # watcher instead of arming a second one next to it
+    echo "$child" > "$PIDFILE" 2>/dev/null || true
+    note "watcher armed (pid $child, poll ${POLL}s, horizon ${ARM_HOURS}h)"
+}
+
+reap_predecessor() {
+    # A SIGKILLed/OOM-killed predecessor leaves its watcher (and any
+    # session subtree) orphaned and polling; arming next to it would
+    # let the next relay flap fire TWO chip sessions at one tunnel —
+    # the machine-wide wedge hazard. The pid is verified against the
+    # watcher's cmdline before the group kill so pid reuse can never
+    # target an innocent process group.
+    [ -f "$PIDFILE" ] || return 0
+    local old
+    old=$(cat "$PIDFILE" 2>/dev/null) || return 0
+    case "$old" in ''|*[!0-9]*) return 0 ;; esac
+    if [ -r "/proc/$old/cmdline" ] \
+            && tr '\0' ' ' < "/proc/$old/cmdline" 2>/dev/null \
+               | grep -qF "$(basename "$AWAIT_BIN")"; then
+        note "reaping orphaned predecessor watcher (pid $old) before arming"
+        reap_group "$old"
+    elif kill -0 -- "-$old" 2>/dev/null \
+            && pgrep -g "$old" -f chip_session.sh > /dev/null 2>&1; then
+        # the watcher pid itself died, but its chip-session subtree
+        # survives in the group (a pgid cannot be reused while members
+        # remain, so this is safe from pid reuse): reap it, or the new
+        # watcher would fire a SECOND session next to it
+        note "predecessor watcher (pid $old) is dead but its session subtree survives; reaping group"
+        reap_group "$old"
+    fi
+    rm -f "$PIDFILE"
+}
+
+session_in_flight() {
+    # a live chip session inside the watcher's process group: the one
+    # state where teardown is genuinely hazardous (INT/KILL mid-device-
+    # queue is the documented machine-wide wedge) — used to DEFER the
+    # self-horizon disarm until the session ends
+    [ -n "$child" ] || return 1
+    pgrep -g "$child" -f chip_session.sh > /dev/null 2>&1
+}
+
+reap_group() {
+    # Kill the watcher's ENTIRE process group — the watcher bash dying
+    # does not take its chip-session subtree with it (a bash's
+    # foreground child survives its parent's death), and an orphaned
+    # session sharing the tunnel with a freshly-fired one is the
+    # machine-wide wedge hazard. INT first so an in-flight python
+    # drains its device queue; KILL after GRACE_S as backstop.
+    local pg=$1
+    [ -n "$pg" ] || return 0
+    kill -INT -- "-$pg" 2>/dev/null || return 0   # group already gone
+    local i=0
+    while [ "$i" -lt "$GRACE_S" ]; do
+        kill -0 -- "-$pg" 2>/dev/null || return 0
+        sleep 1 9>&-
+        i=$(( i + 1 ))
+    done
+    kill -KILL -- "-$pg" 2>/dev/null || true
+}
+
+commit_chip_log() {
+    # await_window.sh commits the chip log after a session IT saw end;
+    # when the supervisor reaps an orphaned session subtree that commit
+    # never ran — do it here so the log survives unattended teardown
+    # (round 2's curve recovery came from exactly this log)
+    commit_file "$CHIP_LOG" \
+        "Chip session log (supervisor teardown, $(date -u +%FT%TZ))"
+}
+
+retire() {
+    # on supervisor exit for any reason, never leave an orphan watcher
+    # (or session subtree) — it would be exactly the unsupervised
+    # process tree this script exists to eliminate.
+    if [ -n "$child" ] && kill -0 "$child" 2>/dev/null; then
+        # disown first: set -m would otherwise print a job-termination
+        # notice into the committed watch log
+        disown "$child" 2>/dev/null || true
+        if session_in_flight; then
+            # a live chip session must NEVER be SIGKILLed mid-device-
+            # queue (CLAUDE.md wedge hazard): INT it (the same signal
+            # chip_session's own step budgets use, so python drains via
+            # KeyboardInterrupt) and wait — no KILL escalation; if the
+            # drain outlives the wait, leaving the session to finish is
+            # strictly safer than wedging the chip
+            note "teardown with a chip session in flight: INT + drain wait (no KILL)"
+            kill -INT -- "-$child" 2>/dev/null || true
+            local i=0
+            while [ "$i" -lt "${TEARDOWN_WAIT_S:-600}" ] \
+                    && kill -0 -- "-$child" 2>/dev/null; do
+                sleep 1 9>&-
+                i=$(( i + 1 ))
+            done
+            if kill -0 -- "-$child" 2>/dev/null; then
+                note "session still draining after ${TEARDOWN_WAIT_S:-600}s; leaving it to finish rather than risk the wedge"
+            fi
+        else
+            reap_group "$child"
+        fi
+    fi
+    rm -f "$PIDFILE"
+    commit_chip_log
+    commit_log
+}
+trap retire EXIT
+
+deadline=$(( $(date +%s) + SUP_HORIZON_H * 3600 ))
+last_commit=$(date +%s)
+rapid_deaths=0
+defer_noted=0
+note "supervising $AWAIT_BIN (check ${CHECK_S}s, respawn ${RESPAWN_DELAY_S}s, self-horizon ${SUP_HORIZON_H}h)"
+reap_predecessor
+spawn
+while true; do
+    if ! kill -0 "$child" 2>/dev/null; then
+        rc=0; wait "$child" 2>/dev/null || rc=$?
+        if [ "$rc" -eq 0 ] && [ -e "$RELAY_MARKER" ]; then
+            note "chip session COMPLETED (watcher rc=0); retiring"
+            child=
+            exit 0
+        elif [ "$rc" -eq 0 ]; then
+            # await_window also exits 0 on a missing relay marker
+            # ("nothing to await") — retiring on that would leave the
+            # round silently unarmed while the log claims completion
+            note "watcher exited 0 but $RELAY_MARKER is gone (marker removed mid-round?); treating as anomaly, re-arming"
+        elif [ "$rc" -eq 4 ]; then
+            note "watcher horizon expired (rc=4); re-arming with a fresh horizon"
+        else
+            note "watcher DIED (rc=$rc); respawning"
+        fi
+        # reap any survivors of the dead watcher's group BEFORE arming a
+        # successor: a respawned watcher that finds the relay alive —
+        # because an orphaned session is still using it — would fire a
+        # SECOND concurrent session (review finding; chip-wedge hazard)
+        reap_group "$child"
+        # capped exponential backoff on rapid deaths (a broken AWAIT_BIN
+        # exiting instantly must not grind out ~50k armed/DIED log lines
+        # over the horizon); a watcher that lived >=30 s resets it
+        if [ $(( $(date +%s) - armed_at )) -lt 30 ]; then
+            rapid_deaths=$(( rapid_deaths + 1 ))
+        else
+            rapid_deaths=0
+        fi
+        backoff=$RESPAWN_DELAY_S
+        if [ "$rapid_deaths" -gt 0 ]; then
+            backoff=$(( RESPAWN_DELAY_S + (1 << (rapid_deaths < 9 ? rapid_deaths : 9)) ))
+            [ "$backoff" -gt 300 ] && backoff=300
+            note "watcher died ${rapid_deaths}x rapidly; backing off ${backoff}s"
+        fi
+        sleep "$backoff" 9>&-
+        spawn
+    fi
+    now=$(date +%s)
+    if [ "$COMMIT_EVERY_S" -gt 0 ] \
+            && [ $(( now - last_commit )) -ge "$COMMIT_EVERY_S" ]; then
+        commit_log
+        last_commit=$now
+    fi
+    if [ "$now" -ge "$deadline" ]; then
+        if session_in_flight; then
+            # disarming now would INT/KILL a python mid-device-queue
+            # (the wedge hazard); the session's own per-step budgets +
+            # watchdog bound how long this defer can last
+            if [ "$defer_noted" -eq 0 ]; then
+                note "self-horizon reached but a chip session is in flight; deferring disarm until it ends"
+                defer_noted=1
+            fi
+        else
+            note "supervisor self-horizon (${SUP_HORIZON_H}h) reached; disarming"
+            exit 4
+        fi
+    fi
+    # 9>&-: a supervisor SIGKILLed mid-sleep orphans this child; it must
+    # not carry the single-instance lock into its afterlife
+    sleep "$CHECK_S" 9>&-
+done
