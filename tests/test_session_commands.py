@@ -38,6 +38,11 @@ def _script_invocations() -> set:
 # (live invocation exactly as chip_session.sh runs it,
 #  module main to call, scaled-down argv, artifact filename or None)
 STEPS = [
+    ("python -m tpu_reductions.bench.firstrow",
+     "tpu_reductions.bench.firstrow",
+     ["--n=65536", "--iterations=8", "--chainreps=2",
+      "--doubles-n=16384", "--doubles-reps=2", "--out=FIRSTROW.json"],
+     "FIRSTROW.json"),
     ("python -m tpu_reductions.bench.spot --type=double "
      "--methods=SUM,MIN,MAX --n=16777216 --iterations=256 "
      "--chainreps=5 --out=double_spot.json",
