@@ -87,7 +87,8 @@ def test_committed_snapshot_is_valid_for_round_end_fallback():
     assert d["source"] == "BENCH_r02_snapshot.json"
 
 
-def test_bench_double_spots_best_effort(tmp_path, capsys, monkeypatch):
+def test_bench_double_spots_best_effort(tmp_path, capsys, monkeypatch,
+                                        stable_chained_timing):
     """The opportunistic DOUBLE scoreboard (VERDICT r2 item 1): f64
     SUM/MIN/MAX rows land in BENCH_doubles.json via the dd path, rows
     persist as they land, stdout stays untouched (the one-JSON-line
@@ -186,3 +187,43 @@ def test_bench_skip_probe_env(monkeypatch, capsys):
     assert rc == 0
     out = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(out)["value"] > 0
+
+
+def test_bench_notes_headline_upset_by_runner_up(monkeypatch, capsys):
+    """Round-4 ADVICE 1: on flagship geometry the single stdout line
+    prints as soon as the first candidate verifies; if a runner-up
+    later wins the race, a corrective stderr note must say so and name
+    BENCH_snapshot.json as authoritative (the printed line itself is
+    immutable — downstream tooling already consumed it)."""
+    import dataclasses
+
+    import bench
+    from tpu_reductions.bench import driver as drv
+    from tpu_reductions.utils.qa import QAStatus
+
+    monkeypatch.setattr(bench, "_write_snapshot", lambda *a, **kw: None)
+    monkeypatch.setattr(bench, "_maybe_double_spots", lambda *a, **kw: None)
+    monkeypatch.setattr(bench, "_on_flagship_geometry", lambda n: True)
+
+    rates = iter([100.0, 250.0, 90.0, 80.0])   # runner-up upsets leader
+
+    def fake_batch(cfgs, logger=None, **kw):
+        cfg = cfgs[0]
+        return [drv.BenchResult(cfg.method, cfg.dtype, cfg.n, cfg.backend,
+                                cfg.kernel, next(rates), 1e-3,
+                                cfg.iterations, QAStatus.PASSED,
+                                1.0, 1.0, 0.0, timing="chained")]
+
+    monkeypatch.setattr(bench, "run_benchmark_batch", fake_batch,
+                        raising=False)
+    import tpu_reductions.bench.driver as driver_mod
+    monkeypatch.setattr(driver_mod, "run_benchmark_batch", fake_batch)
+
+    rc = bench.main(["--n", "65536", "--iterations", "16",
+                     "--platform", "cpu"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    headline = json.loads(cap.out.strip().splitlines()[-1])
+    assert headline["value"] == 100.0        # printed at first verify
+    assert "BENCH_snapshot.json is" in cap.err.replace("\n", " ")
+    assert "250.0" in cap.err

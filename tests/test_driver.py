@@ -210,3 +210,26 @@ def test_benchresult_to_dict_serializes_nonfinite_as_null():
     ok = BenchResult("SUM", "int32", 64, "pallas", 6, 12.5, 1e-6, 4,
                      QAStatus.PASSED, 1.0, 1.0, 0.0)
     assert ok.to_dict()["gbps"] == 12.5
+
+
+def test_noise_swamped_chained_slope_waives(monkeypatch):
+    """The WAIVE-on-noise guard, pinned directly (driver.py: a
+    non-positive chained slope must refuse to report a bandwidth):
+    the CLI-shape tests stabilize their timing around this guard
+    (tests/test_spot.py::stable_chained_timing), so the guard itself
+    needs its own deterministic coverage."""
+    import types
+
+    from tpu_reductions.utils import timing as timing_mod
+
+    monkeypatch.setattr(
+        timing_mod, "time_chained",
+        lambda *a, **kw: types.SimpleNamespace(average_s=-1e-6,
+                                               median_s=-1e-6))
+    cfg = ReduceConfig(method="SUM", dtype="int32", n=4096,
+                       iterations=4, timing="chained", chain_reps=2,
+                       backend="pallas", threads=256, log_file=None)
+    res = run_benchmark(cfg, logger=BenchLogger(None, None))
+    assert res.status == QAStatus.WAIVED
+    assert "non-positive" in res.waived_reason
+    assert res.gbps == 0.0

@@ -7,6 +7,9 @@ import json
 from tpu_reductions.bench.spot import main, run_spots
 from tpu_reductions.config import ReduceConfig
 
+# stable_chained_timing (tests/conftest.py): CLI-shape tests that assert
+# PASSED use it so a loaded host's noise-swamped slope cannot flake them
+
 
 def _base(**kw):
     kw.setdefault("method", "SUM")
@@ -50,7 +53,8 @@ def test_run_spots_contains_a_crashing_method(monkeypatch):
     assert by["MAX"]["status"] in ("PASSED", "WAIVED")
 
 
-def test_spot_cli_double_writes_artifact(tmp_path, capsys):
+def test_spot_cli_double_writes_artifact(tmp_path, capsys,
+                                         stable_chained_timing):
     """The chip session's 'double scoreboard' invocation shape, scaled
     down: f64 rows via the dd path, all oracle-verified, artifact
     complete=true."""
@@ -72,7 +76,7 @@ def test_spot_cli_validates_methods():
         main(["--methods=SUM,NOPE", "--n=64"])
 
 
-def test_spot_cli_xla_backend(tmp_path):
+def test_spot_cli_xla_backend(tmp_path, stable_chained_timing):
     """--backend=xla: the comparator at the same spot discipline (the
     'is the MIN deficit ours or the VPU's' instrument)."""
     out = tmp_path / "x.json"
