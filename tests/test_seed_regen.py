@@ -136,3 +136,39 @@ def test_regenerate_end_to_end(tmp_path):
 
     # an empty dir is a clean no-op
     assert regenerate(tmp_path / "nothing", log=lambda *a: None) is False
+
+
+def test_seed_skips_nonfinite_gbps_rows(tmp_path):
+    """Round-4 ADVICE 3: a PASSED row whose gbps serialized as null
+    (non-finite rates nullify in to_dict) must be skipped — it would
+    crash the seeder's own log line mid-batch and later the sweep
+    resume log — and must not abort the remaining rows' seeding."""
+    spot = _spot_artifact(tmp_path / "s.json",
+                          [_grid_row("SUM", gbps=None),
+                           _grid_row("MIN", gbps=151.0)])
+    logs = []
+    seeded = seed(spot, tmp_path / "grid", log=logs.append)
+    names = [p.name for p in seeded]
+    assert names == ["run-float64-MIN-0.json"]
+    assert any("non-finite gbps; skipped" in l for l in logs)
+
+
+def test_collect_averages_legacy_pins_threads_and_backend(tmp_path):
+    """Round-4 ADVICE 2: the legacy fallback accepts only the FULL
+    flagship geometry — a stray PASSED race cell at threads=1024 (or an
+    xla comparator row) in raw_output must never be averaged into the
+    flagship table when no contract rows exist."""
+    raw = tmp_path / "raw_output"
+    raw.mkdir()
+    # intended legacy: round-2 f64 fetch row at threads=512/pallas
+    (raw / "run-float64-SUM-0.json").write_text(
+        json.dumps(_legacy_row("SUM", gbps=0.87)))
+    # interlopers at the same n/kernel but wrong threads / backend
+    stray1 = _legacy_row("SUM", gbps=9999.0)
+    stray1["threads"] = 1024
+    (raw / "run-float64-SUM-1.json").write_text(json.dumps(stray1))
+    stray2 = _legacy_row("SUM", gbps=8888.0)
+    stray2["backend"] = "xla"
+    (raw / "run-float64-SUM-2.json").write_text(json.dumps(stray2))
+    avgs = collect_averages(tmp_path, log=lambda *a: None)
+    assert avgs[("DOUBLE", "SUM")] == 0.87

@@ -61,11 +61,15 @@ def collect_averages(grid_dir: Path, grid: dict | None = None,
         if cell_matches(row, method=method, dtype=dtype, **contract):
             matching.setdefault(key, []).append(float(gbps))
         elif (row.get("n") == contract["n"]
-              and row.get("kernel") == contract["kernel"]):
-            # legacy fallback is for older-DISCIPLINE cells at the
-            # flagship geometry (e.g. round-2 f64 fetch rows) — a cell
-            # at a different n/kernel must never be averaged into the
-            # n=2^24 table, however it got into the cache
+              and row.get("kernel") == contract["kernel"]
+              and row.get("threads") == contract["threads"]
+              and row.get("backend") == contract["backend"]):
+            # legacy fallback is for older-DISCIPLINE cells at the FULL
+            # flagship geometry (e.g. round-2 f64 fetch rows, measured
+            # at threads=512/pallas) — a cell at a different n/kernel/
+            # threads/backend (say a stray threads=1024 race row) must
+            # never be averaged into the flagship table, however it got
+            # into the cache (round-4 ADVICE 2)
             legacy.setdefault(key, []).append(float(gbps))
     out = {}
     for key in sorted(set(matching) | set(legacy)):

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 from pathlib import Path
@@ -68,6 +69,15 @@ def seed(spot_path: str | Path, grid_dir: str | Path,
         if dtype not in grid["dtypes"] or method not in grid["methods"]:
             continue
         if not cell_matches(row, method=method, dtype=dtype, **contract):
+            continue
+        gbps = row.get("gbps")
+        if not isinstance(gbps, (int, float)) or not math.isfinite(gbps):
+            # a PASSED row whose gbps serialized as null (non-finite
+            # rates nullify in to_dict) must not enter the cache: it
+            # would crash this very log line and later sweep resume
+            # logging, and it carries no averageable rate (round-4
+            # ADVICE 3; mirrors collect_averages' guard)
+            log(f"seed_cache: {dtype} {method}: non-finite gbps; skipped")
             continue
         slots = [raw / f"run-{dtype}-{method}-{rep}.json"
                  for rep in range(grid["repeats"])]
