@@ -176,7 +176,12 @@ reap_group() {
     # foreground child survives its parent's death), and an orphaned
     # session sharing the tunnel with a freshly-fired one is the
     # machine-wide wedge hazard. INT first so an in-flight python
-    # drains its device queue; KILL after GRACE_S as backstop.
+    # drains its device queue; KILL after GRACE_S as backstop — UNLESS
+    # the survivors include session/benchmark work, which must never be
+    # SIGKILLed mid-device-queue (CLAUDE.md wedge): those get an
+    # extended no-KILL drain wait instead, and if they outlive even
+    # that, we return 1 so the caller can refuse to arm a second
+    # session next to them.
     local pg=$1
     [ -n "$pg" ] || return 0
     kill -INT -- "-$pg" 2>/dev/null || return 0   # group already gone
@@ -186,6 +191,20 @@ reap_group() {
         sleep 1 9>&-
         i=$(( i + 1 ))
     done
+    if pgrep -g "$pg" -f 'chip_session\.sh|tpu_reductions|bench\.py' \
+            > /dev/null 2>&1; then
+        note "group $pg still has session work after ${GRACE_S}s; extended no-KILL drain wait"
+        while [ "$i" -lt "${TEARDOWN_WAIT_S:-600}" ] \
+                && kill -0 -- "-$pg" 2>/dev/null; do
+            sleep 1 9>&-
+            i=$(( i + 1 ))
+        done
+        if kill -0 -- "-$pg" 2>/dev/null; then
+            note "group $pg still draining after ${TEARDOWN_WAIT_S:-600}s; leaving it (no KILL — wedge hazard)"
+            return 1
+        fi
+        return 0
+    fi
     kill -KILL -- "-$pg" 2>/dev/null || true
 }
 
@@ -204,29 +223,11 @@ retire() {
     # process tree this script exists to eliminate.
     if [ -n "$child" ] && kill -0 "$child" 2>/dev/null; then
         # disown first: set -m would otherwise print a job-termination
-        # notice into the committed watch log
+        # notice into the committed watch log. reap_group handles the
+        # in-flight-session case itself (extended INT-only drain wait,
+        # never a KILL mid-device-queue — the CLAUDE.md wedge hazard).
         disown "$child" 2>/dev/null || true
-        if session_in_flight; then
-            # a live chip session must NEVER be SIGKILLed mid-device-
-            # queue (CLAUDE.md wedge hazard): INT it (the same signal
-            # chip_session's own step budgets use, so python drains via
-            # KeyboardInterrupt) and wait — no KILL escalation; if the
-            # drain outlives the wait, leaving the session to finish is
-            # strictly safer than wedging the chip
-            note "teardown with a chip session in flight: INT + drain wait (no KILL)"
-            kill -INT -- "-$child" 2>/dev/null || true
-            local i=0
-            while [ "$i" -lt "${TEARDOWN_WAIT_S:-600}" ] \
-                    && kill -0 -- "-$child" 2>/dev/null; do
-                sleep 1 9>&-
-                i=$(( i + 1 ))
-            done
-            if kill -0 -- "-$child" 2>/dev/null; then
-                note "session still draining after ${TEARDOWN_WAIT_S:-600}s; leaving it to finish rather than risk the wedge"
-            fi
-        else
-            reap_group "$child"
-        fi
+        reap_group "$child" || true
     fi
     rm -f "$PIDFILE"
     commit_chip_log
@@ -261,8 +262,17 @@ while true; do
         # reap any survivors of the dead watcher's group BEFORE arming a
         # successor: a respawned watcher that finds the relay alive —
         # because an orphaned session is still using it — would fire a
-        # SECOND concurrent session (review finding; chip-wedge hazard)
-        reap_group "$child"
+        # SECOND concurrent session (review finding; chip-wedge hazard).
+        # If session work outlives even the extended drain (reap_group
+        # rc=1), BLOCK until the group empties: an unarmed watcher is
+        # recoverable, two sessions on one tunnel may wedge the machine.
+        if ! reap_group "$child"; then
+            note "respawn deferred until the predecessor session group drains"
+            while kill -0 -- "-$child" 2>/dev/null; do
+                sleep "$CHECK_S" 9>&-
+            done
+            note "predecessor session group drained; proceeding to respawn"
+        fi
         # capped exponential backoff on rapid deaths (a broken AWAIT_BIN
         # exiting instantly must not grind out ~50k armed/DIED log lines
         # over the horizon); a watcher that lived >=30 s resets it
