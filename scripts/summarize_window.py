@@ -78,6 +78,27 @@ def main(argv=None) -> int:
     root = Path((argv or sys.argv[1:] or ["."])[0])
     sections = []
 
+    fr = _load(root / "FIRSTROW.json")
+    if fr:
+        row = fr.get("row", {})
+        lines = ["## first row (step 0: time-to-first-artifact)",
+                 f"  {fr.get('candidate')}: "
+                 f"{_fmt_gbps(row.get('gbps'))} GB/s "
+                 f"[{row.get('status')}] (chain_reps="
+                 f"{fr.get('chain_reps')})"]
+        for m in fr.get("timeline", []):
+            lines.append(f"  T+{m['t_rel_s']:7.1f}s {m['label']}")
+        persisted = [m["t_rel_s"] for m in fr.get("timeline", [])
+                     if "int row persisted" in m["label"]]
+        if persisted:
+            verdict = ("inside" if persisted[0] < 90 else "OUTSIDE")
+            lines.append(f"  -> first persisted row at "
+                         f"T+{persisted[0]:.1f}s ({verdict} the 90 s "
+                         "target)")
+        if not fr.get("complete", True):
+            lines.append("  (artifact INCOMPLETE — step died mid-run)")
+        sections.append(lines)
+
     bench = _load(root / "BENCH_live.json") or _load(
         root / "BENCH_snapshot.json")
     if bench:

@@ -144,11 +144,12 @@ reap_predecessor() {
     local old
     old=$(cat "$PIDFILE" 2>/dev/null) || return 0
     case "$old" in ''|*[!0-9]*) return 0 ;; esac
+    local reap=0
     if [ -r "/proc/$old/cmdline" ] \
             && tr '\0' ' ' < "/proc/$old/cmdline" 2>/dev/null \
                | grep -qF "$(basename "$AWAIT_BIN")"; then
         note "reaping orphaned predecessor watcher (pid $old) before arming"
-        reap_group "$old"
+        reap=1
     elif kill -0 -- "-$old" 2>/dev/null \
             && pgrep -g "$old" -f chip_session.sh > /dev/null 2>&1; then
         # the watcher pid itself died, but its chip-session subtree
@@ -156,7 +157,16 @@ reap_predecessor() {
         # remain, so this is safe from pid reuse): reap it, or the new
         # watcher would fire a SECOND session next to it
         note "predecessor watcher (pid $old) is dead but its session subtree survives; reaping group"
-        reap_group "$old"
+        reap=1
+    fi
+    if [ "$reap" = 1 ] && ! reap_group "$old"; then
+        # the predecessor's session refuses to drain: arming next to it
+        # would fire a second session at the same tunnel — BLOCK until
+        # the group empties (an unarmed watcher is recoverable; two
+        # sessions may wedge the machine)
+        note "predecessor session group refuses to drain; waiting before arming"
+        wait_for_group_drain "$old"
+        note "predecessor session group drained"
     fi
     rm -f "$PIDFILE"
 }
@@ -191,21 +201,46 @@ reap_group() {
         sleep 1 9>&-
         i=$(( i + 1 ))
     done
-    if pgrep -g "$pg" -f 'chip_session\.sh|tpu_reductions|bench\.py' \
-            > /dev/null 2>&1; then
+    if _session_work_in "$pg"; then
         note "group $pg still has session work after ${GRACE_S}s; extended no-KILL drain wait"
         while [ "$i" -lt "${TEARDOWN_WAIT_S:-600}" ] \
-                && kill -0 -- "-$pg" 2>/dev/null; do
+                && _session_work_in "$pg"; do
             sleep 1 9>&-
             i=$(( i + 1 ))
         done
-        if kill -0 -- "-$pg" 2>/dev/null; then
+        if _session_work_in "$pg"; then
             note "group $pg still draining after ${TEARDOWN_WAIT_S:-600}s; leaving it (no KILL — wedge hazard)"
             return 1
         fi
-        return 0
+        # session work drained; fall through to reap any non-session
+        # stragglers (e.g. a blocked tee) the INT didn't take
     fi
     kill -KILL -- "-$pg" 2>/dev/null || true
+}
+
+_session_work_in() {
+    # session/benchmark processes in group $1 — the ones that must
+    # never be SIGKILLed mid-device-queue; keyed on cmdlines, not
+    # whole-group liveness, so a non-session straggler can neither
+    # block the KILL backstop nor strand the respawn defer loop
+    pgrep -g "$1" -f 'chip_session\.sh|tpu_reductions|bench\.py' \
+        > /dev/null 2>&1
+}
+
+wait_for_group_drain() {
+    # block until group $1 is empty, keeping the hourly log-commit
+    # cadence alive (the header promises armed-ness is verifiable in
+    # git history even while a drain defers everything else)
+    local pg=$1 now
+    while kill -0 -- "-$pg" 2>/dev/null; do
+        sleep "$CHECK_S" 9>&-
+        now=$(date +%s)
+        if [ "$COMMIT_EVERY_S" -gt 0 ] \
+                && [ $(( now - last_commit )) -ge "$COMMIT_EVERY_S" ]; then
+            commit_log
+            last_commit=$now
+        fi
+    done
 }
 
 commit_chip_log() {
@@ -221,15 +256,24 @@ retire() {
     # on supervisor exit for any reason, never leave an orphan watcher
     # (or session subtree) — it would be exactly the unsupervised
     # process tree this script exists to eliminate.
+    local clean=1
     if [ -n "$child" ] && kill -0 "$child" 2>/dev/null; then
         # disown first: set -m would otherwise print a job-termination
         # notice into the committed watch log. reap_group handles the
         # in-flight-session case itself (extended INT-only drain wait,
         # never a KILL mid-device-queue — the CLAUDE.md wedge hazard).
         disown "$child" 2>/dev/null || true
-        reap_group "$child" || true
+        reap_group "$child" || clean=0
     fi
-    rm -f "$PIDFILE"
+    if [ "$clean" = 1 ]; then
+        rm -f "$PIDFILE"
+    else
+        # a live session group is deliberately left draining: KEEP the
+        # pidfile so the next supervisor's reap_predecessor can find it
+        # — deleting it would make the orphan undiscoverable and re-
+        # create the double-session hazard the pidfile exists to stop
+        note "session group left draining; pidfile kept for the next supervisor"
+    fi
     commit_chip_log
     commit_log
 }
@@ -268,9 +312,7 @@ while true; do
         # recoverable, two sessions on one tunnel may wedge the machine.
         if ! reap_group "$child"; then
             note "respawn deferred until the predecessor session group drains"
-            while kill -0 -- "-$child" 2>/dev/null; do
-                sleep "$CHECK_S" 9>&-
-            done
+            wait_for_group_drain "$child"
             note "predecessor session group drained; proceeding to respawn"
         fi
         # capped exponential backoff on rapid deaths (a broken AWAIT_BIN

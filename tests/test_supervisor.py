@@ -290,6 +290,31 @@ def test_untunneled_host_exits_without_arming(tmp_path):
         _stop(sup)
 
 
+def test_stubborn_nonsession_straggler_is_killed_not_waited_on(tmp_path):
+    """A group member that ignores INT but is NOT session work (no
+    device queue to wedge) must be SIGKILLed after the grace — not
+    given the no-KILL session drain, which would strand the supervisor
+    in the defer loop for a process that can never wedge anything."""
+    _git_init(tmp_path)
+    fake = _write_fake_await(
+        tmp_path,
+        # stubborn straggler: ignores INT (disposition survives exec)
+        'bash -c \'trap "" INT; echo $$ >> stubborn.txt; exec sleep 600\' &\n'
+        "exec sleep 600")
+    sup = _spawn_supervisor(tmp_path, fake)
+    stubborn = None
+    try:
+        _wait_for(lambda: (tmp_path / "stubborn.txt").exists(), 15,
+                  "first arm + stubborn straggler")
+        stubborn = int((tmp_path / "stubborn.txt").read_text().split()[0])
+        assert _alive(stubborn)
+    finally:
+        _stop(sup)
+    # INT leaves it alive; the KILL backstop (after GRACE_S=3) must not
+    _wait_for(lambda: not _alive(stubborn), 15,
+              "stubborn straggler SIGKILLed after grace")
+
+
 def test_second_supervisor_refuses_to_double_arm(tmp_path):
     """Two supervisors = two watchers = two concurrent chip sessions at
     the same window. The flock guard makes 'armed' singular."""

@@ -420,6 +420,14 @@ def test_summarize_window_collates_artifacts(tmp_path):
         {"complete": True, "rows": [
             {"method": "SUM", "kernel": 6, "threads": 512,
              "gbps": 1234.0, "status": "PASSED"}]}))
+    (tmp_path / "FIRSTROW.json").write_text(json.dumps(
+        {"candidate": "pallas k7 threads=384", "chain_reps": 3,
+         "complete": True,
+         "row": {"gbps": 6000.0, "status": "PASSED"},
+         "timeline": [
+             {"label": "jax ready", "t_rel_s": 38.0},
+             {"label": "int row persisted -> FIRSTROW.json",
+              "t_rel_s": 61.5}]}))
     r = subprocess.run([sys.executable, str(script), str(tmp_path)],
                        capture_output=True, text=True)
     assert r.returncode == 0
@@ -429,6 +437,9 @@ def test_summarize_window_collates_artifacts(tmp_path):
     assert "1.03x (WIN)" in r.stdout         # pallas vs XLA comparator
     assert "BFLOAT16  SUM" in r.stdout       # weak-#5 rows collated
     assert "1234.0" in r.stdout
+    # step-0 timeline collated with the 90 s verdict (do-this #3)
+    assert "first persisted row at T+61.5s (inside the 90 s target)" \
+        in r.stdout
 
 
 def test_run_shmoo_chained_per_cell_persistence_and_skip():
