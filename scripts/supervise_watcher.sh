@@ -150,12 +150,12 @@ reap_predecessor() {
                | grep -qF "$(basename "$AWAIT_BIN")"; then
         note "reaping orphaned predecessor watcher (pid $old) before arming"
         reap=1
-    elif kill -0 -- "-$old" 2>/dev/null \
-            && pgrep -g "$old" -f chip_session.sh > /dev/null 2>&1; then
-        # the watcher pid itself died, but its chip-session subtree
-        # survives in the group (a pgid cannot be reused while members
-        # remain, so this is safe from pid reuse): reap it, or the new
-        # watcher would fire a SECOND session next to it
+    elif kill -0 -- "-$old" 2>/dev/null && _session_work_in "$old"; then
+        # the watcher pid itself died, but session work (the session
+        # script OR a still-draining benchmark python) survives in the
+        # group (a pgid cannot be reused while members remain, so this
+        # is safe from pid reuse): reap it, or the new watcher would
+        # fire a SECOND session next to it
         note "predecessor watcher (pid $old) is dead but its session subtree survives; reaping group"
         reap=1
     fi
@@ -257,7 +257,11 @@ retire() {
     # (or session subtree) — it would be exactly the unsupervised
     # process tree this script exists to eliminate.
     local clean=1
-    if [ -n "$child" ] && kill -0 "$child" 2>/dev/null; then
+    # group liveness, not watcher-pid liveness: a watcher bash that died
+    # seconds ago can leave its session subtree alive in the group, and
+    # skipping the reap for it would delete the pidfile the next
+    # supervisor needs to find that orphan (review finding)
+    if [ -n "$child" ] && kill -0 -- "-$child" 2>/dev/null; then
         # disown first: set -m would otherwise print a job-termination
         # notice into the committed watch log. reap_group handles the
         # in-flight-session case itself (extended INT-only drain wait,

@@ -48,7 +48,8 @@ def stable_chained_timing(monkeypatch):
     def stabilized(*args, **kwargs):
         sw = real(*args, **kwargs)
         if sw.median_s <= 0 or sw.average_s <= 0:
-            return types.SimpleNamespace(average_s=1e-4, median_s=1e-4)
+            return types.SimpleNamespace(average_s=1e-4, median_s=1e-4,
+                                         samples=[1e-4])
         return sw
 
     monkeypatch.setattr(timing_mod, "time_chained", stabilized)
