@@ -230,13 +230,17 @@ step "first row" 300 FIRSTROW.json BENCH_snapshot.json BENCH_doubles.json -- \
 # to learn the same thing. A wedged-but-ports-open tunnel (the rare
 # case the probe exists for) is bounded by this step's budget instead.
 # BENCH_DOUBLES=0 when step 0 already landed a COMPLETE f64 scoreboard
-# THIS SESSION (grep + an mtime-vs-FIRSTROW_T0 check: a complete
-# scoreboard committed by a PREVIOUS window must not suppress this
-# window's fresh rows) — re-measuring a scoreboard written seconds ago
-# would spend window minutes on redundant rows.
+# THIS SESSION with at least one VERIFIED row (grep + an
+# mtime-vs-FIRSTROW_T0 check: a complete scoreboard committed by a
+# PREVIOUS window must not suppress this window's fresh rows, and an
+# all-FAILED/WAIVED step-0 scoreboard — e.g. a flap mid-dd-compile —
+# must not suppress step 1's fresh attempt either; round-5 ADVICE) —
+# re-measuring a scoreboard of verified rows written seconds ago would
+# spend window minutes on redundant rows.
 step "headline bench" 240 BENCH_live.json BENCH_snapshot.json BENCH_doubles.json -- \
     bash -c 'set -o pipefail; d=1; \
              if grep -q "\"complete\": true" BENCH_doubles.json 2>/dev/null \
+                && grep -q "\"status\": \"PASSED\"" BENCH_doubles.json 2>/dev/null \
                 && [ "$(stat -c %Y BENCH_doubles.json)" -ge "${FIRSTROW_T0%.*}" ]; then d=0; fi; \
              BENCH_SKIP_PROBE=1 BENCH_DOUBLES=$d python bench.py | tee BENCH_live.json'
 

@@ -1,0 +1,22 @@
+#!/bin/bash
+# redlint convenience wrapper: the same invocation the tier-1 gate
+# (tests/test_lint_clean.py) enforces. Exit 0 = clean, 1 = findings.
+#
+#   bash scripts/lint.sh              # lint the gate surface
+#   bash scripts/lint.sh --format=json
+#   bash scripts/lint.sh path.py ...  # lint specific files instead
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+args=()
+paths=()
+for a in "$@"; do
+    case "$a" in
+        --*) args+=("$a") ;;
+        *)   paths+=("$a") ;;
+    esac
+done
+if [ "${#paths[@]}" -eq 0 ]; then
+    paths=(tpu_reductions scripts bench.py __graft_entry__.py)
+fi
+exec python -m tpu_reductions.lint "${paths[@]}" "${args[@]+"${args[@]}"}"

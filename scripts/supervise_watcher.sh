@@ -215,6 +215,7 @@ reap_group() {
         # session work drained; fall through to reap any non-session
         # stragglers (e.g. a blocked tee) the INT didn't take
     fi
+    # redlint: disable=RED008 -- last resort AFTER the INT-first reap and the extended no-KILL drain wait above; only non-session stragglers can still be in this group
     kill -KILL -- "-$pg" 2>/dev/null || true
 }
 

@@ -14,11 +14,21 @@ import os
 
 # harmless on the config path, but kept for plain-jaxlib environments
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Pre-0.4.38 jax has no jax_num_cpu_devices config option; the XLA flag
+# is the portable way to get 8 virtual CPU devices and must be set
+# before jax initializes its backends.
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # older jax: the XLA_FLAGS path above covers it
+    pass
 
 # f64 configs need x64; enabling it globally keeps tests order-independent.
 jax.config.update("jax_enable_x64", True)

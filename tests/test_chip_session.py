@@ -168,6 +168,19 @@ def test_session_step0_is_firstrow_with_t0_export():
     assert "BENCH_DOUBLES=$d" in text
 
 
+def test_doubles_suppression_requires_a_verified_row():
+    """An all-FAILED/WAIVED step-0 scoreboard (e.g. a flap mid-dd-
+    compile) must NOT suppress step 1's fresh doubles attempt (round-5
+    ADVICE): the BENCH_DOUBLES=0 branch demands a PASSED row in
+    BENCH_doubles.json alongside completeness and same-session mtime."""
+    text = SCRIPT.read_text()
+    cond = text[text.index('step "headline bench"'):]
+    cond = cond[:cond.index("python bench.py")]
+    assert '\\"complete\\": true' in cond
+    assert '\\"status\\": \\"PASSED\\"' in cond
+    assert "FIRSTROW_T0" in cond
+
+
 def _flagship_row():
     import json
     return json.dumps({

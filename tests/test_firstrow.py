@@ -55,6 +55,45 @@ def test_firstrow_rehearsal_doubles_avoid_live_contract_path(tmp_path):
     assert os.path.exists(repo_doubles) == existed_before
 
 
+def test_firstrow_complete_mark_lands_inside_the_artifact(tmp_path):
+    """The 'firstrow complete' mark must be appended BEFORE the final
+    persist(complete=True) so total step-0 wall-clock is part of the
+    committed FIRSTROW.json (round-5 satellite): the artifact's own
+    timeline, not just stderr, answers 'how long did step 0 take'."""
+    rc, out = _run(tmp_path)
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert data["complete"] is True
+    assert data["timeline"][-1]["label"] == "firstrow complete"
+
+
+def test_firstrow_doubles_iterations_not_taken_from_int_row(tmp_path,
+                                                            monkeypatch):
+    """A rehearsal --iterations override on the int row must NOT leak
+    into the doubles scoreboard: leaked, it writes a FLAGSHIP_GRID-
+    incompatible yet step-1-suppressing BENCH_doubles.json. Unset, the
+    doubles run at the flagship contract; --doubles-iterations is the
+    explicit rehearsal knob."""
+    seen = {}
+    import bench as bench_mod
+
+    real = bench_mod._maybe_double_spots
+
+    def spy(n=None, iterations=None, reps=None, path=None):
+        seen["iterations"] = iterations
+        return real(n=n, iterations=iterations, reps=reps, path=path)
+
+    monkeypatch.setattr(bench_mod, "_maybe_double_spots", spy)
+    rc, _ = _run(tmp_path)   # int row runs --iterations=8
+    assert rc == 0
+    assert seen["iterations"] is None   # flagship default, not 8
+
+    seen.clear()
+    rc, _ = _run(tmp_path, extra=("--doubles-iterations=16",))
+    assert rc == 0
+    assert seen["iterations"] == 16
+
+
 def test_firstrow_no_snapshot_off_chip(tmp_path):
     """The flagship-geometry gate: a cpu rehearsal (or a smoke --n) must
     never write the round-headline snapshot."""

@@ -1,0 +1,430 @@
+"""redlint rule fixtures: one positive + one negative per rule, plus the
+waiver mechanism (suppression, malformed, stale) and the CLI contracts.
+
+The rules encode CLAUDE.md's hard-won environment doctrine (x64 wedges
+the tunnel, block_until_ready lies, unstaged transfers kill the relay,
+row grammars are an API); these tests pin each rule to a minimal
+violating/conforming source pair so a rule regression is caught by the
+fixture, not by a chip window.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpu_reductions.lint import grammar
+from tpu_reductions.lint.engine import lint_file, lint_paths
+from tpu_reductions.lint.fixers import fix_docstrings
+
+
+def _lint_src(tmp_path, src, name="fixture.py"):
+    f = tmp_path / name
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(src)
+    return lint_file(f)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------- RED001
+
+
+def test_red001_flags_x64_enable_and_jnp_float64(tmp_path):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        'jax.config.update("jax_enable_x64", True)\n'
+        "y = jnp.zeros(4, dtype=jnp.float64)\n"
+    )
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings).count("RED001") == 2
+    assert findings[0].line == 3 and findings[1].line == 4
+
+
+def test_red001_whitelists_x64_module(tmp_path):
+    src = ("import jax\n"
+           'jax.config.update("jax_enable_x64", True)\n')
+    findings = _lint_src(tmp_path, src, name="utils/x64.py")
+    assert "RED001" not in _rules(findings)
+
+
+# ---------------------------------------------------------------- RED002
+
+
+def test_red002_flags_wallclock_around_block_until_ready(tmp_path):
+    src = (
+        "import time\n"
+        "import jax\n"
+        "def bench(f, x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    jax.block_until_ready(f(x))\n"
+        "    return time.perf_counter() - t0\n"
+    )
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings).count("RED002") == 2  # both clock calls
+
+
+def test_red002_allows_wallclock_without_sync_and_whitelisted(tmp_path):
+    # smoke.py-style compile timing: no block_until_ready in scope
+    src = (
+        "import time\n"
+        "def compile_time(f):\n"
+        "    t0 = time.perf_counter()\n"
+        "    f()\n"
+        "    return time.perf_counter() - t0\n"
+    )
+    assert "RED002" not in _rules(_lint_src(tmp_path, src))
+    # the chained-timing home may bracket the sync (it measures the lie)
+    timed = (
+        "import time\n"
+        "import jax\n"
+        "def probe(f, x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    jax.block_until_ready(f(x))\n"
+        "    return time.perf_counter() - t0\n"
+    )
+    assert "RED002" not in _rules(
+        _lint_src(tmp_path, timed, name="utils/calibrate.py"))
+
+
+# ---------------------------------------------------------------- RED003
+
+
+def test_red003_flags_device_put_outside_staging(tmp_path):
+    src = ("import jax\n"
+           "def stage(x):\n"
+           "    return jax.device_put(x)\n")
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings) == ["RED003"]
+    assert findings[0].line == 3
+
+
+def test_red003_whitelists_staging_module(tmp_path):
+    src = ("import jax\n"
+           "def stage(x):\n"
+           "    return jax.device_put(x)\n")
+    assert _rules(_lint_src(tmp_path, src, name="utils/staging.py")) == []
+
+
+# ---------------------------------------------------------------- RED004
+
+
+def test_red004_flags_env_writes_to_jax_platforms(tmp_path):
+    src = (
+        "import os\n"
+        'os.environ["JAX_PLATFORMS"] = "cpu"\n'
+        'os.environ.setdefault("JAX_PLATFORMS", "cpu")\n'
+        'os.putenv("JAX_PLATFORMS", "cpu")\n'
+    )
+    assert _rules(_lint_src(tmp_path, src)) == ["RED004"] * 3
+
+
+def test_red004_allows_other_env_writes(tmp_path):
+    src = ("import os\n"
+           'os.environ["XLA_FLAGS"] = "--xla_foo"\n'
+           'v = os.environ.get("JAX_PLATFORMS")\n')
+    assert _rules(_lint_src(tmp_path, src)) == []
+
+
+# ---------------------------------------------------------------- RED005
+
+
+def test_red005_flags_deviant_grammar_literals(tmp_path):
+    src = (
+        'print("&&&& PASSD reduction_tpu")\n'          # typo'd status
+        'hdr = "DATATYPE OP NODES GB/s"\n'             # wrong unit
+    )
+    assert _rules(_lint_src(tmp_path, src)) == ["RED005", "RED005"]
+
+
+def test_red005_accepts_golden_literals_and_consumers(tmp_path):
+    src = (
+        "import re\n"
+        "def emit(name, status, dt, op, ranks, gbps):\n"
+        '    print(f"&&&& RUNNING {name} --method=SUM")\n'
+        '    print(f"&&&& {name} {status}")\n'
+        '    print("DATATYPE OP NODES GB/sec")\n'
+        # consumer-side regex quoting a grammar fragment is exempt
+        'ROW = re.compile(r"Reduction, Throughput = ([0-9.]+) GB/s, x")\n'
+    )
+    assert _rules(_lint_src(tmp_path, src)) == []
+
+
+def test_red005_golden_templates_validate_themselves():
+    # the spec module's emit templates must pass their own checker once
+    # fields are substituted
+    assert grammar.check_literal(
+        grammar.QA_RUNNING_TEMPLATE.format(name="x", args="--n=1")) is None
+    assert grammar.check_literal(
+        grammar.QA_FINISH_TEMPLATE.format(name="x", status="WAIVED")) is None
+    assert grammar.check_literal(grammar.COLLECTIVE_HEADER) is None
+    line = grammar.THROUGHPUT_TEMPLATE.format(
+        name="Reduction", gbps=90.8413, secs=0.00074, n=1 << 24,
+        devices=1, workgroup=256)
+    assert grammar.check_literal(line) is None
+    assert grammar.THROUGHPUT_RE.match(line)
+
+
+# ---------------------------------------------------------------- RED006
+
+
+def test_red006_flags_uncited_public_docstrings(tmp_path):
+    src = (
+        '"""Module docstring without citation."""\n'
+        "def public_fn():\n"
+        '    """Does something, cites nothing."""\n'
+        "def _private_fn():\n"
+        "    pass\n"
+        "def bare_fn():\n"
+        "    pass\n"
+    )
+    findings = _lint_src(tmp_path, src, name="ops/fixture.py")
+    # module + public_fn (uncited) + bare_fn (missing); _private exempt
+    assert _rules(findings) == ["RED006"] * 3
+
+
+def test_red006_accepts_citations_and_no_analog_marker(tmp_path):
+    src = (
+        '"""Re-creates reduction.cpp:744-745."""\n'
+        "def public_fn():\n"
+        '    """No reference analog (TPU-native)."""\n'
+        "def cited_fn():\n"
+        '    """The SURVEY.md §2 parity table."""\n'
+    )
+    assert _rules(_lint_src(tmp_path, src, name="bench/fixture.py")) == []
+    # outside ops/ and bench/ the rule does not apply at all
+    assert _rules(_lint_src(tmp_path, src.replace('"""M', '"""m'),
+                            name="utils/fixture.py")) == []
+
+
+# ---------------------------------------------------------------- RED007
+
+
+def test_red007_flags_exit_without_drain_in_jax_module(tmp_path):
+    src = (
+        "import sys\n"
+        "import jax\n"
+        "def main():\n"
+        "    jax.jit(lambda x: x)(1)\n"
+        "    return 0\n"
+        'if __name__ == "__main__":\n'
+        "    sys.exit(main())\n"
+    )
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings) == ["RED007"]
+
+
+def test_red007_accepts_watchdog_or_drain(tmp_path):
+    armed = (
+        "import sys\n"
+        "import jax\n"
+        "from tpu_reductions.utils.watchdog import maybe_arm_for_tpu\n"
+        "def main():\n"
+        "    maybe_arm_for_tpu()\n"
+        "    return 0\n"
+        "sys.exit(main())\n"
+    )
+    assert _rules(_lint_src(tmp_path, armed)) == []
+    drained = (
+        "import sys\n"
+        "import jax\n"
+        "def main():\n"
+        "    out = jax.jit(lambda x: x)(1)\n"
+        "    jax.device_get(out)\n"
+        "    return 0\n"
+        "sys.exit(main())\n"
+    )
+    assert _rules(_lint_src(tmp_path, drained)) == []
+    # no jax import -> not an on-chip entry point, exits are fine
+    plain = "import sys\nsys.exit(0)\n"
+    assert _rules(_lint_src(tmp_path, plain)) == []
+
+
+# ---------------------------------------------------------------- RED008
+
+
+def test_red008_flags_sigkill_in_session_scripts(tmp_path):
+    src = (
+        "#!/bin/bash\n"
+        "kill -9 $pid\n"
+        'kill -KILL -- "-$pg"\n'
+        "pkill -s KILL -f bench\n"
+    )
+    findings = _lint_src(tmp_path, src, name="scripts/fixture.sh")
+    assert _rules(findings) == ["RED008"] * 3
+
+
+def test_red008_accepts_int_term_and_prose(tmp_path):
+    src = (
+        "#!/bin/bash\n"
+        "# never SIGKILL a session mid-device-queue (CLAUDE.md)\n"
+        "kill -INT -- \"-$pg\"\n"
+        "kill -TERM $pid\n"
+        "kill -0 $pid && echo alive\n"
+    )
+    assert _rules(_lint_src(tmp_path, src, name="scripts/fixture.sh")) == []
+
+
+# ---------------------------------------------------------------- waivers
+
+
+def test_waiver_suppresses_finding(tmp_path):
+    src = ("import jax\n"
+           "def stage(x):\n"
+           "    return jax.device_put(x)"
+           "  # redlint: disable=RED003 -- tiny fixture payload\n")
+    assert _rules(_lint_src(tmp_path, src)) == []
+
+
+def test_waiver_on_preceding_line_suppresses_next_line(tmp_path):
+    src = ("import jax\n"
+           "def stage(x):\n"
+           "    # redlint: disable=RED003 -- tiny fixture payload\n"
+           "    return jax.device_put(x)\n")
+    assert _rules(_lint_src(tmp_path, src)) == []
+
+
+def test_waiver_without_reason_is_a_finding(tmp_path):
+    src = ("import jax\n"
+           "def stage(x):\n"
+           "    return jax.device_put(x)  # redlint: disable=RED003\n")
+    rules = _rules(_lint_src(tmp_path, src))
+    # the reasonless waiver does NOT suppress, and is itself reported
+    assert sorted(rules) == ["RED000", "RED003"]
+
+
+def test_stale_waiver_is_reported(tmp_path):
+    src = ("x = 1  # redlint: disable=RED003 -- nothing to waive here\n")
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings) == ["RED009"]
+    assert "stale" in findings[0].message
+
+
+def test_waiver_examples_inside_docstrings_are_inert(tmp_path):
+    src = ('"""Usage: add `# redlint: disable=RED003 -- why` inline."""\n'
+           "x = 1\n")
+    assert _rules(_lint_src(tmp_path, src)) == []
+
+
+def test_shell_waiver_suppresses_sigkill(tmp_path):
+    src = ("#!/bin/bash\n"
+           "# redlint: disable=RED008 -- drained group, last resort\n"
+           'kill -KILL -- "-$pg"\n')
+    assert _rules(_lint_src(tmp_path, src, name="scripts/fixture.sh")) == []
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def test_cli_json_format_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\nx = jax.device_put(1)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_reductions.lint", str(bad),
+         "--format=json"],
+        capture_output=True, text=True, cwd=str(Path(__file__).parents[1]))
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload == [{"rule": "RED003", "path": str(bad), "line": 2,
+                        "message": payload[0]["message"]}]
+    assert "device_put" in payload[0]["message"]
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_reductions.lint", str(good)],
+        capture_output=True, text=True, cwd=str(Path(__file__).parents[1]))
+    assert proc.returncode == 0
+    assert "clean" in proc.stdout
+
+
+def test_cli_positive_fixture_per_rule_exits_nonzero(tmp_path):
+    """The acceptance contract: each rule's positive fixture makes the
+    CLI exit non-zero."""
+    fixtures = {
+        "RED001": ("r1.py", 'import jax\n'
+                            'jax.config.update("jax_enable_x64", 1)\n'),
+        "RED002": ("r2.py", "import time\nimport jax\n"
+                            "def f(g, x):\n"
+                            "    t = time.monotonic()\n"
+                            "    jax.block_until_ready(g(x))\n"
+                            "    return time.monotonic() - t\n"),
+        "RED003": ("r3.py", "import jax\ny = jax.device_put(1)\n"),
+        "RED004": ("r4.py", "import os\n"
+                            'os.environ["JAX_PLATFORMS"] = "cpu"\n'),
+        "RED005": ("r5.py", 'print("&&&& FAILD x")\n'),
+        "RED006": ("ops/r6.py", "def f():\n    pass\n"),
+        "RED007": ("r7.py", "import sys\nimport jax\nsys.exit(1)\n"),
+        "RED008": ("r8.sh", "kill -9 $$\n"),
+    }
+    for rule, (name, src) in fixtures.items():
+        f = tmp_path / name
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(src)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_reductions.lint", str(f),
+             "--format=json"],
+            capture_output=True, text=True,
+            cwd=str(Path(__file__).parents[1]))
+        assert proc.returncode == 1, (rule, proc.stdout, proc.stderr)
+        assert rule in {o["rule"] for o in json.loads(proc.stdout)}, rule
+
+
+# ---------------------------------------------------------------- fixer
+
+
+def test_fix_docstrings_appends_no_analog_marker(tmp_path):
+    f = tmp_path / "ops" / "fixme.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        '"""Module under test, cites reduction.cpp:1."""\n'
+        "def helper():\n"
+        '    """Uncited helper."""\n'
+        "    return 1\n"
+        "def multiline():\n"
+        '    """Uncited too.\n\n'
+        "    With a body.\n"
+        '    """\n'
+        "    return 2\n"
+    )
+    fixed = fix_docstrings([f])
+    assert {name for _, _, name in fixed} == {"helper", "multiline"}
+    findings = lint_file(f)
+    assert "RED006" not in _rules(findings)
+    text = f.read_text()
+    assert text.count("No reference analog (TPU-native).") == 2
+    # the fix must leave the module importable
+    compile(text, str(f), "exec")
+
+
+def test_fix_docstrings_leaves_missing_docstrings_alone(tmp_path):
+    f = tmp_path / "bench" / "fixme.py"
+    f.parent.mkdir(parents=True)
+    f.write_text('"""Cites SURVEY.md §2."""\n'
+                 "def bare():\n"
+                 "    return 1\n")
+    assert fix_docstrings([f]) == []
+    assert _rules(lint_file(f)) == ["RED006"]  # still a finding
+
+
+# ---------------------------------------------------------------- misc
+
+
+def test_lint_paths_walks_directories(tmp_path):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "a.py").write_text("import jax\n"
+                                           "y = jax.device_put(1)\n")
+    (tmp_path / "pkg" / "b.sh").write_text("kill -9 $$\n")
+    (tmp_path / "pkg" / "c.txt").write_text("kill -9 $$\n")  # not lintable
+    findings = lint_paths([tmp_path / "pkg"])
+    assert sorted(_rules(findings)) == ["RED003", "RED008"]
+
+
+def test_lint_paths_missing_path_raises():
+    with pytest.raises(FileNotFoundError):
+        lint_paths(["/nonexistent/definitely/missing"])

@@ -1,0 +1,21 @@
+"""Tier-1 gate: the tree itself must be redlint-clean.
+
+Every hard-won environment rule (CLAUDE.md) the linter encodes is only
+worth anything if the repo enforces it on itself: this test runs the
+full pass over the package, the session scripts and the repo-root entry
+points and asserts zero findings — pre-existing violations were either
+fixed or carry a reasoned inline waiver (docs/LINT.md).
+"""
+
+from pathlib import Path
+
+from tpu_reductions.lint.engine import lint_paths
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_repo_is_redlint_clean():
+    targets = [REPO / "tpu_reductions", REPO / "scripts",
+               REPO / "bench.py", REPO / "__graft_entry__.py"]
+    findings = lint_paths(targets)
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)

@@ -21,7 +21,11 @@ Two tiers:
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+# this image may not ship hypothesis; the deterministic geometry sweep
+# below still needs it for the @given decorators, so skip cleanly
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from tpu_reductions.ops import oracle as oracle_mod
 from tpu_reductions.ops.pallas_reduce import pallas_reduce

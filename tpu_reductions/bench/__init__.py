@@ -1,1 +1,1 @@
-"""L4/L5: benchmark drivers, sweep, aggregation, plotting."""
+"""L4/L5: benchmark drivers, sweep, aggregation, plotting. No reference analog (TPU-native)."""

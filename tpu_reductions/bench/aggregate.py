@@ -19,6 +19,9 @@ from collections import defaultdict
 from pathlib import Path
 from typing import Dict, Iterable, List, Tuple
 
+from tpu_reductions.lint.grammar import (COLLECTIVE_HEADER,
+                                         COLLECTIVE_ROW_TEMPLATE)
+
 Key = Tuple[str, str, int]   # (DATATYPE, OP, ranks)
 
 _DTYPE_NAMES = {"int32": "INT", "float64": "DOUBLE", "float32": "FLOAT",
@@ -28,8 +31,9 @@ _DTYPE_NAMES = {"int32": "INT", "float64": "DOUBLE", "float32": "FLOAT",
 def collect(raw_dir: str | Path, out_file: str | Path | None = None
             ) -> List[str]:
     """Concatenate raw run outputs into data rows — the
-    `cat stdout-* > collected.txt` step. Accepts both row-format .txt and
-    the sweep's JSON-lines .json files."""
+    `cat stdout-* > collected.txt` step (getAvgs.sh:7-10). Accepts both
+    row-format .txt and the sweep's JSON-lines .json files.
+    """
     rows: List[str] = []
     for f in sorted(Path(raw_dir).glob("*")):
         if f.suffix == ".json":
@@ -48,7 +52,8 @@ def collect(raw_dir: str | Path, out_file: str | Path | None = None
                     # Python's json.loads accepts NaN/Infinity tokens;
                     # a non-finite rate must not poison the averages
                     continue
-                rows.append(f"{dt} {d['method']} {ranks} {gbps:.3f}")
+                rows.append(COLLECTIVE_ROW_TEMPLATE.format(
+                    dtype=dt, op=d["method"], ranks=ranks, gbps=gbps))
         else:
             for line in f.read_text().splitlines():
                 parts = line.split()
@@ -94,8 +99,9 @@ def write_results(avgs: Dict[Key, float], out_dir: str | Path) -> List[Path]:
         by_file[(dt, op)].append((ranks, gbps))
     for (dt, op), series in by_file.items():
         path = out / f"{dt}_{op}.txt"
-        lines = ["DATATYPE OP NODES GB/sec"]
-        lines += [f"{dt} {op} {ranks} {gbps:.3f}"
+        lines = [COLLECTIVE_HEADER]
+        lines += [COLLECTIVE_ROW_TEMPLATE.format(dtype=dt, op=op,
+                                                 ranks=ranks, gbps=gbps)
                   for ranks, gbps in sorted(series)]
         path.write_text("\n".join(lines) + "\n")
         written.append(path)
@@ -103,7 +109,7 @@ def write_results(avgs: Dict[Key, float], out_dir: str | Path) -> List[Path]:
 
 
 def pipeline(raw_dir: str | Path, out_dir: str | Path) -> List[Path]:
-    """raw_output/ -> collected.txt -> results/*.txt in one call."""
+    """raw_output/ -> collected.txt -> results/*.txt in one call. No reference analog (TPU-native)."""
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     rows = collect(raw_dir, out / "collected.txt")

@@ -213,6 +213,10 @@ def _write_out(path: str, meta: dict, rows: List[dict], *,
 
 
 def main(argv=None) -> int:
+    """CLI: race the tile grid and rank verified configs. No reference
+    analog — the reference pinned exactly one geometry
+    (reduction.cpp:665-668); this sweep exists because Pallas tiling is
+    a free parameter there never was."""
     p = argparse.ArgumentParser(
         prog="tpu_reductions.autotune",
         description="Race the Pallas tile-geometry grid and report the "

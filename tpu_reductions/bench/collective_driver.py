@@ -37,6 +37,10 @@ from tpu_reductions.utils.timing import Stopwatch
 
 @dataclasses.dataclass
 class CollectiveResult:
+    """One collective benchmark outcome — the data behind a rank-0
+    `DATATYPE OP NODES GB/sec` row (reduce.c:81,95) plus the QA status
+    the reference kept only as an exit code (shrQATest.h:51-57)."""
+
     method: str
     dtype: str
     n: int
@@ -52,9 +56,12 @@ class CollectiveResult:
 
     @property
     def passed(self) -> bool:
+        """Status == PASSED (shrQATest.h:51-57 exit-status mapping)."""
         return self.status == QAStatus.PASSED
 
     def to_dict(self) -> dict:
+        """JSON-ready row; status spelled as its QA marker name
+        (SURVEY.md §5 row-grammar contract)."""
         d = dataclasses.asdict(self)
         d["status"] = self.status.name
         return d
@@ -87,7 +94,9 @@ def run_collective_benchmark(cfg: CollectiveConfig,
                              logger: Optional[BenchLogger] = None
                              ) -> List[CollectiveResult]:
     """Run the {methods} x retries grid on one (dtype, rank-count) mesh —
-    one reduce.c process run."""
+    one reduce.c process run (the warmup + RETRY_COUNT timed loop,
+    reduce.c:61-96).
+    """
     import jax
 
     logger = logger or BenchLogger(None, None)
@@ -103,6 +112,7 @@ def run_collective_benchmark(cfg: CollectiveConfig,
             # NOT get it — its whole point (and the FORCE_DD rehearsal
             # hook's) is running the 32-bit TPU numerics regime, where
             # x64 promotion semantics can never exist
+            # redlint: disable=RED001 -- guarded by _use_dd_planes: this arm never runs on the TPU, where f64 always travels as dd planes
             jax.config.update("jax_enable_x64", True)
         return _run_collective_benchmark(cfg, logger)
 
@@ -402,6 +412,8 @@ def _rank0_hint(args) -> bool:
 
 
 def main(argv=None) -> int:
+    """CLI: the MPI_Reduce benchmark executable analog (reduce.c:30-96
+    wrapped in the shrQATest marker discipline, shrQATest.h:83-112)."""
     from tpu_reductions.config import parse_collective
     from tpu_reductions.utils.qa import qa_finish, qa_start
 
@@ -440,6 +452,21 @@ def main(argv=None) -> int:
             # multi-host bring-up BEFORE any device touch (the mpirun
             # tier, ccni_vn.sh:6-8; recipe in docs/MULTIHOST.md)
             from tpu_reductions.parallel.mesh import initialize_distributed
+            import jax
+            if getattr(jax.config, "jax_platforms", None) == "cpu":
+                # pre-0.4.38 jax refuses CPU cross-process computations
+                # unless gloo is selected before the CPU client exists;
+                # newer jax defaults to gloo and drops the option. Done
+                # here (the real subprocess entry, pre device touch —
+                # _apply_platform already recorded the platform) and
+                # not in initialize_distributed: a gloo CPU client
+                # without a live distributed runtime fails to construct,
+                # so unit tests that mock the init must never set it.
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo")
+                except AttributeError:
+                    pass
             initialize_distributed(coordinator_address=cfg.coordinator,
                                    num_processes=cfg.num_processes,
                                    process_id=cfg.process_id)

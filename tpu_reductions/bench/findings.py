@@ -31,7 +31,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 def pow2_label(n: int) -> str:
     """'2^k' for exact powers of two, the literal value otherwise — the
     sweep's sizes are powers of two (bench.sweep.run_shmoo), but a
-    floored label for anything else would name a size that never ran."""
+    floored label for anything else would name a size that never ran.
+
+    No reference analog (TPU-native).
+    """
     n = int(n)
     if n > 0 and n & (n - 1) == 0:
         return f"2^{n.bit_length() - 1}"
@@ -58,7 +61,10 @@ def half_power_points(shmoo_rows: Sequence[dict]) -> List[str]:
     the HBM rate every large payload runs at, and half-of-peak would
     misclassify bandwidth-bound HBM rows as "dispatch-bound". With
     regime tags present, the asymptote is the median HBM-bound rate;
-    without them, the largest-N row's rate."""
+    without them, the largest-N row's rate.
+
+    No reference analog (TPU-native).
+    """
     import statistics
 
     lines = []
@@ -84,7 +90,10 @@ def vmem_cliff(annotated_rows: Sequence[dict]) -> List[str]:
     """The regime boundary from roofline-annotated rows (bench.roofline
     tags each row vmem_resident / hbm_bound): report the flip N and the
     rate drop across it — chip structure the reference's DRAM-bound GPU
-    curves never showed."""
+    curves never showed.
+
+    No reference analog (TPU-native).
+    """
     lines = []
     for (dtype, method), pts in sorted(_curves(annotated_rows).items()):
         last_vmem: Optional[dict] = None
@@ -191,7 +200,10 @@ def derive_findings(rows: Optional[Sequence[dict]] = None,
     `rows` are shmoo rows, ideally roofline-annotated (bench.roofline):
     the half-power points need only (n, gbps); the cliff detection
     additionally needs each row's `regime` tag and silently yields
-    nothing without it."""
+    nothing without it.
+
+    No reference analog (TPU-native).
+    """
     lines: List[str] = []
     if rows:
         lines += half_power_points(rows)

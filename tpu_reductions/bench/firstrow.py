@@ -54,6 +54,10 @@ def _mark(marks: list, label: str) -> None:
 
 
 def main(argv=None) -> int:
+    """CLI: step 0 of a live window (module docstring has the value
+    order). No reference analog — the reference's measurement was
+    seconds-cheap (reduction.cpp:731); this exists because relay
+    windows die in minutes."""
     p = argparse.ArgumentParser(
         prog="tpu_reductions.bench.firstrow",
         description="First verified row of a live window, value-ordered "
@@ -69,6 +73,13 @@ def main(argv=None) -> int:
                    help="override the doubles' n (rehearsal only — "
                         "non-contract rows are not seedable)")
     p.add_argument("--doubles-reps", type=int, default=None)
+    p.add_argument("--doubles-iterations", type=int, default=None,
+                   help="override the doubles' chained span (rehearsal "
+                        "only); unset = the FLAGSHIP_GRID contract. The "
+                        "int row's --iterations is deliberately NOT "
+                        "forwarded: a rehearsal override there must not "
+                        "write a seed-incompatible yet suppressing "
+                        "BENCH_doubles.json")
     p.add_argument("--skip-doubles", action="store_true")
     p.add_argument("--platform", type=str, default=None,
                    choices=("cpu", "tpu"))
@@ -166,13 +177,15 @@ def main(argv=None) -> int:
         dpath = (None if jax.default_backend() == "tpu"
                  else ns.out + ".doubles.json")
         bench._maybe_double_spots(n=ns.doubles_n,
-                                  iterations=ns.iterations,
+                                  iterations=ns.doubles_iterations,
                                   reps=ns.doubles_reps, path=dpath)
         _mark(marks, "f64 scoreboard attempted "
                      f"({dpath or 'BENCH_doubles.json'})")
 
-    persist(row, complete=True)
+    # the terminal mark goes on BEFORE the final persist so total
+    # step-0 wall-clock lands inside the committed FIRSTROW.json
     _mark(marks, "firstrow complete")
+    persist(row, complete=True)
     return 0 if res.passed else 1
 
 

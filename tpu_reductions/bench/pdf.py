@@ -142,7 +142,10 @@ def generate_pdf(out_dir: str | Path, pdf_path: str | Path | None = None,
     Degrades like plot._mpl when matplotlib is absent: both experiment
     scripts end by calling this, and the pipeline's final step must not
     turn an already-written report/figure set into a nonzero exit on a
-    matplotlib-less host — returns None after a skip note instead."""
+    matplotlib-less host — returns None after a skip note instead.
+
+    No reference analog (TPU-native).
+    """
     try:
         import matplotlib
     except ImportError:
@@ -203,6 +206,9 @@ def generate_pdf(out_dir: str | Path, pdf_path: str | Path | None = None,
 
 
 def main(argv=None) -> int:
+    """CLI: compile writeup.pdf from an experiment out_dir — the
+    pdflatex step of the reference pipeline (writeup.tex:1-31) redone
+    in matplotlib (no TeX stack in this image)."""
     import argparse
 
     p = argparse.ArgumentParser(

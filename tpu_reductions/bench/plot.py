@@ -66,6 +66,9 @@ def plot_vs_ranks(avgs: Dict[Key, float], dtype_name: str,
 
     single_chip_lines: {label: GB/s} constants drawn as horizontal lines —
     the CUDA-overlay analog, now carrying the single-TPU-chip numbers.
+
+
+    No reference analog (TPU-native).
     """
     series = {(dt, op): [] for (dt, op, _) in avgs if dt == dtype_name}
     for (dt, op, ranks), gbps in sorted(avgs.items()):
@@ -168,7 +171,10 @@ def plot_scaling_shape(series: Dict[str, Sequence[tuple]],
     dominate (the 1-core serialization story, examples/rank_scaling).
 
     series: {label: [(ranks, gbps), ...]}; empty/zero-lead series are
-    skipped. Returns [] when nothing is plottable."""
+    skipped. Returns [] when nothing is plottable.
+
+    No reference analog (TPU-native).
+    """
     norm = {}
     for label, pts in series.items():
         pts = sorted(pts)

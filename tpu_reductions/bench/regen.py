@@ -18,6 +18,9 @@ flagship contract (sweep.FLAGSHIP_GRID); for a (dtype, op) with no
 contract-matching rows it falls back to whatever PASSED rows exist
 (legacy cells from an older discipline), so a half-migrated cache
 still reports honestly rather than dropping the rows.
+
+
+No reference analog (TPU-native).
 """
 
 from __future__ import annotations
@@ -40,7 +43,10 @@ _DTYPE_LABEL = {"int32": "INT", "float64": "DOUBLE"}
 def collect_averages(grid_dir: Path, grid: dict | None = None,
                      log=print) -> Dict[Tuple[str, str], float]:
     """{(DATATYPE, OP): mean GB/s} from the grid's raw cells, contract-
-    matching rows first, legacy PASSED rows as the labeled fallback."""
+    matching rows first, legacy PASSED rows as the labeled fallback.
+
+    No reference analog (TPU-native).
+    """
     grid = dict(grid or FLAGSHIP_GRID)
     contract = {k: grid[k] for k in ("n", "backend", "kernel", "threads",
                                      "iterations", "timing",
@@ -84,7 +90,10 @@ def collect_averages(grid_dir: Path, grid: dict | None = None,
 def regenerate(out_dir: str | Path, device_kind: str | None = None,
                log=print) -> bool:
     """Re-collate out_dir's report artifacts from disk. Returns False
-    (and does nothing) when out_dir has no experiment data."""
+    (and does nothing) when out_dir has no experiment data.
+
+    No reference analog (TPU-native).
+    """
     out = Path(out_dir)
     grid_dir = out / "single_chip"
     shmoo_file = out / "shmoo.json"
@@ -153,6 +162,9 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
 
 
 def main(argv=None) -> int:
+    """CLI: offline re-collation of an experiment dir — the analysis
+    half of the reference's file pipeline (raw_output -> collected.txt
+    -> results/ -> writeup; SURVEY.md §3.3) without touching a device."""
     p = argparse.ArgumentParser(
         prog="tpu_reductions.bench.regen",
         description="Regenerate an experiment dir's report artifacts "

@@ -16,6 +16,10 @@ from pathlib import Path
 from typing import Dict, Optional, Sequence
 
 from tpu_reductions.bench.aggregate import Key
+# the golden row-schema spec (redlint RED005): the collective table's
+# column set is COLLECTIVE_COLUMNS, so the report's section can never
+# drift from the emitted `DATATYPE OP NODES GB/sec` grammar
+from tpu_reductions.lint.grammar import COLLECTIVE_COLUMNS
 
 # Reference headline numbers (BASELINE.md; mpi/CUdata.txt:2-8) for the
 # comparison table the writeup's narrative was built around.
@@ -137,7 +141,8 @@ def generate_report(avgs: Dict[Key, float],
     # ---- tables (rows built by the shared builders) ----------------------
     coll_rows = [(dt, op, ranks, f"{gbps:.3f}")
                  for dt, op, ranks, gbps in build_coll_rows(avgs)]
-    coll_tbl = _table(coll_rows, ["dtype", "op", "ranks", "GB/s"])
+    coll_tbl = _table(coll_rows, [c.lower() if c.isalpha() else c
+                                  for c in COLLECTIVE_COLUMNS])
 
     sc_rows = []
     for dt, op, ref, ours in build_sc_rows(single_chip):
@@ -321,6 +326,9 @@ def main(argv=None) -> int:
     benchmarks are re-run.
 
         python -m tpu_reductions.bench.report out/ [--calibration cal.json]
+
+
+    No reference analog (TPU-native).
     """
     import argparse
 

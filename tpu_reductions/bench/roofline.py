@@ -41,7 +41,10 @@ def _bytes_per_element(dtype: str) -> int:
 def annotate(shmoo_rows: Sequence[dict],
              device_kind: Optional[str] = None) -> List[dict]:
     """Tag each shmoo row (BenchResult.to_dict()) with its memory
-    regime and, in the HBM regime, the achieved fraction of the roof."""
+    regime and, in the HBM regime, the achieved fraction of the roof.
+
+    No reference analog (TPU-native).
+    """
     kind = device_kind or _DEFAULT_KIND
     model = next((m for k, m in MEMORY_MODEL.items()
                   if kind.startswith(k)), MEMORY_MODEL[_DEFAULT_KIND])
@@ -62,7 +65,10 @@ def annotate(shmoo_rows: Sequence[dict],
 def summarize(annotated: Sequence[dict]) -> List[str]:
     """Human-readable roofline lines for the generated report: per
     (dtype, method), the best HBM-bound fraction and the VMEM-regime
-    peak."""
+    peak.
+
+    No reference analog (TPU-native).
+    """
     lines: List[str] = []
     keys = sorted({(r["dtype"], r["method"]) for r in annotated})
     if annotated:

@@ -51,7 +51,10 @@ def seed(spot_path: str | Path, grid_dir: str | Path,
     skipped (a kernel-7 op-parity spot must never masquerade as a
     kernel-6 flagship cell); acceptable live cells are never
     overwritten (only empty slots and stale-config cells are fair
-    game)."""
+    game).
+
+    No reference analog (TPU-native).
+    """
     grid = dict(grid or FLAGSHIP_GRID)
     contract = {k: grid[k] for k in ("n", "backend", "kernel", "threads",
                                      "iterations", "timing",
@@ -112,6 +115,9 @@ def seed(spot_path: str | Path, grid_dir: str | Path,
 
 
 def main(argv=None) -> int:
+    """CLI: move flagship-contract spot rows into the grid resume cache.
+    No reference analog — resume plumbing for relay-flap windows; the
+    contract itself is sweep.FLAGSHIP_GRID (reduction.cpp:665 geometry)."""
     p = argparse.ArgumentParser(
         prog="tpu_reductions.bench.seed_cache",
         description="Seed the flagship grid's resume cache from spot "

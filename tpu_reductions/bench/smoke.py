@@ -58,7 +58,10 @@ def run_smoke(n: int = 1 << 20, logger: Optional[BenchLogger] = None,
 
     Rows persist via on_result as they land (the live-window
     discipline): a relay death after case k keeps cases 1..k — and the
-    partial manifest still says which kernels lowered."""
+    partial manifest still says which kernels lowered.
+
+    No reference analog (TPU-native).
+    """
     from tpu_reductions.bench.driver import run_benchmark
 
     logger = logger or BenchLogger(None, None)
@@ -90,6 +93,9 @@ def run_smoke(n: int = 1 << 20, logger: Optional[BenchLogger] = None,
 
 
 def main(argv=None) -> int:
+    """CLI: compile+run every never-lowered kernel surface at tiny n.
+    No reference analog — a Mosaic lowering gate the CUDA suite never
+    needed (its kernels compiled at build time)."""
     p = argparse.ArgumentParser(
         prog="tpu_reductions.bench.smoke",
         description="Tiny-n compile+run of every never-lowered kernel "

@@ -55,7 +55,10 @@ def run_spots(base: ReduceConfig, methods: List[str],
     is passed to on_result as soon as it verifies (the persist-per-step
     discipline every live-window lesson demands). Crashes are contained
     per method (driver.crash_result) so one lowering failure cannot
-    take the remaining methods' rows with it."""
+    take the remaining methods' rows with it.
+
+    No reference analog (TPU-native).
+    """
     import dataclasses
 
     from tpu_reductions.bench.driver import crash_result, run_benchmark
@@ -83,6 +86,9 @@ def _write(path: str, meta: dict, rows: List[dict], complete: bool) -> None:
 
 
 def main(argv=None) -> int:
+    """CLI: several methods at one fixed geometry, chained+verified —
+    the reference's per-op benchmark loop (reduction.cpp:203-204 per-op
+    dispatch) compressed into one artifact-per-run instrument."""
     p = argparse.ArgumentParser(
         prog="tpu_reductions.bench.spot",
         description="Oracle-verified chained spot check: several methods "

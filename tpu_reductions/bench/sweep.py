@@ -50,7 +50,10 @@ def cell_matches(row: dict, *, method: str, dtype: str, n: int,
     ran (the resolved backend, never "auto"; the resolved discipline,
     e.g. the f64 dd path's deterministic chained->fetch fallback), so
     the comparison resolves the probe config the same way. Pure: never
-    touches a device."""
+    touches a device.
+
+    No reference analog (TPU-native).
+    """
     probe = ReduceConfig(method=method, dtype=dtype, backend=backend,
                          timing=timing, chain_reps=chain_reps,
                          threads=threads, kernel=kernel)

@@ -384,13 +384,23 @@ def _apply_platform(ns) -> None:
                 if want % nproc != 0:
                     co = (" (mode=co provisions 2x that in virtual "
                           "devices)" if want != ns.num_devices else "")
+                    # redlint: disable=RED007 -- flag-validation exit before any device dispatch; nothing is in flight
                     raise SystemExit(
                         f"--devices={ns.num_devices}{co} must divide "
                         f"evenly among --num-processes={nproc}: every "
                         "process provisions an equal local share "
                         "(docs/MULTIHOST.md)")
                 want //= nproc
-            jax.config.update("jax_num_cpu_devices", want)
+            try:
+                jax.config.update("jax_num_cpu_devices", want)
+            except AttributeError:
+                # pre-0.4.38 jax: provision via XLA_FLAGS instead. This
+                # function's contract is "called before the first
+                # backend touch", so the env route is still effective.
+                import os
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={want}")
 
 
 def build_collective_parser() -> argparse.ArgumentParser:

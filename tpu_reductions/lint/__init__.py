@@ -1,0 +1,21 @@
+"""redlint — AST-based invariant checker for the repo's hard-won TPU
+safety and timing doctrine (CLAUDE.md "Hard-won environment facts";
+output-row contracts SURVEY.md §5).
+
+The reference suite's value is trustworthy numbers; on this platform the
+trust rules are tribal knowledge (float64 wedges the axon tunnel, a bare
+`jax.block_until_ready` lies about execution time, unstaged multi-GiB
+transfers kill the relay, downstream tooling greps exact row grammars).
+This package encodes them as static checks so a careless diff is caught
+before any chip window is spent:
+
+    python -m tpu_reductions.lint [paths] [--format=text|json]
+                                  [--fix-docstrings]
+
+Rules RED001-RED008 are documented in docs/LINT.md; per-line waivers use
+`# redlint: disable=RED00X -- reason`.
+"""
+
+from tpu_reductions.lint.engine import Finding, lint_paths  # noqa: F401
+
+__all__ = ["Finding", "lint_paths"]

@@ -1,0 +1,63 @@
+"""redlint CLI.
+
+    python -m tpu_reductions.lint [paths...] [--format=text|json]
+                                  [--fix-docstrings]
+
+Exit codes: 0 clean, 1 findings, 2 usage error (argparse). JSON output
+is a list of {rule, path, line, message} objects — one per violation —
+for machine consumption (CI annotations, the test gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpu_reductions.lint.engine import lint_paths, summarize
+from tpu_reductions.lint.fixers import fix_docstrings
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.lint",
+        description="redlint: static checks for the repo's TPU safety & "
+                    "timing doctrine (rules RED001-RED008; docs/LINT.md)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories to lint (default: the "
+                        "tpu_reductions package + scripts/)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--fix-docstrings", action="store_true",
+                   help="append an explicit 'No reference analog "
+                        "(TPU-native).' marker to public ops/bench "
+                        "docstrings that lack a citation (RED006), then "
+                        "re-lint")
+    ns = p.parse_args(argv)
+
+    paths = ns.paths or ["tpu_reductions", "scripts"]
+    try:
+        if ns.fix_docstrings:
+            fixed = fix_docstrings(paths)
+            for path, line, name in fixed:
+                print(f"fixed: {path}:{line}: marked '{name}' as "
+                      "no-reference-analog", file=sys.stderr)
+        findings = lint_paths(paths)
+    except FileNotFoundError as e:
+        p.error(str(e))
+
+    if ns.format == "json":
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            counts = ", ".join(f"{r}: {n}"
+                               for r, n in summarize(findings).items())
+            print(f"redlint: {len(findings)} finding(s) ({counts})")
+        else:
+            print("redlint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

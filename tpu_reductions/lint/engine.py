@@ -1,0 +1,179 @@
+"""redlint engine — file walking, waiver plumbing, finding assembly.
+
+Waiver syntax (one honest escape hatch per line, never per file):
+
+    some_dangerous_call()  # redlint: disable=RED003 -- staging N<1MiB
+
+* the comment may sit on the flagged line, or alone on the line above;
+* `disable=` takes a comma-separated rule list;
+* the ` -- reason` is MANDATORY: a waiver without a reason is itself a
+  finding (RED000), and a waiver that suppresses nothing is reported as
+  stale (RED009) so dead waivers can't rot in the tree.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import asdict, dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from tpu_reductions.lint.rules import RawFinding, check_python
+from tpu_reductions.lint.shell import check_shell
+
+WAIVER_RE = re.compile(
+    r"#\s*redlint:\s*disable=(?P<rules>[A-Z0-9, ]+?)"
+    r"(?:\s*--\s*(?P<reason>.*\S))?\s*$")
+
+# engine-level meta rules (docs/LINT.md): not waivable themselves
+RULE_MALFORMED_WAIVER = "RED000"
+RULE_STALE_WAIVER = "RED009"
+
+_SKIP_DIRS = {".git", "__pycache__", ".jax_cache", "node_modules", ".venv"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation: the machine-readable report row the acceptance
+    contract fixes as {rule, path, line, message}."""
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class _Waiver:
+    line: int            # line the waiver comment sits on
+    rules: Tuple[str, ...]
+    reason: str | None
+    applies_to: Tuple[int, ...]  # source lines it can suppress
+    used: bool = False
+
+
+def _comment_lines(source: str, is_python: bool) -> List[Tuple[int, str,
+                                                               bool]]:
+    """(line, comment_text, is_standalone) for every real comment.
+    Python files go through tokenize so waiver EXAMPLES inside
+    docstrings/strings (this module's own docstring, error messages)
+    are never parsed as live waivers; shell falls back to line scanning."""
+    out: List[Tuple[int, str, bool]] = []
+    if is_python:
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    standalone = tok.line.strip().startswith("#")
+                    out.append((tok.start[0], tok.string, standalone))
+            return out
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass  # unparseable: degrade to the shell-style line scan
+    for i, raw in enumerate(source.splitlines(), start=1):
+        if "#" in raw:
+            out.append((i, raw[raw.index("#"):],
+                        raw.strip().startswith("#")))
+    return out
+
+
+def _parse_waivers(source: str, is_python: bool) -> List[_Waiver]:
+    out = []
+    for i, comment, standalone in _comment_lines(source, is_python):
+        m = WAIVER_RE.search(comment)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(",")
+                      if r.strip())
+        # a standalone waiver comment guards the NEXT line; an inline
+        # one guards its own line
+        applies = (i, i + 1) if standalone else (i,)
+        out.append(_Waiver(i, rules, m.group("reason"), applies))
+    return out
+
+
+def _apply_waivers(raw: Iterable[RawFinding], waivers: List[_Waiver],
+                   path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in raw:
+        suppressed = False
+        for w in waivers:
+            if w.reason and f.rule in w.rules and f.line in w.applies_to:
+                w.used = True
+                suppressed = True
+                break
+        if not suppressed:
+            findings.append(Finding(f.rule, path, f.line, f.message))
+    for w in waivers:
+        if not w.reason:
+            findings.append(Finding(
+                RULE_MALFORMED_WAIVER, path, w.line,
+                "waiver without a reason — write "
+                "'# redlint: disable=RED00X -- why this is safe'"))
+        elif not w.used:
+            findings.append(Finding(
+                RULE_STALE_WAIVER, path, w.line,
+                f"stale waiver ({','.join(w.rules)}): no matching finding "
+                "on this line — delete it or fix the rule id"))
+    return findings
+
+
+def lint_file(path: Path, rel: str | None = None) -> List[Finding]:
+    """Lint one file (.py via the AST rules, .sh via the shell pass).
+    `rel` overrides the path string used for whitelist suffix matching
+    and reporting (defaults to the path as given)."""
+    rel = rel if rel is not None else str(path)
+    rel_posix = rel.replace("\\", "/")
+    try:
+        source = path.read_text()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding("RED???", rel, 1, f"unreadable: {e}")]
+    if path.suffix == ".py":
+        raw = check_python(rel_posix, source)
+    elif path.suffix == ".sh":
+        raw = check_shell(rel_posix, source)
+    else:
+        return []
+    waivers = _parse_waivers(source, is_python=path.suffix == ".py")
+    return sorted(_apply_waivers(raw, waivers, rel),
+                  key=lambda f: (f.line, f.rule))
+
+
+def iter_lintable(paths: Sequence[str | Path]) -> List[Path]:
+    """Expand files/dirs into the .py/.sh set, skipping cache dirs."""
+    out: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*")):
+                if f.suffix in (".py", ".sh") and f.is_file() and \
+                        not (_SKIP_DIRS & set(f.parts)):
+                    out.append(f)
+        elif p.is_file():
+            out.append(p)
+        else:
+            raise FileNotFoundError(f"no such path: {p}")
+    return out
+
+
+def lint_paths(paths: Sequence[str | Path]) -> List[Finding]:
+    """Lint every .py/.sh file under `paths`; the package's public
+    entry point (CLI: python -m tpu_reductions.lint)."""
+    findings: List[Finding] = []
+    for f in iter_lintable(paths):
+        findings += lint_file(f)
+    return sorted(findings, key=lambda x: (x.path, x.line, x.rule))
+
+
+def summarize(findings: Sequence[Finding]) -> Dict[str, int]:
+    """Per-rule finding counts for the text report footer."""
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return dict(sorted(counts.items()))

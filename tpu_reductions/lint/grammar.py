@@ -1,0 +1,127 @@
+"""Golden output-row grammar spec — ONE definition of every
+machine-parsed line this suite emits.
+
+Downstream tooling (awk/grep pipelines, the round driver, the judge's
+parity checks) matches these rows byte-for-byte: the QA status markers
+(reference cuda/shared/inc/shrQATest.h:83-112,224-229), the canonical
+throughput line (reduction.cpp:744-745) and the collective row schema
+(reduce.c:67-69,81,95). The producers (utils/qa.py, utils/logging.py,
+bench/aggregate.py, bench/report.py) import their templates from HERE,
+and the static checker (lint/rules.py RED005) validates every other
+string literal in the tree against the same regexes — so the emitters
+and the checker cannot drift apart.
+
+This module must stay dependency-free (stdlib `re` only): it is
+imported both by runtime producers and by the linter, which must never
+pay a jax import.
+"""
+
+from __future__ import annotations
+
+import re
+
+# --------------------------------------------------------------------------
+# QA status markers (shrQATest.h:83-112,224-229; SURVEY.md §5)
+# --------------------------------------------------------------------------
+
+QA_MARKER = "&&&&"
+QA_STATUSES = ("PASSED", "FAILED", "WAIVED")
+
+# exact emit templates — format() placeholders, used by utils/qa.py
+QA_RUNNING_TEMPLATE = "&&&& RUNNING {name} {args}"
+QA_FINISH_TEMPLATE = "&&&& {name} {status}"
+
+QA_RUNNING_RE = re.compile(r"^&&&& RUNNING \S+.*$")
+QA_FINISH_RE = re.compile(r"^&&&& \S+ (PASSED|FAILED|WAIVED)$")
+
+# --------------------------------------------------------------------------
+# Canonical single-chip throughput line (reduction.cpp:744-745)
+# --------------------------------------------------------------------------
+
+THROUGHPUT_TEMPLATE = (
+    "{name}, Throughput = {gbps:.4f} GB/s, Time = {secs:.5f} s, "
+    "Size = {n} Elements, NumDevsUsed = {devices}, "
+    "Workgroup = {workgroup}")
+
+THROUGHPUT_RE = re.compile(
+    r"^(\S+), Throughput = ([0-9.]+) GB/s, Time = ([0-9.eE+-]+) s, "
+    r"Size = (\d+) Elements, NumDevsUsed = (\d+), Workgroup = (\d+)$")
+
+# --------------------------------------------------------------------------
+# Collective row schema (reduce.c:67-69,81,95; getAvgs.sh:7-10)
+# --------------------------------------------------------------------------
+
+COLLECTIVE_COLUMNS = ("DATATYPE", "OP", "NODES", "GB/sec")
+COLLECTIVE_HEADER = " ".join(COLLECTIVE_COLUMNS)  # "DATATYPE OP NODES GB/sec"
+
+COLLECTIVE_ROW_TEMPLATE = "{dtype} {op} {ranks} {gbps:.3f}"
+COLLECTIVE_ROW_RE = re.compile(r"^[A-Z][A-Z0-9]* [A-Z]+ \d+ [0-9.]+$")
+
+# --------------------------------------------------------------------------
+# Static conformance (RED005) — validate a string literal that *looks*
+# like one of the grammars above without knowing its runtime field
+# values. The linter replaces every interpolated f-string field with
+# PLACEHOLDER before matching, so templates validate structurally.
+# --------------------------------------------------------------------------
+
+PLACEHOLDER = "\x00"
+_PH = re.escape(PLACEHOLDER)
+_FIELD = rf"(?:{_PH}|\S+)"          # a formatted field or a literal token
+_STATUS = rf"(?:{_PH}|PASSED|FAILED|WAIVED|RUNNING)"
+
+_STATIC_QA_RES = (
+    re.compile(rf"^&&&& RUNNING(?: {_FIELD})+$"),
+    re.compile(rf"^&&&& {_FIELD} {_STATUS}$"),
+    re.compile(rf"^&&&& {_STATUS}$"),   # grep-side fragments in tests
+)
+_STATIC_THROUGHPUT_RE = re.compile(
+    rf"^{_FIELD}, Throughput = {_FIELD} GB/s, Time = {_FIELD} s, "
+    rf"Size = {_FIELD} Elements, NumDevsUsed = {_FIELD}, "
+    rf"Workgroup = {_FIELD}$")
+
+
+def check_literal(text: str) -> str | None:
+    """RED005 core: if `text` (a string literal with interpolations
+    replaced by PLACEHOLDER) is an attempt at one of the golden row
+    grammars but deviates from it, return an error message; return None
+    when the literal either conforms or is unrelated to any grammar.
+
+    Pure string logic so both the AST rule and tests exercise exactly
+    the spec this module publishes.
+    """
+    # Multi-line literals (docstring-ish) are judged line by line: only
+    # a line that itself trips a trigger is checked.
+    for line in text.splitlines() or [text]:
+        msg = _check_line(line)
+        if msg:
+            return msg
+    return None
+
+
+def _check_line(line: str) -> str | None:
+    s = line.strip()
+    if "&&&" in s:
+        # substring-containment greps ("... PASSED" in out) pass through
+        # as long as the &&&&-anchored part parses under the QA grammar
+        start = s.index("&&&")
+        frag = s[start:]
+        if not any(r.match(frag) for r in _STATIC_QA_RES):
+            return (f"QA marker literal {line!r} does not match the "
+                    f"golden grammar ('{QA_RUNNING_TEMPLATE}' or "
+                    f"'{QA_FINISH_TEMPLATE}' with status in "
+                    f"{'/'.join(QA_STATUSES)})")
+    if "Throughput =" in s:
+        if not _STATIC_THROUGHPUT_RE.match(s):
+            # consumer-side prefixes ("Reduction, Throughput = " in log)
+            # are fine when they are a strict prefix of the template
+            plain = THROUGHPUT_TEMPLATE.replace("{name}", s.split(",")[0])
+            if not plain.startswith(s) and not s.endswith(PLACEHOLDER):
+                return (f"throughput literal {line!r} deviates from the "
+                        f"reduction.cpp:744-745 template "
+                        f"'{THROUGHPUT_TEMPLATE}'")
+    if "DATATYPE" in s and s != COLLECTIVE_HEADER:
+        # a literal mentioning the header's lead token must BE the header
+        if s.startswith("DATATYPE "):
+            return (f"collective header literal {line!r} != golden "
+                    f"'{COLLECTIVE_HEADER}' (reduce.c:67-69)")
+    return None

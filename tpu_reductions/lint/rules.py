@@ -1,0 +1,418 @@
+"""redlint Python rules RED001-RED007 — one AST walk per file.
+
+Each rule encodes one CLAUDE.md "hard-won environment fact" (or the
+SURVEY.md §5 output-row contract) as a static check; docs/LINT.md maps
+every rule id to its provenance. Shell rule RED008 lives in
+lint/shell.py; the waiver plumbing (RED000/RED009) in lint/engine.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from tpu_reductions.lint import grammar
+
+
+@dataclass(frozen=True)
+class RawFinding:
+    """A pre-waiver finding: (rule, line, message); the engine attaches
+    the path and applies waivers."""
+    rule: str
+    line: int
+    message: str
+
+
+# Module whitelists, matched as posix-path suffixes. These name the ONE
+# sanctioned home of each dangerous pattern (the doctrine is "route it
+# through the module that does it safely", not "never do it").
+X64_WHITELIST = ("utils/x64.py", "ops/oracle.py")
+TIMING_WHITELIST = ("ops/chain.py", "utils/timing.py", "utils/calibrate.py",
+                    "utils/debug.py")
+STAGING_WHITELIST = ("utils/staging.py",)
+GRAMMAR_WHITELIST = ("lint/grammar.py",)
+WATCHDOG_WHITELIST = ("utils/watchdog.py",)
+
+# RED006 applies to the measured packages only: every public surface in
+# ops/ and bench/ must carry its reference citation (PARITY.md).
+CITATION_DIRS = ("ops", "bench")
+
+_WALLCLOCK_ATTRS = {"perf_counter", "monotonic"}
+_DEVICE_PUT_ATTRS = {"device_put", "device_put_sharded",
+                     "device_put_replicated"}
+# Markers that satisfy RED007: the module either drains the device queue
+# to the host or arms the relay watchdog before it can exit.
+_DRAIN_NAMES = {"device_get", "maybe_arm_for_tpu"}
+
+_CITATION_RE = re.compile(r"[\w./-]+:\d+(?:-\d+)?|§\s*\d")
+_NO_ANALOG_RE = re.compile(r"no reference analog", re.I)
+
+
+def _suffix_match(rel_posix: str, whitelist: Sequence[str]) -> bool:
+    return any(rel_posix.endswith(w) for w in whitelist)
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute/name chain ('jax.config.update');
+    empty string for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _FileContext:
+    """Per-file AST facts shared by the rules: docstring node ids,
+    regex-consumer literal ids, import aliases."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.tree = tree
+        self.docstrings = set()
+        self.regex_args = set()
+        self.time_aliases = set()     # names bound to time.perf_counter etc.
+        self.imports_jax = False
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Module, ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                body = node.body
+                if body and isinstance(body[0], ast.Expr) \
+                        and _const_str(body[0].value) is not None:
+                    self.docstrings.add(id(body[0].value))
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if chain.startswith("re.") or chain.endswith(".compile"):
+                    # consumer-side patterns (re.compile(r"...")) quote
+                    # the grammars to PARSE them — not emission sites
+                    for a in ast.walk(node):
+                        self.regex_args.add(id(a))
+            if isinstance(node, ast.Import):
+                if any(n.name == "jax" or n.name.startswith("jax.")
+                       for n in node.names):
+                    self.imports_jax = True
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "jax" or mod.startswith("jax."):
+                    self.imports_jax = True
+                if mod == "time":
+                    for n in node.names:
+                        if n.name in _WALLCLOCK_ATTRS:
+                            self.time_aliases.add(n.asname or n.name)
+
+
+def _is_wallclock(node: ast.Call, ctx: _FileContext) -> bool:
+    chain = _attr_chain(node.func)
+    if chain in ("time.perf_counter", "time.monotonic"):
+        return True
+    return isinstance(node.func, ast.Name) and \
+        node.func.id in ctx.time_aliases
+
+
+def _is_block_until_ready(node: ast.Call) -> bool:
+    return isinstance(node.func, ast.Attribute) and \
+        node.func.attr == "block_until_ready"
+
+
+def check_python(rel_posix: str, source: str) -> List[RawFinding]:
+    """Run RED001-RED007 over one Python source file. `rel_posix` is the
+    file's path with posix separators (whitelists match on suffixes, so
+    absolute tmp-dir fixture paths work too)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [RawFinding("RED???", e.lineno or 1,
+                           f"file does not parse: {e.msg}")]
+    ctx = _FileContext(tree)
+    out: List[RawFinding] = []
+    out += _red001(rel_posix, ctx)
+    out += _red002(rel_posix, ctx)
+    out += _red003(rel_posix, ctx)
+    out += _red004(ctx)
+    out += _red005(rel_posix, ctx)
+    out += _red006(rel_posix, ctx)
+    out += _red007(rel_posix, ctx)
+    # nested timing scopes can double-report the same call site
+    return sorted(set(out), key=lambda f: (f.line, f.rule, f.message))
+
+
+# --------------------------------------------------------------------------
+# RED001 — no x64 enables / jax float64 dtypes outside utils/x64.py and
+# ops/oracle.py. float64 ON THE DEVICE wedges the axon tunnel machine-
+# wide (CLAUDE.md); device f64 travels as 32-bit pairs (ops/dd_reduce).
+# Host-side numpy float64 (np.float64) is safe and NOT flagged.
+# --------------------------------------------------------------------------
+
+def _red001(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    if _suffix_match(rel, X64_WHITELIST):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain.endswith("config.update") and node.args and \
+                    _const_str(node.args[0]) == "jax_enable_x64":
+                out.append(RawFinding(
+                    "RED001", node.lineno,
+                    "jax_enable_x64 toggled outside utils/x64.py — x64 on "
+                    "the TPU device wedges the axon tunnel machine-wide; "
+                    "use utils.x64.preserve_x64 scoping"))
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _const_str(kw.value) == "float64" \
+                        and _attr_chain(node.func).split(".")[0] in (
+                            "jnp", "jax"):
+                    out.append(RawFinding(
+                        "RED001", node.lineno,
+                        'dtype="float64" on a jax call — device f64 must '
+                        "go through the 32-bit pair paths (ops/dd_reduce)"))
+        if isinstance(node, ast.Attribute) and node.attr == "float64":
+            chain = _attr_chain(node)
+            if chain in ("jnp.float64", "jax.numpy.float64"):
+                out.append(RawFinding(
+                    "RED001", node.lineno,
+                    f"{chain} dtype literal outside utils/x64.py / "
+                    "ops/oracle.py — jax f64 wedges the tunneled TPU; "
+                    "use the dd pair encodings"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED002 — wall-clock timing bracketing a bare block_until_ready outside
+# the chained-timing modules. On this platform block_until_ready returns
+# on dispatch ack (~20-30 us flat), so perf_counter around it measures
+# nothing (CLAUDE.md; docs/TIMING.md) — only ops/chain's data-dependent
+# chained slope is honest.
+# --------------------------------------------------------------------------
+
+def _red002(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    if _suffix_match(rel, TIMING_WHITELIST):
+        return []
+    out = []
+    # scope = a def (nested defs included via ast.walk: a closure timing
+    # a sync it closes over is the same fake-fast pattern)
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls = [n for n in ast.walk(fn) if isinstance(n, ast.Call)]
+        if not any(_is_block_until_ready(c) for c in calls):
+            continue
+        for c in calls:
+            if _is_wallclock(c, ctx):
+                out.append(RawFinding(
+                    "RED002", c.lineno,
+                    "wall-clock timing around jax.block_until_ready — on "
+                    "the tunneled TPU the sync returns on dispatch ack, "
+                    "so this measures nothing; use the chained slope "
+                    "discipline (ops/chain.py, utils/timing.time_chained)"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED003 — host->device staging outside utils/staging.py. A single
+# >512 MiB transfer through the relay killed two live windows (round 2);
+# staging chunks payloads into 256 MiB messages.
+# --------------------------------------------------------------------------
+
+def _red003(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    if _suffix_match(rel, STAGING_WHITELIST):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _DEVICE_PUT_ATTRS:
+            out.append(RawFinding(
+                "RED003", node.lineno,
+                f"{node.func.attr} outside utils/staging.py — unchunked "
+                "host->device staging over 512 MiB kills the relay; use "
+                "utils.staging.device_put_chunked / stage()"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED004 — writes to the JAX_PLATFORMS env var. The axon TPU plugin
+# IGNORES it (CLAUDE.md): the only effective switch is
+# jax.config.update("jax_platforms", ...), so an env write is a silent
+# no-op that *looks* like platform forcing.
+# --------------------------------------------------------------------------
+
+def _environ_key_nodes(node: ast.Call) -> List[ast.AST]:
+    chain = _attr_chain(node.func)
+    if chain.endswith("environ.setdefault") or chain == "os.putenv":
+        return node.args[:1]
+    if chain.endswith("environ.update"):
+        keys = []
+        for a in node.args:
+            if isinstance(a, ast.Dict):
+                keys += a.keys
+        for kw in node.keywords:
+            if kw.arg:
+                keys.append(ast.Constant(kw.arg, lineno=node.lineno,
+                                         col_offset=0))
+        return keys
+    return []
+
+
+def _red004(ctx: _FileContext) -> List[RawFinding]:
+    out = []
+    msg = ("write to JAX_PLATFORMS env var — the axon TPU plugin ignores "
+           'it; force platforms via jax.config.update("jax_platforms", ...)')
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        _attr_chain(t.value).endswith("environ") and \
+                        _const_str(t.slice) == "JAX_PLATFORMS":
+                    out.append(RawFinding("RED004", node.lineno, msg))
+        if isinstance(node, ast.Call):
+            for key in _environ_key_nodes(node):
+                if _const_str(key) == "JAX_PLATFORMS":
+                    out.append(RawFinding("RED004", node.lineno, msg))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED005 — output-row grammar conformance. Downstream tooling greps the
+# exact &&&& / throughput / collective-row literals (SURVEY.md §5); any
+# emitted literal that *resembles* a grammar but deviates is a silent
+# pipeline break. The golden spec lives in lint/grammar.py and is
+# imported by the producers, so emitters and checker cannot drift.
+# --------------------------------------------------------------------------
+
+def _literal_text(node: ast.AST) -> Optional[str]:
+    """The static text of a string constant or f-string, interpolations
+    replaced by grammar.PLACEHOLDER."""
+    s = _const_str(node)
+    if s is not None:
+        return s
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            c = _const_str(v)
+            parts.append(c if c is not None else grammar.PLACEHOLDER)
+        return "".join(parts)
+    return None
+
+
+def _red005(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    if _suffix_match(rel, GRAMMAR_WHITELIST):
+        return []
+    # constants INSIDE an f-string are judged as part of the whole
+    # JoinedStr, never standalone
+    fstring_parts = {id(v) for n in ast.walk(ctx.tree)
+                     if isinstance(n, ast.JoinedStr) for v in n.values}
+    out = []
+    for node in ast.walk(ctx.tree):
+        if id(node) in ctx.docstrings or id(node) in ctx.regex_args \
+                or id(node) in fstring_parts:
+            continue
+        if not isinstance(node, (ast.JoinedStr, ast.Constant)):
+            continue
+        text = _literal_text(node)
+        if text is None:
+            continue
+        msg = grammar.check_literal(text)
+        if msg:
+            out.append(RawFinding("RED005", node.lineno, msg))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED006 — public docstrings in ops/ and bench/ must cite the reference
+# file:line they re-create (PARITY.md; CLAUDE.md conventions), or carry
+# an explicit "no reference analog" marker for TPU-native machinery.
+# --------------------------------------------------------------------------
+
+def _in_citation_dirs(rel: str) -> bool:
+    parts = rel.split("/")
+    return any(p in CITATION_DIRS for p in parts[:-1])
+
+
+def _red006(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    if not _in_citation_dirs(rel):
+        return []
+    out = []
+
+    def check_doc(node, kind: str, name: str) -> None:
+        doc = ast.get_docstring(node, clean=False)
+        if doc is None:
+            out.append(RawFinding(
+                "RED006", getattr(node, "lineno", 1),
+                f"public {kind} '{name}' in a measured package has no "
+                "docstring — cite the reference file:line it re-creates "
+                "(PARITY.md) or state 'no reference analog'"))
+        elif not (_CITATION_RE.search(doc) or _NO_ANALOG_RE.search(doc)):
+            out.append(RawFinding(
+                "RED006", getattr(node, "lineno", 1),
+                f"public {kind} '{name}' docstring lacks a reference "
+                "citation (file:line / SURVEY.md §N) and does not state "
+                "'no reference analog'"))
+
+    check_doc(ctx.tree, "module", rel.rsplit("/", 1)[-1])
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and \
+                not node.name.startswith("_"):
+            check_doc(node, "def" if not isinstance(node, ast.ClassDef)
+                      else "class", node.name)
+            if isinstance(node, ast.ClassDef):
+                for m in node.body:
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) and \
+                            not m.name.startswith("_"):
+                        check_doc(m, "method", f"{node.name}.{m.name}")
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED007 — process exit in a device-touching module without a drain or
+# watchdog. Killing a process with a large unfinished device queue can
+# wedge the remote chip machine-wide (CLAUDE.md): on-chip entry points
+# must either drain (device_get) or arm utils.watchdog.maybe_arm_for_tpu
+# before any exit path.
+# --------------------------------------------------------------------------
+
+def _red007(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    if _suffix_match(rel, WATCHDOG_WHITELIST) or not ctx.imports_jax:
+        return []
+    names = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+        if isinstance(node, ast.Attribute):
+            names.add(node.attr)
+        if isinstance(node, ast.ImportFrom):
+            names.update(n.asname or n.name for n in node.names)
+    if names & _DRAIN_NAMES:
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        is_exit = False
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            is_exit = chain in ("sys.exit", "os._exit")
+        elif isinstance(node, ast.Raise) and node.exc is not None:
+            target = node.exc.func if isinstance(node.exc, ast.Call) \
+                else node.exc
+            is_exit = isinstance(target, ast.Name) and \
+                target.id == "SystemExit"
+        if is_exit:
+            out.append(RawFinding(
+                "RED007", node.lineno,
+                "process exit in a jax-importing module with no drain "
+                "(device_get) or watchdog arm (maybe_arm_for_tpu) — an "
+                "exit with in-flight device work can wedge the remote "
+                "chip machine-wide"))
+    return out

@@ -1,0 +1,44 @@
+"""redlint shell sub-pass — RED008 over session scripts.
+
+A SIGKILLed process with in-flight device work can wedge the remote
+chip machine-wide (CLAUDE.md; scripts/chip_session.sh:77): session
+scripts must reap INT-first with a drain wait and may escalate past
+SIGTERM only behind an explicit waiver. Line-based, not AST — shell
+quoting is undecidable anyway, and every hit deserves human eyes.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from tpu_reductions.lint.rules import RawFinding
+
+# kill/pkill/killall with a KILL-signal spelling: -9, -KILL, -s KILL,
+# -s 9, --signal KILL/9, SIGKILL
+_SIGKILL_RE = re.compile(
+    r"\b(?:kill|pkill|killall)\b"
+    r"(?=[^#\n]*(?:"
+    r"\s-9\b|\s-KILL\b|\s-SIGKILL\b|"
+    r"\s(?:-s|--signal)[= ](?:SIG)?KILL\b|"
+    r"\s(?:-s|--signal)[= ]9\b|"
+    r"[^#\n]*\bSIGKILL\b"
+    r"))")
+
+
+def check_shell(rel_posix: str, source: str) -> List[RawFinding]:
+    """RED008: flag KILL-signal sends in shell scripts. Comment-only
+    lines are skipped (prose about SIGKILL is doctrine, not a send)."""
+    out: List[RawFinding] = []
+    for i, line in enumerate(source.splitlines(), start=1):
+        code = line.split("#", 1)[0]  # strip trailing comment prose
+        if not code.strip():
+            continue
+        if _SIGKILL_RE.search(code):
+            out.append(RawFinding(
+                "RED008", i,
+                "SIGKILL in a session script — a process killed "
+                "mid-device-queue can wedge the remote chip; reap "
+                "INT-first with a drain wait "
+                "(scripts/supervise_watcher.sh discipline)"))
+    return out

@@ -73,7 +73,10 @@ def auto_chain_span(n: int, dtype: str, *, target_signal_s: float = 6e-3,
     the per-iteration time from the platform roofline (the VMEM-resident
     rate for working sets that fit, since overestimating per-iter time
     undersizes the span) and size the span to ~target_signal_s of real
-    device work, clamped to [lo, hi]."""
+    device work, clamped to [lo, hi].
+
+    No reference analog (TPU-native).
+    """
     import numpy as np
     bytes_per_iter = n * np.dtype(jnp.bfloat16 if dtype == "bfloat16"
                                   else dtype).itemsize
@@ -108,6 +111,9 @@ def make_chained_reduce(core: Callable, op: ReduceOpSpec):
     timings. The returned scalar transitively depends on every
     iteration's reduction, so materializing it on the host bounds the
     completion of all k kernel executions.
+
+
+    No reference analog (TPU-native).
     """
     def chained(x2d, k) -> jax.Array:
         pair = isinstance(x2d, tuple)

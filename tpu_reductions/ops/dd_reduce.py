@@ -66,7 +66,10 @@ from tpu_reductions.ops.pallas_reduce import (LANES,
 def host_split(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """f64 -> (hi, lo) float32 pair with hi + lo == x to ~48 bits. Pure
     numpy so the split can run before any device transfer. Overflows for
-    |x| >= f32 max — use host_split_scaled for full-range payloads."""
+    |x| >= f32 max — use host_split_scaled for full-range payloads.
+
+    No reference analog (TPU-native).
+    """
     x = np.asarray(x, dtype=np.float64)
     hi = x.astype(np.float32)
     lo = (x - hi.astype(np.float64)).astype(np.float32)
@@ -80,7 +83,10 @@ def host_split_scaled(x: np.ndarray
     both f32 overflow (2^128) and the denormal floor for the lo plane.
     Reconstruct with ldexp(hi + lo, s). Power-of-two rescaling is exact,
     so precision matches host_split; payloads containing inf/nan are
-    rejected (the reference's payload contract excludes them)."""
+    rejected (the reference's payload contract excludes them).
+
+    No reference analog (TPU-native).
+    """
     x = np.asarray(x, dtype=np.float64)
     m = float(np.max(np.abs(x))) if x.size else 0.0
     if not np.isfinite(m):
@@ -93,8 +99,9 @@ def host_split_scaled(x: np.ndarray
 
 
 def split_hi_lo(x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """In-graph split (needs x64; used on CPU hosts/tests only)."""
+    """In-graph split (needs x64; used on CPU hosts/tests only). No reference analog (TPU-native)."""
     hi = x.astype(jnp.float32)
+    # redlint: disable=RED001 -- in-graph split runs on x64 CPU hosts/tests only (docstring contract); the TPU path uses host_split
     lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
     return hi, lo
 
@@ -109,7 +116,10 @@ def host_key_encode(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     makes unsigned-integer order match float order. Splitting into 32-bit
     halves and flipping each half's top bit converts unsigned lexicographic
     order into *signed* int32 lexicographic order (TPU integers are
-    signed). Exactly invertible — see host_key_decode."""
+    signed). Exactly invertible — see host_key_decode.
+
+    No reference analog (TPU-native).
+    """
     b = np.ravel(np.asarray(x, dtype=np.float64)).view(np.uint64)
     sign = (b >> np.uint64(63)).astype(bool)
     key = np.where(sign, ~b, b ^ np.uint64(0x8000000000000000))
@@ -121,7 +131,7 @@ def host_key_encode(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 
 def host_key_decode(k_hi: np.ndarray, k_lo: np.ndarray) -> np.ndarray:
-    """Invert host_key_encode: (k_hi, k_lo) int32 -> f64, bit-exact."""
+    """Invert host_key_encode: (k_hi, k_lo) int32 -> f64, bit-exact. No reference analog (TPU-native)."""
     hi_u = (np.asarray(k_hi).view(np.uint32).astype(np.uint64)
             ^ np.uint64(0x80000000))
     lo_u = (np.asarray(k_lo).view(np.uint32).astype(np.uint64)
@@ -149,7 +159,10 @@ def stage_split_padded(x: np.ndarray, method: str, threads: int = 256,
     full-range; s == 0), padded with the largest/smallest key pair (the
     monoid identity in key space).
     Returns (plane_hi, plane_lo, (tm, p, t), s) — finish with
-    host_finish_pairs(..., scale_exp=s)."""
+    host_finish_pairs(..., scale_exp=s).
+
+    No reference analog (TPU-native).
+    """
     method = method.upper()
     flat = np.ravel(np.asarray(x, dtype=np.float64))
     tm, p, t = choose_tiling(flat.size, threads, max_blocks)
@@ -238,7 +251,10 @@ def dd_pallas_call(hi2d: jax.Array, lo2d: jax.Array, method: str, tm: int,
                    interpret: Optional[bool] = None
                    ) -> tuple[jax.Array, jax.Array]:
     """Run the pair-accumulator kernel over staged (R,128) f32 planes.
-    Returns the (TM,128) hi/lo accumulators (jittable, f32-only)."""
+    Returns the (TM,128) hi/lo accumulators (jittable, f32-only).
+
+    No reference analog (TPU-native).
+    """
     rows = hi2d.shape[0]
     interpret = _interpret_default() if interpret is None else interpret
     dt = hi2d.dtype  # f32 planes for SUM, i32 key planes for MIN/MAX
@@ -365,7 +381,10 @@ def make_dd_device_reduce(method: str, n: int, *, threads: int = 256,
     compile through the tunnel (~20-40 s first time). One cache entry
     per (args, backend) shares the jitted core between them; the
     backend key guards against a platform switch mid-process (tests
-    flip cpu/interpret)."""
+    flip cpu/interpret).
+
+    No reference analog (TPU-native).
+    """
     return _dd_device_reduce_cached(method.upper(), n, threads,
                                     max_blocks, interpret,
                                     jax.default_backend())
@@ -450,7 +469,10 @@ def make_dd_staged_reduce(method: str, n: int, *, threads: int = 256,
     """Build (stage_fn, reduce_fn) for f64 benchmarking with no device f64:
     stage_fn(np f64) -> (hi2d, lo2d) device f32 planes (untimed);
     reduce_fn(hi2d, lo2d) -> np.float64 scalar (timed: kernel + host
-    finish, the --cpufinal structure)."""
+    finish, the --cpufinal structure).
+
+    No reference analog (TPU-native).
+    """
     tm, _, _ = choose_tiling(n, threads, max_blocks)
     stage_fn = _make_stage_fn(method.upper(), tm, threads, max_blocks)
 
@@ -469,7 +491,10 @@ def dd_pallas_reduce_f64(x, method: str = "SUM", *, threads: int = 256,
                          max_blocks: int = 64,
                          interpret: Optional[bool] = None) -> np.float64:
     """One-shot f64 reduce via the double-double path (host split ->
-    f32 Pallas -> host finish). Accepts numpy or jax input."""
+    f32 Pallas -> host finish). Accepts numpy or jax input.
+
+    No reference analog (TPU-native).
+    """
     x_np = np.asarray(jax.device_get(x) if isinstance(x, jax.Array) else x,
                       dtype=np.float64)
     hi2d, lo2d, (tm, _, _), s = stage_split_padded(x_np, method, threads,
@@ -483,12 +508,16 @@ def dd_pallas_sum_f64(x: jax.Array, *, threads: int = 256,
                       max_blocks: int = 64,
                       interpret: Optional[bool] = None) -> jax.Array:
     """Fully in-graph f64 SUM (requires x64; CPU hosts/tests — on the
-    axon TPU use dd_pallas_reduce_f64, which never puts f64 on device)."""
-    assert x.dtype == jnp.float64, x.dtype
+    axon TPU use dd_pallas_reduce_f64, which never puts f64 on device).
+
+    No reference analog (TPU-native).
+    """
+    assert x.dtype == jnp.float64, x.dtype  # redlint: disable=RED001 -- CPU-hosts/tests-only entry point (docstring contract); never reached on the axon TPU
     x = jnp.ravel(x)
     tm, p, t = choose_tiling(x.size, threads, max_blocks)
     rows = p * t * tm
     x = jnp.pad(x, (0, rows * LANES - x.size))  # SUM identity: 0.0
     hi, lo = split_hi_lo(x.reshape(rows, LANES))
     acc_hi, acc_lo = dd_pallas_call(hi, lo, "SUM", tm, interpret=interpret)
+    # redlint: disable=RED001 -- same CPU-only contract as the assert above
     return jnp.sum(acc_hi.astype(jnp.float64) + acc_lo.astype(jnp.float64))

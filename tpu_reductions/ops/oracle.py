@@ -92,6 +92,9 @@ def _load() -> Optional[ctypes.CDLL]:
 
 
 def native_available() -> bool:
+    """Whether the C oracle library built (csrc/, auto-compiled on
+    first use) — the host-reference availability check the reference
+    never needed (its CPU oracle was inline, reduction.cpp:748-780)."""
     return _load() is not None
 
 
@@ -101,9 +104,11 @@ _SUFFIX = {"int32": "i32", "float32": "f32", "float64": "f64"}
 def host_reduce(x: np.ndarray, method: str) -> np.ndarray:
     """Compute the oracle reduction of `x` on the host.
 
-    SUM of reals returns float64 regardless of input dtype (the Kahan
-    accumulator's precision); SUM of int32 wraps mod 2^32 to match the
-    device's int32 accumulator; MIN/MAX return the input dtype.
+    Kahan sum for reals (reduction.cpp:214-227), linear scans for
+    min/max (reduction.cpp:228-249). SUM of reals returns float64
+    regardless of input dtype (the Kahan accumulator's precision); SUM
+    of int32 wraps mod 2^32 to match the device's int32 accumulator;
+    MIN/MAX return the input dtype.
     """
     method = method.upper()
     x = np.ascontiguousarray(x)
@@ -150,7 +155,10 @@ def host_reduce(x: np.ndarray, method: str) -> np.ndarray:
 def native_fill(n: int, dtype: str, rank: int = 0, seed: int = 0
                 ) -> Optional[np.ndarray]:
     """Generate a payload with the native MT19937 filler; None if the
-    native library is unavailable (callers fall back to utils.rng)."""
+    native library is unavailable (callers fall back to utils.rng).
+
+    No reference analog (TPU-native).
+    """
     lib = _load()
     if lib is None or dtype not in _SUFFIX:
         return None

@@ -64,6 +64,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # pre-0.4.38 jax spells it TPUCompilerParams; same constructor
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 from tpu_reductions.ops.registry import ReduceOpSpec, get_op
 
 LANES = 128      # TPU vector lane count (last-dim tile), pallas_guide.md
@@ -73,7 +77,10 @@ SUBLANES = 8     # 32-bit sublane tile (f32/i32)
 def sublanes_for(dtype) -> int:
     """Minimum sublane count by element width (pallas_guide.md tiling
     table): 8 for 32-bit, 16 for bf16/f16, 32 for 8-bit. 64-bit types only
-    exist on the interpret path (CPU hosts), where 8 is fine."""
+    exist on the interpret path (CPU hosts), where 8 is fine.
+
+    No reference analog (TPU-native).
+    """
     return {8: 8, 4: 8, 2: 16, 1: 32}[np.dtype(dtype).itemsize]
 
 
@@ -104,6 +111,9 @@ def choose_tiling(n: int, threads: int = 256, max_blocks: int = 64,
 
 
 def padded_2d_shape(n: int, tm: int, p: int, t: int) -> tuple[int, int]:
+    """(rows, LANES) device layout for n elements under the (tm, p, t)
+    tiling — the grid-shape arithmetic of the CUDA launch config
+    (reduction.cpp:665-668), relaid for the (sublane, lane) VPU tile."""
     return (p * t * tm, LANES)
 
 
@@ -115,7 +125,10 @@ def stage_padded(x: np.ndarray | jax.Array, tm: int, p: int, t: int,
 
     Multi-GiB host payloads stage through bounded per-message transfers
     (utils/staging.py — single bulk messages at 4 GiB killed the tunnel
-    relay in both round-2 live windows); the result is identical."""
+    relay in both round-2 live windows); the result is identical.
+
+    No reference analog (TPU-native).
+    """
     if isinstance(x, np.ndarray):
         from tpu_reductions.utils.staging import maybe_chunked_stage
         flat = np.ravel(x)
@@ -214,7 +227,10 @@ def elementwise_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
                      interpret: Optional[bool] = None) -> jax.Array:
     """Kernel 8: whole-tile elementwise combine into a (TM,128) resident
     accumulator — maximal VPU regularity, zero relayout per step.
-    Returns the (TM, 128) accumulator."""
+    Returns the (TM, 128) accumulator.
+
+    No reference analog (TPU-native).
+    """
     return _accumulator_call(x2d, op, tm,
                              lambda tile, acc_dt: tile.astype(acc_dt),
                              acc_rows=tm, interpret=interpret)
@@ -374,7 +390,10 @@ def single_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int,
                      interpret: Optional[bool] = None) -> jax.Array:
     """Kernel 6: per step, fold the tile to its sublane block, then
     combine into the resident accumulator. Returns the (sublane_tile, 128)
-    accumulator."""
+    accumulator.
+
+    No reference analog (TPU-native).
+    """
     return _accumulator_call(
         x2d, op, tm,
         lambda tile, _acc_dt: _tile_to_sublane(tile, op, tm),
@@ -385,7 +404,10 @@ def two_pass_call(x2d: jax.Array, op: ReduceOpSpec, tm: int, p: int, t: int,
                   interpret: Optional[bool] = None) -> jax.Array:
     """Run the partials kernel over a staged (P*T*TM, 128) array.
     Returns (P*sublane, 128) partials — sublane block i is block i's
-    partial (see _two_pass_kernel on why a block, not a row)."""
+    partial (see _two_pass_kernel on why a block, not a row).
+
+    No reference analog (TPU-native).
+    """
     interpret = _interpret_default() if interpret is None else interpret
     sub = sublanes_for(x2d.dtype)
     return pl.pallas_call(
@@ -444,7 +466,10 @@ def _multipass_finish(partials: jax.Array, op: ReduceOpSpec, threads: int,
 def finish(partials: jax.Array, op: ReduceOpSpec) -> jax.Array:
     """Final (small) reduction of an accumulator/partials block to a scalar
     — the warp-final analog. The block is at most a few KB, so a plain XLA
-    reduce is the right tool (fused, on-chip)."""
+    reduce is the right tool (fused, on-chip).
+
+    No reference analog (TPU-native).
+    """
     return op.jnp_reduce(partials)
 
 
@@ -597,7 +622,10 @@ def make_staged_core(method: str, n: int, dtype, *, threads: int = 256,
                      interpret: Optional[bool] = None):
     """Build (op, stage_fn, core) with `core(x2d) -> scalar` entirely
     on-device (no host finish) — the chainable form consumed by
-    ops/chain.make_chained_reduce for honest slope timing."""
+    ops/chain.make_chained_reduce for honest slope timing.
+
+    No reference analog (TPU-native).
+    """
     op, stage_fn, device_fn = _make_staged_parts(
         method, n, dtype, threads=threads, max_blocks=max_blocks,
         kernel=kernel, cpu_thresh=cpu_thresh,

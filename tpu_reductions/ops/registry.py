@@ -20,7 +20,7 @@ import numpy as np
 
 @dataclasses.dataclass(frozen=True)
 class ReduceOpSpec:
-    """One reduction operator, described for every backend that needs it."""
+    """One reduction operator, described for every backend that needs it. No reference analog (TPU-native)."""
 
     name: str                       # SUM | MIN | MAX
     jnp_reduce: Callable            # full-array reduce (XLA baseline)
@@ -30,6 +30,10 @@ class ReduceOpSpec:
     monoid_identity: Callable       # dtype -> identity scalar (for padding)
 
     def identity(self, dtype) -> np.ndarray:
+        """Padding identity for `dtype` — what ragged tails are filled
+        with so padded lanes cannot perturb the result (the guard the
+        reference's non-pow2 min/max kernels lacked,
+        reduction_kernel.cu:140,157)."""
         return self.monoid_identity(np.dtype(dtype))
 
 
@@ -50,7 +54,10 @@ def _jnp_sum_same_dtype(x, **kw):
 
 def accum_dtype(dtype):
     """Accumulator dtype for SUM: f32 for sub-32-bit floats, else the
-    input dtype."""
+    input dtype.
+
+    No reference analog (TPU-native).
+    """
     dt = jnp.dtype(dtype)
     if jnp.issubdtype(dt, jnp.floating) and dt.itemsize < 4:
         return jnp.float32
@@ -102,6 +109,8 @@ OPS = {
 
 
 def get_op(name: str) -> ReduceOpSpec:
+    """Lookup by the CLI spelling (SUM/MIN/MAX — the reference's
+    --method flag values, reduction.cpp:84-204)."""
     try:
         return OPS[name.upper()]
     except KeyError:

@@ -32,9 +32,23 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:  # jax>=0.4.35 exposes shard_map at top level
-    shard_map = jax.shard_map
+    _shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(*args, **kwargs):
+    """jax.shard_map with the replication-checker kwarg normalized:
+    newer jax spells it check_vma, pre-0.4.38 spells it check_rep (no
+    reference analog — a jax version shim)."""
+    try:
+        return _shard_map(*args, **kwargs)
+    except TypeError:
+        if "check_vma" in kwargs:
+            kwargs = dict(kwargs)
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(*args, **kwargs)
+        raise
 
 from tpu_reductions.ops.registry import get_op
 
@@ -148,6 +162,11 @@ def shard_payload(x_global: np.ndarray, mesh: Mesh, axis: str) -> jax.Array:
     if mesh_spans_processes(mesh):
         return jax.make_array_from_callback(
             x_global.shape, sharding, lambda idx: x_global[idx])
+    # Sharded placement: utils.staging's chunked path cannot express a
+    # NamedSharding, and each device receives only its n/k shard — the
+    # >512 MiB single-message relay hazard is the single-DEVICE staging
+    # path, which does go through utils/staging.py.
+    # redlint: disable=RED003 -- sharded n/k-per-device placement, not single-device bulk staging
     return jax.device_put(x_global, sharding)
 
 

@@ -6,7 +6,9 @@ cuda/shared/src/shrUtils.cpp:157,173-280; the benchmark routes its canonical
 throughput line to LOGBOTH|MASTER at reduction.cpp:744-745). The MPI side
 prints a fixed `DATATYPE OP NODES GB/sec` schema that the awk aggregation
 scripts depend on (reduce.c:67-69,81,95; getAvgs.sh:7-10). The row schema
-IS the metrics API (SURVEY.md §5) — both formats are preserved verbatim.
+IS the metrics API (SURVEY.md §5) — both formats are preserved verbatim,
+and their templates live in lint/grammar.py, the golden spec the static
+checker (redlint RED005) holds every other emitter to.
 """
 
 from __future__ import annotations
@@ -14,6 +16,10 @@ from __future__ import annotations
 import sys
 from pathlib import Path
 from typing import Optional, TextIO
+
+from tpu_reductions.lint.grammar import (COLLECTIVE_HEADER,
+                                         COLLECTIVE_ROW_TEMPLATE,
+                                         THROUGHPUT_TEMPLATE)
 
 
 def throughput_line(gbps: float, secs: float, n: int, *, name: str = "Reduction",
@@ -23,9 +29,8 @@ def throughput_line(gbps: float, secs: float, n: int, *, name: str = "Reduction"
     `Reduction, Throughput = %.4f GB/s, Time = %.5f s, Size = %u Elements,
      NumDevsUsed = %d, Workgroup = %u`
     """
-    return (f"{name}, Throughput = {gbps:.4f} GB/s, Time = {secs:.5f} s, "
-            f"Size = {n} Elements, NumDevsUsed = {devices}, "
-            f"Workgroup = {workgroup}")
+    return THROUGHPUT_TEMPLATE.format(name=name, gbps=gbps, secs=secs, n=n,
+                                      devices=devices, workgroup=workgroup)
 
 
 def collective_row(dtype: str, op: str, ranks: int, gbps: float) -> str:
@@ -33,10 +38,13 @@ def collective_row(dtype: str, op: str, ranks: int, gbps: float) -> str:
     with the same upper-cased dtype spelling (INT/DOUBLE/FLOAT)."""
     names = {"int32": "INT", "float64": "DOUBLE", "float32": "FLOAT",
              "bfloat16": "BF16"}
-    return f"{names.get(dtype, dtype.upper())} {op.upper()} {ranks} {gbps:.3f}"
+    return COLLECTIVE_ROW_TEMPLATE.format(
+        dtype=names.get(dtype, dtype.upper()), op=op.upper(), ranks=ranks,
+        gbps=gbps)
 
 
-COLLECTIVE_HEADER = "DATATYPE OP NODES GB/sec"  # header row (reduce.c:67-69)
+# COLLECTIVE_HEADER (reduce.c:67-69) is imported from lint/grammar.py
+# above and re-exported here so existing importers keep working.
 
 
 class BenchLogger:

@@ -5,7 +5,10 @@ markers and maps status to the process exit code (reference
 cuda/shared/inc/shrQATest.h:83-112,224-229; wired into the benchmark at
 reduction.cpp:87,203; WAIVED used for incapable hardware at
 reduction.cpp:148-155). We keep the exact marker grammar so CI-style greps
-keep working, and keep exit code = status.
+keep working, and keep exit code = status. The marker templates live in
+lint/grammar.py — the golden spec the static checker (redlint RED005)
+validates every other emitter against, so this producer can never drift
+from the checked grammar.
 """
 
 from __future__ import annotations
@@ -13,6 +16,8 @@ from __future__ import annotations
 import enum
 import sys
 from typing import Optional
+
+from tpu_reductions.lint.grammar import QA_FINISH_TEMPLATE, QA_RUNNING_TEMPLATE
 
 
 class QAStatus(enum.IntEnum):
@@ -27,7 +32,8 @@ def qa_start(name: str, argv: Optional[list] = None, *, out=None) -> None:
     """Print the RUNNING marker (shrQAStart analog, shrQATest.h:83-112)."""
     out = out or sys.stdout
     args = " ".join(argv) if argv else ""
-    print(f"&&&& RUNNING {name} {args}".rstrip(), file=out)
+    print(QA_RUNNING_TEMPLATE.format(name=name, args=args).rstrip(),
+          file=out)
     out.flush()
 
 
@@ -35,7 +41,7 @@ def qa_finish(name: str, status: QAStatus, *, out=None) -> int:
     """Print the terminal marker and return the exit code
     (shrQAFinishExit analog minus the exit, shrQATest.h:224-229)."""
     out = out or sys.stdout
-    print(f"&&&& {name} {status.name}", file=out)
+    print(QA_FINISH_TEMPLATE.format(name=name, status=status.name), file=out)
     out.flush()
     return int(status)
 
