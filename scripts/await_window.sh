@@ -18,24 +18,52 @@
 # in the log every ~10 min so "armed" is verifiable afterwards.
 #
 # Usage: bash scripts/await_window.sh [poll_seconds=20] [max_hours=13]
-#   CHIP_LOG=chip_session_rNN.log overrides the session log name.
+#   CHIP_LOG=chip_session_rNN.log overrides the session log name
+#   (default: derived from the highest ROUND<N>.md in the repo — the
+#   round in flight — so nobody has to bump a hardcoded pin per round).
+#   Chaos-harness overrides (docs/RESILIENCE.md):
+#     TPU_REDUCTIONS_RELAY_MARKER  tunneled-host marker file
+#     TPU_REDUCTIONS_RELAY_PORTS   comma-separated probe ports
+#     AWAIT_ROOT                   repo root to run in (rehearsal repos)
+#     SESSION_BIN                  session script (tests substitute one)
 set -uo pipefail
-cd "$(dirname "$0")/.."
+cd "${AWAIT_ROOT:-$(dirname "$0")/..}"
 
 POLL=${1:-20}
 MAX_HOURS=${2:-13}
-LOG=${CHIP_LOG:-chip_session_r04.log}
+RELAY_MARKER=${TPU_REDUCTIONS_RELAY_MARKER:-/root/.relay.py}
+SESSION_BIN=${SESSION_BIN:-scripts/chip_session.sh}
 
-if [ ! -e /root/.relay.py ]; then
+current_round() {
+    # highest ROUND<N>.md names the round in flight; r00 when none
+    # (rehearsal repos) — the round-5 fix for the stale r04 pin this
+    # default used to hardcode
+    local n=0 f k
+    for f in ROUND[0-9]*.md; do
+        [ -e "$f" ] || continue
+        k=${f#ROUND}; k=${k%.md}
+        case "$k" in *[!0-9]*) continue ;; esac
+        [ "$k" -gt "$n" ] && n=$k
+    done
+    printf 'r%02d' "$n"
+}
+LOG=${CHIP_LOG:-chip_session_$(current_round).log}
+
+if [ ! -e "$RELAY_MARKER" ]; then
     echo "await_window: untunneled host (no relay marker); nothing to await"
     exit 0
 fi
 
 probe() {
-    # -S skips site init (~2 s in this venv); stdlib sockets only
+    # -S skips site init (~2 s in this venv); stdlib sockets only.
+    # Ports come from the same env override the watchdog honors, so
+    # the chaos harness's fake relay (faults/relay.py) is probed by
+    # the identical machinery a real window would use.
     python -S -c '
-import socket, sys
-for port in (8082, 8083):
+import os, socket, sys
+ports = [int(p) for p in os.environ.get("TPU_REDUCTIONS_RELAY_PORTS",
+                                        "8082,8083").split(",") if p.strip()]
+for port in ports:
     try:
         socket.create_connection(("127.0.0.1", port), timeout=2).close()
         sys.exit(0)
@@ -53,7 +81,7 @@ echo "await_window: polling relay every ${POLL}s (horizon ${MAX_HOURS}h," \
 while true; do
     if probe; then
         echo "await_window: relay ALIVE at $(date -u +%FT%TZ); starting chip session"
-        bash scripts/chip_session.sh 2>&1 | tee -a "$LOG"
+        bash "$SESSION_BIN" 2>&1 | tee -a "$LOG"
         rc=${PIPESTATUS[0]}
         echo "await_window: chip session exited rc=$rc at $(date -u +%FT%TZ)"
         # commit the session log itself: round 2's curve recovery came

@@ -21,15 +21,20 @@ cd "$(dirname "$0")/.."
 # machinery a dead relay hangs) — this gate must stay genuinely
 # JAX-free. Semantics mirror watchdog.tunneled_environment/relay_alive
 # (marker file; any port connecting, or an inconclusive local error,
-# counts as alive).
+# counts as alive), including the TPU_REDUCTIONS_RELAY_MARKER/_PORTS
+# env overrides the chaos harness (faults/relay.py,
+# docs/RESILIENCE.md) points at its fake relay.
 relay_ok() {
     # -S: skip site initialization (~2 s in this venv) — stdlib only
     python -S -c '
 import os, socket, sys
-if not os.path.exists("/root/.relay.py"):
+marker = os.environ.get("TPU_REDUCTIONS_RELAY_MARKER", "/root/.relay.py")
+if not os.path.exists(marker):
     sys.exit(0)      # untunneled host: no relay by construction
+ports = [int(p) for p in os.environ.get("TPU_REDUCTIONS_RELAY_PORTS",
+                                        "8082,8083").split(",") if p.strip()]
 inconclusive = False
-for port in (8082, 8083):
+for port in ports:
     try:
         socket.create_connection(("127.0.0.1", port), timeout=2).close()
         sys.exit(0)

@@ -30,7 +30,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import json
 import re
 import sys
 
@@ -111,7 +110,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     missing = sorted(set(spans) - {r["n"] for r in rows})
-    json.dump(rows, open(ns.out, "w"), indent=1)
+    from tpu_reductions.utils.jsonio import atomic_json_dump
+    atomic_json_dump(ns.out, rows)
     print(f"recovered {len(rows)} rows -> {ns.out}; "
           f"unmeasured cells: {missing}")
     return 0

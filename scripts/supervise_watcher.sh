@@ -57,8 +57,21 @@ cd "${SUP_ROOT:-$(dirname "$0")/..}"
 
 POLL=${1:-20}
 ARM_HOURS=${2:-13}
-CHIP_LOG=${CHIP_LOG:-chip_session_r05.log}
-WATCH_LOG=${WATCH_LOG:-round5_watch.log}
+current_round() {
+    # highest ROUND<N>.md names the round in flight (same derivation as
+    # await_window.sh — the fix for the per-round hardcoded log pins)
+    local n=0 f k
+    for f in ROUND[0-9]*.md; do
+        [ -e "$f" ] || continue
+        k=${f#ROUND}; k=${k%.md}
+        case "$k" in *[!0-9]*) continue ;; esac
+        [ "$k" -gt "$n" ] && n=$k
+    done
+    printf '%d' "$n"
+}
+ROUND_N=$(current_round)
+CHIP_LOG=${CHIP_LOG:-$(printf 'chip_session_r%02d.log' "$ROUND_N")}
+WATCH_LOG=${WATCH_LOG:-round${ROUND_N}_watch.log}
 AWAIT_BIN=${AWAIT_BIN:-scripts/await_window.sh}
 CHECK_S=${CHECK_S:-2}
 RESPAWN_DELAY_S=${RESPAWN_DELAY_S:-1}
@@ -70,8 +83,9 @@ SUP_HORIZON_H=${SUP_HORIZON_H:-20}
 # grace costs nothing in the common case)
 GRACE_S=${GRACE_S:-60}
 # same untunneled-host marker await_window.sh keys off; overridable so
-# the rehearsal tests can run on any host
-RELAY_MARKER=${RELAY_MARKER:-/root/.relay.py}
+# the rehearsal tests can run on any host (the chaos harness sets
+# TPU_REDUCTIONS_RELAY_MARKER for the whole stack at once)
+RELAY_MARKER=${RELAY_MARKER:-${TPU_REDUCTIONS_RELAY_MARKER:-/root/.relay.py}}
 
 if [ ! -e "$RELAY_MARKER" ]; then
     echo "supervisor: untunneled host (no $RELAY_MARKER); nothing to supervise" >&2

@@ -126,14 +126,16 @@ def test_chained_race_persists_per_candidate(tmp_path):
     snapshots = []
     out = tmp_path / "race.json"
 
-    meta = {"method": "SUM", "dtype": "int32", "n": 4096}
+    from tpu_reductions.bench.resume import Checkpoint
+    ck = Checkpoint(str(out), {"method": "SUM", "dtype": "int32",
+                               "n": 4096},
+                    rows_key="ranked", key_fn=at._row_key)
 
     def spy(cfg, res):
         seen.append((cfg.kernel, cfg.threads, res.status.name))
-        # mimic main()'s persist, snapshotting the file state after
-        # each candidate the way a mid-race death would find it
-        at._write_out(str(out), meta,
-                      [at._row(cfg, res)], best=None, complete=False)
+        # main()'s persist: the file state after each candidate is
+        # what a mid-race death would leave behind
+        ck.add(at._row(cfg, res), extra={"best": None})
         snapshots.append(json.loads(out.read_text()))
 
     pairs = at.autotune(base, grid=grid, on_result=spy)

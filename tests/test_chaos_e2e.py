@@ -1,0 +1,241 @@
+"""End-to-end chaos: the full death -> watchdog exit-3 -> watcher
+re-arm -> resume pipeline on --platform=cpu (the acceptance scenario of
+docs/RESILIENCE.md).
+
+A scripted relay flap (faults/relay.FakeRelay) kills a real spot
+subprocess mid-batch via the real watchdog (exit 3); re-invocation
+resumes from the persisted rows; the final row set matches an
+uninterrupted run's. The watcher layer (scripts/await_window.sh) is
+driven the same way: an aborted session re-arms, a completed one
+retires, and the session log is committed either way."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_reductions.faults.relay import FakeRelay
+from tpu_reductions.faults.schedule import Phase
+
+REPO = Path(__file__).resolve().parent.parent
+SPOT_ARGS = ["--platform=cpu", "--type=int", "--methods=SUM,MIN,MAX",
+             "--n=16384", "--iterations=8", "--chainreps=2"]
+
+
+def _chaos_env(relay, marker, *, faults=None, interval="0.1", grace="2"):
+    env = {**os.environ,
+           "TPU_REDUCTIONS_CHAOS_ARM": "1",
+           "TPU_REDUCTIONS_RELAY_MARKER": str(marker),
+           "TPU_REDUCTIONS_RELAY_PORTS": str(relay.port),
+           "TPU_REDUCTIONS_WATCHDOG_INTERVAL_S": interval,
+           "TPU_REDUCTIONS_WATCHDOG_GRACE": grace}
+    env.pop("TPU_REDUCTIONS_FAULTS", None)
+    if faults is not None:
+        env["TPU_REDUCTIONS_FAULTS"] = json.dumps(faults)
+    return env
+
+
+def _wait_for_rows(out: Path, n: int, timeout_s: float = 20.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            rows = json.loads(out.read_text()).get("rows", [])
+            if len(rows) >= n:
+                return rows
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    pytest.fail(f"timed out waiting for {n} persisted row(s) in {out}")
+
+
+def _spot(out: Path, env, extra=()):
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpu_reductions.bench.spot",
+         *SPOT_ARGS, *extra, f"--out={out}"],
+        env=env, cwd=str(REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def test_chaos_smoke_flap_exit3_then_resume_matches_uninterrupted(tmp_path):
+    """THE acceptance pipeline, tier-1 sized: relay dies mid-batch ->
+    real watchdog exits 3 with the measured prefix persisted ->
+    re-invocation resumes those rows (zero re-measures) and completes
+    -> the row set equals an uninterrupted control run's."""
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    out = tmp_path / "spot.json"
+    with FakeRelay() as relay:
+        # method 2 (MIN) wedges in its device call — the round-2 death
+        # shape — while the test flips the relay dead underneath it
+        env = _chaos_env(relay, marker, faults={
+            "bench.run": {"after": 1, "action": "stall", "seconds": 120}})
+        proc = _spot(out, env)
+        _wait_for_rows(out, 1)          # SUM verified and persisted
+        relay.force("refuse")
+        rc = proc.wait(timeout=60)
+        stderr = proc.stderr.read()
+        assert rc == 3, f"expected watchdog exit 3, got {rc}: {stderr}"
+        assert "relay watchdog: relay is gone" in stderr
+        interrupted = json.loads(out.read_text())
+        assert interrupted["complete"] is False
+        assert [r["method"] for r in interrupted["rows"]] == ["SUM"]
+
+        # window 2: relay back, no faults — resume the banked row
+        relay.force("accept")
+        time.sleep(0.15)
+        proc2 = _spot(out, _chaos_env(relay, marker))
+        assert proc2.wait(timeout=60) == 0
+        assert "resumed from prior artifact" in proc2.stderr.read()
+        resumed = json.loads(out.read_text())
+        assert resumed["complete"] is True
+        assert resumed["rows"][0] == interrupted["rows"][0]  # reused row
+
+        # uninterrupted control: identical final row set
+        out2 = tmp_path / "control.json"
+        proc3 = _spot(out2, _chaos_env(relay, marker))
+        assert proc3.wait(timeout=60) == 0
+        control = json.loads(out2.read_text())
+    assert [(r["method"], r["status"]) for r in resumed["rows"]] \
+        == [(r["method"], r["status"]) for r in control["rows"]]
+    assert resumed["complete"] == control["complete"] is True
+
+
+def test_transient_flap_is_retried_not_fatal(tmp_path):
+    """A device call that fails while the relay still answers is a
+    transient flap: the retry wrapper (utils/retry.py) re-runs it and
+    the batch completes with every row measured — no exit 3, no FAILED
+    row."""
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    out = tmp_path / "spot.json"
+    with FakeRelay() as relay:
+        env = _chaos_env(relay, marker, faults={
+            "bench.run": {"after": 1, "times": 1, "action": "raise"}})
+        env["TPU_REDUCTIONS_DEVICE_RETRIES"] = "2"
+        proc = _spot(out, env)
+        rc = proc.wait(timeout=60)
+        stderr = proc.stderr.read()
+        assert rc == 0, stderr
+        assert "retry: transient device-call failure" in stderr
+    data = json.loads(out.read_text())
+    assert [r["method"] for r in data["rows"]] == ["SUM", "MIN", "MAX"]
+    assert all(r["status"] in ("PASSED", "WAIVED") for r in data["rows"])
+
+
+def _git(root, *args):
+    subprocess.run(["git", *args], cwd=root, check=True,
+                   capture_output=True)
+
+
+def test_await_window_rearms_after_exit3_and_retires_on_complete(tmp_path):
+    """The watcher half of the pipeline: an aborted session (rc=3, the
+    watchdog's code) RE-ARMS the watcher; the next window's session
+    completes (rc=0) and retires it; the session log is committed."""
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "t@t")
+    _git(tmp_path, "config", "user.name", "t")
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    session = tmp_path / "fake_session.sh"
+    session.write_text(
+        "#!/usr/bin/env bash\n"
+        "echo run >> sessions.txt\n"
+        'n=$(wc -l < sessions.txt)\n'
+        '[ "$n" -le 1 ] && { echo "session aborts (flap)"; exit 3; }\n'
+        'echo "session completes"; exit 0\n')
+    session.chmod(0o755)
+    with FakeRelay() as relay:
+        env = {**os.environ,
+               "AWAIT_ROOT": str(tmp_path),
+               "SESSION_BIN": str(session),
+               "CHIP_LOG": "chip.log",
+               "TPU_REDUCTIONS_RELAY_MARKER": str(marker),
+               "TPU_REDUCTIONS_RELAY_PORTS": str(relay.port)}
+        proc = subprocess.run(
+            ["bash", str(REPO / "scripts" / "await_window.sh"), "1", "1"],
+            env=env, cwd=str(tmp_path), capture_output=True, text=True,
+            timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "re-arming (session rc=3" in proc.stdout
+    assert (tmp_path / "sessions.txt").read_text().count("run") == 2
+    log_commits = subprocess.run(
+        ["git", "log", "--oneline", "--", "chip.log"], cwd=tmp_path,
+        capture_output=True, text=True).stdout.strip().splitlines()
+    assert len(log_commits) >= 2   # one commit per session's log growth
+
+
+def test_await_window_log_default_derives_from_round(tmp_path):
+    """Satellite: no more stale r04 pins — the default session log name
+    tracks the highest ROUND<N>.md in the repo."""
+    (tmp_path / "ROUND7.md").write_text("# round 7\n")
+    (tmp_path / "ROUND11.md").write_text("# round 11\n")
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    env = {**os.environ,
+           "AWAIT_ROOT": str(tmp_path),
+           "TPU_REDUCTIONS_RELAY_MARKER": str(marker),
+           # a port nothing listens on: the watcher must idle, hit the
+           # 0-hour horizon, and exit 4 having named its log
+           "TPU_REDUCTIONS_RELAY_PORTS": "1"}
+    env.pop("CHIP_LOG", None)
+    proc = subprocess.run(
+        ["bash", str(REPO / "scripts" / "await_window.sh"), "1", "0"],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=60)
+    assert proc.returncode == 4
+    assert "chip_session_r11.log" in proc.stdout
+
+
+def test_await_window_untunneled_host_exits_clean(tmp_path):
+    env = {**os.environ,
+           "AWAIT_ROOT": str(tmp_path),
+           "TPU_REDUCTIONS_RELAY_MARKER": str(tmp_path / "absent")}
+    proc = subprocess.run(
+        ["bash", str(REPO / "scripts" / "await_window.sh"), "1", "1"],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=30)
+    assert proc.returncode == 0
+    assert "untunneled" in proc.stdout
+
+
+@pytest.mark.slow
+def test_slow_wall_clock_flap_schedule_kills_and_resumes(tmp_path):
+    """The long-flap scenario on wall-clock phases (no test-driven
+    force()): the relay schedule itself opens a window, dies for
+    seconds mid-batch, and comes back — the watchdog exits 3 during
+    the dead phase, and the post-flap re-invocation completes from the
+    persisted prefix."""
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    out = tmp_path / "spot.json"
+    schedule = [Phase("accept", duration_s=3.0),
+                Phase("refuse", duration_s=6.0),
+                Phase("accept")]
+    with FakeRelay(schedule) as relay:
+        env = _chaos_env(relay, marker, interval="0.5", faults={
+            # every method after the first wedges long enough to
+            # straddle the schedule's dead phase
+            "bench.run": {"after": 1, "action": "stall", "seconds": 30}})
+        proc = _spot(out, env)
+        rc = proc.wait(timeout=120)
+        assert rc == 3, proc.stderr.read()
+        interrupted = json.loads(out.read_text())
+        assert interrupted["complete"] is False
+        assert len(interrupted["rows"]) >= 1
+
+        # wait out the dead phase; the relay flaps back on its own
+        deadline = time.monotonic() + 30
+        from tpu_reductions.utils.watchdog import probe_relay
+        while probe_relay(ports=(relay.port,), timeout_s=0.3) != "alive":
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+        proc2 = _spot(out, _chaos_env(relay, marker))
+        assert proc2.wait(timeout=120) == 0
+        final = json.loads(out.read_text())
+    assert final["complete"] is True
+    assert [r["method"] for r in final["rows"]] == ["SUM", "MIN", "MAX"]
+    assert final["rows"][:len(interrupted["rows"])] == interrupted["rows"]

@@ -1,0 +1,257 @@
+"""Chaos layer (tpu_reductions/faults/): the scriptable fake relay,
+the env-driven fault points, and the device-call retry classifier —
+the machinery that makes every relay-flap failure path testable
+off-chip (docs/RESILIENCE.md)."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from tpu_reductions.faults import inject
+from tpu_reductions.faults.inject import InjectedFault, fault_point
+from tpu_reductions.faults.relay import FakeRelay
+from tpu_reductions.faults.schedule import Phase, load_schedule
+from tpu_reductions.utils.retry import retry_device_call
+from tpu_reductions.utils.watchdog import probe_relay
+
+
+# ---------------------------------------------------------------- schedule
+
+
+def test_schedule_parses_json_and_validates():
+    phases = load_schedule('[{"behavior": "accept", "connections": 2},'
+                           ' {"behavior": "refuse", "duration_s": 1},'
+                           ' {"behavior": "stall"}]')
+    assert [p.behavior for p in phases] == ["accept", "refuse", "stall"]
+    assert phases[0].connections == 2 and phases[1].duration_s == 1
+
+
+def test_schedule_rejects_malformed():
+    with pytest.raises(ValueError):
+        load_schedule("[]")                       # empty tests nothing
+    with pytest.raises(ValueError):
+        load_schedule('[{"behavior": "explode"}]')
+    with pytest.raises(ValueError):
+        # refused connects never reach userspace: count-advance invalid
+        load_schedule('[{"behavior": "refuse", "connections": 1}]')
+    with pytest.raises(ValueError):
+        load_schedule('[{"behavior": "accept", "duration_s": 1,'
+                      ' "connections": 1}]')
+    with pytest.raises(ValueError):
+        load_schedule('[{"behavior": "accept", "typo_s": 1}]')
+
+
+def test_schedule_loads_from_file(tmp_path):
+    f = tmp_path / "flap.json"
+    f.write_text('[{"behavior": "accept"}]')
+    assert load_schedule(str(f))[0].behavior == "accept"
+
+
+# ---------------------------------------------------------------- FakeRelay
+
+
+def test_fake_relay_flap_schedule_drives_probe_verdicts():
+    """The canonical flap — accept, die, come back — as seen by the
+    very probe the watchdog uses."""
+    with FakeRelay([Phase("accept", connections=2),
+                    Phase("refuse", duration_s=0.4),
+                    Phase("accept")]) as relay:
+        ports = (relay.port,)
+        assert probe_relay(ports=ports) == "alive"
+        assert probe_relay(ports=ports) == "alive"   # advances phase
+        time.sleep(0.1)
+        assert probe_relay(ports=ports, timeout_s=0.3) == "dead"
+        time.sleep(0.6)
+        assert probe_relay(ports=ports) == "alive"   # relay flapped back
+        # the serve loop books the accept a tick after the kernel
+        # completes the connect: poll rather than race it
+        deadline = time.monotonic() + 2.0
+        while relay.connections < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert relay.connections >= 3
+
+
+def test_fake_relay_force_overrides_schedule():
+    """force() is the deterministic flip the e2e tests use: no racing
+    wall-clock phases."""
+    with FakeRelay() as relay:
+        assert probe_relay(ports=(relay.port,)) == "alive"
+        relay.force("refuse")
+        time.sleep(0.15)   # let the serve loop close the listener
+        assert probe_relay(ports=(relay.port,), timeout_s=0.3) == "dead"
+        relay.force("accept")
+        time.sleep(0.15)
+        assert probe_relay(ports=(relay.port,)) == "alive"
+
+
+def test_fake_relay_stall_is_wedged_but_ports_open():
+    """A stalled relay ACCEPTS connections (probes say alive) but never
+    services them — the wedged-tunnel case budgets exist for."""
+    with FakeRelay([Phase("stall")]) as relay:
+        assert probe_relay(ports=(relay.port,)) == "alive"
+        with socket.create_connection(("127.0.0.1", relay.port),
+                                      timeout=2) as s:
+            s.settimeout(0.3)
+            with pytest.raises(socket.timeout):
+                s.recv(1)   # held open, never answered
+
+
+# ---------------------------------------------------------------- inject
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(inject.ENV_VAR, raising=False)
+    inject.reset()
+    yield
+    inject.reset()
+
+
+def test_fault_point_noop_without_plan():
+    assert fault_point("bench.run") is None
+
+
+def test_fault_point_after_and_times_window(monkeypatch):
+    """`after` skips hits, `times` bounds firing — the flap model: the
+    point fails transiently, then 'recovers' and never fires again."""
+    monkeypatch.setenv(inject.ENV_VAR, json.dumps(
+        {"bench.run": {"after": 1, "times": 2, "action": "raise"}}))
+    inject.reset()
+    assert fault_point("bench.run") is None          # hit 0: before after
+    with pytest.raises(InjectedFault):
+        fault_point("bench.run")                     # hit 1
+    with pytest.raises(InjectedFault):
+        fault_point("bench.run")                     # hit 2
+    assert fault_point("bench.run") is None          # recovered
+    assert fault_point("other.point") is None        # unplanned point
+
+
+def test_fault_point_passive_specs_returned(monkeypatch):
+    monkeypatch.setenv(inject.ENV_VAR, json.dumps(
+        {"watchdog.probe": {"action": "dead"}}))
+    inject.reset()
+    spec = fault_point("watchdog.probe")
+    assert spec is not None and spec["action"] == "dead"
+
+
+def test_fault_plan_from_file(tmp_path, monkeypatch):
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"staging.chunk": {"action": "raise"}}))
+    monkeypatch.setenv(inject.ENV_VAR, f"@{plan}")
+    inject.reset()
+    with pytest.raises(InjectedFault):
+        fault_point("staging.chunk")
+
+
+def test_fault_plan_malformed_is_loud(monkeypatch):
+    """A chaos run whose plan silently parses to nothing would test
+    nothing while looking green."""
+    monkeypatch.setenv(inject.ENV_VAR, "{not json")
+    inject.reset()
+    with pytest.raises(ValueError):
+        fault_point("bench.run")
+
+
+# ---------------------------------------------------------------- retry
+
+
+def test_retry_transient_flap_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(None)
+        if len(calls) < 3:
+            raise RuntimeError("tunnel hiccup")
+        return "row"
+
+    slept = []
+    out = retry_device_call(flaky, retries=3, backoff_s=0.01,
+                            _sleep=slept.append,
+                            _tunneled=lambda: True,
+                            _alive=lambda: True)
+    assert out == "row" and len(calls) == 3
+    assert slept == [0.01, 0.02]   # bounded exponential backoff
+
+
+def test_retry_dead_relay_is_fatal_immediately():
+    """A dead relay never comes back in-session: retrying can only
+    hang — defer to the watchdog (re-raise on the first failure)."""
+    calls = []
+
+    def dies():
+        calls.append(None)
+        raise RuntimeError("relay gone")
+
+    with pytest.raises(RuntimeError):
+        retry_device_call(dies, retries=5, backoff_s=0.01,
+                          _sleep=lambda s: None,
+                          _tunneled=lambda: True,
+                          _alive=lambda: False)
+    assert len(calls) == 1
+
+
+def test_retry_untunneled_error_is_deterministic_no_retry():
+    calls = []
+
+    def broken():
+        calls.append(None)
+        raise ValueError("lowering gap")
+
+    with pytest.raises(ValueError):
+        retry_device_call(broken, retries=5, backoff_s=0.01,
+                          _sleep=lambda s: None,
+                          _tunneled=lambda: False,
+                          _alive=lambda: True)
+    assert len(calls) == 1
+
+
+def test_retry_budget_exhaustion_reraises_last_error():
+    with pytest.raises(RuntimeError, match="still flapping"):
+        retry_device_call(
+            lambda: (_ for _ in ()).throw(RuntimeError("still flapping")),
+            retries=2, backoff_s=0.01, _sleep=lambda s: None,
+            _tunneled=lambda: True, _alive=lambda: True)
+
+
+def test_retry_env_budget(monkeypatch):
+    from tpu_reductions.utils.retry import retry_budget
+    monkeypatch.setenv("TPU_REDUCTIONS_DEVICE_RETRIES", "0")
+    assert retry_budget() == 0
+    assert retry_budget(4) == 4   # explicit argument wins
+    monkeypatch.delenv("TPU_REDUCTIONS_DEVICE_RETRIES")
+    from tpu_reductions.utils.retry import DEFAULT_RETRIES
+    assert retry_budget() == DEFAULT_RETRIES
+
+
+# ------------------------------------------------- injected probe loop
+
+
+def test_watchdog_probe_fault_fires_exit(monkeypatch):
+    """The watchdog probe loop consults the `watchdog.probe` fault
+    point: a scripted dead verdict must walk the grace counter to the
+    exit exactly like a real outage."""
+    import threading
+
+    from tpu_reductions.utils.watchdog import (WATCHDOG_EXIT_CODE,
+                                               start_relay_watchdog)
+
+    monkeypatch.setenv(inject.ENV_VAR, json.dumps(
+        {"watchdog.probe": {"action": "dead"}}))
+    inject.reset()
+    fired = threading.Event()
+    codes = []
+
+    def fake_exit(code):
+        codes.append(code)
+        fired.set()
+
+    stop = start_relay_watchdog(interval_s=0.02, grace=2,
+                                _probe=lambda: True, _exit=fake_exit)
+    try:
+        assert stop is not None
+        assert fired.wait(timeout=5.0)
+        assert codes[0] == WATCHDOG_EXIT_CODE
+    finally:
+        stop.set()

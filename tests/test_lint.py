@@ -245,6 +245,42 @@ def test_red007_accepts_watchdog_or_drain(tmp_path):
     assert _rules(_lint_src(tmp_path, plain)) == []
 
 
+# ---------------------------------------------------------------- RED010
+
+
+def test_red010_flags_raw_json_artifact_writes(tmp_path):
+    src = (
+        "import json\n"
+        "from pathlib import Path\n"
+        "def persist(rows, path):\n"
+        '    json.dump(rows, open(path, "w"), indent=1)\n'
+        '    Path(path).write_text(json.dumps(rows) + "\\n")\n'
+    )
+    findings = _lint_src(tmp_path, src)
+    assert _rules(findings) == ["RED010", "RED010"]
+
+
+def test_red010_accepts_jsonio_routes_and_non_artifact_text(tmp_path):
+    src = (
+        "import json\n"
+        "from pathlib import Path\n"
+        "from tpu_reductions.utils.jsonio import atomic_json_dump\n"
+        "def persist(rows, path):\n"
+        "    atomic_json_dump(path, rows)\n"
+        "    print(json.dumps(rows))\n"          # log line, not a file
+        '    Path(path).write_text("plain notes\\n")\n'  # not JSON
+    )
+    assert _rules(_lint_src(tmp_path, src)) == []
+    # the one sanctioned home of the raw write is jsonio itself
+    src_jsonio = (
+        "import json\n"
+        "def atomic(path, obj):\n"
+        '    json.dump(obj, open(path + ".tmp", "w"))\n'
+    )
+    assert _rules(_lint_src(tmp_path, src_jsonio,
+                            name="utils/jsonio.py")) == []
+
+
 # ---------------------------------------------------------------- RED008
 
 
@@ -361,6 +397,8 @@ def test_cli_positive_fixture_per_rule_exits_nonzero(tmp_path):
         "RED006": ("ops/r6.py", "def f():\n    pass\n"),
         "RED007": ("r7.py", "import sys\nimport jax\nsys.exit(1)\n"),
         "RED008": ("r8.sh", "kill -9 $$\n"),
+        "RED010": ("r10.py", "import json\n"
+                             'json.dump({}, open("rows.json", "w"))\n'),
     }
     for rule, (name, src) in fixtures.items():
         f = tmp_path / name

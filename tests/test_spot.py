@@ -101,7 +101,7 @@ def test_spot_cli_waived_rows_exit_zero(monkeypatch, tmp_path):
                  "gbps": None, "status": s, "backend": "xla"}
                 for m, s in zip(["SUM", "MIN", "MAX"], statuses)]
 
-    def patched(base, methods, logger=None, on_result=None):
+    def patched(base, methods, logger=None, on_result=None, resume=None):
         rows = fake_rows(patched.statuses)
         if on_result:
             for r in rows:

@@ -75,6 +75,40 @@ def test_chunked_reduces_correctly_end_to_end():
     assert got == int(x.min())
 
 
+def test_chunk_loop_interruption_leaves_no_partial_buffer(monkeypatch):
+    """Satellite (ISSUE 2): a fault injected mid-payload — the round-2
+    relay-death point — must leave NO partially-staged buffer that a
+    subsequent resume could observe: the call raises before returning
+    anything, and a clean re-invocation (the resume) produces the
+    complete, bit-exact staged array despite the module-cached donated
+    insert function having been used by the doomed attempt."""
+    import json as _json
+
+    from tpu_reductions.faults import inject
+    from tpu_reductions.faults.inject import InjectedFault
+
+    op = get_op("SUM")
+    n = 4097
+    x = np.arange(n, dtype=np.int32)
+    tm, p, t = choose_tiling(n, 32, 8)
+    rows, lanes = padded_2d_shape(n, tm, p, t)
+    expected = stage_padded(x, tm, p, t, op)
+
+    monkeypatch.setenv(inject.ENV_VAR, _json.dumps(
+        {"staging.chunk": {"after": 2, "action": "raise"}}))
+    inject.reset()
+    with pytest.raises(InjectedFault):
+        device_put_chunked(x, rows, lanes, op.identity(x.dtype),
+                           chunk_bytes=512)   # dies chunks into the loop
+    monkeypatch.delenv(inject.ENV_VAR)
+    inject.reset()
+
+    staged = device_put_chunked(x, rows, lanes, op.identity(x.dtype),
+                                chunk_bytes=512)
+    np.testing.assert_array_equal(np.asarray(staged),
+                                  np.asarray(expected))
+
+
 @pytest.mark.slow
 def test_chunked_staging_at_true_hazard_scale():
     """The exact payload class that killed both round-2 windows —

@@ -225,6 +225,72 @@ def test_maybe_arm_arms_when_relay_alive(monkeypatch):
         s.close()
 
 
+def test_inconclusive_probes_counted_in_exit_report():
+    """Satellite (ISSUE 2): EMFILE-class probes still reset the dead
+    counter (firing on them would be the wedge hazard), but they are
+    COUNTED and surfaced in the exit-3 stderr report instead of
+    silently vanishing — the postmortem must see a probe loop that
+    spent its window starved of fds."""
+    fired = threading.Event()
+    script = ["alive",                       # arming probe
+              "inconclusive", "inconclusive",  # counted, not dead
+              "dead", "inconclusive",        # resets the dead counter
+              "dead", "dead"]                # grace=2 -> fire
+    calls = []
+
+    def probe():
+        calls.append(None)
+        i = len(calls) - 1
+        return script[i] if i < len(script) else "dead"
+
+    def fake_exit(code):
+        fired.set()
+
+    import sys as _sys
+    captured = []
+
+    class _Cap:
+        def write(self, s):
+            captured.append(s)
+
+        def flush(self):
+            pass
+
+    real_err = _sys.stderr
+    _sys.stderr = _Cap()
+    try:
+        stop = start_relay_watchdog(interval_s=0.02, grace=2,
+                                    _probe=probe, _exit=fake_exit)
+        assert stop is not None
+        assert fired.wait(timeout=5.0)
+    finally:
+        stop.set()
+        _sys.stderr = real_err
+    text = "".join(captured)
+    assert "relay is gone" in text
+    assert "3 inconclusive probe(s)" in text
+
+
+def test_env_overrides_point_probe_at_fake_relay(monkeypatch):
+    """TPU_REDUCTIONS_RELAY_PORTS / _RELAY_MARKER are the chaos
+    harness's seam: the probe and the tunneled-environment check must
+    honor them over the baked-in defaults."""
+    import tpu_reductions.utils.watchdog as wd
+
+    s, port = _listener()
+    try:
+        monkeypatch.setenv("TPU_REDUCTIONS_RELAY_PORTS", str(port))
+        assert wd.relay_alive() is True
+        assert wd.resolved_ports() == (port,)
+    finally:
+        s.close()
+    monkeypatch.setenv("TPU_REDUCTIONS_RELAY_MARKER", __file__)
+    assert wd.tunneled_environment() is True
+    monkeypatch.setenv("TPU_REDUCTIONS_RELAY_MARKER",
+                       __file__ + ".does-not-exist")
+    assert wd.tunneled_environment() is False
+
+
 # The chip-session step-machinery contracts (rc=3 abort with
 # artifacts committed, relay-death-between-steps, budgets, the
 # window-summary trap) are rehearsed in tests/test_chip_session.py

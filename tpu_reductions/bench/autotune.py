@@ -140,6 +140,7 @@ def autotune(base: ReduceConfig,
              logger: Optional[BenchLogger] = None,
              comparator: bool = False,
              on_result=None,
+             resume=None,
              ) -> List[Tuple[ReduceConfig, BenchResult]]:
     """Race the grid; return (config, result) pairs sorted fastest-first
     with verified (PASSED) candidates ranked strictly above the rest.
@@ -155,15 +156,34 @@ def autotune(base: ReduceConfig,
     live-window lesson of examples/tpu_run/RECOVERY.md). Legacy timing
     modes keep the batch path: their comparability NEEDS the shared
     pre-fetch sync regime, so their on_result only fires at batch
-    finalize."""
+    finalize.
+
+    `resume(cfg)`, when given (chained mode only — per-candidate
+    measurements are the only ones safely reusable across processes),
+    returns a prior interrupted race's BenchResult for that candidate
+    (bench/resume.result_from_row); the candidate is then not re-raced.
+    A transient relay flap retries the candidate before the crash
+    containment records it FAILED (utils/retry.py)."""
     logger = logger or BenchLogger(None, None)
     cfgs = candidate_configs(base, grid, comparator=comparator)
     if base.timing == "chained":
         from tpu_reductions.bench.driver import crash_result, run_benchmark
+        from tpu_reductions.utils.retry import retry_device_call
         results = []
         for cfg in cfgs:
+            prior = resume(cfg) if resume is not None else None
+            if prior is not None:
+                logger.log(f"autotune kernel={cfg.kernel} "
+                           f"threads={cfg.threads}: resumed from prior "
+                           "race artifact")
+                if on_result is not None:
+                    on_result(cfg, prior)
+                results.append(prior)
+                continue
             try:
-                res = run_benchmark(cfg, logger=logger)
+                res = retry_device_call(
+                    lambda: run_benchmark(cfg, logger=logger),
+                    log=logger.log)
             except Exception as e:
                 # one candidate that cannot even compile (e.g. a Mosaic
                 # lowering gap on the real chip for a kernel the
@@ -202,14 +222,28 @@ def _row(cfg: ReduceConfig, res: BenchResult) -> dict:
     return row
 
 
-def _write_out(path: str, meta: dict, rows: List[dict], *,
-               best, complete: bool) -> None:
-    """Atomic dump of the race state (utils/jsonio.py — the relay
-    watchdog can os._exit at ANY instant; `complete=False` marks
-    mid-race snapshots)."""
-    from tpu_reductions.utils.jsonio import atomic_json_dump
-    atomic_json_dump(path, {**meta, "complete": complete, "best": best,
-                            "ranked": rows})
+def _row_key(row: dict) -> tuple:
+    """A ranked row's identity inside the race artifact — the resume
+    key (bench/resume.Checkpoint): the full geometry, with the XLA
+    comparator's nulled knobs collapsing to one baseline slot."""
+    return (row.get("backend"), row.get("kernel"), row.get("threads"),
+            row.get("max_blocks"), row.get("stream_buffers"))
+
+
+def _cfg_key(cfg: ReduceConfig) -> tuple:
+    """The same resume key computed from a candidate config — must
+    mirror _row exactly (null geometry for the XLA comparator; depth
+    only for the streaming kernel) or resume would never match.
+
+    No reference analog (TPU-native).
+    """
+    xla = cfg.backend == "xla"
+    return (cfg.backend,
+            None if xla else cfg.kernel,
+            None if xla else cfg.threads,
+            None if xla else cfg.max_blocks,
+            cfg.stream_buffers if not xla and cfg.kernel == KERNEL_STREAM
+            else None)
 
 
 def main(argv=None) -> int:
@@ -264,23 +298,34 @@ def main(argv=None) -> int:
     maybe_arm_for_tpu()  # a race hung on a dead relay loses its ranking
     logger = BenchLogger(None, None, console=sys.stderr)
 
+    # meta is the resume contract: a re-invocation after a mid-race
+    # watchdog exit reuses only rows raced under the SAME op/dtype/n/
+    # grid/discipline (bench/resume.Checkpoint)
     meta = {"method": ns.method.upper(),
-            "dtype": DTYPE_ALIASES[ns.dtype], "n": ns.n}
-    live_rows: List[dict] = []
+            "dtype": DTYPE_ALIASES[ns.dtype], "n": ns.n,
+            "grid": ns.grid, "timing": ns.timing,
+            "iterations": ns.iterations, "chain_reps": ns.chain_reps,
+            "stat": ns.stat}
+    from tpu_reductions.bench.resume import Checkpoint, result_from_row
+    ck = Checkpoint(ns.out, meta, rows_key="ranked", key_fn=_row_key,
+                    # ranked-so-far order at every persist: a relay
+                    # death mid-race keeps a sorted, readable artifact
+                    sort_key=lambda r: (r["status"] != "PASSED",
+                                        -(r["gbps"] or 0.0)))
 
     def persist(cfg, res):
-        # ranked-so-far after EVERY candidate, flagged incomplete: a
-        # relay death mid-race keeps the measured candidates on disk
-        live_rows.append(_row(cfg, res))
-        if ns.out:
-            _write_out(ns.out, meta,
-                       sorted(live_rows,
-                              key=lambda r: (r["status"] != "PASSED",
-                                             -(r["gbps"] or 0.0))),
-                       best=None, complete=False)
+        # after EVERY candidate, flagged incomplete: a relay death
+        # mid-race keeps the measured candidates on disk
+        ck.add(_row(cfg, res), extra={"best": None})
+
+    def resume_candidate(cfg):
+        row = ck.resume(_cfg_key(cfg))
+        return result_from_row(cfg, row) if row is not None else None
 
     pairs = autotune(base, grid=GRIDS[ns.grid], logger=logger,
-                     comparator=ns.comparator, on_result=persist)
+                     comparator=ns.comparator, on_result=persist,
+                     resume=(resume_candidate
+                             if ns.timing == "chained" else None))
     rows = []
     for cfg, res in pairs:
         row = _row(cfg, res)
@@ -309,7 +354,7 @@ def main(argv=None) -> int:
               f"maxblocks={best['max_blocks']}{bdepth} "
               f"-> {best['gbps']} GB/s")
     if ns.out:
-        _write_out(ns.out, meta, rows, best=best, complete=True)
+        ck.finalize(extra={"best": best})
         print(f"wrote {ns.out}")
     return 0 if best else 1
 

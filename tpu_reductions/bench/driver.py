@@ -27,6 +27,7 @@ import numpy as np
 
 from tpu_reductions.config import (KERNEL_MXU, KERNEL_SINGLE_PASS,
                                    LIVE_KERNELS, ReduceConfig)
+from tpu_reductions.faults.inject import fault_point
 from tpu_reductions.ops import oracle as oracle_mod
 from tpu_reductions.ops.registry import tolerance
 from tpu_reductions.utils.logging import BenchLogger, throughput_line
@@ -243,6 +244,12 @@ def run_benchmark(cfg: ReduceConfig, logger: Optional[BenchLogger] = None,
     after all finalizes instead.
     """
     import jax
+
+    # chaos hook: one benchmark dispatch = one interruptible unit; an
+    # injected raise/stall here stands in for the relay flapping under
+    # this config's device work (faults/inject.py; the retry wrapper
+    # and the e2e chaos tests drive this point)
+    fault_point("bench.run")
 
     if logger is None:
         logger = _make_logger(cfg)

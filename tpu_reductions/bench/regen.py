@@ -116,11 +116,12 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
             cal = None
     platform = (cal or {}).get("platform", "tpu")
 
+    from tpu_reductions.utils.jsonio import atomic_json_dump
     sc = collect_averages(grid_dir, log=log) if grid_dir.is_dir() else {}
     if sc:
-        (grid_dir / "averages.json").write_text(
-            json.dumps({f"{d} {m}": g for (d, m), g in sorted(sc.items())},
-                       indent=1))
+        atomic_json_dump(
+            grid_dir / "averages.json",
+            {f"{d} {m}": g for (d, m), g in sorted(sc.items())})
 
     shmoo_rows: List[dict] = []
     if shmoo_file.exists():
@@ -144,7 +145,7 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
     ann = annotate(shmoo_rows, device_kind=device_kind)
     roof_lines = summarize(ann)
     if ann:
-        (out / "roofline.json").write_text(json.dumps(ann, indent=1))
+        atomic_json_dump(out / "roofline.json", ann)
 
     paths = generate_report({}, single_chip=sc, figures=figures,
                             out_dir=out, platform=platform,

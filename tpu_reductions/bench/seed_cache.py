@@ -84,13 +84,8 @@ def seed(spot_path: str | Path, grid_dir: str | Path,
             continue
         slots = [raw / f"run-{dtype}-{method}-{rep}.json"
                  for rep in range(grid["repeats"])]
-        current = {}
-        for f in slots:
-            if f.exists():
-                try:
-                    current[f] = json.loads(f.read_text())
-                except (OSError, ValueError):
-                    current[f] = {}
+        from tpu_reductions.bench.resume import load_cell, store_cell
+        current = {f: load_cell(f) for f in slots if f.exists()}
         if any(_same_measurement(row, cur) for cur in current.values()):
             continue   # this exact measurement is already in the cache
         for rep, f in enumerate(slots):
@@ -101,9 +96,8 @@ def seed(spot_path: str | Path, grid_dir: str | Path,
             out = dict(row)
             out["repeat"] = rep
             out["seeded_from"] = os.path.basename(str(spot_path))
-            tmp = f.with_suffix(".json.tmp")
-            tmp.write_text(json.dumps(out) + "\n")
-            tmp.replace(f)
+            store_cell(f, out)   # atomic (utils/jsonio): a kill mid-
+            #                      seed can't truncate a grid cell
             seeded.append(f)
             log(f"seed_cache: {dtype} {method} "
                 f"{row.get('gbps', float('nan')):.4f} GB/s -> {f.name}")

@@ -53,20 +53,32 @@ CASES: Tuple[Tuple[str, str, str, Optional[int], int, int], ...] = (
 
 
 def run_smoke(n: int = 1 << 20, logger: Optional[BenchLogger] = None,
-              on_result=None) -> List[dict]:
+              on_result=None, resume=None) -> List[dict]:
     """Compile+run each case once at tiny n; return manifest rows.
 
     Rows persist via on_result as they land (the live-window
     discipline): a relay death after case k keeps cases 1..k — and the
-    partial manifest still says which kernels lowered.
+    partial manifest still says which kernels lowered. A transient
+    relay flap retries the case (utils/retry.py); `resume(name)`
+    reuses an interrupted run's already-lowered cases
+    (bench/resume.Checkpoint) so a re-invoked smoke never re-pays a
+    tunnel compile it already banked.
 
     No reference analog (TPU-native).
     """
     from tpu_reductions.bench.driver import run_benchmark
+    from tpu_reductions.utils.retry import retry_device_call
 
     logger = logger or BenchLogger(None, None)
     rows: List[dict] = []
     for name, dtype, method, kernel, threads, depth in CASES:
+        prior = resume(name) if resume is not None else None
+        if prior is not None:
+            logger.log(f"smoke {name}: resumed from prior manifest")
+            rows.append(prior)
+            if on_result is not None:
+                on_result(prior)
+            continue
         kw = dict(method=method, dtype=dtype, n=n, threads=threads,
                   stream_buffers=depth, iterations=8, warmup=1,
                   timing="chained", chain_reps=2, stat="median",
@@ -77,7 +89,9 @@ def run_smoke(n: int = 1 << 20, logger: Optional[BenchLogger] = None,
         cfg = ReduceConfig(**kw)
         t0 = time.perf_counter()
         try:
-            res = run_benchmark(cfg, logger=logger)
+            res = retry_device_call(
+                lambda: run_benchmark(cfg, logger=logger),
+                log=logger.log)
             row = {"name": name, "status": res.status.name,
                    "ok": res.status.name in ("PASSED", "WAIVED"),
                    "seconds": round(time.perf_counter() - t0, 2),
@@ -119,25 +133,22 @@ def main(argv=None) -> int:
     maybe_arm_for_tpu()   # a smoke hung on a dead relay reports nothing
     logger = BenchLogger(None, None, console=sys.stderr)
 
-    live: List[dict] = []
+    from tpu_reductions.bench.resume import Checkpoint
+    ck = Checkpoint(ns.out, {"n": ns.n}, rows_key="cases",
+                    key_fn=lambda r: r.get("name"))
 
     def persist(row):
-        live.append(row)
+        ck.add(row)
         print(f"  smoke {row['name']:<22} {row['status']:<7} "
               f"{row['seconds']:6.1f}s"
               + (f"  {row['error']}" if row["error"] else ""))
-        if ns.out:
-            from tpu_reductions.utils.jsonio import atomic_json_dump
-            atomic_json_dump(ns.out, {"n": ns.n,
-                                      "complete": False, "cases": live})
 
-    rows = run_smoke(n=ns.n, logger=logger, on_result=persist)
+    rows = run_smoke(n=ns.n, logger=logger, on_result=persist,
+                     resume=ck.resume)
     ok = sum(r["ok"] for r in rows)
     print(f"smoke: {ok}/{len(rows)} cases lowered and verified")
     if ns.out:
-        from tpu_reductions.utils.jsonio import atomic_json_dump
-        atomic_json_dump(ns.out, {"n": ns.n, "complete": True,
-                                  "cases": rows})
+        ck.finalize()
         print(f"wrote {ns.out}")
     # >=1 pass proves the device path is sane; all-fail means the races
     # are doomed and the session log should say so loudly

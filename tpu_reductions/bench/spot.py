@@ -27,7 +27,6 @@ CLI:
 from __future__ import annotations
 
 import argparse
-import json
 import math
 import sys
 from typing import List, Optional
@@ -50,25 +49,40 @@ def _row(cfg: ReduceConfig, res) -> dict:
 
 def run_spots(base: ReduceConfig, methods: List[str],
               logger: Optional[BenchLogger] = None,
-              on_result=None) -> List[dict]:
+              on_result=None, resume=None) -> List[dict]:
     """Run `methods` sequentially at base's geometry; each method's row
     is passed to on_result as soon as it verifies (the persist-per-step
     discipline every live-window lesson demands). Crashes are contained
     per method (driver.crash_result) so one lowering failure cannot
-    take the remaining methods' rows with it.
+    take the remaining methods' rows with it; a transient relay flap is
+    retried first (utils/retry.py). `resume(method)`, when given,
+    returns a prior run's reusable row (bench/resume.Checkpoint) — the
+    method is then skipped, interruption-proofing a re-invoked
+    scoreboard.
 
     No reference analog (TPU-native).
     """
     import dataclasses
 
     from tpu_reductions.bench.driver import crash_result, run_benchmark
+    from tpu_reductions.utils.retry import retry_device_call
 
     logger = logger or BenchLogger(None, None)
     rows = []
     for method in methods:
+        prior = resume(method) if resume is not None else None
+        if prior is not None:
+            logger.log(f"spot {method}: resumed from prior artifact "
+                       "(interrupted run; row reused, not re-measured)")
+            rows.append(prior)
+            if on_result is not None:
+                on_result(prior)
+            continue
         cfg = dataclasses.replace(base, method=method)
         try:
-            res = run_benchmark(cfg, logger=logger)
+            res = retry_device_call(
+                lambda: run_benchmark(cfg, logger=logger),
+                log=logger.log)
         except Exception as e:
             res = crash_result(cfg, e, logger)
         row = _row(cfg, res)
@@ -138,24 +152,28 @@ def main(argv=None) -> int:
     maybe_arm_for_tpu()   # a spot hung on a dead relay reports nothing
     logger = BenchLogger(None, None, console=sys.stderr)
 
+    # meta is the full resume contract (bench/resume.Checkpoint): a
+    # re-invocation reuses an interrupted run's rows only when every
+    # one of these matches — a different geometry/span/discipline
+    # re-measures
     meta = {"dtype": DTYPE_ALIASES[ns.dtype], "n": ns.n,
             "kernel": ns.kernel, "threads": ns.threads,
-            "timing": "chained", "stat": "median"}
-    live: List[dict] = []
+            "timing": "chained", "stat": "median",
+            "backend": ns.backend, "iterations": ns.iterations,
+            "chain_reps": ns.chain_reps, "max_blocks": ns.max_blocks,
+            "stream_buffers": ns.stream_buffers}
+    from tpu_reductions.bench.resume import Checkpoint
+    ck = Checkpoint(ns.out, meta, key_fn=lambda r: r.get("method"))
 
-    def persist(row):
-        live.append(row)
-        if ns.out:
-            _write(ns.out, meta, live, complete=False)
-
-    rows = run_spots(base, methods, logger=logger, on_result=persist)
+    rows = run_spots(base, methods, logger=logger, on_result=ck.add,
+                     resume=ck.resume)
     for r in rows:
         gbps = r["gbps"]
         print(f"{r['dtype']:>9} {r['method']:>4} n={r['n']:>10} "
               f"{'n/a' if gbps is None or not math.isfinite(gbps or 0.0) else format(gbps, '10.2f')} GB/s "
               f"[{r['status']}]")
     if ns.out:
-        _write(ns.out, meta, rows, complete=True)
+        ck.finalize()
         print(f"wrote {ns.out}")
     # exit contract mirrors the single-chip shmoo: a by-design waiver
     # (e.g. --backend=xla --type=double on TPU, which would need x64)

@@ -16,7 +16,6 @@ raw -> collected -> averaged pipeline (aggregate.py).
 from __future__ import annotations
 
 import dataclasses
-import json
 from pathlib import Path
 from typing import List, Optional
 
@@ -153,10 +152,16 @@ def _run_cells(cfgs, logger, on_result, log_row=None):
     examples/tpu_run/RECOVERY.md). Shared by run_shmoo and sweep_all;
     regime-SENSITIVE legacy disciplines must keep their shared batch."""
     from tpu_reductions.bench.driver import crash_result, run_benchmark
+    from tpu_reductions.utils.retry import retry_device_call
     results = []
     for sub in cfgs:
         try:
-            res = run_benchmark(sub, logger=logger)
+            # a transient relay flap (relay back before the watchdog
+            # grace) retries the cell; a dead relay re-raises straight
+            # into the crash containment (utils/retry.py)
+            res = retry_device_call(
+                lambda: run_benchmark(sub, logger=logger),
+                log=logger.log)
         except Exception as e:
             res = crash_result(sub, e, logger)
         if log_row is not None:
@@ -274,10 +279,8 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                 fname = (raw_dir / f"run-{dtype}-{method}-{rep}.json"
                          if raw_dir else None)
                 if resume and fname and fname.exists():
-                    try:
-                        row = json.loads(fname.read_text())
-                    except (json.JSONDecodeError, OSError):
-                        row = {}  # truncated by an interrupted run: re-run
+                    from tpu_reductions.bench.resume import load_cell
+                    row = load_cell(fname)  # {} when truncated: re-run
                     # only reuse a cached cell that (a) succeeded and
                     # (b) was measured under the SAME sweep parameters —
                     # stale-config or failed cells are re-run
@@ -323,12 +326,12 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
         logger.log(f"sweep {cfg.dtype} {cfg.method} rep={rep} "
                    f"-> {res.gbps:.4f} GB/s [{res.status.name}]")
         if fname and res.passed:
-            # failures are never cached: a retry must re-measure; write
-            # via temp+rename so an interrupt can't leave a truncated
-            # cache file behind
-            tmp = fname.with_suffix(".json.tmp")
-            tmp.write_text(json.dumps(row) + "\n")
-            tmp.replace(fname)
+            # failures are never cached: a retry must re-measure; the
+            # shared atomic cell writer (bench/resume.store_cell ->
+            # utils/jsonio) guarantees an interrupt can't leave a
+            # truncated cache file behind
+            from tpu_reductions.bench.resume import store_cell
+            store_cell(fname, row)
 
     queued_cfgs = [cfg for _, _, _, cfg in queued]
     if queued_cfgs and all(resolved_timing(c) == "chained"

@@ -1,0 +1,31 @@
+"""Chaos layer: scriptable relay faults + deterministic injection.
+
+The reference's only fault handling is the per-call CUDA abort macro
+(cutil_inline_runtime.h:34-44): every failure is loud, local and
+immediate. This platform's dominant failure mode is none of those — a
+flapping tunnel relay that hangs processes forever mid-device-wait
+(CLAUDE.md "Hard-won environment facts"; both round-2 windows died this
+way) — and the defenses that grew around it (utils/watchdog.py,
+utils/staging.py chunking, the per-row persist discipline, sweep
+resume) were point fixes that had never been exercised under an
+*actual* injected failure. This package makes every one of those
+failure paths testable off-chip:
+
+  * `faults.relay.FakeRelay` — a real TCP listener whose accept/refuse/
+    stall behavior follows a JSON fault schedule (`faults.schedule`),
+    standing in for the tunnel relay the watchdog probes;
+  * `faults.inject` — env-var driven (`TPU_REDUCTIONS_FAULTS`)
+    deterministic fault points compiled into the hazardous loops (the
+    watchdog probe loop, the staging chunk loop, chained execution,
+    benchmark dispatch), near-zero cost when disabled;
+
+so the full death -> watchdog exit-3 -> watcher re-arm -> resume
+pipeline (docs/RESILIENCE.md) runs end-to-end in CI on --platform=cpu.
+"""
+
+from tpu_reductions.faults.inject import InjectedFault, fault_point
+from tpu_reductions.faults.relay import FakeRelay
+from tpu_reductions.faults.schedule import Phase, load_schedule
+
+__all__ = ["FakeRelay", "InjectedFault", "Phase", "fault_point",
+           "load_schedule"]

@@ -1,0 +1,122 @@
+"""Env-driven deterministic fault injection (`TPU_REDUCTIONS_FAULTS`).
+
+The hazardous loops this repo grew around the flapping relay — the
+watchdog probe loop (utils/watchdog.py), the staging chunk loop
+(utils/staging.py), chained execution (utils/timing.time_chained),
+benchmark dispatch (bench/driver.run_benchmark) — each call
+`fault_point("<name>")` at their vulnerable step. With the env var
+unset that call is one dict lookup of None; with it set to a JSON plan
+(or `@/path/to/plan.json`), named points fire scripted faults:
+
+    TPU_REDUCTIONS_FAULTS='{"bench.run": {"after": 1, "action": "stall",
+                            "seconds": 120}}'
+
+Plan entry fields:
+    after    skip the first N hits of the point (default 0)
+    times    fire at most N times, then go quiet (default: forever) —
+             `times` bounded firing is how a transient flap (fails,
+             then recovers) is scripted
+    action   raise        raise InjectedFault (a flap-surfaced error)
+             stall        sleep `seconds` (default 3600) — a process
+                          stuck in a device wait; only the watchdog's
+                          os._exit can end it, which is the point
+             exit         os._exit(`code`, default 1) — a SIGKILL-class
+                          death mid-persist (the jsonio atomicity test)
+             dead / inconclusive / anything else — no side effect; the
+                          spec dict is returned for the caller to
+                          interpret (the watchdog probe loop maps
+                          "dead"/"inconclusive" onto probe verdicts)
+
+Registered fault points: `watchdog.probe`, `staging.chunk`,
+`chain.step`, `bench.run` (docs/RESILIENCE.md keeps the list).
+
+Counters are process-global and monotonic; `reset()` re-arms them for
+in-process tests (subprocesses start fresh by construction).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+ENV_VAR = "TPU_REDUCTIONS_FAULTS"
+
+
+class InjectedFault(RuntimeError):
+    """A scripted failure from a fault point — the stand-in for the
+    error surface a relay flap produces mid-device-call."""
+
+
+_counters: Dict[str, int] = {}
+_plan_cache: tuple = (None, {})   # (raw env string, parsed plan)
+
+
+def reset() -> None:
+    """Clear hit counters and the plan cache (in-process tests)."""
+    global _plan_cache
+    _counters.clear()
+    _plan_cache = (None, {})
+
+
+def _plan() -> dict:
+    """Parse (and cache, keyed on the raw env value) the active plan.
+    A malformed plan raises ValueError loudly: a chaos run that
+    silently injects nothing would test nothing while looking green."""
+    global _plan_cache
+    raw = os.environ.get(ENV_VAR)
+    if not raw:
+        return {}
+    cached_raw, cached = _plan_cache
+    if raw == cached_raw:
+        return cached
+    src = raw
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            src = f.read()
+    try:
+        plan = json.loads(src)
+    except ValueError as e:
+        raise ValueError(f"{ENV_VAR}: malformed fault plan: {e}") from e
+    if not isinstance(plan, dict):
+        raise ValueError(f"{ENV_VAR}: fault plan must be a JSON object "
+                         "mapping fault-point names to specs")
+    _plan_cache = (raw, plan)
+    return plan
+
+
+def active() -> bool:
+    """Whether any fault plan is armed (cheap env check)."""
+    return bool(os.environ.get(ENV_VAR))
+
+
+def fault_point(name: str) -> Optional[dict]:
+    """Declare a fault point. Returns None when the point does not fire
+    (no plan / not this point / outside its after..times window).
+    Side-effect actions (raise/stall/exit) fire here; passive specs are
+    returned for the caller to interpret (module docstring)."""
+    if not os.environ.get(ENV_VAR):
+        return None
+    spec = _plan().get(name)
+    if spec is None:
+        return None
+    hit = _counters.get(name, 0)
+    _counters[name] = hit + 1
+    after = int(spec.get("after", 0))
+    times = spec.get("times")
+    if hit < after:
+        return None
+    if times is not None and hit >= after + int(times):
+        return None
+    action = spec.get("action", "raise")
+    if action == "raise":
+        raise InjectedFault(spec.get("message",
+                                     f"injected fault at {name} "
+                                     f"(hit {hit})"))
+    if action == "stall":
+        time.sleep(float(spec.get("seconds", 3600)))
+        return spec
+    if action == "exit":
+        os._exit(int(spec.get("code", 1)))
+    return spec

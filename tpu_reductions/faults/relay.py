@@ -1,0 +1,232 @@
+"""Scriptable fake tunnel relay — a real TCP listener with faults.
+
+Stands in for the axon tunnel relay (`/root/.relay.py`, ports 8082..)
+that utils/watchdog.py probes and scripts/await_window.sh polls: a real
+socket on a real port whose accept/refuse/stall behavior follows a
+fault schedule (faults/schedule.py), so the dead-relay and flapping-
+relay scenarios that have only ever happened *live* (round-2 window
+deaths, the round-4 ~6-minute flap) can be reproduced deterministically
+in CI. Point the consumers at it with the standard env overrides:
+
+    TPU_REDUCTIONS_RELAY_PORTS=<port>   (watchdog probes, shell probes)
+    TPU_REDUCTIONS_RELAY_MARKER=<file>  (any existing file = "tunneled")
+
+Python API:
+
+    with FakeRelay([Phase("accept", connections=1),
+                    Phase("refuse")]) as relay:
+        ... relay.port ...
+
+`force(behavior)` overrides the schedule from test code — the
+deterministic way to flip a relay dead the moment an artifact lands,
+without racing wall-clock phases.
+
+CLI (for shell-level chaos rehearsals of await_window/chip_session):
+
+    python -m tpu_reductions.faults.relay --schedule=flap.json \
+        [--port=0] [--port-file=PATH] [--max-seconds=S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import threading
+import time
+from typing import List, Optional, Sequence, Union
+
+from tpu_reductions.faults.schedule import Phase, load_schedule
+
+_TICK_S = 0.05
+
+
+class FakeRelay:
+    """A schedule-driven TCP listener on 127.0.0.1.
+
+    Thread-backed; `start()` binds and returns the port, `stop()` tears
+    everything down (held `stall` connections included). Context-manager
+    friendly. `connections` counts observed connection attempts
+    (refused connects never reach userspace and are not counted)."""
+
+    def __init__(self, schedule: Union[str, Sequence, None] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._phases: List[Phase] = (load_schedule(schedule) if schedule
+                                     else [Phase("accept")])
+        self._host = host
+        self._want_port = port
+        self._forced: Optional[str] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._listener: Optional[socket.socket] = None
+        self._held: List[socket.socket] = []
+        self._phase_i = 0
+        self._phase_t0 = 0.0
+        self._phase_conns = 0
+        self.port: Optional[int] = None
+        self.connections = 0
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> int:
+        """Bind (reserving the port for the relay's whole life, so a
+        refuse phase can re-listen on the same port) and start the
+        behavior thread; returns the port."""
+        self._listener = self._bind()
+        self.port = self._listener.getsockname()[1]
+        self._phase_t0 = time.monotonic()
+        self._thread = threading.Thread(target=self._serve,
+                                        name="fake-relay", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._close_listener()
+        for c in self._held:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._held.clear()
+
+    def __enter__(self) -> "FakeRelay":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- test control -------------------------------------------------
+
+    def force(self, behavior: str) -> None:
+        """Override the schedule with a fixed behavior from now on —
+        the deterministic flip tests use instead of racing wall-clock
+        phases ('refuse' the moment the artifact under test lands)."""
+        if behavior not in ("accept", "refuse", "stall"):
+            raise ValueError(f"unknown behavior {behavior!r}")
+        with self._lock:
+            self._forced = behavior
+
+    @property
+    def behavior(self) -> str:
+        """The behavior currently in force (forced override first)."""
+        with self._lock:
+            if self._forced is not None:
+                return self._forced
+            return self._phases[self._phase_i].behavior
+
+    # -- internals ----------------------------------------------------
+
+    def _bind(self) -> socket.socket:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self._host, self._want_port if self.port is None
+                else self.port))
+        s.listen(8)
+        s.settimeout(_TICK_S)
+        return s
+
+    def _close_listener(self) -> None:
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+
+    def _advance_if_due(self) -> None:
+        with self._lock:
+            if self._phase_i >= len(self._phases) - 1:
+                return
+            ph = self._phases[self._phase_i]
+            due = ((ph.duration_s is not None
+                    and time.monotonic() - self._phase_t0 >= ph.duration_s)
+                   or (ph.connections is not None
+                       and self._phase_conns >= ph.connections))
+            if due:
+                self._phase_i += 1
+                self._phase_t0 = time.monotonic()
+                self._phase_conns = 0
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            self._advance_if_due()
+            behavior = self.behavior
+            if behavior == "refuse":
+                # no listener = kernel answers ECONNREFUSED, exactly
+                # what a dead relay process looks like from a probe
+                self._close_listener()
+                time.sleep(_TICK_S)
+                continue
+            if self._listener is None:
+                try:
+                    self._listener = self._bind()
+                except OSError:
+                    # port transiently unavailable (TIME_WAIT edge):
+                    # retry next tick rather than dying silently
+                    time.sleep(_TICK_S)
+                    continue
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                continue
+            self.connections += 1
+            with self._lock:
+                self._phase_conns += 1
+            if behavior == "stall":
+                self._held.append(conn)   # wedged-but-ports-open
+            else:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+
+def main(argv=None) -> int:
+    """CLI: run a schedule-driven fake relay until the schedule's
+    terminal phase has held for --max-seconds (or forever). Writes the
+    bound port to --port-file (atomic) so shell chaos rehearsals can
+    point TPU_REDUCTIONS_RELAY_PORTS at it."""
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.faults.relay",
+        description="Scriptable fake tunnel relay (chaos harness)")
+    p.add_argument("--schedule", required=True,
+                   help="fault schedule: JSON file path or inline JSON")
+    p.add_argument("--port", type=int, default=0,
+                   help="port to bind (0 = ephemeral)")
+    p.add_argument("--port-file", default=None,
+                   help="write the bound port here once listening")
+    p.add_argument("--max-seconds", type=float, default=None,
+                   help="total runtime bound (default: run until killed)")
+    ns = p.parse_args(argv)
+    try:
+        phases = load_schedule(ns.schedule)
+    except ValueError as e:
+        p.error(str(e))
+    relay = FakeRelay(phases, port=ns.port)
+    relay.start()
+    print(f"fake relay: listening on 127.0.0.1:{relay.port} "
+          f"({len(phases)} phase(s))", flush=True)
+    if ns.port_file:
+        from tpu_reductions.utils.jsonio import atomic_text_dump
+        atomic_text_dump(ns.port_file, f"{relay.port}\n")
+    t0 = time.monotonic()
+    try:
+        while ns.max_seconds is None \
+                or time.monotonic() - t0 < ns.max_seconds:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        relay.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

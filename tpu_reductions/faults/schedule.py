@@ -1,0 +1,93 @@
+"""Fault-schedule parsing for the fake relay (faults/relay.py).
+
+A schedule is a JSON list of phases the relay steps through in order:
+
+    [{"behavior": "accept", "duration_s": 2},
+     {"behavior": "refuse", "connections": 3},
+     {"behavior": "accept"}]
+
+* `behavior` (required):
+    accept — connections complete and are closed immediately (a healthy
+             relay as seen by watchdog.relay_alive);
+    refuse — the listening socket is closed: connects get ECONNREFUSED
+             (the dead-relay signature both round-2 windows hit);
+    stall  — connections complete but are held open and never serviced
+             (the wedged-but-ports-open tunnel chip_session.sh's budget
+             discipline exists for: probes say alive, work hangs).
+* phase advance (optional, at most one of):
+    duration_s   — advance after this much wall time;
+    connections  — advance after this many observed connection attempts
+                   (refused connects are invisible to userspace, so a
+                   `refuse` phase must use duration_s).
+  A phase with neither holds forever (the schedule's terminal state).
+
+The flap the watchdog was built against is simply
+accept -> refuse(duration) -> accept.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import List, Sequence, Union
+
+BEHAVIORS = ("accept", "refuse", "stall")
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase:
+    """One relay behavior interval of a fault schedule."""
+
+    behavior: str
+    duration_s: float | None = None
+    connections: int | None = None
+
+    def __post_init__(self):
+        if self.behavior not in BEHAVIORS:
+            raise ValueError(f"unknown behavior {self.behavior!r} "
+                             f"(expected one of {BEHAVIORS})")
+        if self.duration_s is not None and self.connections is not None:
+            raise ValueError("a phase advances on duration_s OR "
+                             "connections, not both")
+        if self.behavior == "refuse" and self.connections is not None:
+            raise ValueError("refused connects never reach userspace: a "
+                             "'refuse' phase must advance on duration_s")
+        if self.duration_s is not None and self.duration_s < 0:
+            raise ValueError(f"duration_s must be >= 0, got "
+                             f"{self.duration_s}")
+        if self.connections is not None and self.connections <= 0:
+            raise ValueError(f"connections must be > 0, got "
+                             f"{self.connections}")
+
+
+def load_schedule(src: Union[str, os.PathLike, Sequence]) -> List[Phase]:
+    """Parse a schedule from a JSON file path, a JSON string, or an
+    already-decoded list of phase dicts/Phases. Raises ValueError on
+    anything malformed — a chaos run with a silently-empty schedule
+    would test nothing while looking green."""
+    if isinstance(src, (str, os.PathLike)) and os.path.exists(src):
+        with open(src) as f:
+            src = json.load(f)
+    elif isinstance(src, str):
+        src = json.loads(src)
+    if not isinstance(src, (list, tuple)) or not src:
+        raise ValueError("a fault schedule is a non-empty JSON list of "
+                         "phases")
+    phases = []
+    for i, p in enumerate(src):
+        if isinstance(p, Phase):
+            phases.append(p)
+            continue
+        if not isinstance(p, dict):
+            raise ValueError(f"phase {i}: expected an object, got "
+                             f"{type(p).__name__}")
+        unknown = set(p) - {"behavior", "duration_s", "connections"}
+        if unknown:
+            raise ValueError(f"phase {i}: unknown key(s) "
+                             f"{sorted(unknown)}")
+        try:
+            phases.append(Phase(**p))
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"phase {i}: {e}") from e
+    return phases

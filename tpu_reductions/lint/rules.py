@@ -1,4 +1,4 @@
-"""redlint Python rules RED001-RED007 — one AST walk per file.
+"""redlint Python rules RED001-RED007 + RED010 — one AST walk per file.
 
 Each rule encodes one CLAUDE.md "hard-won environment fact" (or the
 SURVEY.md §5 output-row contract) as a static check; docs/LINT.md maps
@@ -34,6 +34,7 @@ TIMING_WHITELIST = ("ops/chain.py", "utils/timing.py", "utils/calibrate.py",
 STAGING_WHITELIST = ("utils/staging.py",)
 GRAMMAR_WHITELIST = ("lint/grammar.py",)
 WATCHDOG_WHITELIST = ("utils/watchdog.py",)
+JSONIO_WHITELIST = ("utils/jsonio.py",)
 
 # RED006 applies to the measured packages only: every public surface in
 # ops/ and bench/ must carry its reference citation (PARITY.md).
@@ -142,6 +143,7 @@ def check_python(rel_posix: str, source: str) -> List[RawFinding]:
     out += _red005(rel_posix, ctx)
     out += _red006(rel_posix, ctx)
     out += _red007(rel_posix, ctx)
+    out += _red010(rel_posix, ctx)
     # nested timing scopes can double-report the same call site
     return sorted(set(out), key=lambda f: (f.line, f.rule, f.message))
 
@@ -415,4 +417,47 @@ def _red007(rel: str, ctx: _FileContext) -> List[RawFinding]:
                 "(device_get) or watchdog arm (maybe_arm_for_tpu) — an "
                 "exit with in-flight device work can wedge the remote "
                 "chip machine-wide"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED010 — raw JSON artifact writes outside utils/jsonio.py. A watchdog
+# os._exit (or a SIGKILL-class death — faults/inject.py action "exit")
+# can land mid-write at any instant: a truncating json.dump / a
+# write_text(json.dumps(...)) destroys the resume cache the rows were
+# persisted into. Artifact writes must route through the fsync'd
+# temp+rename helpers (utils/jsonio.atomic_json_dump /
+# bench/resume.store_cell). json.dumps to stdout/log lines is fine —
+# only file-writing spellings are flagged.
+# --------------------------------------------------------------------------
+
+def _red010(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    if _suffix_match(rel, JSONIO_WHITELIST):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain == "json.dump" or chain.endswith(".json.dump"):
+            out.append(RawFinding(
+                "RED010", node.lineno,
+                "raw json.dump of an artifact file — a kill mid-write "
+                "truncates the resume cache; use utils.jsonio."
+                "atomic_json_dump (temp+fsync+rename)"))
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "write_text":
+            dumps_inside = any(
+                isinstance(sub, ast.Call)
+                and _attr_chain(sub.func).endswith("json.dumps")
+                for a in list(node.args)
+                + [kw.value for kw in node.keywords]
+                for sub in ast.walk(a))
+            if dumps_inside:
+                out.append(RawFinding(
+                    "RED010", node.lineno,
+                    "write_text(json.dumps(...)) of an artifact file — "
+                    "an in-place truncating write destroys the rows "
+                    "persisted so far; use utils.jsonio."
+                    "atomic_json_dump or bench/resume.store_cell"))
     return out

@@ -236,47 +236,74 @@ def main(argv=None) -> int:
     _apply_platform(ns)
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
     maybe_arm_for_tpu()  # no-op off-TPU; exits 3 on a dead relay
-    def _persist(payload: dict) -> None:
-        if ns.out is None:
-            return
-        from tpu_reductions.utils.jsonio import atomic_json_dump
-        atomic_json_dump(ns.out, payload)
+    import jax
+
+    from tpu_reductions.bench.resume import Checkpoint
+    from tpu_reductions.utils.jsonio import atomic_json_dump
+    platform = jax.default_backend()
 
     if ns.ladder:
         # rungs run (and persist) one at a time: a window that dies
-        # between rungs keeps the VMEM rung's data instead of nothing
-        rungs = []
+        # between rungs keeps the VMEM rung's data instead of nothing.
+        # An interrupted ladder (--out left complete:false) resumes its
+        # measured rungs on re-invocation (bench/resume.Checkpoint) —
+        # a COMPLETE ladder re-measures: the trust verdict is fresh per
+        # window by contract (scripts/chip_session.sh step 3); the
+        # reused-rung keys are (platform, n, dtype) so a cpu rehearsal
+        # can never satisfy a chip ladder.
+        # chain_span/reps sit in the meta contract (rung dicts don't
+        # record them): an interrupted ladder at different spans
+        # re-measures instead of resuming apples as oranges
+        ck = Checkpoint(ns.out, {"dtype": ns.dtype,
+                                 "chain_span": ns.chain_span,
+                                 "reps": ns.reps},
+                        rows_key="rungs",
+                        key_fn=lambda r: (r.get("platform"),
+                                          r.get("n"), r.get("dtype")))
         specs = [(ns.n, ns.chain_span),
                  (ns.n * 4, max(8, ns.chain_span // 4))]
+        payload = None
         for i, (n, span) in enumerate(specs):
-            cal = calibrate(n=n, dtype=ns.dtype, iters=ns.iters,
-                            reps=ns.reps, chain_span=span)
-            rungs.append(cal)
-            print(cal.describe(), flush=True)
+            rung = ck.resume((platform, n, ns.dtype),
+                             reusable=lambda r: True)
+            if rung is not None:
+                print(f"calibrate: rung n={n} resumed from interrupted "
+                      f"{ns.out}", flush=True)
+            else:
+                cal = calibrate(n=n, dtype=ns.dtype, iters=ns.iters,
+                                reps=ns.reps, chain_span=span)
+                rung = cal.to_dict()
+                print(cal.describe(), flush=True)
             if i < len(specs) - 1:
                 # no verdict fields yet: the HBM (last) rung decides,
                 # and it has not run — a partial file must never be
                 # mistaken for a decided one (same completeness key as
                 # spot/smoke artifacts)
-                payload = {"rungs": [c.to_dict() for c in rungs],
-                           "complete": False}
+                ck.add(rung)
+                payload = {"rungs": ck.rows, "complete": False}
             else:
-                verdict = rungs[-1]   # the HBM-bound (last) rung decides
-                payload = {
-                    "rungs": [c.to_dict() for c in rungs],
-                    "complete": True,
+                # the HBM-bound (last) rung decides; its to_dict
+                # already carries the verdict properties
+                extra = {
                     "block_awaits_execution":
-                        verdict.block_awaits_execution,
-                    "indeterminate": verdict.indeterminate,
-                    "deciding_n": verdict.n,
+                        rung["block_awaits_execution"],
+                    "indeterminate": rung["indeterminate"],
+                    "deciding_n": rung["n"],
                 }
-            _persist(payload)
+                ck.add(rung, extra=extra)
+                ck.finalize(extra=extra)
+                payload = {"rungs": ck.rows, "complete": True, **extra}
         print(json.dumps(payload))
         return 0
+    # single-rung mode: an interrupted run has nothing partial to keep
+    # (one rung is all-or-nothing), but a prior incomplete artifact
+    # from a ladder must not be clobbered silently — the plain dump
+    # stays whole-artifact
     cal = calibrate(n=ns.n, dtype=ns.dtype, iters=ns.iters, reps=ns.reps,
                     chain_span=ns.chain_span)
     print(cal.describe())
-    _persist({**cal.to_dict(), "complete": True})
+    if ns.out is not None:
+        atomic_json_dump(ns.out, {**cal.to_dict(), "complete": True})
     print(json.dumps(cal.to_dict()))
     return 0
 

@@ -1,12 +1,19 @@
-"""Atomic JSON artifact writes, shared by every persisting tool.
+"""Atomic artifact writes, shared by every persisting tool.
 
 The races, spots, and the bench snapshot all persist mid-run artifacts
-that a relay-watchdog os._exit (utils/watchdog.py) can interrupt at ANY
-instant; an in-place truncating write would destroy the rows persisted
-so far — the exact loss the mid-run snapshots exist to prevent. One
-temp+rename helper instead of a per-module copy (the cutil pattern of
-one shared error-checked write path, cutil_inline_runtime.h:34-44, at
-the file layer)."""
+that a relay-watchdog os._exit (utils/watchdog.py) — or a SIGKILL-class
+death injected by the chaos harness (faults/inject.py action "exit") —
+can interrupt at ANY instant; an in-place truncating write would
+destroy the rows persisted so far — the exact loss the mid-run
+snapshots exist to prevent. One temp+fsync+rename helper instead of a
+per-module copy (the cutil pattern of one shared error-checked write
+path, cutil_inline_runtime.h:34-44, at the file layer). The fsync
+matters: os.replace alone orders the rename against nothing, so a
+power-loss/SIGKILL straddling the rename could publish an empty inode
+under the artifact's name. redlint RED010 (docs/LINT.md) keeps raw
+json.dump / write_text(json.dumps(...)) artifact writes out of the
+rest of the tree.
+"""
 
 from __future__ import annotations
 
@@ -14,13 +21,46 @@ import json
 import os
 
 
-def atomic_json_dump(path: str | os.PathLike, obj, *, indent: int = 1
-                     ) -> None:
-    """Serialize `obj` to `path` via temp file + os.replace (atomic on
-    POSIX): readers see either the previous complete artifact or the
-    new one, never a truncation."""
+def _replace_atomic(tmp: str, path: str) -> None:
+    """fsync'd os.replace: the temp file's bytes are durable before the
+    rename publishes them, so readers (and post-crash resumes) see the
+    previous complete artifact or the new one — never a truncation."""
+    os.replace(tmp, path)
+    # best-effort directory fsync so the rename itself is durable;
+    # not all filesystems/platforms allow opening a directory
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
+def atomic_json_dump(path: str | os.PathLike, obj, *,
+                     indent: int | None = 1) -> None:
+    """Serialize `obj` to `path` via temp file + fsync + os.replace.
+    `indent=None` writes the compact one-line form (+ newline) the
+    per-cell resume caches use."""
     path = os.fspath(path)
     tmp = f"{path}.tmp"
     with open(tmp, "w") as f:
         json.dump(obj, f, indent=indent)
-    os.replace(tmp, path)
+        if indent is None:
+            f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    _replace_atomic(tmp, path)
+
+
+def atomic_text_dump(path: str | os.PathLike, text: str) -> None:
+    """Same durability contract for small non-JSON artifacts (port
+    files, markers)."""
+    path = os.fspath(path)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    _replace_atomic(tmp, path)

@@ -1,0 +1,81 @@
+"""Bounded retry for individual device calls under a flapping relay.
+
+The reference retried nothing — its failure model was a local CUDA
+error, deterministic and fatal (cutil_inline_runtime.h:34-44 aborts on
+first error). This platform adds a failure class the reference never
+had: the tunnel relay FLAPS (round 4: a ~6-minute window appeared and
+died mid-step), so a device call can fail *transiently* — the relay is
+back before the watchdog's grace expires — and a blanket fail-fast
+would throw away a recoverable row.
+
+`retry_device_call` wraps ONE device call with bounded exponential
+backoff and classifies each failure by probing the relay
+(utils/watchdog.py):
+
+  * tunneled + relay DEAD at failure time -> fatal: re-raise
+    immediately. Retrying against a dead relay can only hang (CLAUDE.md:
+    it never comes back in-session within a window); the watchdog owns
+    that path (exit 3), and the caller's crash containment
+    (bench/driver.crash_result) owns the row.
+  * tunneled + relay alive (or inconclusive) -> transient flap surface:
+    back off and retry, up to `retries` times.
+  * untunneled host -> deterministic error (compile failure, lowering
+    gap): no retry — re-running a broken kernel buys nothing and CI
+    must stay fast.
+
+TPU_REDUCTIONS_DEVICE_RETRIES overrides the retry budget (0 disables).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from tpu_reductions.utils.watchdog import relay_alive, tunneled_environment
+
+DEFAULT_RETRIES = 2
+DEFAULT_BACKOFF_S = 0.5
+
+
+def retry_budget(retries: Optional[int] = None) -> int:
+    """The effective retry count: explicit argument, else the
+    TPU_REDUCTIONS_DEVICE_RETRIES env override, else DEFAULT_RETRIES."""
+    if retries is not None:
+        return retries
+    try:
+        return int(os.environ["TPU_REDUCTIONS_DEVICE_RETRIES"])
+    except (KeyError, ValueError):
+        return DEFAULT_RETRIES
+
+
+def retry_device_call(fn: Callable, *, retries: Optional[int] = None,
+                      backoff_s: float = DEFAULT_BACKOFF_S,
+                      log=None, _sleep=time.sleep,
+                      _tunneled=None, _alive=None):
+    """Call `fn()`; on failure, classify (module docstring) and either
+    re-raise (fatal/deterministic) or back off exponentially and retry
+    (transient flap). The LAST failure is always re-raised so callers'
+    crash containment sees the real error. `_tunneled`/`_alive` are
+    injectable probes for tests."""
+    tunneled = _tunneled or tunneled_environment
+    alive = _alive or relay_alive
+    budget = retry_budget(retries)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:
+            if not tunneled():
+                raise            # deterministic off-tunnel error
+            if not alive():
+                raise            # dead relay: watchdog territory
+            if attempt >= budget:
+                raise            # flap outlasted the retry budget
+            delay = backoff_s * (2 ** attempt)
+            attempt += 1
+            if log is not None:
+                log(f"retry: transient device-call failure "
+                    f"({type(e).__name__}: {e}); relay answers — "
+                    f"retry {attempt}/{budget} in {delay:.1f}s")
+            _sleep(delay)

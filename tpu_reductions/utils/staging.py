@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_reductions.faults.inject import fault_point
+
 # Per-message bound. 2 GiB messages survived the tunnel, 4 GiB killed
 # it twice; 256 MiB keeps a wide margin while adding only ~16 messages
 # per surviving GiB.
@@ -82,6 +84,11 @@ def device_put_chunked(flat: np.ndarray, rows: int, lanes: int,
     full_rows = flat.size // lanes
     row_step = max(1, chunk_bytes // (lanes * flat.dtype.itemsize))
     for r in range(0, full_rows, row_step):
+        # chaos hook: the round-2 killer was a relay death mid-payload
+        # — an injected fault here rehearses that exact interruption
+        # point (faults/inject.py; tests/test_staging.py proves no
+        # partially-staged buffer survives it)
+        fault_point("staging.chunk")
         k = min(row_step, full_rows - r)
         chunk = np.ascontiguousarray(
             flat[r * lanes:(r + k) * lanes]).reshape(k, lanes)

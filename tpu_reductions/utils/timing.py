@@ -23,6 +23,8 @@ from typing import Callable, Dict, Optional
 
 import jax
 
+from tpu_reductions.faults.inject import fault_point
+
 
 @dataclass
 class Stopwatch:
@@ -174,6 +176,10 @@ def time_chained(chained_fn, x, k_lo: int, k_hi: int, reps: int = 5,
     fetch = materialize or jax.device_get
 
     def run(k) -> float:
+        # chaos hook: every chained sample blocks on a host
+        # materialization through the tunnel — the exact wait a relay
+        # flap strands forever (faults/inject.py scripts that death)
+        fault_point("chain.step")
         t0 = time.perf_counter()
         fetch(chained_fn(x, k))
         return time.perf_counter() - t0

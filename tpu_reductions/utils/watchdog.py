@@ -24,6 +24,16 @@ unfinished device queue because the remote lease can wedge the chip.
 That hazard assumes a LIVE tunnel; the watchdog only ever fires when
 the relay is gone, at which point nothing this process does can reach
 the chip and the lease is orphaned either way.
+
+Chaos-testability (docs/RESILIENCE.md): the relay endpoint is
+overridable via TPU_REDUCTIONS_RELAY_PORTS / TPU_REDUCTIONS_RELAY_MARKER
+so the fake relay (faults/relay.py) can stand in for the real one;
+TPU_REDUCTIONS_WATCHDOG_INTERVAL_S / TPU_REDUCTIONS_WATCHDOG_GRACE
+compress the probe cadence for CI; TPU_REDUCTIONS_CHAOS_ARM=1 arms the
+watchdog on a non-TPU backend (a --platform=cpu chaos run still needs
+the exit-3 contract exercised); and the probe loop carries the
+`watchdog.probe` fault point (faults/inject.py) for scripted
+dead/inconclusive verdicts.
 """
 
 from __future__ import annotations
@@ -34,6 +44,8 @@ import sys
 import threading
 from typing import Optional, Sequence
 
+from tpu_reductions.faults.inject import fault_point
+
 RELAY_PORTS = (8082, 8083)
 WATCHDOG_EXIT_CODE = 3
 # presence of the relay script marks the tunneled environment — the
@@ -42,37 +54,73 @@ WATCHDOG_EXIT_CODE = 3
 RELAY_MARKER = "/root/.relay.py"
 
 
-def tunneled_environment(marker: str = RELAY_MARKER) -> bool:
-    """True on the tunneled dev box (relay script present)."""
+def resolved_ports(ports: Optional[Sequence[int]] = None
+                   ) -> Sequence[int]:
+    """The relay ports to probe: an explicit argument wins, then the
+    TPU_REDUCTIONS_RELAY_PORTS env override (comma-separated — the
+    chaos harness points it at faults/relay.py), then the module's
+    RELAY_PORTS resolved at CALL time (so tests and deployments can
+    repoint it)."""
+    if ports is not None:
+        return ports
+    env = os.environ.get("TPU_REDUCTIONS_RELAY_PORTS")
+    if env:
+        return tuple(int(p) for p in env.split(",") if p.strip())
+    return RELAY_PORTS
+
+
+def tunneled_environment(marker: Optional[str] = None) -> bool:
+    """True on the tunneled dev box (relay script present). The marker
+    path honors the TPU_REDUCTIONS_RELAY_MARKER env override so chaos
+    rehearsals can declare any host 'tunneled'."""
+    if marker is None:
+        marker = os.environ.get("TPU_REDUCTIONS_RELAY_MARKER",
+                                RELAY_MARKER)
     return os.path.exists(marker)
 
 
-def relay_alive(ports: Optional[Sequence[int]] = None,
+def probe_relay(ports: Optional[Sequence[int]] = None,
                 host: str = "127.0.0.1",
-                timeout_s: float = 2.0) -> bool:
-    """True if ANY relay port accepts a TCP connection. `ports=None`
-    resolves the module's RELAY_PORTS at CALL time (so tests and
-    deployments can repoint it).
+                timeout_s: float = 2.0) -> str:
+    """One relay probe: 'alive' | 'dead' | 'inconclusive'.
 
-    Error classification is deliberately asymmetric: a refused
-    connection or a timeout is evidence the RELAY is gone; any other
-    OSError (EMFILE, ephemeral-port exhaustion, ...) is evidence THIS
-    PROCESS is degraded, which says nothing about the tunnel — report
-    alive, because a false 'dead' verdict fires os._exit against a
-    live tunnel with work in flight (the one teardown CLAUDE.md says
-    can wedge the remote chip)."""
+    Classification is deliberately asymmetric: a refused connection or
+    a timeout is evidence the RELAY is gone; any other OSError (EMFILE,
+    ephemeral-port exhaustion, ...) is evidence THIS PROCESS is
+    degraded, which says nothing about the tunnel — 'inconclusive',
+    which liveness consumers must treat as alive, because a false
+    'dead' verdict fires os._exit against a live tunnel with work in
+    flight (the one teardown CLAUDE.md says can wedge the remote
+    chip). The watchdog loop counts inconclusive probes and surfaces
+    the tally in its exit-3 report instead of losing the signal."""
     inconclusive = False
-    for port in (RELAY_PORTS if ports is None else ports):
+    for port in resolved_ports(ports):
         try:
             with socket.create_connection((host, port),
                                           timeout=timeout_s):
-                return True
+                return "alive"
         except (ConnectionRefusedError, ConnectionResetError,
                 socket.timeout, TimeoutError):
             continue
         except OSError:
             inconclusive = True
-    return inconclusive
+    return "inconclusive" if inconclusive else "dead"
+
+
+def relay_alive(ports: Optional[Sequence[int]] = None,
+                host: str = "127.0.0.1",
+                timeout_s: float = 2.0) -> bool:
+    """True if ANY relay port accepts a TCP connection; inconclusive
+    local-resource errors count as alive (see probe_relay)."""
+    return probe_relay(ports, host, timeout_s) != "dead"
+
+
+def _verdict(result) -> str:
+    """Normalize a probe result: injected bool probes (tests) mean
+    alive/dead; the tri-state string passes through."""
+    if isinstance(result, str):
+        return result
+    return "alive" if result else "dead"
 
 
 def start_relay_watchdog(interval_s: float = 60.0, grace: int = 3,
@@ -85,27 +133,54 @@ def start_relay_watchdog(interval_s: float = 60.0, grace: int = 3,
     Arms only when the relay is reachable RIGHT NOW: a CPU run, a
     DRYRUN rehearsal, or a box with no tunnel at all has no relay, and
     killing those after `grace` probes would turn the watchdog into the
-    outage. `_exit` and `_probe` are injectable for tests."""
-    probe = _probe or (lambda: relay_alive(ports, host))
-    if not probe():
+    outage. `_exit` and `_probe` are injectable for tests (_probe may
+    return the tri-state string or a plain bool).
+
+    The loop consults the `watchdog.probe` fault point each cycle
+    (faults/inject.py): a scripted {"action": "dead"|"inconclusive"}
+    spec overrides that cycle's real probe — how CI reproduces flaps
+    and local-resource storms without a real outage."""
+    probe = _probe or (lambda: probe_relay(ports, host))
+    if _verdict(probe()) == "dead":
         return None
     stop = threading.Event()
 
     def watch():
         dead = 0
+        inconclusive_total = 0
         while not stop.wait(interval_s):
-            if probe():
+            spec = fault_point("watchdog.probe")
+            if spec is not None and spec.get("action") in (
+                    "dead", "inconclusive"):
+                verdict = spec["action"]
+            else:
+                verdict = _verdict(probe())
+            if verdict == "inconclusive":
+                # a local resource error says nothing about the tunnel:
+                # treated as alive (never fire os._exit on it), but
+                # COUNTED — a probe loop starving on EMFILE for an hour
+                # must show up in the postmortem, not vanish
+                inconclusive_total += 1
+                dead = 0
+                continue
+            if verdict == "alive":
                 dead = 0
                 continue
             dead += 1
             print(f"relay watchdog: ports "
-                  f"{tuple(RELAY_PORTS if ports is None else ports)} dead "
+                  f"{tuple(resolved_ports(ports))} dead "
                   f"({dead}/{grace})", file=sys.stderr, flush=True)
             if dead >= grace:
+                diag = ""
+                if inconclusive_total:
+                    diag = (f" [{inconclusive_total} inconclusive "
+                            "probe(s) — local resource errors (EMFILE/"
+                            "ephemeral-port exhaustion) counted as "
+                            "alive, not dead]")
                 print("relay watchdog: relay is gone (unrecoverable "
                       "in-session, CLAUDE.md); exiting so the step "
-                      "harness keeps the artifacts persisted so far",
-                      file=sys.stderr, flush=True)
+                      "harness keeps the artifacts persisted so far"
+                      + diag, file=sys.stderr, flush=True)
                 _exit(WATCHDOG_EXIT_CODE)
 
     threading.Thread(target=watch, name="relay-watchdog",
@@ -122,6 +197,20 @@ def _forced_platforms() -> str:
     return jax.config.jax_platforms or ""
 
 
+def _chaos_armed() -> bool:
+    """TPU_REDUCTIONS_CHAOS_ARM=1: arm the watchdog even on a non-TPU
+    backend (still only in a tunneled environment) so --platform=cpu
+    chaos runs exercise the real exit-3 pipeline end-to-end."""
+    return os.environ.get("TPU_REDUCTIONS_CHAOS_ARM") == "1"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
 def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
                       _exit=os._exit,
                       _sleep=None) -> Optional[threading.Event]:
@@ -129,8 +218,13 @@ def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
     environment is the tunneled dev box (relay script present —
     tunneled_environment). A real pod/local TPU host has no relay by
     construction and must run unwatched; CPU runs and DRYRUN
-    rehearsals are no-ops via the backend check. Call AFTER backend
-    resolution (and after any jax.distributed bring-up).
+    rehearsals are no-ops via the backend check (unless
+    TPU_REDUCTIONS_CHAOS_ARM=1 — the chaos harness needs the exit-3
+    contract live on --platform=cpu). Call AFTER backend resolution
+    (and after any jax.distributed bring-up).
+    TPU_REDUCTIONS_WATCHDOG_INTERVAL_S / TPU_REDUCTIONS_WATCHDOG_GRACE
+    override the cadence (CI compresses minutes to fractions of a
+    second).
 
     In the tunneled environment a failed arming probe is not a reason
     to decline protection — it means the relay is ALREADY dead and any
@@ -139,6 +233,10 @@ def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
     the watchdog code instead of proceeding unwatched."""
     import time
 
+    interval_s = _env_float("TPU_REDUCTIONS_WATCHDOG_INTERVAL_S",
+                            interval_s)
+    grace = int(_env_float("TPU_REDUCTIONS_WATCHDOG_GRACE", grace))
+
     # Pre-JAX gate, pure sockets: on the tunneled box with an already-
     # dead relay, jax.default_backend() itself initializes the axon
     # plugin and hangs forever — the arming call would become the hang
@@ -146,12 +244,14 @@ def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
     # backend touch; only a run explicitly forced off-TPU
     # (jax_platforms set and excluding tpu, e.g. the CLIs' --platform
     # =cpu) may proceed past a dead relay, because its device work
-    # never crosses the tunnel.
+    # never crosses the tunnel — except under chaos arming, where the
+    # exit-3 contract is exactly what is being rehearsed.
     if tunneled_environment() and not relay_alive():
         (_sleep or time.sleep)(2.0)
         if not relay_alive():
             platforms = _forced_platforms()
-            if platforms and "tpu" not in platforms:
+            if platforms and "tpu" not in platforms \
+                    and not _chaos_armed():
                 return None
             print("relay watchdog: tunneled box but the relay is "
                   "already dead (pre-JAX probe); device discovery "
@@ -162,7 +262,9 @@ def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
 
     import jax
 
-    if jax.default_backend() != "tpu" or not tunneled_environment():
+    if not tunneled_environment():
+        return None
+    if jax.default_backend() != "tpu" and not _chaos_armed():
         return None
     stop = start_relay_watchdog(interval_s=interval_s, grace=grace,
                                 _exit=_exit)
