@@ -17,6 +17,18 @@
 # default horizon (13 h) outlasts a round, and a heartbeat line lands
 # in the log every ~10 min so "armed" is verifiable afterwards.
 #
+# Wedge-aware arming (ISSUE 3): a TCP probe only proves the relay's
+# PORTS answer — a stalled relay (accepts, never services) or a wedged
+# device lease (jax.devices() hangs machine-wide) both pass it and then
+# hang the session forever. Before firing, the hang-proof preflight
+# (python -m tpu_reductions.utils.preflight: sacrificial subprocess
+# under a hard timeout, never a JAX call in THIS process tree's
+# foreground) must classify the chip LIVE; its verdict persists to the
+# health file both supervisors consume. A session that exits 4 (the
+# watchdog's heartbeat HANG trigger, distinct from the dead-relay 3)
+# defers re-arm until the health verdict clears instead of burning
+# window minutes on back-to-back hangs.
+#
 # Usage: bash scripts/await_window.sh [poll_seconds=20] [max_hours=13]
 #   CHIP_LOG=chip_session_rNN.log overrides the session log name
 #   (default: derived from the highest ROUND<N>.md in the repo — the
@@ -26,13 +38,20 @@
 #     TPU_REDUCTIONS_RELAY_PORTS   comma-separated probe ports
 #     AWAIT_ROOT                   repo root to run in (rehearsal repos)
 #     SESSION_BIN                  session script (tests substitute one)
+#     PREFLIGHT_CMD                preflight command (tests substitute)
+#     TPU_REDUCTIONS_PREFLIGHT=0   skip the preflight gate entirely
+#     TPU_REDUCTIONS_HEALTH_FILE / _HEALTH_TTL_S   health-file seam
 set -uo pipefail
-cd "${AWAIT_ROOT:-$(dirname "$0")/..}"
+REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
+cd "${AWAIT_ROOT:-$REPO_DIR}"
 
 POLL=${1:-20}
 MAX_HOURS=${2:-13}
 RELAY_MARKER=${TPU_REDUCTIONS_RELAY_MARKER:-/root/.relay.py}
 SESSION_BIN=${SESSION_BIN:-scripts/chip_session.sh}
+PREFLIGHT_CMD=${PREFLIGHT_CMD:-}
+HEALTH_FILE=${TPU_REDUCTIONS_HEALTH_FILE:-.chip_health.json}
+HEALTH_TTL_S=${TPU_REDUCTIONS_HEALTH_TTL_S:-300}
 
 current_round() {
     # highest ROUND<N>.md names the round in flight; r00 when none
@@ -72,6 +91,49 @@ for port in ports:
 sys.exit(1)'
 }
 
+preflight() {
+    # The wedge gate the port probe cannot be (header): hang-proof by
+    # construction — utils/preflight.py spawns a sacrificial discovery
+    # subprocess under a hard timeout, so this call is bounded even
+    # against a stalled relay or a wedged lease. rc 0=LIVE, 3=NO_RELAY,
+    # 4=STALLED/WEDGED. TPU_REDUCTIONS_PREFLIGHT=0 skips (and tests
+    # substitute PREFLIGHT_CMD).
+    [ "${TPU_REDUCTIONS_PREFLIGHT:-1}" = 0 ] && return 0
+    if [ -n "$PREFLIGHT_CMD" ]; then
+        $PREFLIGHT_CMD
+        return $?
+    fi
+    PYTHONPATH="$REPO_DIR${PYTHONPATH:+:$PYTHONPATH}" \
+        python -m tpu_reductions.utils.preflight
+}
+
+health_verdict() {
+    # fresh verdict from the preflight health file; '' when absent,
+    # stale (mtime past the TTL — a wedge verdict must never outlive
+    # the flap that caused it) or unparseable
+    [ -f "$HEALTH_FILE" ] || return 0
+    local mt now
+    mt=$(stat -c %Y "$HEALTH_FILE" 2>/dev/null) || return 0
+    now=$(date +%s)
+    [ $(( now - mt )) -le "$HEALTH_TTL_S" ] || return 0
+    sed -n 's/.*"verdict": *"\([A-Z_]*\)".*/\1/p' "$HEALTH_FILE" | head -1
+}
+
+wait_health_clear() {
+    # a STALLED/WEDGED verdict means the next session can only hang:
+    # hold re-arm until the verdict clears (a fresh LIVE preflight or
+    # TTL expiry), instead of burning window minutes on repeat hangs
+    local v
+    v=$(health_verdict)
+    case "$v" in STALLED|WEDGED) ;; *) return 0 ;; esac
+    echo "await_window: health verdict $v; deferring until it clears" \
+         "(TTL ${HEALTH_TTL_S}s)"
+    while v=$(health_verdict); do
+        case "$v" in STALLED|WEDGED) sleep "$POLL" ;; *) break ;; esac
+    done
+    echo "await_window: health verdict cleared at $(date -u +%FT%TZ); resuming polling"
+}
+
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 # ~10-min heartbeat, derived from the poll interval
 beat_every=$(( (600 + POLL - 1) / POLL )); [ "$beat_every" -lt 1 ] && beat_every=1
@@ -80,24 +142,51 @@ echo "await_window: polling relay every ${POLL}s (horizon ${MAX_HOURS}h," \
      "session log ${LOG}, re-arming after aborted sessions)"
 while true; do
     if probe; then
-        echo "await_window: relay ALIVE at $(date -u +%FT%TZ); starting chip session"
-        bash "$SESSION_BIN" 2>&1 | tee -a "$LOG"
-        rc=${PIPESTATUS[0]}
-        echo "await_window: chip session exited rc=$rc at $(date -u +%FT%TZ)"
-        # commit the session log itself: round 2's curve recovery came
-        # FROM this log (examples/tpu_run/RECOVERY.md) — it must survive
-        # even if nobody is attending when the watcher fires
-        if [ -s "$LOG" ] && git add -- "$LOG" \
-                && ! git diff --cached --quiet -- "$LOG"; then
-            git commit -q -m "Chip session log ($(date -u +%FT%TZ), rc=$rc)" \
-                -- "$LOG" || true
+        pf_rc=0
+        preflight || pf_rc=$?
+        if [ "$pf_rc" -ne 0 ]; then
+            # ports answer but the chip is not usable — the hang the
+            # port probe cannot see (rc 3=NO_RELAY: it died between
+            # probes; rc 4=STALLED/WEDGED: firing would hang forever)
+            echo "await_window: relay ports answer but preflight says" \
+                 "NOT LIVE (rc=$pf_rc; 3=relay dead, 4=stall/wedge);" \
+                 "not firing a session"
+            [ "$pf_rc" -eq 4 ] && wait_health_clear
+        else
+            echo "await_window: relay ALIVE at $(date -u +%FT%TZ); starting chip session"
+            bash "$SESSION_BIN" 2>&1 | tee -a "$LOG"
+            rc=${PIPESTATUS[0]}
+            echo "await_window: chip session exited rc=$rc at $(date -u +%FT%TZ)"
+            # commit the session log itself: round 2's curve recovery
+            # came FROM this log (examples/tpu_run/RECOVERY.md) — it
+            # must survive even if nobody is attending at fire time
+            if [ -s "$LOG" ] && git add -- "$LOG" \
+                    && ! git diff --cached --quiet -- "$LOG"; then
+                git commit -q -m "Chip session log ($(date -u +%FT%TZ), rc=$rc)" \
+                    -- "$LOG" || true
+            fi
+            if [ "$rc" -eq 0 ]; then
+                exit 0
+            fi
+            # aborted session: the window closed early — re-arm for the
+            # next, distinguishing the watchdog's two exits: 3 = relay
+            # DEAD (polling finds the next window), 4 = HANG with live
+            # ports (stalled relay / wedged lease — re-arming straight
+            # away would fire into the same hang; hold until the health
+            # verdict clears)
+            if [ "$rc" -eq 3 ]; then
+                echo "await_window: re-arming (session rc=3: relay DEAD" \
+                     "mid-session; remaining value can land in a later window)"
+            elif [ "$rc" -eq 4 ]; then
+                echo "await_window: session rc=4: HANG with relay alive" \
+                     "(stalled relay or wedged lease — heartbeat watchdog);" \
+                     "deferring re-arm until the health verdict clears"
+                wait_health_clear
+            else
+                echo "await_window: re-arming (session rc=$rc; remaining value" \
+                     "can land in a later window)"
+            fi
         fi
-        if [ "$rc" -eq 0 ]; then
-            exit 0
-        fi
-        # aborted session: the window closed early — re-arm for the next
-        echo "await_window: re-arming (session rc=$rc; remaining value" \
-             "can land in a later window)"
     fi
     probes=$(( probes + 1 ))
     if [ $(( probes % beat_every )) -eq 0 ]; then

@@ -86,6 +86,12 @@ GRACE_S=${GRACE_S:-60}
 # the rehearsal tests can run on any host (the chaos harness sets
 # TPU_REDUCTIONS_RELAY_MARKER for the whole stack at once)
 RELAY_MARKER=${RELAY_MARKER:-${TPU_REDUCTIONS_RELAY_MARKER:-/root/.relay.py}}
+# preflight health file (utils/preflight.py; same seam await_window.sh
+# reads): a fresh STALLED/WEDGED verdict means sessions can only hang —
+# respawning a watcher against it burns window minutes on back-to-back
+# hangs (exit 4), so respawn DEFERS until the verdict clears
+HEALTH_FILE=${TPU_REDUCTIONS_HEALTH_FILE:-.chip_health.json}
+HEALTH_TTL_S=${TPU_REDUCTIONS_HEALTH_TTL_S:-300}
 
 if [ ! -e "$RELAY_MARKER" ]; then
     echo "supervisor: untunneled host (no $RELAY_MARKER); nothing to supervise" >&2
@@ -258,6 +264,40 @@ wait_for_group_drain() {
     done
 }
 
+health_verdict() {
+    # fresh verdict from the preflight health file; '' when absent,
+    # stale (mtime past TTL) or unparseable — same derivation as
+    # await_window.sh so both layers read one source of truth
+    [ -f "$HEALTH_FILE" ] || return 0
+    local mt now
+    mt=$(stat -c %Y "$HEALTH_FILE" 2>/dev/null) || return 0
+    now=$(date +%s)
+    [ $(( now - mt )) -le "$HEALTH_TTL_S" ] || return 0
+    sed -n 's/.*"verdict": *"\([A-Z_]*\)".*/\1/p' "$HEALTH_FILE" | head -1
+}
+
+wait_health_clear() {
+    # defer a respawn while the chip is known-wedged (exit-4 territory:
+    # hang with live ports), keeping the hourly log-commit cadence
+    # alive like wait_for_group_drain does; clears on a fresh LIVE
+    # preflight or TTL expiry
+    local v now
+    v=$(health_verdict)
+    case "$v" in STALLED|WEDGED) ;; *) return 0 ;; esac
+    note "health verdict $v (hang with live ports); deferring watcher respawn until it clears"
+    while v=$(health_verdict); do
+        case "$v" in STALLED|WEDGED) ;; *) break ;; esac
+        sleep "$CHECK_S" 9>&-
+        now=$(date +%s)
+        if [ "$COMMIT_EVERY_S" -gt 0 ] \
+                && [ $(( now - last_commit )) -ge "$COMMIT_EVERY_S" ]; then
+            commit_log
+            last_commit=$now
+        fi
+    done
+    note "health verdict cleared; proceeding to respawn"
+}
+
 commit_chip_log() {
     # await_window.sh commits the chip log after a session IT saw end;
     # when the supervisor reaps an orphaned session subtree that commit
@@ -334,6 +374,12 @@ while true; do
             wait_for_group_drain "$child"
             note "predecessor session group drained; proceeding to respawn"
         fi
+        # wedge gate (ISSUE 3): a fresh STALLED/WEDGED preflight
+        # verdict means a respawned watcher would fire sessions that
+        # exit 4 (hang with live ports) in a loop — hold the respawn
+        # until the health file clears; the deferral lands in the
+        # watch log instead of as back-to-back hang exits
+        wait_health_clear
         # capped exponential backoff on rapid deaths (a broken AWAIT_BIN
         # exiting instantly must not grind out ~50k armed/DIED log lines
         # over the horizon); a watcher that lived >=30 s resets it

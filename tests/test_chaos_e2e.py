@@ -32,7 +32,11 @@ def _chaos_env(relay, marker, *, faults=None, interval="0.1", grace="2"):
            "TPU_REDUCTIONS_RELAY_MARKER": str(marker),
            "TPU_REDUCTIONS_RELAY_PORTS": str(relay.port),
            "TPU_REDUCTIONS_WATCHDOG_INTERVAL_S": interval,
-           "TPU_REDUCTIONS_WATCHDOG_GRACE": grace}
+           "TPU_REDUCTIONS_WATCHDOG_GRACE": grace,
+           # isolate the preflight health seam: a chaos subprocess must
+           # neither read a real window's verdict nor leave one behind
+           "TPU_REDUCTIONS_HEALTH_FILE": str(Path(marker).parent
+                                             / "health.json")}
     env.pop("TPU_REDUCTIONS_FAULTS", None)
     if faults is not None:
         env["TPU_REDUCTIONS_FAULTS"] = json.dumps(faults)
@@ -102,6 +106,108 @@ def test_chaos_smoke_flap_exit3_then_resume_matches_uninterrupted(tmp_path):
     assert [(r["method"], r["status"]) for r in resumed["rows"]] \
         == [(r["method"], r["status"]) for r in control["rows"]]
     assert resumed["complete"] == control["complete"] is True
+
+
+def test_chaos_stall_relay_heartbeat_exit4_then_resume(tmp_path):
+    """ISSUE 3's previously-fatal scenario: the relay flips to `stall`
+    (ports ACCEPT — the watchdog's port probe keeps saying alive — but
+    nothing is serviced) while a benchmark's device work wedges. The
+    old stack hung forever; the heartbeat trigger must exit 4 within
+    the compressed deadline with the 'alive' port verdict attached,
+    keep every previously-persisted row, and resume them
+    byte-identically on re-invocation."""
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    out = tmp_path / "spot.json"
+    with FakeRelay() as relay:
+        env = _chaos_env(relay, marker, faults={
+            "bench.run": {"after": 1, "action": "stall", "seconds": 120}})
+        # compressed heartbeat deadlines: steady 5 s (legit cpu-test
+        # device regions finish in well under that), compile 60 s (the
+        # first-jit budget must never be what fires)
+        env["TPU_REDUCTIONS_HEARTBEAT_DEADLINE_S"] = "5.0"
+        env["TPU_REDUCTIONS_HEARTBEAT_COMPILE_DEADLINE_S"] = "60"
+        proc = _spot(out, env)
+        _wait_for_rows(out, 1)          # SUM verified and persisted
+        relay.force("stall")            # wedged-but-ports-open
+        rc = proc.wait(timeout=60)      # the old failure mode: forever
+        stderr = proc.stderr.read()
+        assert rc == 4, f"expected heartbeat exit 4, got {rc}: {stderr}"
+        assert "HANG" in stderr
+        # the port verdict is attached: ports were ALIVE when it fired
+        assert "verdict at fire time: alive" in stderr
+        interrupted = json.loads(out.read_text())
+        assert interrupted["complete"] is False
+        assert [r["method"] for r in interrupted["rows"]] == ["SUM"]
+
+        # the stall clears; re-invocation resumes the banked row
+        # byte-identically and completes the remaining methods
+        relay.force("accept")
+        time.sleep(0.15)
+        proc2 = _spot(out, _chaos_env(relay, marker))
+        assert proc2.wait(timeout=60) == 0
+        assert "resumed from prior artifact" in proc2.stderr.read()
+        resumed = json.loads(out.read_text())
+    assert resumed["complete"] is True
+    assert resumed["rows"][0] == interrupted["rows"][0]  # byte-identical
+    assert [r["method"] for r in resumed["rows"]] == ["SUM", "MIN", "MAX"]
+
+
+def test_await_window_defers_on_non_live_preflight(tmp_path):
+    """The wedge-aware polling loop: relay ports answer, but a
+    preflight verdict of 4 (stall/wedge) must stop await_window from
+    firing a hang-forever session — it logs the deferral and holds
+    until the health verdict clears (here: the health file goes
+    non-wedged), then fires."""
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "t@t")
+    _git(tmp_path, "config", "user.name", "t")
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    health = tmp_path / "health.json"
+    # scripted preflight: rc=4 while the health file says WEDGED, 0
+    # after — the seam the real `python -m tpu_reductions.utils.
+    # preflight` fills live (its own classification is covered in
+    # tests/test_preflight.py)
+    pf = tmp_path / "fake_preflight.sh"
+    pf.write_text(
+        "#!/usr/bin/env bash\n"
+        'grep -q WEDGED "$TPU_REDUCTIONS_HEALTH_FILE" 2>/dev/null'
+        ' && exit 4\n'
+        "exit 0\n")
+    pf.chmod(0o755)
+    session = tmp_path / "fake_session.sh"
+    session.write_text("#!/usr/bin/env bash\necho session-ran\nexit 0\n")
+    session.chmod(0o755)
+    health.write_text('{"verdict": "WEDGED", "ts": 0}\n')
+
+    import threading
+
+    def clear_health():
+        time.sleep(3.0)
+        health.write_text('{"verdict": "LIVE", "ts": 0}\n')
+
+    with FakeRelay() as relay:
+        env = {**os.environ,
+               "AWAIT_ROOT": str(tmp_path),
+               "SESSION_BIN": str(session),
+               "PREFLIGHT_CMD": str(pf),
+               "CHIP_LOG": "chip.log",
+               "TPU_REDUCTIONS_HEALTH_FILE": str(health),
+               "TPU_REDUCTIONS_RELAY_MARKER": str(marker),
+               "TPU_REDUCTIONS_RELAY_PORTS": str(relay.port)}
+        t = threading.Thread(target=clear_health, daemon=True)
+        t.start()
+        proc = subprocess.run(
+            ["bash", str(REPO / "scripts" / "await_window.sh"), "1", "1"],
+            env=env, cwd=str(tmp_path), capture_output=True, text=True,
+            timeout=60)
+        t.join()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "preflight says NOT LIVE" in proc.stdout
+    assert "deferring until it clears" in proc.stdout
+    assert "health verdict cleared" in proc.stdout
+    assert "session-ran" in proc.stdout + (tmp_path / "chip.log").read_text()
 
 
 def test_transient_flap_is_retried_not_fatal(tmp_path):

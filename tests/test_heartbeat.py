@@ -1,0 +1,164 @@
+"""Forward-progress heartbeat (utils/heartbeat.py) + the watchdog's
+exit-4 hang trigger: the two tunnel failure modes the port probe
+cannot see (stalled relay, wedged lease) must fire a prompt,
+artifact-preserving exit instead of a forever-hang."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_reductions.utils import heartbeat
+from tpu_reductions.utils.heartbeat import HANG_EXIT_CODE
+
+
+@pytest.fixture(autouse=True)
+def _fresh_heartbeat():
+    heartbeat.reset()
+    yield
+    heartbeat.reset()
+
+
+def test_guard_marks_in_flight_and_balances():
+    assert heartbeat.snapshot()["in_flight"] is False
+    with heartbeat.guard("staging"):
+        snap = heartbeat.snapshot()
+        assert snap["in_flight"] is True
+        assert snap["phase"] == "staging"
+        assert snap["beats"] >= 1
+    assert heartbeat.snapshot()["in_flight"] is False
+
+
+def test_guards_nest_and_unwind_on_exception():
+    with heartbeat.guard("device"):
+        with heartbeat.guard("compile"):
+            assert heartbeat.snapshot()["phase"] == "compile"
+        assert heartbeat.snapshot()["phase"] == "device"
+        with pytest.raises(RuntimeError):
+            with heartbeat.guard("staging"):
+                raise RuntimeError("boom")
+        # the failed inner guard must not strand its phase
+        assert heartbeat.snapshot()["phase"] == "device"
+    assert heartbeat.snapshot()["in_flight"] is False
+
+
+def test_tick_refreshes_mark_and_relabels_phase():
+    with heartbeat.guard("compile"):
+        time.sleep(0.05)
+        assert heartbeat.snapshot()["age_s"] >= 0.04
+        heartbeat.tick("steady")
+        snap = heartbeat.snapshot()
+        assert snap["age_s"] < 0.04
+        assert snap["phase"] == "steady"
+
+
+def test_tick_outside_guard_is_noop():
+    heartbeat.tick("steady")
+    snap = heartbeat.snapshot()
+    assert snap["beats"] == 0 and snap["in_flight"] is False
+
+
+def test_deadline_env_overrides(monkeypatch):
+    monkeypatch.setenv("TPU_REDUCTIONS_HEARTBEAT_DEADLINE_S", "7")
+    monkeypatch.setenv("TPU_REDUCTIONS_HEARTBEAT_COMPILE_DEADLINE_S", "42")
+    assert heartbeat.deadline_for("steady") == 7.0
+    assert heartbeat.deadline_for(heartbeat.PHASE_COMPILE) == 42.0
+    monkeypatch.delenv("TPU_REDUCTIONS_HEARTBEAT_DEADLINE_S")
+    assert heartbeat.deadline_for(None) == heartbeat.DEFAULT_DEADLINE_S
+
+
+def test_suppress_fault_freezes_the_mark(monkeypatch):
+    """The chaos seam: a scripted {'action': 'suppress'} on
+    heartbeat.tick models a site that keeps looping while its progress
+    marks stop landing — the deterministic way to starve the heartbeat
+    without wall-clock sleeps (faults/inject.py)."""
+    from tpu_reductions.faults import inject
+    monkeypatch.setenv(inject.ENV_VAR,
+                       '{"heartbeat.tick": {"action": "suppress"}}')
+    inject.reset()
+    try:
+        with heartbeat.guard("device"):      # begin's mark: suppressed
+            heartbeat.tick()
+            heartbeat.tick()
+            assert heartbeat.snapshot()["beats"] == 0
+    finally:
+        inject.reset()
+
+
+def test_retry_device_call_runs_under_a_guard():
+    from tpu_reductions.utils.retry import retry_device_call
+
+    seen = {}
+
+    def fn():
+        seen.update(heartbeat.snapshot())
+        return 7
+
+    assert retry_device_call(fn, _tunneled=lambda: False) == 7
+    assert seen["in_flight"] is True and seen["phase"] == "device"
+    assert heartbeat.snapshot()["in_flight"] is False
+
+
+def test_watchdog_hang_trigger_fires_exit4_with_relay_verdict(
+        monkeypatch, capsys):
+    """The tentpole contract: relay probe says ALIVE every cycle
+    (stalled relay / wedged lease look exactly like this), the guarded
+    region goes stale past its deadline -> exit 4 with the port
+    verdict attached to the report."""
+    from tpu_reductions.utils.watchdog import start_relay_watchdog
+
+    monkeypatch.setenv("TPU_REDUCTIONS_HEARTBEAT_DEADLINE_S", "0.05")
+    fired = threading.Event()
+    codes = []
+
+    def fake_exit(code):
+        codes.append(code)
+        fired.set()
+
+    stop = start_relay_watchdog(interval_s=0.02, grace=3,
+                                _probe=lambda: "alive", _exit=fake_exit)
+    assert stop is not None
+    try:
+        # no guard open: several cycles pass without firing
+        time.sleep(0.2)
+        assert not fired.is_set()
+        with heartbeat.guard("device"):
+            assert fired.wait(timeout=5.0)
+    finally:
+        stop.set()
+    assert codes[0] == HANG_EXIT_CODE
+    err = capsys.readouterr().err
+    assert "HANG" in err
+    assert "verdict at fire time: alive" in err
+
+
+def test_watchdog_hang_trigger_respects_compile_deadline(monkeypatch):
+    """A compile-phase guard tolerates the long deadline (the 20-40 s
+    first-Pallas-compile budget): with steady compressed to 50 ms but
+    compile left at 30 s, a stale compile guard must NOT fire."""
+    from tpu_reductions.utils.watchdog import start_relay_watchdog
+
+    monkeypatch.setenv("TPU_REDUCTIONS_HEARTBEAT_DEADLINE_S", "0.05")
+    monkeypatch.setenv("TPU_REDUCTIONS_HEARTBEAT_COMPILE_DEADLINE_S", "30")
+    fired = threading.Event()
+    stop = start_relay_watchdog(interval_s=0.02, grace=3,
+                                _probe=lambda: "alive",
+                                _exit=lambda c: fired.set())
+    assert stop is not None
+    try:
+        with heartbeat.guard(heartbeat.PHASE_COMPILE):
+            time.sleep(0.3)
+            assert not fired.is_set()
+    finally:
+        stop.set()
+
+
+def test_hang_trigger_disabled_by_nonpositive_deadline(monkeypatch):
+    monkeypatch.setenv("TPU_REDUCTIONS_HEARTBEAT_DEADLINE_S", "0")
+    from tpu_reductions.utils.watchdog import _check_hang
+
+    with heartbeat.guard("device"):
+        time.sleep(0.05)
+        _check_hang("alive", None,
+                    lambda c: (_ for _ in ()).throw(
+                        AssertionError("fired with trigger disabled")))

@@ -281,6 +281,61 @@ def test_red010_accepts_jsonio_routes_and_non_artifact_text(tmp_path):
                             name="utils/jsonio.py")) == []
 
 
+# ---------------------------------------------------------------- RED011
+
+
+def test_red011_flags_bare_backend_touch_in_bench_main(tmp_path):
+    src = (
+        "import jax\n"
+        "def main(argv=None):\n"
+        "    backend = jax.default_backend()\n"
+        "    devs = jax.devices()\n"
+        "    return 0\n"
+    )
+    rules = _rules(_lint_src(tmp_path, src, name="bench/fixture.py"))
+    assert rules.count("RED011") == 2
+
+
+def test_red011_accepts_gated_touch_and_non_main_scopes(tmp_path):
+    # gate BEFORE the touch: conforming (the firstrow.py pattern)
+    gated = (
+        "import jax\n"
+        "from tpu_reductions.utils.watchdog import maybe_arm_for_tpu\n"
+        "def main(argv=None):\n"
+        "    maybe_arm_for_tpu()\n"
+        "    return jax.default_backend()\n"
+    )
+    assert "RED011" not in _rules(_lint_src(tmp_path, gated,
+                                            name="bench/fixture.py"))
+    # a touch AFTER main's gate line but in a helper: not a main path
+    helper = (
+        "import jax\n"
+        "def _resolve():\n"
+        "    return jax.default_backend()\n"
+    )
+    assert "RED011" not in _rules(_lint_src(tmp_path, helper,
+                                            name="bench/fixture.py"))
+    # outside bench/: utility modules resolve backends after their
+    # callers gated — the doctrine is scoped to entry points
+    assert "RED011" not in _rules(_lint_src(
+        tmp_path,
+        "import jax\ndef main():\n    return jax.devices()\n",
+        name="utils/fixture.py"))
+
+
+def test_red011_gate_must_precede_the_touch(tmp_path):
+    src = (
+        "import jax\n"
+        "from tpu_reductions.utils.watchdog import maybe_arm_for_tpu\n"
+        "def main(argv=None):\n"
+        "    devs = jax.devices()\n"
+        "    maybe_arm_for_tpu()\n"
+        "    return devs\n"
+    )
+    assert "RED011" in _rules(_lint_src(tmp_path, src,
+                                        name="bench/fixture.py"))
+
+
 # ---------------------------------------------------------------- RED008
 
 
@@ -399,6 +454,9 @@ def test_cli_positive_fixture_per_rule_exits_nonzero(tmp_path):
         "RED008": ("r8.sh", "kill -9 $$\n"),
         "RED010": ("r10.py", "import json\n"
                              'json.dump({}, open("rows.json", "w"))\n'),
+        "RED011": ("bench/r11.py", "import jax\n"
+                                   "def main():\n"
+                                   "    return jax.devices()\n"),
     }
     for rule, (name, src) in fixtures.items():
         f = tmp_path / name

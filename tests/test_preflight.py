@@ -1,0 +1,147 @@
+"""Hang-proof preflight (utils/preflight.py): a sacrificial subprocess
+classifies the chip LIVE / NO_RELAY / STALLED / WEDGED under a hard
+timeout — the parent never blocks on a JAX call, so the classification
+itself can never become the hang it exists to prevent."""
+
+import json
+import time
+
+import pytest
+
+from tpu_reductions.faults import inject
+from tpu_reductions.faults.relay import FakeRelay
+from tpu_reductions.utils import preflight
+from tpu_reductions.utils.jsonio import atomic_json_dump
+
+
+@pytest.fixture
+def tunneled(monkeypatch, tmp_path):
+    """A tunneled environment pointed at a FakeRelay, with an isolated
+    health file; yields the relay."""
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    health = tmp_path / "health.json"
+    with FakeRelay() as relay:
+        monkeypatch.setenv("TPU_REDUCTIONS_RELAY_MARKER", str(marker))
+        monkeypatch.setenv("TPU_REDUCTIONS_RELAY_PORTS", str(relay.port))
+        monkeypatch.setenv("TPU_REDUCTIONS_HEALTH_FILE", str(health))
+        monkeypatch.setenv("TPU_REDUCTIONS_PREFLIGHT_PLATFORM", "cpu")
+        monkeypatch.delenv(inject.ENV_VAR, raising=False)
+        yield relay
+
+
+def test_live_chip_classifies_live(tunneled, monkeypatch):
+    record = preflight.run_preflight(timeout_s=60.0)
+    assert record["verdict"] == preflight.LIVE
+    assert record["relay"] == "alive"
+    # the verdict persisted (atomic, utils/jsonio) and reads back fresh
+    assert preflight.read_health()["verdict"] == preflight.LIVE
+
+
+def test_scripted_wedge_classifies_wedged_without_parent_jax(
+        tunneled, monkeypatch):
+    """The acceptance scenario: the `preflight.probe` fault point fires
+    in the SACRIFICIAL child (before its jax import) and wedges it —
+    exactly what a wedged device lease does to discovery — while the
+    relay services connections normally. The parent classifies WEDGED
+    within the hard timeout, never touching a JAX backend itself."""
+    monkeypatch.setenv(inject.ENV_VAR, json.dumps(
+        {"preflight.probe": {"action": "stall", "seconds": 60}}))
+    t0 = time.monotonic()
+    record = preflight.run_preflight(timeout_s=2.0)
+    assert record["verdict"] == preflight.WEDGED
+    assert record["relay"] == "alive"
+    assert time.monotonic() - t0 < 30   # bounded, never child-duration
+    assert "hung past" in record["detail"]
+
+
+def test_stalled_relay_classifies_stalled(tunneled, monkeypatch):
+    """Ports accept but connections are held unserviced (the relay
+    `stall` behavior): discovery hangs AND the service probe hangs —
+    STALLED, not WEDGED."""
+    tunneled.force("stall")
+    monkeypatch.setenv(inject.ENV_VAR, json.dumps(
+        {"preflight.probe": {"action": "stall", "seconds": 60}}))
+    record = preflight.run_preflight(timeout_s=2.0)
+    assert record["verdict"] == preflight.STALLED
+
+
+def test_dead_relay_classifies_no_relay_without_spawning(tunneled):
+    tunneled.force("refuse")
+    time.sleep(0.15)   # let the listener actually close
+    t0 = time.monotonic()
+    record = preflight.run_preflight(timeout_s=60.0)
+    assert record["verdict"] == preflight.NO_RELAY
+    assert time.monotonic() - t0 < 10   # no discovery subprocess paid
+    assert "not attempted" in record["detail"]
+
+
+def test_read_health_rejects_stale_and_garbage(tmp_path, monkeypatch):
+    health = tmp_path / "health.json"
+    monkeypatch.setenv("TPU_REDUCTIONS_HEALTH_FILE", str(health))
+    assert preflight.read_health() is None          # absent
+    health.write_text("{not json")
+    assert preflight.read_health() is None          # unparseable
+    atomic_json_dump(health, {"verdict": "WEDGED",
+                              "ts": time.time() - 9999})
+    assert preflight.read_health() is None          # stale (TTL)
+    atomic_json_dump(health, {"verdict": "WEDGED", "ts": time.time()})
+    assert preflight.read_health()["verdict"] == "WEDGED"
+
+
+def test_gate_verdict_modes(tmp_path, monkeypatch):
+    health = tmp_path / "health.json"
+    monkeypatch.setenv("TPU_REDUCTIONS_HEALTH_FILE", str(health))
+    atomic_json_dump(health, {"verdict": "STALLED", "ts": time.time()})
+    monkeypatch.delenv("TPU_REDUCTIONS_PREFLIGHT", raising=False)
+    assert preflight.gate_verdict() == "STALLED"    # fresh file answers
+    monkeypatch.setenv("TPU_REDUCTIONS_PREFLIGHT", "0")
+    assert preflight.gate_verdict() is None         # gate disabled
+    # no fresh file + passive default: no discovery subprocess is paid
+    monkeypatch.delenv("TPU_REDUCTIONS_PREFLIGHT", raising=False)
+    health.unlink()
+    assert preflight.gate_verdict() is None
+
+
+def test_maybe_arm_exits_4_on_fresh_wedge_verdict(tmp_path, monkeypatch):
+    """The pre-JAX wedge gate (watchdog.maybe_arm_for_tpu): on the
+    tunneled box with a fresh STALLED/WEDGED health verdict, the first
+    jax call can only hang — exit 4 BEFORE it, unless the run is
+    explicitly forced off-TPU (whose device work never crosses the
+    tunnel)."""
+    import tpu_reductions.utils.watchdog as wd
+
+    health = tmp_path / "health.json"
+    monkeypatch.setenv("TPU_REDUCTIONS_HEALTH_FILE", str(health))
+    atomic_json_dump(health, {"verdict": "WEDGED", "ts": time.time()})
+    monkeypatch.setattr(wd, "tunneled_environment", lambda *a: True)
+    monkeypatch.setattr(wd, "relay_alive", lambda *a, **k: True)
+    monkeypatch.setattr(wd, "_forced_platforms", lambda: "")  # unforced
+    codes = []
+    out = wd.maybe_arm_for_tpu(_exit=lambda c: codes.append(c),
+                               _sleep=lambda s: None)
+    assert out is None
+    assert codes == [wd.HANG_EXIT_CODE]
+
+    # forced off-TPU: the wedge cannot reach a cpu run — proceed
+    monkeypatch.setattr(wd, "_forced_platforms", lambda: "cpu")
+    codes.clear()
+    wd.maybe_arm_for_tpu(_exit=lambda c: codes.append(c),
+                         _sleep=lambda s: None)
+    assert codes == []
+
+
+def test_cli_exit_codes_map_verdicts(tunneled, monkeypatch, capsys):
+    """0=LIVE, 3=NO_RELAY, 4=STALLED/WEDGED — the vocabulary
+    scripts/await_window.sh keys its firing decision on."""
+    assert preflight.main(["--timeout=60"]) == 0
+    tunneled.force("refuse")
+    time.sleep(0.15)
+    assert preflight.main(["--timeout=60"]) == 3
+    tunneled.force("accept")
+    time.sleep(0.3)    # let the refuse-phase listener rebind
+    monkeypatch.setenv(inject.ENV_VAR, json.dumps(
+        {"preflight.probe": {"action": "stall", "seconds": 60}}))
+    assert preflight.main(["--timeout=2"]) == 4
+    out = capsys.readouterr().out
+    assert "preflight: LIVE" in out and "preflight: NO_RELAY" in out

@@ -90,16 +90,86 @@ def _build_payload(cfg: CollectiveConfig, k: int) -> np.ndarray:
     return np.concatenate(blocks)
 
 
+def collective_meta(cfg: CollectiveConfig) -> dict:
+    """The resume contract of one collective invocation (bench/resume.
+    Checkpoint meta): prior rows are reused only when every one of
+    these round-trips identically — a different geometry/discipline
+    never resumes. The payload/verification knobs (seed included: a
+    different payload is a different measurement) all participate.
+
+    No reference analog (TPU-native).
+    """
+    return {"method": cfg.method, "dtype": cfg.dtype, "n": cfg.n,
+            "retries": cfg.retries, "devices": cfg.num_devices,
+            "rooted": cfg.rooted, "mode": cfg.mode,
+            "mapping": cfg.mapping, "timing": cfg.timing,
+            "chain_span": cfg.chain_span, "quantized": cfg.quantized,
+            "seed": cfg.seed}
+
+
+def _result_from_collective_row(row: dict) -> CollectiveResult:
+    """Resurrect a CollectiveResult from a persisted artifact row so
+    resumed rows flow through the same exit-status/report paths as
+    fresh ones (the bench/resume.result_from_row analog for the
+    collective driver). No reference analog (TPU-native)."""
+    return CollectiveResult(
+        row["method"], row["dtype"], row["n"], row["ranks"],
+        row["repeat"], row.get("rooted", "none"),
+        row.get("time_s", 0.0), row.get("reference_gbps", 0.0),
+        row.get("busbw_gbps", 0.0),
+        QAStatus[row.get("status", "FAILED")],
+        row.get("algorithm", "all_reduce"))
+
+
+def _resume_rows(cfg: CollectiveConfig, checkpoint, row_key,
+                 logger: BenchLogger) -> Optional[List[CollectiveResult]]:
+    """Reuse a prior interrupted run's FULL row set for this config
+    (all `retries` rows present and reusable), re-emitting the rank-0
+    row grammar so the stdout-analog job files reconstruct; None means
+    measure fresh. Whole-config grain: chained mode times all reps in
+    one slope call, so per-rep partial resume would re-measure anyway."""
+    key = row_key or (lambda rep: rep)
+    prior = [checkpoint.resume(key(rep)) for rep in range(cfg.retries)]
+    if not prior or not all(r is not None for r in prior):
+        return None
+    logger.log(COLLECTIVE_HEADER)
+    results = []
+    for row in prior:
+        # the row lands in the new artifact unchanged (byte-identical
+        # resume rule, bench/resume.Checkpoint.resume)
+        checkpoint.add(row)
+        gbps = row.get("reference_gbps")
+        if row.get("status") == "PASSED" and gbps:
+            logger.log(collective_row(row["dtype"], row["method"],
+                                      row["ranks"], gbps))
+        results.append(_result_from_collective_row(row))
+    logger.log(f"note: {len(prior)} row(s) resumed from prior artifact "
+               "(interrupted run; rows reused, not re-measured)")
+    return results
+
+
 def run_collective_benchmark(cfg: CollectiveConfig,
-                             logger: Optional[BenchLogger] = None
+                             logger: Optional[BenchLogger] = None,
+                             checkpoint=None, row_key=None
                              ) -> List[CollectiveResult]:
     """Run the {methods} x retries grid on one (dtype, rank-count) mesh —
     one reduce.c process run (the warmup + RETRY_COUNT timed loop,
     reduce.c:61-96).
+
+    `checkpoint` (bench/resume.Checkpoint), when given, persists each
+    row the moment it lands and — when an interrupted prior artifact
+    already holds this config's complete row set — skips the device
+    entirely and reuses it (`row_key(rep)` maps a repeat index to the
+    checkpoint key; default the index itself).
     """
     import jax
 
     logger = logger or BenchLogger(None, None)
+
+    if checkpoint is not None:
+        reused = _resume_rows(cfg, checkpoint, row_key, logger)
+        if reused is not None:
+            return reused
 
     from tpu_reductions.utils.x64 import preserve_x64
 
@@ -114,7 +184,9 @@ def run_collective_benchmark(cfg: CollectiveConfig,
             # x64 promotion semantics can never exist
             # redlint: disable=RED001 -- guarded by _use_dd_planes: this arm never runs on the TPU, where f64 always travels as dd planes
             jax.config.update("jax_enable_x64", True)
-        return _run_collective_benchmark(cfg, logger)
+        return _run_collective_benchmark(cfg, logger,
+                                         checkpoint=checkpoint,
+                                         row_key=row_key)
 
 
 def _use_dd_planes(dtype: str) -> bool:
@@ -130,9 +202,20 @@ def _use_dd_planes(dtype: str) -> bool:
 
 
 def _run_collective_benchmark(cfg: CollectiveConfig,
-                              logger: BenchLogger
+                              logger: BenchLogger,
+                              checkpoint=None, row_key=None
                               ) -> List[CollectiveResult]:
     import jax
+
+    key = row_key or (lambda rep: rep)
+
+    def book(res: CollectiveResult) -> CollectiveResult:
+        # persist-per-row: the row is on disk the moment it exists — a
+        # relay flap mid-sweep loses nothing already measured
+        results.append(res)
+        if checkpoint is not None:
+            checkpoint.add(res.to_dict())
+        return res
 
     from tpu_reductions.parallel.collectives import (
         bandwidth_report, collective_algorithm, dd_ring_algorithm,
@@ -283,7 +366,7 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
                 # measurements (aggregate.collect also drops non-PASSED).
                 logger.log(f"note: rep {rep} slope non-positive "
                            f"(interconnect stall); rep WAIVED")
-                results.append(CollectiveResult(
+                book(CollectiveResult(
                     method, dtype, cfg.n, k, rep, rooted, 0.0, 0.0, 0.0,
                     status if status == QAStatus.FAILED
                     else QAStatus.WAIVED, algorithm))
@@ -292,7 +375,7 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
                                   algorithm=algorithm)
             logger.log(collective_row(dtype, method, k,
                                       bw["reference_gbps"]))
-            results.append(CollectiveResult(
+            book(CollectiveResult(
                 method, dtype, cfg.n, k, rep, rooted, dt,
                 bw["reference_gbps"], bw["busbw_gbps"], status,
                 algorithm))
@@ -315,7 +398,7 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
 
         bw = bandwidth_report(payload_bytes, k, dt, algorithm=algorithm)
         logger.log(collective_row(dtype, method, k, bw["reference_gbps"]))
-        results.append(CollectiveResult(
+        book(CollectiveResult(
             method, dtype, cfg.n, k, rep, rooted, dt,
             bw["reference_gbps"], bw["busbw_gbps"], status, algorithm))
     return results
@@ -488,11 +571,24 @@ def main(argv=None) -> int:
     # promptly instead (utils/watchdog.py; no-op off-TPU)
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
     maybe_arm_for_tpu()
+    # --out: the Checkpoint resume discipline every other --out-writing
+    # entry point already has (bench/resume.py) — rows persisted the
+    # moment they land, an interrupted run's rows reused on
+    # re-invocation under the same contract. Rank-0 only: non-reporting
+    # processes must not race the artifact file.
+    ck = None
+    if cfg.out and reporting:
+        from tpu_reductions.bench.resume import Checkpoint
+        ck = Checkpoint(cfg.out, collective_meta(cfg),
+                        key_fn=lambda r: r.get("repeat"))
     try:
-        results = run_collective_benchmark(cfg, logger=logger)
+        results = run_collective_benchmark(cfg, logger=logger,
+                                           checkpoint=ck)
     except Exception as e:  # fail-fast with the QA protocol intact
         logger.log(f"error: {type(e).__name__}: {e}")
         return qa_finish(name, QAStatus.FAILED, out=qa_out)
+    if ck is not None:
+        ck.finalize()
     # WAIVED rows (noise-swamped chained slopes, unsupported combos) are
     # not failures — same tolerance as the single-chip shmoo exit
     ok = all(r.passed or r.status == QAStatus.WAIVED for r in results)

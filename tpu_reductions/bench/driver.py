@@ -30,6 +30,7 @@ from tpu_reductions.config import (KERNEL_MXU, KERNEL_SINGLE_PASS,
 from tpu_reductions.faults.inject import fault_point
 from tpu_reductions.ops import oracle as oracle_mod
 from tpu_reductions.ops.registry import tolerance
+from tpu_reductions.utils import heartbeat
 from tpu_reductions.utils.logging import BenchLogger, throughput_line
 from tpu_reductions.utils.qa import QAStatus
 from tpu_reductions.utils.rng import host_data
@@ -309,8 +310,11 @@ class _PendingResult:
         import jax
         cfg = self.cfg
         status = QAStatus.PASSED
-        dev_val = float(np.asarray(jax.device_get(self.result),
-                                   dtype=np.float64))
+        # post-fetch this materialization pays real execution + tunnel
+        # latency; guard it so a stall here draws exit 4, not a hang
+        with heartbeat.guard("fetch"):
+            dev_val = float(np.asarray(jax.device_get(self.result),
+                                       dtype=np.float64))
         host_val = float("nan")
         diff = float("nan")
         if cfg.verify:
@@ -507,7 +511,11 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
                                timing=cfg.timing)
 
     stage_fn, reduce_fn = _make_device_fn(cfg, backend)
-    x_dev = jax.block_until_ready(stage_fn(x_np))   # H2D + pad, untimed
+    # H2D + pad, untimed; compile-phase guard: the first staging call
+    # builds its insert/pad executables (big payloads additionally tick
+    # per chunk inside utils/staging.py)
+    with heartbeat.guard(heartbeat.PHASE_COMPILE):
+        x_dev = jax.block_until_ready(stage_fn(x_np))
 
     if cfg.trace_dir:
         # jax.profiler capture of the hot loop (SURVEY.md §5 tracing)
@@ -544,7 +552,11 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
                                timing="chained",
                                slope_samples_s=list(
                                    getattr(sw, "samples", []) or []))
-        result = reduce_fn(x_dev)   # untimed — the verification value
+        # untimed — the verification value. First use of the UNchained
+        # executable, so this dispatch can legitimately block on a
+        # compile: label the guard accordingly (utils/heartbeat.py)
+        with heartbeat.guard(heartbeat.PHASE_COMPILE):
+            result = reduce_fn(x_dev)
     else:
         result, sw = time_fn(reduce_fn, x_dev, iterations=cfg.iterations,
                              warmup=max(cfg.warmup, 1), mode=timing_mode)

@@ -183,7 +183,15 @@ def sweep_collective(*, rank_counts=(2, 4, 8), methods=("MAX", "MIN", "SUM"),
     analog (sbatch --nodes {32,128,512}, mpi/submit_all.sh:3-4), with the
     reference's op order (MAX, MIN, SUM — reduce.c:73) and RETRY_COUNT
     repeats. Writes per-"job" row files into out_dir/raw_output, the
-    stdout-vn-<jobid> analog, ready for aggregate.pipeline()."""
+    stdout-vn-<jobid> analog, ready for aggregate.pipeline().
+
+    Interruption-proof (bench/resume.Checkpoint): with an out_dir, every
+    row persists to out_dir/collective_sweep.json the moment it lands,
+    and a re-invocation over an INTERRUPTED sweep resumes its
+    per-rank-count rows (whole-config grain, keyed (ranks, dtype,
+    method, repeat)) instead of restarting the 2..1024 ladder — the
+    resume contract every other --out-writing entry point already has;
+    a completed sweep re-measures fresh, as everywhere."""
     from tpu_reductions.bench.collective_driver import run_collective_benchmark
     from tpu_reductions.config import CollectiveConfig
 
@@ -191,11 +199,26 @@ def sweep_collective(*, rank_counts=(2, 4, 8), methods=("MAX", "MIN", "SUM"),
     raw_dir = Path(out_dir) / "raw_output" if out_dir else None
     if raw_dir:
         raw_dir.mkdir(parents=True, exist_ok=True)
+    ck = None
+    if out_dir:
+        from tpu_reductions.bench.resume import Checkpoint
+        # rank/dtype/method live in the row KEY, not the meta: a sweep
+        # re-invoked with a different rank list must still reuse the
+        # rank counts it shares with the interrupted run
+        ck = Checkpoint(Path(out_dir) / "collective_sweep.json",
+                        {"n": n, "retries": retries, "rooted": rooted,
+                         "mode": mode, "mapping": mapping,
+                         "timing": timing, "chain_span": chain_span},
+                        key_fn=lambda r: (r.get("ranks"), r.get("dtype"),
+                                          r.get("method"),
+                                          r.get("repeat")))
     rows = []
     for k in rank_counts:
         # per-job logger writing the stdout-<mode>-<jobid> analog: the
         # driver itself emits the header + rows, exactly like the real
-        # per-job stdout (aggregate.collect skips the header row)
+        # per-job stdout (aggregate.collect skips the header row); on a
+        # resumed sweep the driver re-emits reused rows, so the
+        # (truncated-on-open) job file always reconstructs completely
         job_logger = BenchLogger(
             str(raw_dir / f"stdout-{mode}-{k}ranks.txt") if raw_dir else None,
             None, console=logger.console)
@@ -206,8 +229,13 @@ def sweep_collective(*, rank_counts=(2, 4, 8), methods=("MAX", "MIN", "SUM"),
                                        rooted=rooted, mode=mode,
                                        mapping=mapping, timing=timing,
                                        chain_span=chain_span)
-                for res in run_collective_benchmark(cfg, logger=job_logger):
+                for res in run_collective_benchmark(
+                        cfg, logger=job_logger, checkpoint=ck,
+                        row_key=lambda rep, _k=k, _d=cfg.dtype,
+                        _m=cfg.method: (_k, _d, _m, rep)):
                     rows.append(res.to_dict())
+    if ck is not None:
+        ck.finalize()
     return rows
 
 

@@ -184,6 +184,9 @@ class CollectiveConfig:
     coordinator: Optional[str] = None   # host0 address, e.g. "10.0.0.1:8476"
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    out: Optional[str] = None        # --out artifact (bench/resume
+    #                                  Checkpoint: per-repeat rows,
+    #                                  persist-per-row + resume)
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
@@ -455,6 +458,11 @@ def build_collective_parser() -> argparse.ArgumentParser:
                    default=None,
                    help="Multi-host: this process's id in [0, "
                         "num_processes)")
+    p.add_argument("--out", type=str, default=None,
+                   help="JSON artifact path (bench/resume.Checkpoint "
+                        "shape: rows persisted the moment they land; "
+                        "an interrupted run resumes them on "
+                        "re-invocation under the same contract)")
     return p
 
 
@@ -471,5 +479,5 @@ def parse_collective(argv=None) -> CollectiveConfig:
         qatest=ns.qatest, timing=ns.timing, chain_span=ns.chain_span,
         quantized=ns.quantized,
         coordinator=ns.coordinator, num_processes=ns.num_processes,
-        process_id=ns.process_id,
+        process_id=ns.process_id, out=ns.out,
     )

@@ -22,13 +22,19 @@ Plan entry fields:
                           os._exit can end it, which is the point
              exit         os._exit(`code`, default 1) — a SIGKILL-class
                           death mid-persist (the jsonio atomicity test)
-             dead / inconclusive / anything else — no side effect; the
-                          spec dict is returned for the caller to
-                          interpret (the watchdog probe loop maps
-                          "dead"/"inconclusive" onto probe verdicts)
+             dead / inconclusive / suppress / anything else — no side
+                          effect; the spec dict is returned for the
+                          caller to interpret (the watchdog probe loop
+                          maps "dead"/"inconclusive" onto probe
+                          verdicts; the heartbeat maps "suppress" onto
+                          a frozen progress mark — utils/heartbeat.py)
 
 Registered fault points: `watchdog.probe`, `staging.chunk`,
-`chain.step`, `bench.run` (docs/RESILIENCE.md keeps the list).
+`chain.step`, `bench.run`, `heartbeat.tick` (every progress mark,
+utils/heartbeat.py), `preflight.probe` (fired in the sacrificial
+discovery subprocess BEFORE its jax import — a scripted `stall` there
+is how a wedged device lease is rehearsed without a device,
+utils/preflight.py). docs/RESILIENCE.md keeps the list.
 
 Counters are process-global and monotonic; `reset()` re-arms them for
 in-process tests (subprocesses start fresh by construction).

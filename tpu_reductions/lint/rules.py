@@ -1,4 +1,5 @@
-"""redlint Python rules RED001-RED007 + RED010 — one AST walk per file.
+"""redlint Python rules RED001-RED007 + RED010/RED011 — one AST walk
+per file.
 
 Each rule encodes one CLAUDE.md "hard-won environment fact" (or the
 SURVEY.md §5 output-row contract) as a static check; docs/LINT.md maps
@@ -144,6 +145,7 @@ def check_python(rel_posix: str, source: str) -> List[RawFinding]:
     out += _red006(rel_posix, ctx)
     out += _red007(rel_posix, ctx)
     out += _red010(rel_posix, ctx)
+    out += _red011(rel_posix, ctx)
     # nested timing scopes can double-report the same call site
     return sorted(set(out), key=lambda f: (f.line, f.rule, f.message))
 
@@ -460,4 +462,52 @@ def _red010(rel: str, ctx: _FileContext) -> List[RawFinding]:
                     "an in-place truncating write destroys the rows "
                     "persisted so far; use utils.jsonio."
                     "atomic_json_dump or bench/resume.store_cell"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED011 — bare first JAX backend touch in a bench/ entry-point main
+# path. On the tunneled box jax.devices() / jax.default_backend() can
+# hang FOREVER — a dead relay hangs backend init, and a stalled relay /
+# wedged device lease hang it while the ports still answer (the hangs
+# the port probe cannot see). Entry points must run the pre-JAX gates
+# first: utils.watchdog.maybe_arm_for_tpu (pure-socket dead-relay gate
+# + health-file wedge gate + the armed watchdog) or utils.preflight
+# (sacrificial-subprocess discovery under a hard timeout).
+# --------------------------------------------------------------------------
+
+_BACKEND_TOUCHES = {"jax.devices", "jax.default_backend"}
+_PREGATE_NAMES = {"maybe_arm_for_tpu", "run_preflight", "gate_verdict"}
+
+
+def _red011(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    parts = rel.split("/")
+    if "bench" not in parts[:-1]:
+        return []
+    out = []
+    for fn in ctx.tree.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or fn.name != "main":
+            continue
+        gate_line = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = _attr_chain(node.func).rsplit(".", 1)[-1]
+                if name in _PREGATE_NAMES and (gate_line is None
+                                               or node.lineno < gate_line):
+                    gate_line = node.lineno
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain in _BACKEND_TOUCHES and (gate_line is None
+                                              or node.lineno < gate_line):
+                out.append(RawFinding(
+                    "RED011", node.lineno,
+                    f"bare {chain}() in a bench entry-point main path — "
+                    "on the tunneled box backend discovery hangs forever "
+                    "under a dead/stalled relay or a wedged lease; call "
+                    "utils.watchdog.maybe_arm_for_tpu (or run the "
+                    "utils.preflight gate) BEFORE the first backend "
+                    "touch"))
     return out

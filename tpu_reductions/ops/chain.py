@@ -22,6 +22,14 @@ lookup, host sync — leaving the true per-iteration device time. The
 slope estimator is valid on honest platforms too (it is just amortized
 timing), so it is the portable default for bandwidth numbers.
 
+Liveness: a chained trip's host-visible boundary is the materialization
+that bounds it — the fori_loop body is traced once and its iterations
+never re-enter Python, so the forward-progress heartbeat for chained
+execution ticks at `utils/timing.time_chained`'s per-trip fetch (one
+heartbeat guard per trip, 'compile' phase for the first). A trip
+stranded by a stalled relay therefore goes heartbeat-stale and draws
+the watchdog's exit 4 (utils/heartbeat.py) instead of hanging forever.
+
 Mechanism: the staged (rows, 128) array is the `lax.fori_loop` carry;
 each step reduces it, then folds the step's scalar into element [0, 0]
 with the op's own combine (a one-element dynamic-update on a loop-carried

@@ -1,0 +1,155 @@
+"""Forward-progress heartbeat — liveness keyed on work, not ports.
+
+PR 2's watchdog (utils/watchdog.py) detects exactly one of the tunnel's
+three failure modes: a DEAD relay (TCP refuse -> exit 3). The other two
+— a STALLED relay (ports accept, nothing is serviced; faults/relay.py's
+`stall` behavior) and a WEDGED device lease (jax.devices() hangs
+machine-wide while the relay answers) — keep the port probe green while
+every device wait hangs forever, which is precisely the row-losing
+outcome the watchdog exists to prevent. The reference's fail-fast layer
+(the per-call CUDA error check, cutil_inline_runtime.h:34-44) assumed
+failures are loud; this platform's worst failures are silent.
+
+This module is the shared progress mark every device-touching site
+ticks:
+
+  * `guard(phase)` wraps ONE blocking device region (the retry
+    wrapper's guarded call, utils/retry.py; the staging chunk loop,
+    utils/staging.py; chained-trip materializations,
+    utils/timing.time_chained). Entering and leaving both count as
+    progress; while at least one guard is open the region is WATCHED.
+  * `tick(phase=None)` refreshes the mark from inside a long guarded
+    loop (per staged chunk, per timed iteration, per slope sample) and
+    may relabel the current phase ("compile" -> "steady" once the first
+    executable is built).
+  * The watchdog (utils/watchdog.py) reads `snapshot()` every probe
+    cycle: a guarded region whose mark is older than the phase's
+    deadline fires `os._exit(HANG_EXIT_CODE)` (4 — distinct from the
+    dead-relay exit 3) with the relay-port verdict attached, so a
+    postmortem can tell stall-with-live-ports from dead.
+
+Phase-aware deadlines: the first Pallas compile through the tunnel
+takes 20-40 s (CLAUDE.md), so the "compile" phase tolerates
+TPU_REDUCTIONS_HEARTBEAT_COMPILE_DEADLINE_S (default 300 s); every
+other phase gets TPU_REDUCTIONS_HEARTBEAT_DEADLINE_S (default 120 s;
+<= 0 disables the hang trigger entirely). Host-only work between
+guards is deliberately unwatched — an oracle recompute can take
+minutes without ever being able to hang on the tunnel.
+
+Chaos seam: every mark update consults the `heartbeat.tick` fault
+point (faults/inject.py). A passive `{"action": "suppress"}` spec
+freezes the mark while the site keeps looping — the deterministic way
+tests starve the heartbeat without wall-clock sleeps; `raise`/`stall`
+fire at the mark site like at any other point.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import List, Optional
+
+from tpu_reductions.faults.inject import fault_point
+
+# distinct from the dead-relay WATCHDOG_EXIT_CODE (3): exit 4 means the
+# process was making no forward progress while the relay PORTS still
+# answered (stalled relay or wedged lease)
+HANG_EXIT_CODE = 4
+
+PHASE_COMPILE = "compile"
+DEFAULT_DEADLINE_S = 120.0
+DEFAULT_COMPILE_DEADLINE_S = 300.0
+
+_lock = threading.Lock()
+_depth = 0
+_phases: List[str] = []
+_mark: Optional[float] = None   # monotonic time of the last progress
+_beats = 0
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def deadline_for(phase: Optional[str]) -> float:
+    """The staleness budget for `phase` (seconds; <= 0 disables the
+    hang trigger). 'compile' tolerates the 20-40 s first-Pallas-compile
+    tunnel cost; everything else is steady-state."""
+    if phase == PHASE_COMPILE:
+        return _env_float("TPU_REDUCTIONS_HEARTBEAT_COMPILE_DEADLINE_S",
+                          DEFAULT_COMPILE_DEADLINE_S)
+    return _env_float("TPU_REDUCTIONS_HEARTBEAT_DEADLINE_S",
+                      DEFAULT_DEADLINE_S)
+
+
+def _touch(phase: Optional[str] = None) -> None:
+    """One progress mark; the chaos seam (module docstring) can
+    suppress it."""
+    global _mark, _beats
+    spec = fault_point("heartbeat.tick")
+    if spec is not None and spec.get("action") == "suppress":
+        return
+    with _lock:
+        if phase is not None and _phases:
+            _phases[-1] = phase
+        _mark = time.monotonic()
+        _beats += 1
+
+
+def tick(phase: Optional[str] = None) -> None:
+    """Record forward progress from inside a guarded loop; `phase`
+    relabels the current guard (e.g. 'compile' -> 'steady' once the
+    first executable exists). A tick outside any guard is a no-op —
+    only explicitly guarded device regions are watched."""
+    with _lock:
+        if _depth == 0:
+            return
+    _touch(phase)
+
+
+@contextlib.contextmanager
+def guard(phase: str):
+    """Watch one blocking device region: entering arms the hang
+    trigger for this region (entry and exit both count as progress);
+    guards nest (retry wraps a benchmark whose staging opens its
+    own)."""
+    global _depth, _mark, _beats
+    with _lock:
+        _depth += 1
+        _phases.append(phase)
+    _touch()
+    try:
+        yield
+    finally:
+        with _lock:
+            _depth = max(0, _depth - 1)
+            if _phases:
+                _phases.pop()
+            _mark = time.monotonic()
+            _beats += 1
+
+
+def snapshot() -> dict:
+    """The watchdog's read: {in_flight, age_s, phase, beats}. age_s is
+    time since the last progress mark (0.0 when nothing ever ticked)."""
+    with _lock:
+        in_flight = _depth > 0
+        phase = _phases[-1] if _phases else None
+        age = (time.monotonic() - _mark) if _mark is not None else 0.0
+        return {"in_flight": in_flight, "age_s": age,
+                "phase": phase, "beats": _beats}
+
+
+def reset() -> None:
+    """Clear all state (in-process tests; subprocesses start fresh)."""
+    global _depth, _mark, _beats
+    with _lock:
+        _depth = 0
+        _phases.clear()
+        _mark = None
+        _beats = 0

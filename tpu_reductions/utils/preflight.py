@@ -1,0 +1,266 @@
+"""Hang-proof chip preflight — classify the tunnel BEFORE the first
+JAX backend touch.
+
+On the tunneled box, `jax.devices()` itself can hang forever: a dead
+relay hangs it (utils/watchdog.py's pre-JAX socket gate catches that),
+but so do a STALLED relay (ports accept, nothing is serviced —
+faults/relay.py's `stall` behavior) and a WEDGED device lease
+(machine-wide: every process's discovery hangs while the relay
+answers). Both are invisible to a TCP probe, so the main process must
+never be the one to find out — a SACRIFICIAL subprocess runs device
+discovery under a hard timeout instead, and the parent classifies the
+outcome without ever importing a backend:
+
+    LIVE      discovery completed within the timeout
+    NO_RELAY  relay ports refuse (dead relay — exit-3 territory)
+    STALLED   discovery hung and a relay connection is accepted but
+              never serviced (held open, no bytes, no close)
+    WEDGED    discovery hung while the relay services connections
+              normally — the lease itself is stuck
+
+The service probe that splits STALLED from WEDGED connects and waits
+briefly for any response: a healthy relay closes (or answers) the
+probe connection; a stalled one holds it silently — exactly the
+accept-vs-stall split faults/relay.py implements, so the chaos suite
+exercises this classification for real.
+
+The verdict is persisted atomically (utils/jsonio) to a health file
+(TPU_REDUCTIONS_HEALTH_FILE, default `.chip_health.json`, freshness
+TPU_REDUCTIONS_HEALTH_TTL_S, default 300 s) that
+`watchdog.maybe_arm_for_tpu` gates on pre-JAX and the shell
+supervisors (`scripts/await_window.sh`, `scripts/supervise_watcher.sh`)
+consume — so a wedged lease stops the polling loop from spawning
+hang-forever sessions and the incident lands in the watch log instead
+of as silence.
+
+Chaos seam: the sacrificial child calls the `preflight.probe` fault
+point (faults/inject.py) BEFORE importing jax — a scripted
+`{"action": "stall"}` wedges the child exactly like a wedged lease
+would, without any device, and the parent classifies it under a fake
+relay while never blocking on a JAX call itself. The child honors
+TPU_REDUCTIONS_PREFLIGHT_PLATFORM to force its discovery platform
+(rehearsals force `cpu`).
+
+CLI (hang-proof by construction; exit 0=LIVE, 3=NO_RELAY,
+4=STALLED/WEDGED):
+
+    python -m tpu_reductions.utils.preflight [--timeout=S] \
+        [--health-file=PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+from tpu_reductions.utils.jsonio import atomic_json_dump
+from tpu_reductions.utils.watchdog import (probe_relay, resolved_ports,
+                                           tunneled_environment)
+
+LIVE = "LIVE"
+NO_RELAY = "NO_RELAY"
+STALLED = "STALLED"
+WEDGED = "WEDGED"
+
+DEFAULT_TIMEOUT_S = 60.0
+DEFAULT_HEALTH_FILE = ".chip_health.json"
+DEFAULT_HEALTH_TTL_S = 300.0
+
+# The sacrificial discovery program. The fault point fires FIRST so a
+# scripted wedge never needs jax at all; the platform override is the
+# rehearsal seam (jax.config, not JAX_PLATFORMS — the axon plugin
+# ignores the env var, CLAUDE.md).
+_CHILD_PROG = """\
+import os
+from tpu_reductions.faults.inject import fault_point
+fault_point("preflight.probe")
+import jax
+plat = os.environ.get("TPU_REDUCTIONS_PREFLIGHT_PLATFORM")
+if plat:
+    jax.config.update("jax_platforms", plat)
+print("backend=%s devices=%d" % (jax.default_backend(),
+                                 len(jax.devices())), flush=True)
+"""
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def health_file_path(path: Optional[str] = None) -> str:
+    """The health-file location: explicit argument, then the
+    TPU_REDUCTIONS_HEALTH_FILE env override, then `.chip_health.json`
+    in the cwd (the repo root for every supervisor/entry point)."""
+    if path is not None:
+        return os.fspath(path)
+    return os.environ.get("TPU_REDUCTIONS_HEALTH_FILE",
+                          DEFAULT_HEALTH_FILE)
+
+
+def _service_probe(ports: Optional[Sequence[int]] = None,
+                   host: str = "127.0.0.1",
+                   connect_timeout_s: float = 2.0,
+                   service_timeout_s: float = 2.0) -> str:
+    """'serviced' | 'held' | 'refused': connect to a relay port and
+    wait briefly for ANY response. A live relay process closes (EOF)
+    or answers the probe connection; a stalled one accepts and holds
+    it silently — the split between WEDGED and STALLED."""
+    for port in resolved_ports(ports):
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=connect_timeout_s) as s:
+                s.settimeout(service_timeout_s)
+                try:
+                    s.recv(1)          # EOF or bytes both mean serviced
+                    return "serviced"
+                except socket.timeout:
+                    return "held"
+        except OSError:
+            continue
+    return "refused"
+
+
+def run_preflight(timeout_s: Optional[float] = None,
+                  health_file: Optional[str] = None,
+                  ports: Optional[Sequence[int]] = None) -> dict:
+    """Run one sacrificial-subprocess discovery and classify the chip;
+    the parent never touches a JAX backend, so this can NEVER hang past
+    `timeout_s` (+ a bounded kill grace). Persists and returns the
+    verdict record {verdict, relay, elapsed_s, ts, detail}."""
+    timeout_s = timeout_s if timeout_s is not None else _env_float(
+        "TPU_REDUCTIONS_PREFLIGHT_TIMEOUT_S", DEFAULT_TIMEOUT_S)
+    t0 = time.monotonic()
+    tunneled = tunneled_environment()
+    relay = probe_relay(ports) if tunneled else "untunneled"
+    if tunneled and relay == "dead":
+        # a refusing relay cannot serve discovery; no child needed —
+        # and spawning one would just burn the timeout confirming it
+        return _persist(health_file, NO_RELAY, relay,
+                        time.monotonic() - t0,
+                        "relay ports refuse; discovery not attempted")
+
+    proc = subprocess.Popen([sys.executable, "-c", _CHILD_PROG],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        if proc.returncode == 0:
+            return _persist(health_file, LIVE, relay,
+                            time.monotonic() - t0, out.strip())
+        detail = (f"discovery subprocess exited rc={proc.returncode}: "
+                  f"{err.strip()[-300:]}")
+    except subprocess.TimeoutExpired:
+        # the child is sacrificial BY DESIGN: its only in-flight work
+        # is discovery itself, so killing it cannot orphan a device
+        # queue (the CLAUDE.md wedge needs queued work, which a hung
+        # discovery never reached)
+        proc.terminate()
+        try:
+            proc.communicate(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        detail = f"discovery subprocess hung past {timeout_s:.1f}s"
+    verdict = _classify_hang(ports, tunneled)
+    return _persist(health_file, verdict, relay,
+                    time.monotonic() - t0, detail)
+
+
+def _classify_hang(ports, tunneled: bool) -> str:
+    """A discovery that hung (or died abnormally): split by what the
+    relay does with a fresh connection (module docstring)."""
+    if not tunneled:
+        return WEDGED        # no relay to blame; the backend is stuck
+    service = _service_probe(ports)
+    if service == "refused":
+        return NO_RELAY      # relay died under the child
+    return STALLED if service == "held" else WEDGED
+
+
+def _persist(health_file: Optional[str], verdict: str, relay: str,
+             elapsed_s: float, detail: str) -> dict:
+    record = {"verdict": verdict, "relay": relay,
+              "elapsed_s": round(elapsed_s, 2), "ts": time.time(),
+              "detail": detail}
+    atomic_json_dump(health_file_path(health_file), record)
+    return record
+
+
+def read_health(path: Optional[str] = None,
+                ttl_s: Optional[float] = None) -> Optional[dict]:
+    """The persisted verdict record iff it exists, parses, and is
+    fresh (ts within TPU_REDUCTIONS_HEALTH_TTL_S); None otherwise — a
+    stale verdict must never veto a later window (the relay flaps back
+    in minutes, CLAUDE.md)."""
+    import json
+    ttl_s = ttl_s if ttl_s is not None else _env_float(
+        "TPU_REDUCTIONS_HEALTH_TTL_S", DEFAULT_HEALTH_TTL_S)
+    try:
+        with open(health_file_path(path)) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(record, dict):
+        return None
+    ts = record.get("ts")
+    if not isinstance(ts, (int, float)) or time.time() - ts > ttl_s:
+        return None
+    return record
+
+
+def gate_verdict() -> Optional[str]:
+    """The verdict `watchdog.maybe_arm_for_tpu` gates on pre-JAX:
+    TPU_REDUCTIONS_PREFLIGHT=0 disables the gate entirely; a fresh
+    health file answers for free; TPU_REDUCTIONS_PREFLIGHT=1 runs an
+    active preflight when no fresh verdict exists (the default is
+    passive — file-only — so --platform=cpu entry points never pay a
+    discovery subprocess)."""
+    mode = os.environ.get("TPU_REDUCTIONS_PREFLIGHT")
+    if mode == "0":
+        return None
+    record = read_health()
+    if record is not None:
+        return record.get("verdict")
+    if mode == "1":
+        return run_preflight().get("verdict")
+    return None
+
+
+def main(argv=None) -> int:
+    """CLI used by scripts/await_window.sh before firing a chip
+    session: hang-proof by construction; prints one verdict line and
+    exits 0 (LIVE), 3 (NO_RELAY — dead-relay territory) or 4
+    (STALLED/WEDGED — hang territory)."""
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.utils.preflight",
+        description="Hang-proof pre-JAX chip preflight "
+                    "(sacrificial-subprocess device discovery)")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="discovery hard timeout in seconds (default "
+                        "TPU_REDUCTIONS_PREFLIGHT_TIMEOUT_S or 60)")
+    p.add_argument("--health-file", default=None,
+                   help="verdict file (default TPU_REDUCTIONS_HEALTH_"
+                        "FILE or .chip_health.json)")
+    ns = p.parse_args(argv)
+    record = run_preflight(timeout_s=ns.timeout,
+                           health_file=ns.health_file)
+    print(f"preflight: {record['verdict']} (relay {record['relay']}, "
+          f"{record['elapsed_s']:.1f}s) — {record['detail']}",
+          flush=True)
+    if record["verdict"] == LIVE:
+        return 0
+    if record["verdict"] == NO_RELAY:
+        return 3
+    return 4
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,7 @@ import os
 import time
 from typing import Callable, Optional
 
+from tpu_reductions.utils import heartbeat
 from tpu_reductions.utils.watchdog import relay_alive, tunneled_environment
 
 DEFAULT_RETRIES = 2
@@ -52,19 +53,26 @@ def retry_budget(retries: Optional[int] = None) -> int:
 def retry_device_call(fn: Callable, *, retries: Optional[int] = None,
                       backoff_s: float = DEFAULT_BACKOFF_S,
                       log=None, _sleep=time.sleep,
-                      _tunneled=None, _alive=None):
+                      _tunneled=None, _alive=None,
+                      phase: str = "device"):
     """Call `fn()`; on failure, classify (module docstring) and either
     re-raise (fatal/deterministic) or back off exponentially and retry
     (transient flap). The LAST failure is always re-raised so callers'
     crash containment sees the real error. `_tunneled`/`_alive` are
-    injectable probes for tests."""
+    injectable probes for tests.
+
+    The guarded call runs under a heartbeat guard (utils/heartbeat.py,
+    labeled `phase`): a call that blocks forever on a stalled relay or
+    wedged lease — a hang the relay-port probe reports healthy — is
+    the watchdog's exit-4 territory, not a retryable error."""
     tunneled = _tunneled or tunneled_environment
     alive = _alive or relay_alive
     budget = retry_budget(retries)
     attempt = 0
     while True:
         try:
-            return fn()
+            with heartbeat.guard(phase):
+                return fn()
         except Exception as e:
             if not tunneled():
                 raise            # deterministic off-tunnel error

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_reductions.faults.inject import fault_point
+from tpu_reductions.utils import heartbeat
 
 # Per-message bound. 2 GiB messages survived the tunnel, 4 GiB killed
 # it twice; 256 MiB keeps a wide margin while adding only ~16 messages
@@ -83,21 +84,27 @@ def device_put_chunked(flat: np.ndarray, rows: int, lanes: int,
 
     full_rows = flat.size // lanes
     row_step = max(1, chunk_bytes // (lanes * flat.dtype.itemsize))
-    for r in range(0, full_rows, row_step):
-        # chaos hook: the round-2 killer was a relay death mid-payload
-        # — an injected fault here rehearses that exact interruption
-        # point (faults/inject.py; tests/test_staging.py proves no
-        # partially-staged buffer survives it)
-        fault_point("staging.chunk")
-        k = min(row_step, full_rows - r)
-        chunk = np.ascontiguousarray(
-            flat[r * lanes:(r + k) * lanes]).reshape(k, lanes)
-        buf = insert(buf, jax.device_put(chunk), jnp.int32(r))
-    tail = flat[full_rows * lanes:]
-    if tail.size:
-        last = np.full((1, lanes), identity, dtype=flat.dtype)
-        last[0, :tail.size] = tail
-        buf = insert(buf, jax.device_put(last), jnp.int32(full_rows))
+    # heartbeat guard: a chunk transfer stranded by a stalled relay is
+    # the hang the watchdog's port probe cannot see — each staged chunk
+    # ticks forward progress so only a genuinely stuck transfer goes
+    # stale (utils/heartbeat.py; watchdog exit 4)
+    with heartbeat.guard("staging"):
+        for r in range(0, full_rows, row_step):
+            # chaos hook: the round-2 killer was a relay death mid-
+            # payload — an injected fault here rehearses that exact
+            # interruption point (faults/inject.py; tests/test_staging.
+            # py proves no partially-staged buffer survives it)
+            fault_point("staging.chunk")
+            k = min(row_step, full_rows - r)
+            chunk = np.ascontiguousarray(
+                flat[r * lanes:(r + k) * lanes]).reshape(k, lanes)
+            buf = insert(buf, jax.device_put(chunk), jnp.int32(r))
+            heartbeat.tick()
+        tail = flat[full_rows * lanes:]
+        if tail.size:
+            last = np.full((1, lanes), identity, dtype=flat.dtype)
+            last[0, :tail.size] = tail
+            buf = insert(buf, jax.device_put(last), jnp.int32(full_rows))
     return buf
 
 

@@ -24,6 +24,7 @@ from typing import Callable, Dict, Optional
 import jax
 
 from tpu_reductions.faults.inject import fault_point
+from tpu_reductions.utils import heartbeat
 
 
 @dataclass
@@ -122,15 +123,21 @@ def time_fn(fn: Callable, *args, iterations: int = 100, warmup: int = 1,
         raise ValueError(f"unknown timing mode {mode!r}")
     sw = stopwatch or Stopwatch()
     result = None
-    for _ in range(warmup):
-        result = jax.block_until_ready(fn(*args))
+    # warm-up is where the executable gets built: the first launch can
+    # legitimately block 20-40 s on a tunnel compile, so its heartbeat
+    # phase is 'compile' (the long deadline); the timed loop below is
+    # steady-state (utils/heartbeat.py)
+    with heartbeat.guard(heartbeat.PHASE_COMPILE):
+        for _ in range(warmup):
+            result = jax.block_until_ready(fn(*args))
 
     if mode == "bulk":
-        sw.start()
-        for _ in range(iterations):
-            result = fn(*args)
-        jax.block_until_ready(result)
-        sw.stop()  # booked the whole span as one session...
+        with heartbeat.guard("bulk"):
+            sw.start()
+            for _ in range(iterations):
+                result = fn(*args)
+            jax.block_until_ready(result)
+            sw.stop()  # booked the whole span as one session...
         # ...rebook it as `iterations` sessions so average_s is
         # per-iteration, preserving anything accumulated before this call.
         # The span is NOT a per-iteration sample: drop it so median_s
@@ -139,12 +146,14 @@ def time_fn(fn: Callable, *args, iterations: int = 100, warmup: int = 1,
         sw.samples.pop()
         return result, sw
 
-    for _ in range(iterations):
-        sw.start()
-        result = jax.block_until_ready(fn(*args))
-        if mode == "fetch":
-            jax.device_get(result)  # full host materialization round-trip
-        sw.stop()
+    with heartbeat.guard(mode):
+        for _ in range(iterations):
+            sw.start()
+            result = jax.block_until_ready(fn(*args))
+            if mode == "fetch":
+                jax.device_get(result)  # full host materialization trip
+            sw.stop()
+            heartbeat.tick()
     return result, sw
 
 
@@ -175,14 +184,24 @@ def time_chained(chained_fn, x, k_lo: int, k_hi: int, reps: int = 5,
     # rejects arrays with non-addressable shards
     fetch = materialize or jax.device_get
 
+    trips = 0
+
     def run(k) -> float:
         # chaos hook: every chained sample blocks on a host
         # materialization through the tunnel — the exact wait a relay
-        # flap strands forever (faults/inject.py scripts that death)
+        # flap strands forever (faults/inject.py scripts that death).
+        # Each trip is one heartbeat-guarded region (ops/chain.py trip
+        # boundaries surface HERE — the in-program fori_loop trips are
+        # invisible to the host, so the materialization that bounds
+        # them is the tickable boundary); the first trip compiles.
+        nonlocal trips
         fault_point("chain.step")
-        t0 = time.perf_counter()
-        fetch(chained_fn(x, k))
-        return time.perf_counter() - t0
+        phase = heartbeat.PHASE_COMPILE if trips == 0 else "chained"
+        trips += 1
+        with heartbeat.guard(phase):
+            t0 = time.perf_counter()
+            fetch(chained_fn(x, k))
+            return time.perf_counter() - t0
 
     run(k_lo)   # warm-up: compile (k is traced — one executable for both)
     run(k_hi)   # warm-up: queue drain at the long trip count

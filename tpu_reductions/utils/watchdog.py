@@ -14,7 +14,11 @@ The watchdog is a daemon thread probing the relay's TCP ports every
 `interval_s`; after `grace` consecutive dead probes it writes a
 diagnostic to stderr and hard-exits the process (os._exit — the main
 thread is wedged in a foreign blocking call and cannot run Python
-cleanup). The reference has no analog — its fail-fast layer is the
+cleanup). A second, port-independent trigger (ISSUE 3) reads the
+forward-progress heartbeat (utils/heartbeat.py) each cycle and exits 4
+(HANG_EXIT_CODE) when a guarded device region stalls past its
+phase-aware deadline — the failure modes the port probe cannot see
+(stalled relay, wedged device lease). The reference has no analog — its fail-fast layer is the
 per-call CUDA error check (cutil_inline_runtime.h:34-44); this is the
 same fail-fast idea applied to the transport this platform actually
 fails through.
@@ -45,6 +49,10 @@ import threading
 from typing import Optional, Sequence
 
 from tpu_reductions.faults.inject import fault_point
+from tpu_reductions.utils import heartbeat
+from tpu_reductions.utils.heartbeat import HANG_EXIT_CODE  # noqa: F401
+#   (re-exported: consumers treat exit 3 = relay dead, exit 4 = hang
+#    with live ports as one watchdog vocabulary)
 
 RELAY_PORTS = (8082, 8083)
 WATCHDOG_EXIT_CODE = 3
@@ -139,7 +147,17 @@ def start_relay_watchdog(interval_s: float = 60.0, grace: int = 3,
     The loop consults the `watchdog.probe` fault point each cycle
     (faults/inject.py): a scripted {"action": "dead"|"inconclusive"}
     spec overrides that cycle's real probe — how CI reproduces flaps
-    and local-resource storms without a real outage."""
+    and local-resource storms without a real outage.
+
+    Second trigger (ISSUE 3): every cycle also reads the shared
+    progress heartbeat (utils/heartbeat.py). A guarded device region
+    whose last progress mark is older than its phase deadline is a
+    HANG the port probe cannot see — a stalled relay (ports accept,
+    nothing serviced) or a wedged device lease both keep the probe
+    verdict 'alive' while every device wait blocks forever. That fires
+    exit 4 (HANG_EXIT_CODE, distinct from the dead-relay exit 3) with
+    the port verdict attached to the report, so postmortems can tell
+    stall-with-live-ports from dead."""
     probe = _probe or (lambda: probe_relay(ports, host))
     if _verdict(probe()) == "dead":
         return None
@@ -155,6 +173,7 @@ def start_relay_watchdog(interval_s: float = 60.0, grace: int = 3,
                 verdict = spec["action"]
             else:
                 verdict = _verdict(probe())
+            _check_hang(verdict, ports, _exit)
             if verdict == "inconclusive":
                 # a local resource error says nothing about the tunnel:
                 # treated as alive (never fire os._exit on it), but
@@ -186,6 +205,30 @@ def start_relay_watchdog(interval_s: float = 60.0, grace: int = 3,
     threading.Thread(target=watch, name="relay-watchdog",
                      daemon=True).start()
     return stop
+
+
+def _check_hang(relay_verdict: str, ports, _exit) -> None:
+    """The heartbeat half of the watch loop: fire HANG_EXIT_CODE (4)
+    when a guarded device region (utils/heartbeat.py) has made no
+    progress within its phase deadline. Runs on EVERY probe cycle —
+    the whole point is that the relay verdict may be 'alive' (stalled
+    relay, wedged lease) while the process is stuck; the verdict is
+    attached to the exit report, never consulted as a gate."""
+    snap = heartbeat.snapshot()
+    if not snap["in_flight"]:
+        return
+    deadline = heartbeat.deadline_for(snap["phase"])
+    if deadline <= 0 or snap["age_s"] < deadline:
+        return
+    print(f"relay watchdog: HANG — no heartbeat progress for "
+          f"{snap['age_s']:.1f}s in phase {snap['phase']!r} "
+          f"(deadline {deadline:.1f}s, {snap['beats']} beat(s) total); "
+          f"relay ports {tuple(resolved_ports(ports))} verdict at fire "
+          f"time: {relay_verdict} — a stalled relay or a wedged device "
+          "lease hangs device waits the port probe reports healthy; "
+          "exiting 4 so the rows persisted so far survive "
+          "(docs/RESILIENCE.md)", file=sys.stderr, flush=True)
+    _exit(HANG_EXIT_CODE)
 
 
 def _forced_platforms() -> str:
@@ -259,6 +302,30 @@ def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
                   "call", file=sys.stderr, flush=True)
             _exit(WATCHDOG_EXIT_CODE)
             return None  # unreachable except under an injected _exit
+
+    # Wedge gate, still pre-JAX: a STALLED relay / WEDGED device lease
+    # keeps the ports answering while jax.devices() hangs forever — the
+    # socket probe above cannot see it. The hang-proof preflight
+    # (utils/preflight.py: sacrificial subprocess under a hard timeout)
+    # persists its verdict to a health file; a fresh non-LIVE verdict
+    # stops this process before its first backend touch (exit 4 — hang
+    # territory, not dead-relay territory). TPU_REDUCTIONS_PREFLIGHT=1
+    # forces an active preflight run when no fresh verdict exists; =0
+    # disables the gate.
+    if tunneled_environment():
+        from tpu_reductions.utils.preflight import gate_verdict
+        verdict = gate_verdict()
+        if verdict in ("STALLED", "WEDGED"):
+            platforms = _forced_platforms()
+            if not (platforms and "tpu" not in platforms
+                    and not _chaos_armed()):
+                print(f"relay watchdog: preflight health verdict is "
+                      f"{verdict} (ports answer but device discovery "
+                      "hangs); refusing to make the first jax call — "
+                      "it can only hang forever", file=sys.stderr,
+                      flush=True)
+                _exit(HANG_EXIT_CODE)
+                return None  # unreachable except under injected _exit
 
     import jax
 
