@@ -187,6 +187,10 @@ def main(argv=None) -> int:
         p.error("--n must be positive")
 
     import os
+    # flight recorder FIRST (before the device probe): the outage path
+    # must land in the run record too (docs/OBSERVABILITY.md)
+    from tpu_reductions.obs.ledger import arm_session, emit
+    arm_session("bench.py", argv=list(argv) if argv else sys.argv[1:])
     # BENCH_SKIP_PROBE=1: the caller (chip_session.sh) verified the
     # relay seconds ago; the probe subprocess would re-pay a full jax
     # init (~30-40 s of a window that may only be minutes long) to
@@ -198,7 +202,13 @@ def main(argv=None) -> int:
     if outage is not None:
         print(f"accelerator unavailable: {outage}; reporting the outage "
               "instead of hanging", file=sys.stderr)
-        print(json.dumps(_snapshot_fallback(outage)))
+        payload = _snapshot_fallback(outage)
+        # the preflight verdict used to be only on disk
+        # (.chip_health.json) — the outage event carries it into the
+        # run record, fresh or stale (staleness is itself evidence)
+        emit("bench.outage", outage=outage, health=_health_record())
+        emit("bench.metric", **payload)
+        print(json.dumps(payload))
         return 1
 
     from tpu_reductions.config import _apply_platform
@@ -285,6 +295,9 @@ def main(argv=None) -> int:
         if printed_value is None:
             payload = _payload(results)
             print(json.dumps(payload), flush=True)
+            # the round-metric line, in the run record as well as on
+            # stdout (obs/timeline.py; docs/OBSERVABILITY.md)
+            emit("bench.metric", **payload)
             printed_value = payload["value"]
 
     for i, cfg in enumerate(cfgs):
@@ -325,6 +338,34 @@ def main(argv=None) -> int:
               f"is {final_best} GB/s — BENCH_snapshot.json is "
               "authoritative", file=sys.stderr)
     return 0 if passed else 1
+
+
+def _health_record() -> dict | None:
+    """The raw preflight verdict record (.chip_health.json) for the
+    outage event — deliberately NOT TTL-gated like preflight.read_health:
+    a stale verdict in an outage report is still evidence (it says the
+    wedge predates this run), it just must be labeled stale."""
+    import os
+    import time as _time
+
+    from tpu_reductions.utils.preflight import (DEFAULT_HEALTH_TTL_S,
+                                                health_file_path)
+    try:
+        with open(health_file_path()) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(record, dict):
+        return None
+    ts = record.get("ts")
+    if isinstance(ts, (int, float)):
+        try:
+            ttl = float(os.environ.get("TPU_REDUCTIONS_HEALTH_TTL_S",
+                                       DEFAULT_HEALTH_TTL_S))
+        except ValueError:
+            ttl = DEFAULT_HEALTH_TTL_S
+        record["stale"] = _time.time() - ts > ttl
+    return record
 
 
 def _maybe_double_spots(n: int | None = None, iterations: int | None = None,

@@ -45,6 +45,14 @@ set -uo pipefail
 REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
 cd "${AWAIT_ROOT:-$REPO_DIR}"
 
+# Flight-recorder shell emitter (docs/OBSERVABILITY.md): arm/re-arm/
+# defer decisions land in the same ledger the chip session appends to,
+# so the timeline CLI can reconstruct the WHOLE window — watcher
+# included. No-op unless TPU_REDUCTIONS_LEDGER is set.
+# shellcheck disable=SC1091
+source "$REPO_DIR/scripts/obs_event.sh" 2>/dev/null \
+    || obs_event() { :; }
+
 POLL=${1:-20}
 MAX_HOURS=${2:-13}
 RELAY_MARKER=${TPU_REDUCTIONS_RELAY_MARKER:-/root/.relay.py}
@@ -140,6 +148,7 @@ beat_every=$(( (600 + POLL - 1) / POLL )); [ "$beat_every" -lt 1 ] && beat_every
 probes=0
 echo "await_window: polling relay every ${POLL}s (horizon ${MAX_HOURS}h," \
      "session log ${LOG}, re-arming after aborted sessions)"
+obs_event watcher.arm poll_s="$POLL" horizon_h="$MAX_HOURS"
 while true; do
     if probe; then
         pf_rc=0
@@ -151,12 +160,15 @@ while true; do
             echo "await_window: relay ports answer but preflight says" \
                  "NOT LIVE (rc=$pf_rc; 3=relay dead, 4=stall/wedge);" \
                  "not firing a session"
+            obs_event watcher.defer reason=preflight rc="$pf_rc"
             [ "$pf_rc" -eq 4 ] && wait_health_clear
         else
             echo "await_window: relay ALIVE at $(date -u +%FT%TZ); starting chip session"
+            obs_event watcher.fire probes="$probes"
             bash "$SESSION_BIN" 2>&1 | tee -a "$LOG"
             rc=${PIPESTATUS[0]}
             echo "await_window: chip session exited rc=$rc at $(date -u +%FT%TZ)"
+            obs_event watcher.session_end rc="$rc"
             # commit the session log itself: round 2's curve recovery
             # came FROM this log (examples/tpu_run/RECOVERY.md) — it
             # must survive even if nobody is attending at fire time
@@ -166,6 +178,7 @@ while true; do
                     -- "$LOG" || true
             fi
             if [ "$rc" -eq 0 ]; then
+                obs_event watcher.retire rc=0
                 exit 0
             fi
             # aborted session: the window closed early — re-arm for the
@@ -177,14 +190,18 @@ while true; do
             if [ "$rc" -eq 3 ]; then
                 echo "await_window: re-arming (session rc=3: relay DEAD" \
                      "mid-session; remaining value can land in a later window)"
+                obs_event watcher.rearm rc=3
             elif [ "$rc" -eq 4 ]; then
                 echo "await_window: session rc=4: HANG with relay alive" \
                      "(stalled relay or wedged lease — heartbeat watchdog);" \
                      "deferring re-arm until the health verdict clears"
+                obs_event watcher.defer reason=hang rc=4
                 wait_health_clear
+                obs_event watcher.rearm rc=4
             else
                 echo "await_window: re-arming (session rc=$rc; remaining value" \
                      "can land in a later window)"
+                obs_event watcher.rearm rc="$rc"
             fi
         fi
     fi
@@ -194,6 +211,7 @@ while true; do
     fi
     if [ "$(date +%s)" -ge "$deadline" ]; then
         echo "await_window: no completed session within ${MAX_HOURS}h; giving up"
+        obs_event watcher.expire hours="$MAX_HOURS" probes="$probes"
         exit 4
     fi
     sleep "$POLL"

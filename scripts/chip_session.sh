@@ -11,6 +11,14 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
+# Flight-recorder shell emitter (docs/OBSERVABILITY.md): resolved via
+# BASH_SOURCE so lib-mode sourcing (tests) finds it regardless of cwd;
+# a missing helper degrades to a no-op — observability must never be
+# the reason a live window aborts.
+# shellcheck disable=SC1091
+source "$(dirname "${BASH_SOURCE[0]}")/obs_event.sh" 2>/dev/null \
+    || obs_event() { :; }
+
 # Quick relay gate (no JAX import, ~instant): on the tunneled box a
 # dead relay can never come back in-session (CLAUDE.md), so starting —
 # or continuing to — any on-chip step would either hang at device
@@ -71,8 +79,10 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
         # signal — this probe catches a relay that died between steps
         # regardless of how the previous step reported it
         echo "=== chip_session: ABORT — relay died before step '$name'; remaining steps skipped ==="
+        obs_event session.abort reason=relay-dead-between-steps step="$name"
         exit 3
     fi
+    obs_event step.start name="$name" budget="$budget"
     local status=ok rc=0
     # Per-step wall-clock budget (round-3 verdict, weak #2): a
     # slow-but-alive stall — a Mosaic lowering pileup, a multi-minute
@@ -94,6 +104,14 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
         # because no Pallas candidate passed — the exact hypothesis the
         # step probes); losing it to a later wedge would defeat the
         # script's commit-between-steps contract
+    fi
+    obs_event step.end name="$name" rc="$rc" status="$status"
+    # the ledger itself is a per-step artifact: commit it with whatever
+    # the step produced, so the postmortem record survives a window
+    # death exactly like the measurement rows do
+    if [ -n "${TPU_REDUCTIONS_LEDGER:-}" ] \
+            && [ -e "${TPU_REDUCTIONS_LEDGER}" ]; then
+        arts+=("${TPU_REDUCTIONS_LEDGER}")
     fi
     # add per artifact, and commit only the ones that exist: one
     # missing path must block neither the add nor the commit of the
@@ -155,6 +173,16 @@ summarize_on_exit() {
     timeout 300 python -m tpu_reductions.bench.seed_cache \
         double_spot.json int_op_spot_k6.json BENCH_doubles.json \
         --grid-dir examples/tpu_run/single_chip || true
+    # Flight-recorder collation (pure disk work, same as the rest of
+    # this trap): the machine summary lands next to the flagship
+    # evidence so regen appends the window-utilization table to
+    # report.md (bench/regen.py), and the dirty dir triggers the regen
+    # below even when nothing else changed this window.
+    if [ -n "${TPU_REDUCTIONS_LEDGER:-}" ] \
+            && [ -s "${TPU_REDUCTIONS_LEDGER}" ]; then
+        timeout 120 python -m tpu_reductions.obs.timeline "$TPU_REDUCTIONS_LEDGER" --json examples/tpu_run/obs_timeline.json --quiet \
+            || true
+    fi
     if [ -n "$(git status --porcelain -- examples/tpu_run)" ] \
             || [ "$(git log -1 --format=%H -- examples/tpu_run)" \
                  != "$TPU_RUN_HEAD" ]; then
@@ -170,6 +198,15 @@ summarize_on_exit() {
     fi
     python scripts/summarize_window.py . > WINDOW_SUMMARY.md 2>/dev/null \
         || true
+    # the per-window utilization table is COMPUTED from the ledger
+    # (obs/timeline.py --summary-md), never hand-written — appended so
+    # the summary commit below carries it
+    if [ -n "${TPU_REDUCTIONS_LEDGER:-}" ] \
+            && [ -s "${TPU_REDUCTIONS_LEDGER}" ]; then
+        echo >> WINDOW_SUMMARY.md
+        timeout 120 python -m tpu_reductions.obs.timeline "$TPU_REDUCTIONS_LEDGER" --summary-md >> WINDOW_SUMMARY.md \
+            || true
+    fi
     if [ -s WINDOW_SUMMARY.md ] && git add -- WINDOW_SUMMARY.md \
             && ! git diff --cached --quiet -- WINDOW_SUMMARY.md; then
         git commit -q -m "Window summary (auto-collated at session exit)" \
@@ -188,8 +225,17 @@ fi
 
 trap summarize_on_exit EXIT
 
+# Flight recorder armed for the whole session (docs/OBSERVABILITY.md):
+# every step's entry point inherits the ledger path and appends typed
+# events; step() commits the ledger alongside each step's artifacts.
+# An explicit env wins (the chaos harness points it at a tmp file).
+: "${TPU_REDUCTIONS_LEDGER:=obs_ledger.jsonl}"
+export TPU_REDUCTIONS_LEDGER
+obs_event session.start prog=chip_session
+
 if ! relay_ok; then
     echo "=== chip_session: relay is dead before the session started; nothing on-chip can run — aborting (rc=3) ==="
+    obs_event session.abort reason=relay-dead-at-start
     exit 3
 fi
 
@@ -345,4 +391,5 @@ step "fine tile race" 420 tune_fine.json -- \
 step "flagship experiment" 10800 examples/tpu_run -- \
     bash scripts/run_tpu_experiment.sh examples/tpu_run
 
+obs_event session.end prog=chip_session
 echo "=== chip_session: done ==="

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# obs_event — the SHELL producer of flight-recorder events
+# (docs/OBSERVABILITY.md). The supervisors are deliberately python-free
+# (scripts/supervise_watcher.sh: nothing in them may hang on a dead
+# relay or pay a jax import), so they cannot route through
+# tpu_reductions/obs/ledger.py; this sourced helper is the one
+# sanctioned shell-side emitter, held to the same row grammar
+# (lint/grammar.py EVENT_ROW_RE — tests/test_obs.py validates its
+# output against the python schema).
+#
+# Usage (after `source scripts/obs_event.sh`):
+#   obs_event <event> [key=value ...]
+#
+# No-op unless TPU_REDUCTIONS_LEDGER names the ledger file (and
+# TPU_REDUCTIONS_OBS_DISABLE != 1). One printf >> append = one write
+# syscall for these line-sized events, so concurrent python/shell
+# producers interleave at line granularity — the same no-torn-lines
+# contract as the python emitter. Values that look numeric pass through
+# as JSON numbers; everything else is escaped into a JSON string.
+# Failures are swallowed (`|| true`): observability must never abort a
+# session step.
+
+obs_event() {
+    [ -n "${TPU_REDUCTIONS_LEDGER:-}" ] || return 0
+    [ "${TPU_REDUCTIONS_OBS_DISABLE:-0}" = 1 ] && return 0
+    local ev=$1 fields="" kv k v
+    shift
+    for kv in "$@"; do
+        k=${kv%%=*}
+        v=${kv#*=}
+        if printf '%s' "$v" | grep -Eq '^-?[0-9]+(\.[0-9]+)?$'; then
+            fields="$fields, \"$k\": $v"
+        else
+            v=$(printf '%s' "$v" | sed -e 's/\\/\\\\/g' -e 's/"/\\"/g')
+            fields="$fields, \"$k\": \"$v\""
+        fi
+    done
+    printf '{"t": %s, "ev": "%s", "pid": %d, "src": "shell"%s}\n' \
+        "$(date +%s.%N)" "$ev" "$$" "$fields" \
+        >> "$TPU_REDUCTIONS_LEDGER" 2>/dev/null || true
+}

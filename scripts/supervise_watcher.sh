@@ -50,10 +50,17 @@
 #                       a round; bounded so a forgotten supervisor does
 #                       not commit into the next round forever)
 set -uo pipefail
+# Flight-recorder shell emitter (docs/OBSERVABILITY.md) — resolved
+# BEFORE the SUP_ROOT cd so rehearsal repos still find it. Pure bash
+# like the rest of this script: nothing here may pay a python/jax
+# import, and obs_event is a printf append (scripts/obs_event.sh).
+_OBS_LIB="$(cd "$(dirname "$0")" && pwd)/obs_event.sh"
 # SUP_ROOT: the rehearsal tests (tests/test_supervisor.py) point this at
 # a temp git repo so kill/retire/re-arm behavior is provable off-chip
 # without touching the real round log
 cd "${SUP_ROOT:-$(dirname "$0")/..}"
+# shellcheck disable=SC1090
+source "$_OBS_LIB" 2>/dev/null || obs_event() { :; }
 
 POLL=${1:-20}
 ARM_HOURS=${2:-13}
@@ -151,6 +158,8 @@ spawn() {
     # watcher instead of arming a second one next to it
     echo "$child" > "$PIDFILE" 2>/dev/null || true
     note "watcher armed (pid $child, poll ${POLL}s, horizon ${ARM_HOURS}h)"
+    obs_event supervisor.spawn watcher_pid="$child" poll_s="$POLL" \
+        horizon_h="$ARM_HOURS"
 }
 
 reap_predecessor() {
@@ -285,6 +294,7 @@ wait_health_clear() {
     v=$(health_verdict)
     case "$v" in STALLED|WEDGED) ;; *) return 0 ;; esac
     note "health verdict $v (hang with live ports); deferring watcher respawn until it clears"
+    obs_event supervisor.defer reason=health verdict="$v"
     while v=$(health_verdict); do
         case "$v" in STALLED|WEDGED) ;; *) break ;; esac
         sleep "$CHECK_S" 9>&-
@@ -350,6 +360,7 @@ while true; do
         rc=0; wait "$child" 2>/dev/null || rc=$?
         if [ "$rc" -eq 0 ] && [ -e "$RELAY_MARKER" ]; then
             note "chip session COMPLETED (watcher rc=0); retiring"
+            obs_event supervisor.retire rc=0
             child=
             exit 0
         elif [ "$rc" -eq 0 ]; then
@@ -362,6 +373,7 @@ while true; do
         else
             note "watcher DIED (rc=$rc); respawning"
         fi
+        obs_event supervisor.respawn watcher_rc="$rc"
         # reap any survivors of the dead watcher's group BEFORE arming a
         # successor: a respawned watcher that finds the relay alive —
         # because an orphaned session is still using it — would fire a

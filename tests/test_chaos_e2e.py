@@ -26,7 +26,8 @@ SPOT_ARGS = ["--platform=cpu", "--type=int", "--methods=SUM,MIN,MAX",
              "--n=16384", "--iterations=8", "--chainreps=2"]
 
 
-def _chaos_env(relay, marker, *, faults=None, interval="0.1", grace="2"):
+def _chaos_env(relay, marker, *, faults=None, interval="0.1", grace="2",
+               ledger=None):
     env = {**os.environ,
            "TPU_REDUCTIONS_CHAOS_ARM": "1",
            "TPU_REDUCTIONS_RELAY_MARKER": str(marker),
@@ -38,8 +39,11 @@ def _chaos_env(relay, marker, *, faults=None, interval="0.1", grace="2"):
            "TPU_REDUCTIONS_HEALTH_FILE": str(Path(marker).parent
                                              / "health.json")}
     env.pop("TPU_REDUCTIONS_FAULTS", None)
+    env.pop("TPU_REDUCTIONS_LEDGER", None)
     if faults is not None:
         env["TPU_REDUCTIONS_FAULTS"] = json.dumps(faults)
+    if ledger is not None:
+        env["TPU_REDUCTIONS_LEDGER"] = str(ledger)
     return env
 
 
@@ -115,12 +119,19 @@ def test_chaos_stall_relay_heartbeat_exit4_then_resume(tmp_path):
     old stack hung forever; the heartbeat trigger must exit 4 within
     the compressed deadline with the 'alive' port verdict attached,
     keep every previously-persisted row, and resume them
-    byte-identically on re-invocation."""
+    byte-identically on re-invocation.
+
+    ISSUE 4 acceptance rides the same scenario: both windows share one
+    flight-recorder ledger, and the timeline CLI must reconstruct the
+    full death narrative — arm -> compile -> staging -> stall ->
+    heartbeat exit 4 -> resume — with per-phase wall-clock attribution
+    (the stall carved into the 'stalled' bucket)."""
     marker = tmp_path / "relay.marker"
     marker.write_text("tunneled\n")
     out = tmp_path / "spot.json"
+    led = tmp_path / "ledger.jsonl"
     with FakeRelay() as relay:
-        env = _chaos_env(relay, marker, faults={
+        env = _chaos_env(relay, marker, ledger=led, faults={
             "bench.run": {"after": 1, "action": "stall", "seconds": 120}})
         # compressed heartbeat deadlines: steady 5 s (legit cpu-test
         # device regions finish in well under that), compile 60 s (the
@@ -144,13 +155,43 @@ def test_chaos_stall_relay_heartbeat_exit4_then_resume(tmp_path):
         # byte-identically and completes the remaining methods
         relay.force("accept")
         time.sleep(0.15)
-        proc2 = _spot(out, _chaos_env(relay, marker))
+        proc2 = _spot(out, _chaos_env(relay, marker, ledger=led))
         assert proc2.wait(timeout=60) == 0
         assert "resumed from prior artifact" in proc2.stderr.read()
         resumed = json.loads(out.read_text())
     assert resumed["complete"] is True
     assert resumed["rows"][0] == interrupted["rows"][0]  # byte-identical
     assert [r["method"] for r in resumed["rows"]] == ["SUM", "MIN", "MAX"]
+
+    # ---- flight-recorder reconstruction (ISSUE 4 acceptance) ----
+    from tpu_reductions.obs.timeline import read_ledger, summarize
+    events, torn = read_ledger(led)
+    assert torn == 0                    # no torn lines under os._exit
+    evs = [e["ev"] for e in events]
+    # the narrative, in order: arm -> compile -> staging -> stall ->
+    # exit 4; then the second window's resume
+    assert "session.start" in evs and "watchdog.arm" in evs
+    compiles = [e for e in events if e["ev"] == "hb.phase"
+                and e.get("phase") == "compile"]
+    assert compiles, "compile phase transitions must be recorded"
+    assert "staging.stage" in evs
+    stall = next(e for e in events if e["ev"] == "fault.fire")
+    assert stall["action"] == "stall"
+    exit4 = next(e for e in events if e["ev"] == "watchdog.exit")
+    assert exit4["code"] == 4 and exit4["relay"] == "alive"
+    assert exit4["age_s"] >= 5.0        # past the compressed deadline
+    assert evs.index("fault.fire") < evs.index("watchdog.exit")
+    assert "resume.reuse" in evs[evs.index("watchdog.exit"):]
+    summary = summarize(led, events, torn)
+    sessions = summary["sessions"]
+    dead = next(s for s in sessions if s["end"] == "exit 4")
+    alive = next(s for s in sessions if s["end"] == "end")
+    # per-phase attribution: the stalled window spent most of its wall
+    # clock in the carved 'stalled' bucket; the resume window reused
+    # the banked row
+    assert dead["phases_s"]["stalled"] >= 5.0
+    assert dead["utilization"]["stalled"] > 0.3
+    assert alive["reused_rows"] >= 1 and alive["persists"] >= 1
 
 
 def test_await_window_defers_on_non_live_preflight(tmp_path):
@@ -232,6 +273,50 @@ def test_transient_flap_is_retried_not_fatal(tmp_path):
     assert all(r["status"] in ("PASSED", "WAIVED") for r in data["rows"])
 
 
+def test_chaos_sigkill_midbatch_ledger_has_zero_torn_lines(tmp_path):
+    """Ledger crash-safety (ISSUE 4 satellite): a SIGKILL-class death
+    mid-batch (faults/inject.py action "exit" — os._exit with no
+    cleanup, the same no-atexit shape as a real SIGKILL) must leave a
+    ledger with ZERO torn/partial lines, and the timeline CLI must
+    still reconstruct the run (first session 'cut', second 'end')."""
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    out = tmp_path / "spot.json"
+    led = tmp_path / "ledger.jsonl"
+    with FakeRelay() as relay:
+        env = _chaos_env(relay, marker, ledger=led, faults={
+            "bench.run": {"after": 1, "action": "exit", "code": 9}})
+        proc = _spot(out, env)
+        rc = proc.wait(timeout=60)
+        assert rc == 9, proc.stderr.read()
+        interrupted = json.loads(out.read_text())
+        assert interrupted["complete"] is False
+
+        # second window, no faults: completes against the same ledger
+        proc2 = _spot(out, _chaos_env(relay, marker, ledger=led))
+        assert proc2.wait(timeout=60) == 0
+
+    from tpu_reductions.obs.timeline import (read_ledger, summarize,
+                                             main as timeline_main)
+    events, torn = read_ledger(led)
+    assert torn == 0, "ledger must have no torn lines under SIGKILL"
+    assert events
+    # every line byte-validates against the registered row grammar
+    from tpu_reductions.lint.grammar import EVENT_ROW_RE
+    for raw in led.read_text().splitlines():
+        assert EVENT_ROW_RE.match(raw), raw
+    sessions = summarize(led, events, torn)["sessions"]
+    assert len(sessions) == 2
+    # the killed run has no terminal event (no atexit under os._exit);
+    # the fault that killed it is its last recorded fact
+    assert sessions[0]["end"] == "cut"
+    killed = [e for e in events if e.get("pid") == sessions[0]["pid"]]
+    assert killed[-1]["ev"] == "fault.fire"
+    assert killed[-1]["action"] == "exit"
+    assert sessions[1]["end"] == "end"
+    assert timeline_main([str(led)]) == 0
+
+
 def _git(root, *args):
     subprocess.run(["git", *args], cwd=root, check=True,
                    capture_output=True)
@@ -240,12 +325,15 @@ def _git(root, *args):
 def test_await_window_rearms_after_exit3_and_retires_on_complete(tmp_path):
     """The watcher half of the pipeline: an aborted session (rc=3, the
     watchdog's code) RE-ARMS the watcher; the next window's session
-    completes (rc=0) and retires it; the session log is committed."""
+    completes (rc=0) and retires it; the session log is committed —
+    and (ISSUE 4) the arm/fire/re-arm/retire decisions land in the
+    flight-recorder ledger as watcher.* events."""
     _git(tmp_path, "init", "-q")
     _git(tmp_path, "config", "user.email", "t@t")
     _git(tmp_path, "config", "user.name", "t")
     marker = tmp_path / "relay.marker"
     marker.write_text("tunneled\n")
+    led = tmp_path / "ledger.jsonl"
     session = tmp_path / "fake_session.sh"
     session.write_text(
         "#!/usr/bin/env bash\n"
@@ -259,6 +347,7 @@ def test_await_window_rearms_after_exit3_and_retires_on_complete(tmp_path):
                "AWAIT_ROOT": str(tmp_path),
                "SESSION_BIN": str(session),
                "CHIP_LOG": "chip.log",
+               "TPU_REDUCTIONS_LEDGER": str(led),
                "TPU_REDUCTIONS_RELAY_MARKER": str(marker),
                "TPU_REDUCTIONS_RELAY_PORTS": str(relay.port)}
         proc = subprocess.run(
@@ -268,6 +357,16 @@ def test_await_window_rearms_after_exit3_and_retires_on_complete(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "re-arming (session rc=3" in proc.stdout
     assert (tmp_path / "sessions.txt").read_text().count("run") == 2
+    # watcher narrative in the ledger: armed -> fired -> session died
+    # (rc=3) -> re-armed -> fired -> retired on rc=0
+    evs = [json.loads(line) for line in led.read_text().splitlines()]
+    names = [e["ev"] for e in evs]
+    assert names.index("watcher.arm") < names.index("watcher.fire")
+    rearm = next(e for e in evs if e["ev"] == "watcher.rearm")
+    assert rearm["rc"] == 3
+    assert names[-1] == "watcher.retire"
+    assert [e["rc"] for e in evs if e["ev"] == "watcher.session_end"] \
+        == [3, 0]
     log_commits = subprocess.run(
         ["git", "log", "--oneline", "--", "chip.log"], cwd=tmp_path,
         capture_output=True, text=True).stdout.strip().splitlines()

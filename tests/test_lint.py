@@ -336,6 +336,47 @@ def test_red011_gate_must_precede_the_touch(tmp_path):
                                         name="bench/fixture.py"))
 
 
+# ---------------------------------------------------------------- RED012
+
+
+def test_red012_flags_event_shaped_print_and_write(tmp_path):
+    # an f-string event row printed from a utils module: ad-hoc
+    # emission that bypasses the ledger's single-write append
+    printed = (
+        "t = 1.0\n"
+        "print(f'{{\"t\": {t}, \"ev\": \"x.y\", \"pid\": 1}}')\n"
+    )
+    assert "RED012" in _rules(_lint_src(tmp_path, printed,
+                                        name="utils/fixture.py"))
+    written = (
+        "f = open('ledger.jsonl', 'a')\n"
+        "f.write('{\"t\": 1, \"ev\": \"a.b\", \"pid\": 2}')\n"
+    )
+    assert "RED012" in _rules(_lint_src(tmp_path, written,
+                                        name="bench/fixture.py"))
+
+
+def test_red012_accepts_sanctioned_producer_and_non_events(tmp_path):
+    # the ledger module itself is the sanctioned producer
+    producer = "print('{\"t\": 1, \"ev\": \"a.b\", \"pid\": 2}')\n"
+    assert "RED012" not in _rules(_lint_src(tmp_path, producer,
+                                            name="obs/ledger.py"))
+    # a non-event print in scope is fine
+    assert "RED012" not in _rules(_lint_src(
+        tmp_path, "print('spot SUM resumed')\n",
+        name="utils/fixture.py"))
+    # outside the runtime packages the rule does not apply
+    assert "RED012" not in _rules(_lint_src(tmp_path, producer,
+                                            name="fixture.py"))
+
+
+def test_red012_waivable_with_reason(tmp_path):
+    src = ("print('{\"t\": 1, \"ev\": \"a.b\", \"pid\": 2}')"
+           "  # redlint: disable=RED012 -- doc example, not a producer\n")
+    assert _rules(_lint_src(tmp_path, src,
+                            name="utils/fixture.py")) == []
+
+
 # ---------------------------------------------------------------- RED008
 
 
@@ -457,6 +498,8 @@ def test_cli_positive_fixture_per_rule_exits_nonzero(tmp_path):
         "RED011": ("bench/r11.py", "import jax\n"
                                    "def main():\n"
                                    "    return jax.devices()\n"),
+        "RED012": ("utils/r12.py",
+                   "print('{\"t\": 1, \"ev\": \"a.b\", \"pid\": 1}')\n"),
     }
     for rule, (name, src) in fixtures.items():
         f = tmp_path / name

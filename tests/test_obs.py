@@ -1,0 +1,417 @@
+"""Flight-recorder unit coverage (tpu_reductions/obs/): ledger
+crash-safety contracts, span semantics, seam events, timeline
+attribution math, the WINDOW_SUMMARY table, both producers against the
+one grammar, and the no-timing-distortion guarantee
+(docs/OBSERVABILITY.md)."""
+
+import json
+import os
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_reductions.lint.grammar import EVENT_NAME_RE, EVENT_ROW_RE
+from tpu_reductions.obs import ledger
+from tpu_reductions.obs.spans import span
+from tpu_reductions.obs.timeline import (analyze_session, main as
+                                         timeline_main, read_ledger,
+                                         split_sessions,
+                                         summarize, summary_markdown)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _isolated_ledger(monkeypatch):
+    """Every test starts unarmed with a clean env and leaves nothing
+    armed behind (the module holds a process-global fd)."""
+    monkeypatch.delenv("TPU_REDUCTIONS_LEDGER", raising=False)
+    monkeypatch.delenv("TPU_REDUCTIONS_OBS_DISABLE", raising=False)
+    ledger.disarm()
+    yield
+    ledger.disarm()
+
+
+def _lines(path):
+    return [json.loads(line) for line in
+            Path(path).read_text().splitlines() if line.strip()]
+
+
+# ---------------------------------------------------------------- ledger
+
+def test_unarmed_emit_is_noop(tmp_path):
+    assert not ledger.armed()
+    assert ledger.emit("x.y", a=1) is False
+
+
+def test_arm_emit_shape_and_grammar(tmp_path, monkeypatch):
+    led = tmp_path / "l.jsonl"
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER", str(led))
+    assert ledger.arm_session("unit.test", argv=["--a"]) == str(led)
+    assert ledger.emit("a.b", n=3, s="txt", none_field=None)
+    rows = _lines(led)
+    assert rows[0]["ev"] == "session.start"
+    assert rows[0]["prog"] == "unit.test"
+    assert rows[1] == {**rows[1], "ev": "a.b", "n": 3, "s": "txt",
+                       "none_field": None}
+    for raw in led.read_text().splitlines():
+        assert EVENT_ROW_RE.match(raw), raw
+        assert EVENT_NAME_RE.match(json.loads(raw)["ev"])
+
+
+def test_disable_env_hard_off(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER", str(tmp_path / "l"))
+    monkeypatch.setenv("TPU_REDUCTIONS_OBS_DISABLE", "1")
+    assert ledger.arm() is None
+    assert not ledger.armed()
+
+
+def test_emit_never_raises_and_disarms_on_io_error(tmp_path,
+                                                   monkeypatch):
+    led = tmp_path / "l.jsonl"
+    assert ledger.arm(led)
+    monkeypatch.setattr(os, "write",
+                        lambda *a: (_ for _ in ()).throw(OSError("x")))
+    assert ledger.emit("a.b") is False      # swallowed, not raised
+    monkeypatch.undo()
+    assert not ledger.armed()               # disarmed after the failure
+
+
+def test_invalid_event_name_dropped(tmp_path):
+    led = tmp_path / "l.jsonl"
+    assert ledger.arm(led)
+    assert ledger.emit("Bad Name!") is False
+    assert ledger.emit("good.name") is True
+    assert [r["ev"] for r in _lines(led)] == ["good.name"]
+
+
+def test_nonfinite_fields_serialize_null(tmp_path):
+    led = tmp_path / "l.jsonl"
+    assert ledger.arm(led)
+    assert ledger.emit("a.b", bad=float("nan"), worse=float("inf"))
+    row = _lines(led)[0]
+    assert row["bad"] is None and row["worse"] is None
+
+
+def test_emit_attaches_heartbeat_phase(tmp_path):
+    from tpu_reductions.utils import heartbeat
+    led = tmp_path / "l.jsonl"
+    assert ledger.arm(led)
+    heartbeat.reset()
+    with heartbeat.guard("staging"):
+        ledger.emit("inside.guard")
+    ledger.emit("outside.guard")
+    rows = {r["ev"]: r for r in _lines(led)}
+    assert rows["inside.guard"]["phase"] == "staging"
+    assert "phase" not in rows["outside.guard"]
+    # the guard itself recorded its transitions
+    phases = [(r.get("prev"), r.get("phase")) for r in _lines(led)
+              if r["ev"] == "hb.phase"]
+    assert (None, "staging") in phases and ("staging", None) in phases
+
+
+# ----------------------------------------------------------------- spans
+
+def test_span_emits_start_end_with_duration(tmp_path):
+    assert ledger.arm(tmp_path / "l.jsonl")
+    with span("work", item=1):
+        pass
+    rows = _lines(tmp_path / "l.jsonl")
+    assert [r["ev"] for r in rows] == ["work.start", "work.end"]
+    assert rows[1]["dur_s"] >= 0 and rows[1]["item"] == 1
+
+
+def test_span_records_error_and_reraises(tmp_path):
+    assert ledger.arm(tmp_path / "l.jsonl")
+    with pytest.raises(ValueError):
+        with span("work"):
+            raise ValueError("boom")
+    end = _lines(tmp_path / "l.jsonl")[-1]
+    assert end["ev"] == "work.end" and "ValueError: boom" in end["error"]
+
+
+# ------------------------------------------------------------ seam events
+
+def test_retry_events(tmp_path):
+    from tpu_reductions.utils.retry import retry_device_call
+    assert ledger.arm(tmp_path / "l.jsonl")
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("flap")
+        return 42
+
+    assert retry_device_call(flaky, retries=2, _sleep=lambda s: None,
+                             _tunneled=lambda: True,
+                             _alive=lambda: True) == 42
+    rows = [r for r in _lines(tmp_path / "l.jsonl")
+            if r["ev"] == "retry.attempt"]
+    assert len(rows) == 1
+    assert rows[0]["attempt"] == 1 and "flap" in rows[0]["error"]
+    assert rows[0]["delay_s"] > 0
+
+
+def test_retry_fatal_event_on_dead_relay(tmp_path):
+    from tpu_reductions.utils.retry import retry_device_call
+    assert ledger.arm(tmp_path / "l.jsonl")
+    with pytest.raises(RuntimeError):
+        retry_device_call(lambda: (_ for _ in ()).throw(
+            RuntimeError("dead")), retries=2, _sleep=lambda s: None,
+            _tunneled=lambda: True, _alive=lambda: False)
+    fatal = [r for r in _lines(tmp_path / "l.jsonl")
+             if r["ev"] == "retry.fatal"]
+    assert fatal and fatal[0]["reason"] == "relay-dead"
+
+
+def test_checkpoint_events(tmp_path):
+    from tpu_reductions.bench.resume import Checkpoint
+    assert ledger.arm(tmp_path / "l.jsonl")
+    out = tmp_path / "art.json"
+    ck = Checkpoint(out, {"n": 4}, key_fn=lambda r: r["k"])
+    ck.add({"k": "a", "status": "PASSED"})
+    ck.finalize()
+    # re-open the INTERRUPTED shape: rewrite as complete: false first
+    data = json.loads(out.read_text())
+    data["complete"] = False
+    out.write_text(json.dumps(data))
+    ck2 = Checkpoint(out, {"n": 4}, key_fn=lambda r: r["k"])
+    assert ck2.resume("a") is not None
+    evs = [r["ev"] for r in _lines(tmp_path / "l.jsonl")]
+    assert evs.count("artifact.persist") == 2      # add + finalize
+    assert "resume.decision" in evs and "resume.reuse" in evs
+    modes = [r["mode"] for r in _lines(tmp_path / "l.jsonl")
+             if r["ev"] == "resume.decision"]
+    assert modes == ["fresh", "resume"]
+
+
+def test_staging_chunk_events(tmp_path):
+    import numpy as np
+
+    from tpu_reductions.utils.staging import device_put_chunked
+    assert ledger.arm(tmp_path / "l.jsonl")
+    flat = np.arange(1024, dtype=np.int32)
+    device_put_chunked(flat, 8, 128, 0, chunk_bytes=2 * 128 * 4)
+    evs = [r["ev"] for r in _lines(tmp_path / "l.jsonl")]
+    assert evs[0] == "staging.start"
+    assert evs.count("staging.chunk") == 4         # 8 rows / 2-row step
+    assert "staging.end" in evs
+
+
+def test_fault_fire_event(tmp_path, monkeypatch):
+    from tpu_reductions.faults import inject
+    assert ledger.arm(tmp_path / "l.jsonl")
+    monkeypatch.setenv(inject.ENV_VAR,
+                       json.dumps({"p.x": {"action": "note"}}))
+    inject.reset()
+    assert inject.fault_point("p.x") == {"action": "note"}
+    inject.reset()
+    rows = _lines(tmp_path / "l.jsonl")
+    assert rows[0]["ev"] == "fault.fire"
+    assert rows[0]["point"] == "p.x" and rows[0]["action"] == "note"
+
+
+# --------------------------------------------- timing: no distortion
+
+def test_chain_trip_events_and_undistorted_slope(tmp_path):
+    """The acceptance guarantee: chained slopes unchanged within noise
+    with the recorder ARMED — a deterministic sleep-based chained fn
+    must still measure its per-iteration cost, and every trip must land
+    as an event AFTER its timed window."""
+    from tpu_reductions.utils import heartbeat
+    from tpu_reductions.utils.timing import time_chained
+    heartbeat.reset()
+    assert ledger.arm(tmp_path / "l.jsonl")
+    per_iter = 0.002
+
+    def chained(x, k):
+        time.sleep(per_iter * k)
+        return x
+
+    sw = time_chained(chained, 0, k_lo=1, k_hi=6, reps=3,
+                      materialize=lambda x: x)
+    assert abs(sw.median_s - per_iter) < per_iter * 0.75
+    rows = _lines(tmp_path / "l.jsonl")
+    trips = [r for r in rows if r["ev"] == "chain.trip"]
+    slopes = [r for r in rows if r["ev"] == "chain.slope"]
+    assert len(trips) == 2 + 2 * 3 and len(slopes) == 3
+    assert trips[0]["phase"] == "compile"          # first trip compiles
+    assert all(t["dur_s"] > 0 for t in trips)
+
+
+# -------------------------------------------------------------- timeline
+
+def _mk_ledger(path, events):
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_timeline_attribution_math(tmp_path):
+    led = tmp_path / "l.jsonl"
+    t0 = 1000.0
+    _mk_ledger(led, [
+        {"t": t0, "ev": "session.start", "pid": 1, "prog": "x"},
+        {"t": t0 + 1, "ev": "hb.phase", "pid": 1, "phase": "compile",
+         "prev": None},
+        {"t": t0 + 5, "ev": "hb.phase", "pid": 1, "phase": "chained",
+         "prev": "compile"},
+        {"t": t0 + 8, "ev": "hb.phase", "pid": 1, "phase": None,
+         "prev": "chained"},
+        {"t": t0 + 9, "ev": "session.end", "pid": 1},
+    ])
+    events, torn = read_ledger(led)
+    assert torn == 0
+    s = summarize(led, events, torn)["sessions"][0]
+    assert s["phases_s"]["host"] == pytest.approx(2.0)   # 0..1 + 8..9
+    assert s["phases_s"]["compile"] == pytest.approx(4.0)
+    assert s["phases_s"]["measure"] == pytest.approx(3.0)
+    assert s["end"] == "end"
+    assert s["utilization"]["compile"] == pytest.approx(4 / 9, abs=1e-3)
+
+
+def test_timeline_stall_carved_from_phase_bucket(tmp_path):
+    led = tmp_path / "l.jsonl"
+    t0 = 1000.0
+    _mk_ledger(led, [
+        {"t": t0, "ev": "session.start", "pid": 2, "prog": "x"},
+        {"t": t0 + 1, "ev": "hb.phase", "pid": 2, "phase": "device",
+         "prev": None},
+        {"t": t0 + 11, "ev": "watchdog.exit", "pid": 2, "code": 4,
+         "age_s": 8.0, "phase": "device", "relay": "alive"},
+    ])
+    events, torn = read_ledger(led)
+    s = summarize(led, events, torn)["sessions"][0]
+    assert s["end"] == "exit 4"
+    assert s["phases_s"]["stalled"] == pytest.approx(8.0)
+    assert s["phases_s"]["measure"] == pytest.approx(2.0)
+
+
+def test_timeline_retry_carved_from_host(tmp_path):
+    led = tmp_path / "l.jsonl"
+    _mk_ledger(led, [
+        {"t": 0.0, "ev": "session.start", "pid": 3, "prog": "x"},
+        {"t": 1.0, "ev": "retry.attempt", "pid": 3, "delay_s": 2.0},
+        {"t": 4.0, "ev": "session.end", "pid": 3},
+    ])
+    events, torn = read_ledger(led)
+    s = summarize(led, events, torn)["sessions"][0]
+    assert s["phases_s"]["retrying"] == pytest.approx(2.0)
+    assert s["phases_s"]["host"] == pytest.approx(2.0)
+
+
+def test_timeline_counts_torn_lines_and_survives_them(tmp_path):
+    led = tmp_path / "l.jsonl"
+    _mk_ledger(led, [{"t": 1.0, "ev": "session.start", "pid": 4}])
+    with open(led, "a") as f:
+        f.write('{"t": 2.0, "ev": "trunc')       # torn mid-write
+    events, torn = read_ledger(led)
+    assert torn == 1 and len(events) == 1
+    assert "1 torn line(s)" in summary_markdown(
+        summarize(led, events, torn)) or summarize(
+        led, events, torn)["torn_lines"] == 1
+
+
+def test_timeline_splits_sessions_per_pid_and_start(tmp_path):
+    events = [
+        {"t": 0.0, "ev": "watcher.arm", "pid": 9, "src": "shell"},
+        {"t": 1.0, "ev": "session.start", "pid": 5, "prog": "a"},
+        {"t": 2.0, "ev": "session.end", "pid": 5},
+        {"t": 3.0, "ev": "session.start", "pid": 6, "prog": "b"},
+    ]
+    sessions = split_sessions(events)
+    assert len(sessions) == 3
+    assert analyze_session(sessions[0])["prog"] is None   # shell pseudo
+    assert analyze_session(sessions[1])["prog"] == "a"
+    assert analyze_session(sessions[2])["end"] == "cut"   # no terminal
+
+
+def test_timeline_cli_json_and_summary_md(tmp_path, capsys):
+    led = tmp_path / "l.jsonl"
+    _mk_ledger(led, [
+        {"t": 0.0, "ev": "session.start", "pid": 7, "prog": "spot"},
+        {"t": 1.0, "ev": "session.end", "pid": 7},
+    ])
+    out = tmp_path / "summary.json"
+    assert timeline_main([str(led), "--json", str(out),
+                          "--summary-md"]) == 0
+    printed = capsys.readouterr().out
+    assert "window utilization (flight recorder)" in printed
+    assert "| spot (pid 7) |" in printed
+    summary = json.loads(out.read_text())
+    assert summary["sessions"][0]["prog"] == "spot"
+    assert timeline_main([str(tmp_path / "absent.jsonl")]) == 1
+
+
+# ------------------------------------------------------- shell producer
+
+def test_shell_emitter_matches_python_grammar(tmp_path):
+    led = tmp_path / "shell.jsonl"
+    subprocess.run(
+        ["bash", "-c",
+         f'source "{REPO}/scripts/obs_event.sh"; '
+         "obs_event step.start name='double scoreboard' budget=300; "
+         "obs_event step.end name=x rc=0 status=ok"],
+        env={**os.environ, "TPU_REDUCTIONS_LEDGER": str(led)},
+        check=True, timeout=30)
+    raws = led.read_text().splitlines()
+    assert len(raws) == 2
+    for raw in raws:
+        assert EVENT_ROW_RE.match(raw), raw
+        rec = json.loads(raw)
+        assert rec["src"] == "shell"
+    assert json.loads(raws[0])["name"] == "double scoreboard"
+    assert json.loads(raws[0])["budget"] == 300
+
+
+def test_shell_emitter_noop_without_ledger(tmp_path):
+    r = subprocess.run(
+        ["bash", "-c",
+         f'source "{REPO}/scripts/obs_event.sh"; obs_event x.y; '
+         "echo done"],
+        env={k: v for k, v in os.environ.items()
+             if k != "TPU_REDUCTIONS_LEDGER"},
+        capture_output=True, text=True, timeout=30)
+    assert r.returncode == 0 and "done" in r.stdout
+
+
+# ----------------------------------------------------- bench.py satellite
+
+def test_bench_outage_event_carries_health_verdict(tmp_path,
+                                                   monkeypatch,
+                                                   capsys):
+    import bench
+    monkeypatch.chdir(tmp_path)
+    led = tmp_path / "l.jsonl"
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER", str(led))
+    health = tmp_path / "health.json"
+    health.write_text(json.dumps(
+        {"verdict": "STALLED", "relay": "alive", "ts": time.time()}))
+    monkeypatch.setenv("TPU_REDUCTIONS_HEALTH_FILE", str(health))
+    monkeypatch.setattr(bench, "_device_probe",
+                        lambda platform=None: "probe hung")
+    assert bench.main([]) == 1
+    rows = _lines(led)
+    outage = next(r for r in rows if r["ev"] == "bench.outage")
+    assert outage["outage"] == "probe hung"
+    assert outage["health"]["verdict"] == "STALLED"
+    assert outage["health"]["stale"] is False
+    # the fallback metric line is in the record too
+    assert any(r["ev"] == "bench.metric" for r in rows)
+
+
+def test_bench_metric_event_on_cpu_run(tmp_path, monkeypatch,
+                                       stable_chained_timing):
+    import bench
+    monkeypatch.chdir(tmp_path)
+    led = tmp_path / "l.jsonl"
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER", str(led))
+    rc = bench.main(["--n", "65536", "--iterations", "16",
+                     "--platform", "cpu"])
+    assert rc == 0
+    metric = [r for r in _lines(led) if r["ev"] == "bench.metric"]
+    assert metric and metric[0]["unit"] == "GB/s"
+    assert metric[0]["value"] > 0

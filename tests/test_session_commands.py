@@ -146,6 +146,20 @@ STEPS = [
      ["--method=SUM", "--type=int", "--n=65536", "--iterations=4",
       "--chainreps=2", "--grid=fine", "--out=tune_fine.json"],
      "tune_fine.json"),
+    # flight-recorder collation (session exit trap): the machine
+    # summary for bench/regen, and the WINDOW_SUMMARY.md table — the
+    # rehearsal synthesizes a tiny ledger first (see the timeline
+    # special-case in the test body)
+    ('python -m tpu_reductions.obs.timeline "$TPU_REDUCTIONS_LEDGER" '
+     "--json examples/tpu_run/obs_timeline.json --quiet",
+     "tpu_reductions.obs.timeline",
+     ["obs_ledger.jsonl", "--json", "obs_timeline.json", "--quiet"],
+     None),
+    ('python -m tpu_reductions.obs.timeline "$TPU_REDUCTIONS_LEDGER" '
+     "--summary-md >> WINDOW_SUMMARY.md",
+     "tpu_reductions.obs.timeline",
+     ["obs_ledger.jsonl", "--summary-md"],
+     None),
 ]
 
 
@@ -159,13 +173,22 @@ def test_manifest_matches_script_invocation_for_invocation():
 
 @pytest.mark.parametrize("fragment,module,argv,artifact",
                          STEPS, ids=[s[1].rsplit(".", 1)[-1] + ":" +
-                                     (s[3] or "ladder") for s in STEPS])
+                                     (s[3] or s[2][-1].lstrip("-"))
+                                     for s in STEPS])
 def test_session_command_rehearses_green(fragment, module, argv,
                                          artifact, tmp_path,
                                          monkeypatch):
     import importlib
     mod = importlib.import_module(module)
     monkeypatch.chdir(tmp_path)
+    if module == "tpu_reductions.obs.timeline":
+        # the collation steps read the ledger the session built up —
+        # synthesize a tiny one through the real emitter
+        from tpu_reductions.obs import ledger
+        assert ledger.arm(tmp_path / "obs_ledger.jsonl")
+        ledger.emit("session.start", prog="rehearsal")
+        ledger.emit("session.end")
+        ledger.disarm()
     rc = mod.main(argv)
     assert rc == 0, f"{module} {argv} -> rc={rc}"
     if artifact:

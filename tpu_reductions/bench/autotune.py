@@ -294,6 +294,10 @@ def main(argv=None) -> int:
                         iterations=ns.iterations, warmup=ns.warmup,
                         stat=ns.stat, timing=ns.timing,
                         chain_reps=ns.chain_reps, log_file=None)
+    # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md)
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("bench.autotune",
+                argv=list(argv) if argv else sys.argv[1:])
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
     maybe_arm_for_tpu()  # a race hung on a dead relay loses its ranking
     logger = BenchLogger(None, None, console=sys.stderr)

@@ -567,6 +567,11 @@ def main(argv=None) -> int:
     logger = BenchLogger(None, None,
                          console=open(os.devnull, "w")
                          if (cfg.qatest or not reporting) else None)
+    # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md;
+    # every process emits — events carry pid, so a multi-process ledger
+    # still splits into per-process sessions in the timeline CLI)
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("bench.collective_driver", argv=args)
     # a collective hung on a mid-run relay death reports nothing; exit
     # promptly instead (utils/watchdog.py; no-op off-TPU)
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu

@@ -516,6 +516,11 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
     # per chunk inside utils/staging.py)
     with heartbeat.guard(heartbeat.PHASE_COMPILE):
         x_dev = jax.block_until_ready(stage_fn(x_np))
+    # flight-recorder: staging completion, untimed region (chunked big
+    # payloads additionally emit per-chunk from utils/staging.py)
+    from tpu_reductions.obs import ledger
+    ledger.emit("staging.stage", nbytes=int(getattr(x_np, "nbytes", 0)),
+                method=cfg.method, dtype=cfg.dtype, n=cfg.n)
 
     if cfg.trace_dir:
         # jax.profiler capture of the hot loop (SURVEY.md §5 tracing)
@@ -587,6 +592,9 @@ def main(argv=None) -> int:
     name = "tpu_reductions"
     qa_start(name, list(argv) if argv else sys.argv[1:])
     cfg, shmoo = parse_single_chip(argv)
+    # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md)
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session(name, argv=list(argv) if argv else sys.argv[1:])
     # a run that hangs on a mid-benchmark relay death reports nothing;
     # exit promptly instead (utils/watchdog.py; no-op off-TPU)
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu

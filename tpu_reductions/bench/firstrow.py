@@ -51,6 +51,10 @@ def _mark(marks: list, label: str) -> None:
     t = time.time()
     marks.append({"label": label, "t_rel_s": round(t - _T0, 2)})
     print(f"firstrow: T+{t - _T0:6.1f}s {label}", file=sys.stderr, flush=True)
+    # flight-recorder copy of the stage mark: the step-0 timeline joins
+    # the session narrative (obs/timeline.py), not just FIRSTROW.json
+    from tpu_reductions.obs import ledger
+    ledger.emit("firstrow.mark", label=label, t_rel_s=round(t - _T0, 2))
 
 
 def main(argv=None) -> int:
@@ -96,6 +100,10 @@ def main(argv=None) -> int:
     _apply_platform(ns)
     import jax
 
+    # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md)
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("bench.firstrow",
+                argv=list(argv) if argv else sys.argv[1:], t0=_T0)
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
     maybe_arm_for_tpu()   # a relay death mid-row must exit 3, not hang
     _mark(marks, f"jax ready (backend={jax.default_backend()}, "

@@ -152,6 +152,21 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                             calibration=cal, roofline=roof_lines,
                             annotated_rows=ann)
     log(f"regen: report: {paths['md']} {paths['tex']}")
+    # flight-recorder collation: chip_session's exit trap drops the
+    # timeline summary (obs/timeline.py --json) next to the flagship
+    # evidence — fold its window-utilization table into the report so
+    # "where did the window's minutes go" ships with the numbers
+    tl_file = out / "obs_timeline.json"
+    if tl_file.exists():
+        try:
+            from tpu_reductions.obs.timeline import summary_markdown
+            tl = json.loads(tl_file.read_text())
+            with open(paths["md"], "a") as f:
+                f.write("\n" + summary_markdown(tl) + "\n")
+            log("regen: appended window-utilization table "
+                "(obs_timeline.json)")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: obs_timeline.json unusable ({e}); skipped")
     pdf = generate_pdf(out, platform=platform,
                        data={"avgs": {}, "single_chip": sc or None,
                              "calibration": cal,

@@ -36,6 +36,7 @@ import os
 from pathlib import Path
 from typing import Callable, List, Optional
 
+from tpu_reductions.obs import ledger
 from tpu_reductions.utils.jsonio import atomic_json_dump
 
 
@@ -74,6 +75,8 @@ def prior_artifact(path: Optional[str | os.PathLike],
     meta = json.loads(json.dumps(meta))
     if not all(data.get(k) == v for k, v in meta.items()):
         return None
+    ledger.emit("resume.decision", mode="resume-single",
+                path=os.fspath(path))
     return data
 
 
@@ -116,6 +119,13 @@ class Checkpoint:
                 for row in prior.get(rows_key, []):
                     if isinstance(row, dict):
                         self._prior[key_fn(row)] = row
+        if self.path is not None:
+            # flight-recorder: the resume-vs-fresh decision is exactly
+            # the fact the old postmortems had to infer from artifact
+            # mtimes (obs/timeline.py surfaces it directly)
+            ledger.emit("resume.decision",
+                        mode="resume" if self._prior else "fresh",
+                        path=self.path, prior_rows=len(self._prior))
 
     def _load_prior(self) -> Optional[dict]:
         if self.path is None or not os.path.exists(self.path):
@@ -141,6 +151,7 @@ class Checkpoint:
         row = self._prior.get(key)
         if row is not None and reusable(row):
             self.reused.append(key)
+            ledger.emit("resume.reuse", key=str(key), path=self.path)
             return row
         return None
 
@@ -171,6 +182,10 @@ class Checkpoint:
         atomic_json_dump(self.path, {**self.meta, **(extra or {}),
                                      "complete": complete,
                                      self.rows_key: rows})
+        # flight-recorder: one event per persisted artifact state — the
+        # "what was already safe on disk when it died" answer
+        ledger.emit("artifact.persist", path=self.path, rows=len(rows),
+                    complete=complete)
 
 
 def load_cell(path: str | os.PathLike) -> dict:
@@ -196,6 +211,8 @@ def store_cell(path: str | os.PathLike, row: dict) -> None:
     No reference analog (TPU-native).
     """
     atomic_json_dump(path, row, indent=None)
+    ledger.emit("artifact.persist", path=os.fspath(path), rows=1,
+                complete=True, grain="cell")
 
 
 def result_from_row(cfg, row: dict):

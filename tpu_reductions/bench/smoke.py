@@ -129,6 +129,9 @@ def main(argv=None) -> int:
                 "pipeline has a full working set")
     _apply_platform(ns)
 
+    # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md)
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("bench.smoke", argv=list(argv) if argv else sys.argv[1:])
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
     maybe_arm_for_tpu()   # a smoke hung on a dead relay reports nothing
     logger = BenchLogger(None, None, console=sys.stderr)

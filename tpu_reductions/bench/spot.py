@@ -148,6 +148,9 @@ def main(argv=None) -> int:
                         iterations=ns.iterations, warmup=2,
                         timing="chained", chain_reps=ns.chain_reps,
                         stat="median", log_file=None)
+    # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md)
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("bench.spot", argv=list(argv) if argv else sys.argv[1:])
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
     maybe_arm_for_tpu()   # a spot hung on a dead relay reports nothing
     logger = BenchLogger(None, None, console=sys.stderr)

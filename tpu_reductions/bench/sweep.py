@@ -23,6 +23,7 @@ from tpu_reductions.bench.driver import (BenchResult, _resolve_backend,
                                          resolved_timing,
                                          run_benchmark_batch)
 from tpu_reductions.config import KERNEL_SINGLE_PASS, ReduceConfig
+from tpu_reductions.obs import ledger
 from tpu_reductions.utils.logging import BenchLogger
 
 # The flagship single-chip grid contract (scripts/run_tpu_experiment.sh
@@ -214,6 +215,9 @@ def sweep_collective(*, rank_counts=(2, 4, 8), methods=("MAX", "MIN", "SUM"),
                                           r.get("repeat")))
     rows = []
     for k in rank_counts:
+        # flight-recorder: one event per rank rung, so a postmortem can
+        # tell how far up the 2..1024 ladder a cut sweep climbed
+        ledger.emit("sweep.rank", ranks=k)
         # per-job logger writing the stdout-<mode>-<jobid> analog: the
         # driver itself emits the header + rows, exactly like the real
         # per-job stdout (aggregate.collect skips the header row); on a
@@ -322,6 +326,9 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
                         logger.log(f"sweep {dtype} {method} rep={rep} "
                                    f"-> resumed ({row['gbps']:.4f} GB/s "
                                    f"[{row['status']}])")
+                        ledger.emit("sweep.cell", dtype=dtype,
+                                    method=method, rep=rep,
+                                    mode="resumed")
                         continue
                 cfg = ReduceConfig(method=method, dtype=dtype, n=n,
                                    iterations=iterations, backend=backend,
@@ -353,6 +360,8 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
         rows[idx] = row
         logger.log(f"sweep {cfg.dtype} {cfg.method} rep={rep} "
                    f"-> {res.gbps:.4f} GB/s [{res.status.name}]")
+        ledger.emit("sweep.cell", dtype=cfg.dtype, method=cfg.method,
+                    rep=rep, mode="fresh", status=res.status.name)
         if fname and res.passed:
             # failures are never cached: a retry must re-measure; the
             # shared atomic cell writer (bench/resume.store_cell ->

@@ -116,6 +116,12 @@ def fault_point(name: str) -> Optional[dict]:
     if times is not None and hit >= after + int(times):
         return None
     action = spec.get("action", "raise")
+    # flight-recorder: a chaos run is only a replayable narrative if
+    # every scripted fault is IN the record — emitted before the
+    # side-effect so a stall/exit death certificate has its cause on
+    # the line above it (obs/ledger.py fsyncs per event)
+    from tpu_reductions.obs import ledger
+    ledger.emit("fault.fire", point=name, action=action, hit=hit)
     if action == "raise":
         raise InjectedFault(spec.get("message",
                                      f"injected fault at {name} "

@@ -58,6 +58,37 @@ COLLECTIVE_ROW_TEMPLATE = "{dtype} {op} {ranks} {gbps:.3f}"
 COLLECTIVE_ROW_RE = re.compile(r"^[A-Z][A-Z0-9]* [A-Z]+ \d+ [0-9.]+$")
 
 # --------------------------------------------------------------------------
+# Flight-recorder event rows (obs/ledger.py; docs/OBSERVABILITY.md).
+# One JSON object per line, leading keys fixed as {"t": ..., "ev": ...,
+# "pid": ...} so awk/grep postmortems can key on byte offsets the same
+# way they key on the throughput/collective rows above. The sanctioned
+# producers — obs/ledger.py (python) and scripts/obs_event.sh (shell;
+# the supervisor is python-free by design) — are held to EVENT_ROW_RE
+# by tests; redlint RED012 bans ad-hoc print/write emission of
+# event-shaped lines anywhere else (lint/rules.py).
+# --------------------------------------------------------------------------
+
+# the trigger token RED012 keys on: a literal containing this is an
+# attempt at an event row and must come from a sanctioned producer
+EVENT_KEY = '"ev":'
+
+# legal event-type names: dotted lowercase (session.start, hb.phase,
+# watchdog.exit, ...) — obs/ledger.py validates every emit against this
+EVENT_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_]+)*$")
+
+# one complete ledger line, either producer
+EVENT_ROW_RE = re.compile(
+    r'^\{"t": [0-9]+(?:\.[0-9]+)?, "ev": "[a-z][a-z0-9_.]*", '
+    r'"pid": [0-9]+(?:, .*)?\}$')
+
+
+def looks_like_event(text: str) -> bool:
+    """RED012 trigger: does this literal attempt the event-row grammar?
+    Pure string logic (same contract as check_literal below)."""
+    return EVENT_KEY in text
+
+
+# --------------------------------------------------------------------------
 # Static conformance (RED005) — validate a string literal that *looks*
 # like one of the grammars above without knowing its runtime field
 # values. The linter replaces every interpolated f-string field with

@@ -1,4 +1,4 @@
-"""redlint Python rules RED001-RED007 + RED010/RED011 — one AST walk
+"""redlint Python rules RED001-RED007 + RED010-RED012 — one AST walk
 per file.
 
 Each rule encodes one CLAUDE.md "hard-won environment fact" (or the
@@ -36,6 +36,10 @@ STAGING_WHITELIST = ("utils/staging.py",)
 GRAMMAR_WHITELIST = ("lint/grammar.py",)
 WATCHDOG_WHITELIST = ("utils/watchdog.py",)
 JSONIO_WHITELIST = ("utils/jsonio.py",)
+OBS_WHITELIST = ("obs/ledger.py",)
+# RED012 polices the runtime/measurement packages where event-shaped
+# lines would otherwise leak out as prints
+OBS_SCOPE_DIRS = ("utils", "bench", "obs", "faults")
 
 # RED006 applies to the measured packages only: every public surface in
 # ops/ and bench/ must carry its reference citation (PARITY.md).
@@ -146,6 +150,7 @@ def check_python(rel_posix: str, source: str) -> List[RawFinding]:
     out += _red007(rel_posix, ctx)
     out += _red010(rel_posix, ctx)
     out += _red011(rel_posix, ctx)
+    out += _red012(rel_posix, ctx)
     # nested timing scopes can double-report the same call site
     return sorted(set(out), key=lambda f: (f.line, f.rule, f.message))
 
@@ -510,4 +515,45 @@ def _red011(rel: str, ctx: _FileContext) -> List[RawFinding]:
                     "utils.watchdog.maybe_arm_for_tpu (or run the "
                     "utils.preflight gate) BEFORE the first backend "
                     "touch"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED012 — ad-hoc emission of flight-recorder event rows. The event-row
+# schema ({"t": ..., "ev": ..., "pid": ...}; lint/grammar.py
+# EVENT_ROW_RE) is machine-parsed by the timeline CLI exactly like the
+# throughput/collective rows are by awk pipelines — an event-shaped
+# line printed or written anywhere but the sanctioned producers
+# (obs/ledger.py; scripts/obs_event.sh on the shell side) bypasses the
+# crash-safe single-write append + fsync contract, so a kill can tear
+# it and the postmortem parser chokes on the suite's own output.
+# --------------------------------------------------------------------------
+
+def _red012(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    if _suffix_match(rel, OBS_WHITELIST):
+        return []
+    parts = rel.split("/")
+    if not (set(OBS_SCOPE_DIRS) & set(parts[:-1])):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        is_print = chain == "print"
+        is_write = isinstance(node.func, ast.Attribute) and \
+            node.func.attr in ("write", "write_text")
+        if not (is_print or is_write):
+            continue
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            text = _literal_text(a)
+            if text is not None and grammar.looks_like_event(text):
+                out.append(RawFinding(
+                    "RED012", node.lineno,
+                    "event-shaped line emitted outside obs/ledger — "
+                    "ad-hoc prints/writes bypass the crash-safe "
+                    "single-write append (torn lines break the "
+                    "timeline CLI); route through "
+                    "tpu_reductions.obs.ledger.emit (or "
+                    "scripts/obs_event.sh from shell)"))
     return out

@@ -1,0 +1,34 @@
+"""obs — the flight recorder: structured telemetry for chip sessions.
+
+The reference multiplexed every benchmark line into per-app + master
+logs precisely so runs could be audited after the fact (shrLog/shrLogEx,
+cuda/shared/src/shrUtils.cpp:157,173-280; SURVEY.md §5 — the row schema
+IS the metrics API). On this platform the audit question is harsher:
+live relay windows die in minutes (CLAUDE.md), sessions end in watchdog
+exit 3/4, and the story of *where the minutes went* — compile vs
+staging vs measuring vs retrying vs stalled — used to be scattered
+across watch logs, heartbeat stderr and per-artifact JSON. This package
+is the machine-readable record:
+
+  * `obs.ledger`  — crash-safe append-only JSONL event ledger (atomic
+    single-line appends, fsync policy shared with utils/jsonio; no
+    torn lines under SIGKILL). Armed by every entry point alongside
+    the watchdog; a no-op unless TPU_REDUCTIONS_LEDGER names a file.
+  * `obs.spans`   — span/event helpers over the ledger for the
+    instrumented seams (utils/retry, utils/staging, utils/timing,
+    utils/heartbeat phase transitions, utils/watchdog exits,
+    bench/resume checkpoints, faults/inject firings).
+  * `obs.timeline` — the post-mortem CLI: reconstructs a session
+    timeline from a ledger, attributes wall-clock per phase, and
+    computes window-utilization metrics (text report, summary JSON,
+    and the WINDOW_SUMMARY.md markdown table).
+
+Strictly host-side by contract: instrumentation adds no device work, no
+sync, and never emits inside a timed region (docs/OBSERVABILITY.md has
+the overhead guarantees; docs/TIMING.md the ack-vs-materialization
+attribution rules the phase labels preserve).
+"""
+
+from tpu_reductions.obs.ledger import arm, arm_session, armed, emit
+
+__all__ = ["arm", "arm_session", "armed", "emit"]

@@ -1,0 +1,255 @@
+"""Crash-safe append-only JSONL event ledger — the flight recorder core.
+
+Design constraints, in order:
+
+  1. **No torn lines.** A watchdog `os._exit` (utils/watchdog.py) or a
+     SIGKILL-class death (faults/inject.py action "exit") can land at
+     ANY instant; a postmortem that cannot parse its own ledger is
+     worse than none. Every event is therefore ONE `os.write` of one
+     complete line to an O_APPEND fd — appends of line-sized writes are
+     atomic at the fd layer, so concurrent writers (the session, the
+     watchdog thread, the shell supervisors via scripts/obs_event.sh)
+     interleave at line granularity and a kill can only lose the line
+     in flight, never tear a previous one. The write is fsync'd — the
+     same durability contract as utils/jsonio (an event that claimed a
+     row persisted must itself survive the power cut).
+  2. **Never the failure.** `emit` never raises and never blocks on
+     anything but the local filesystem: observability must not take
+     down the measurement it observes. Internal errors disarm the
+     ledger after one stderr warning.
+  3. **Free when off.** Unarmed (TPU_REDUCTIONS_LEDGER unset) or
+     disabled (TPU_REDUCTIONS_OBS_DISABLE=1), `emit` is one attribute
+     test. No entry point changes behavior when the recorder is off.
+  4. **Host-side only.** No jax import, no device call, no sync — and
+     callers only emit OUTSIDE timed regions (docs/OBSERVABILITY.md
+     "overhead guarantees"; the timing seams in utils/timing.py emit
+     after their perf_counter windows close).
+
+Row grammar: `{"t": <epoch>, "ev": "<type>", "pid": <pid>, ...}` — the
+leading keys are fixed and the schema lives in lint/grammar.py
+(EVENT_ROW_RE / EVENT_NAME_RE) like every other machine-parsed row this
+suite emits; redlint RED012 bans ad-hoc emission outside this module
+and scripts/obs_event.sh. Events carry the current heartbeat phase
+(utils/heartbeat.py) when one is active, so ack-vs-materialization
+attribution stays honest per docs/TIMING.md.
+
+This is the shrLog/shrLogEx master-log multiplex of the reference
+(cuda/shared/src/shrUtils.cpp:157,173-280) rebuilt as a typed,
+crash-ordered event stream instead of prose lines.
+
+CLI (used by tests and hand-driven postmortems; the shell supervisors
+use scripts/obs_event.sh instead to stay python-free):
+
+    python -m tpu_reductions.obs.ledger <event> [key=value ...] \
+        [--ledger PATH]
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+from tpu_reductions.lint.grammar import EVENT_NAME_RE
+
+ENV_PATH = "TPU_REDUCTIONS_LEDGER"
+ENV_DISABLE = "TPU_REDUCTIONS_OBS_DISABLE"
+
+_fd: Optional[int] = None
+_path: Optional[str] = None
+_session_open = False
+
+
+def disabled() -> bool:
+    """TPU_REDUCTIONS_OBS_DISABLE=1: hard off, even when armed."""
+    return os.environ.get(ENV_DISABLE) == "1"
+
+
+def resolved_path(path: Optional[str | os.PathLike] = None
+                  ) -> Optional[str]:
+    """The ledger file: explicit argument, else TPU_REDUCTIONS_LEDGER,
+    else None (recorder off — the default for bare CLI invocations;
+    scripts/chip_session.sh exports the env for live windows)."""
+    if path is not None:
+        return os.fspath(path)
+    return os.environ.get(ENV_PATH) or None
+
+
+def armed() -> bool:
+    """Whether emits currently reach a ledger file."""
+    return _fd is not None and not disabled()
+
+
+def _warn(msg: str) -> None:
+    print(f"obs.ledger: {msg} (recorder disarmed; the run continues "
+          "unobserved)", file=sys.stderr, flush=True)
+
+
+def arm(path: Optional[str | os.PathLike] = None) -> Optional[str]:
+    """Open (create) the ledger for appending; returns the path or None
+    when the recorder stays off. Idempotent for the same path; arming a
+    different path closes the previous fd."""
+    global _fd, _path
+    if disabled():
+        return None
+    path = resolved_path(path)
+    if path is None:
+        return None
+    if _fd is not None and _path == path:
+        return path
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    except OSError as e:
+        _warn(f"cannot open ledger {path!r}: {e}")
+        return None
+    if _fd is not None:
+        try:
+            os.close(_fd)
+        except OSError:
+            pass
+    _fd, _path = fd, path
+    return path
+
+
+def disarm() -> None:
+    """Close the ledger (tests; subprocesses end via session.end)."""
+    global _fd, _path, _session_open
+    if _fd is not None:
+        try:
+            os.close(_fd)
+        except OSError:
+            pass
+    _fd, _path, _session_open = None, None, False
+
+
+def _current_phase() -> Optional[str]:
+    """The active heartbeat phase, lazily (no import cycle: heartbeat
+    emits through this module and this module only READS heartbeat)."""
+    try:
+        from tpu_reductions.utils import heartbeat
+        snap = heartbeat.snapshot()
+        return snap["phase"] if snap["in_flight"] else None
+    except Exception:
+        return None
+
+
+def _clean(v):
+    """JSON-safe field value: non-finite floats become null (the
+    RFC-8259 discipline of BenchResult.to_dict), unknown types
+    stringify."""
+    if isinstance(v, float) and (v != v or v in (float("inf"),
+                                                 float("-inf"))):
+        return None
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_clean(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _clean(x) for k, x in v.items()}
+    return str(v)
+
+
+def emit(ev: str, **fields) -> bool:
+    """Append one event; returns True iff a line landed. NEVER raises.
+
+    Fields pass through as JSON (None stays null — an explicit
+    `phase=None` records a cleared phase); the current heartbeat phase
+    is attached automatically when the caller does not pass one."""
+    global _fd
+    if _fd is None or disabled():
+        return False
+    try:
+        if not EVENT_NAME_RE.match(ev):
+            _warn_once_bad_name(ev)
+            return False
+        rec = {"t": round(time.time(), 6), "ev": ev, "pid": os.getpid()}
+        if "phase" not in fields:
+            phase = _current_phase()
+            if phase is not None:
+                rec["phase"] = phase
+        for k, v in fields.items():
+            rec[str(k)] = _clean(v)
+        line = (json.dumps(rec) + "\n").encode("utf-8", "replace")
+        os.write(_fd, line)          # ONE write: line-atomic append
+        os.fsync(_fd)                # jsonio durability contract
+        return True
+    except Exception as e:           # constraint 2: never the failure
+        try:
+            _warn(f"append failed: {type(e).__name__}: {e}")
+            disarm()
+        except Exception:
+            pass
+        return False
+
+
+_bad_names: set = set()
+
+
+def _warn_once_bad_name(ev: str) -> None:
+    if ev not in _bad_names:
+        _bad_names.add(ev)
+        print(f"obs.ledger: dropped event with non-grammar name {ev!r} "
+              "(lint/grammar.py EVENT_NAME_RE)", file=sys.stderr,
+              flush=True)
+
+
+def arm_session(prog: str, argv=None, **fields) -> Optional[str]:
+    """The entry-point hook: arm from the environment and record
+    `session.start` (+ a best-effort `session.end` at interpreter exit
+    — watchdog exits bypass atexit by design and are recorded by their
+    own `watchdog.exit` event instead). Call it next to
+    `maybe_arm_for_tpu` in every main; a no-op when no ledger is
+    configured."""
+    global _session_open
+    path = arm()
+    if path is None:
+        return None
+    emit("session.start", prog=prog,
+         argv=list(argv) if argv is not None else None, **fields)
+    if not _session_open:
+        _session_open = True
+        atexit.register(_end_session)
+    return path
+
+
+def _end_session() -> None:
+    emit("session.end")
+
+
+def main(argv=None) -> int:
+    """CLI append: one event from the command line (tests, hand-driven
+    postmortem annotations). key=value fields parse numerics; the
+    shell supervisors use scripts/obs_event.sh instead (no python
+    import on their hot paths)."""
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.obs.ledger",
+        description="Append one event to the flight-recorder ledger")
+    p.add_argument("event", help="dotted event name (lint/grammar.py "
+                                 "EVENT_NAME_RE)")
+    p.add_argument("fields", nargs="*", help="key=value event fields")
+    p.add_argument("--ledger", default=None,
+                   help="ledger path (default TPU_REDUCTIONS_LEDGER)")
+    ns = p.parse_args(argv)
+    if arm(ns.ledger) is None:
+        print("obs.ledger: no ledger configured "
+              f"(--ledger or {ENV_PATH})", file=sys.stderr)
+        return 1
+    fields = {}
+    for kv in ns.fields:
+        k, _, v = kv.partition("=")
+        try:
+            fields[k] = int(v)
+        except ValueError:
+            try:
+                fields[k] = float(v)
+            except ValueError:
+                fields[k] = v
+    return 0 if emit(ns.event, **fields) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

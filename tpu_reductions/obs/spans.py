@@ -1,0 +1,47 @@
+"""Span API over the flight-recorder ledger (obs/ledger.py).
+
+A span is one host-side region worth postmortem attribution: it emits
+`<name>.start` on entry and `<name>.end` (with `dur_s`, and `error`
+when the region raised) on exit. This is the named-stopwatch idea of
+the reference's cutil timer registry (cutCreateTimer/cutStartTimer,
+cutil.cpp:1567-1692) re-pointed at the event ledger instead of an
+in-memory average — the duration lands in the crash-ordered record, so
+it survives the process.
+
+Spans are strictly host-side instrumentation: they never sync a
+device, and the instrumented seams only open spans OUTSIDE timed
+regions (utils/timing.py emits after its perf_counter windows close;
+docs/OBSERVABILITY.md has the full overhead contract). When the ledger
+is unarmed a span is two attribute tests — safe to leave in hot-ish
+host paths.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from tpu_reductions.obs import ledger
+
+event = ledger.emit     # alias: seams import one module for both
+
+
+@contextlib.contextmanager
+def span(name: str, **fields):
+    """Bracket one host-side region with `<name>.start` / `<name>.end`
+    events; `dur_s` is monotonic wall-clock, `error` records a raising
+    region (the exception is re-raised untouched — spans observe,
+    never contain)."""
+    if not ledger.armed():
+        yield
+        return
+    ledger.emit(name + ".start", **fields)
+    t0 = time.monotonic()
+    try:
+        yield
+    except BaseException as e:
+        ledger.emit(name + ".end", dur_s=round(time.monotonic() - t0, 6),
+                    error=f"{type(e).__name__}: {e}"[:200], **fields)
+        raise
+    ledger.emit(name + ".end", dur_s=round(time.monotonic() - t0, 6),
+                **fields)

@@ -1,0 +1,307 @@
+"""Post-mortem timeline: reconstruct chip sessions from a flight
+recorder ledger and attribute wall-clock per phase.
+
+The reference audited runs by re-reading accumulated logs offline
+(getAvgs.sh over stdout-*; the shrLog master log). This is that
+analysis layer for the event ledger (obs/ledger.py): purely offline,
+never touches a device, safe to run the moment a watchdog exit 3/4
+hands control back — docs/RESILIENCE.md's runbook says to run it
+FIRST.
+
+What it computes, per session (one `session.start`..end/exit stream
+per pid) and for the window as a whole:
+
+  * a chronological narrative (every event, T+offset from the ledger's
+    first event — the firstrow timeline generalized to every entry
+    point);
+  * per-phase wall-clock attribution from the heartbeat phase
+    transitions (`hb.phase` events, utils/heartbeat.py): measure /
+    compile / staging / host, with retry backoff carved out of host
+    time (retry.attempt events) and exit-4 stall age carved out of the
+    stalled guard's bucket (watchdog.exit events) — so "where did the
+    minutes go" has a machine answer;
+  * window-utilization metrics: the fraction of recorded seconds spent
+    measuring vs compiling vs staging vs retrying vs stalled.
+
+Outputs: a text report (default), `--json OUT` (summary JSON written
+atomically via utils/jsonio — bench/regen collates it into report.md),
+and `--summary-md` (the WINDOW_SUMMARY.md per-window utilization
+table, so the next live round's summary is computed, not hand-written).
+
+Torn/unparseable lines are COUNTED and reported, never fatal: the
+ledger's single-write append contract makes them impossible in normal
+operation, so a nonzero count is itself a finding.
+
+CLI:
+    python -m tpu_reductions.obs.timeline <ledger.jsonl> \
+        [--json OUT] [--summary-md] [--max-events N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Tuple
+
+BUCKETS = ("measure", "compile", "staging", "retrying", "stalled",
+           "host")
+
+
+def _bucket(phase: Optional[str]) -> str:
+    """Map a heartbeat phase label to an attribution bucket. Unknown
+    guarded phases (chained/fetch/bulk/periter/device/steady/...) are
+    measurement by construction — only guarded device regions carry a
+    phase at all (utils/heartbeat.py)."""
+    if phase is None:
+        return "host"
+    if phase == "compile":
+        return "compile"
+    if phase == "staging":
+        return "staging"
+    return "measure"
+
+
+def read_ledger(path) -> Tuple[List[dict], int]:
+    """Parse a JSONL ledger -> (events sorted by t, torn_line_count).
+    A line that fails to parse, or parses to something that is not an
+    event row, counts as torn."""
+    events: List[dict] = []
+    torn = 0
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                torn += 1
+                continue
+            if isinstance(rec, dict) and isinstance(rec.get("t"),
+                                                    (int, float)) \
+                    and isinstance(rec.get("ev"), str):
+                events.append(rec)
+            else:
+                torn += 1
+    events.sort(key=lambda e: e["t"])
+    return events, torn
+
+
+def split_sessions(events: List[dict]) -> List[dict]:
+    """Group events into sessions: per pid, a new session opens at each
+    `session.start` (events before one — e.g. shell supervisor events —
+    form their own leading pseudo-session). Sessions order by first
+    event time."""
+    by_pid: dict = {}
+    for e in events:
+        by_pid.setdefault(e.get("pid"), []).append(e)
+    sessions = []
+    for pid, evs in by_pid.items():
+        cur = None
+        for e in evs:
+            if e["ev"] == "session.start" or cur is None:
+                cur = {"pid": pid, "events": []}
+                sessions.append(cur)
+            cur["events"].append(e)
+    sessions.sort(key=lambda s: s["events"][0]["t"])
+    return sessions
+
+
+def analyze_session(sess: dict) -> dict:
+    """Per-phase wall-clock attribution for one session (module
+    docstring has the carving rules)."""
+    evs = sess["events"]
+    t0, t1 = evs[0]["t"], evs[-1]["t"]
+    buckets = dict.fromkeys(BUCKETS, 0.0)
+    phase: Optional[str] = None
+    retry_s = 0.0
+    exit_event = None
+    prog = next((e.get("prog") for e in evs
+                 if e["ev"] == "session.start"), None)
+    for i, e in enumerate(evs):
+        if e["ev"] == "hb.phase":
+            phase = e.get("phase")
+        if e["ev"] == "retry.attempt":
+            d = e.get("delay_s")
+            retry_s += float(d) if isinstance(d, (int, float)) else 0.0
+        if e["ev"] == "watchdog.exit" and exit_event is None:
+            exit_event = e
+        nxt = evs[i + 1]["t"] if i + 1 < len(evs) else t1
+        buckets[_bucket(phase)] += max(0.0, nxt - e["t"])
+    # retry backoff sleeps run between guards (phase None -> host):
+    # carve them into their own bucket, bounded so clock skew between
+    # events can never drive host time negative
+    carve = min(retry_s, buckets["host"])
+    buckets["host"] -= carve
+    buckets["retrying"] += carve
+    # an exit-4 hang accrued its no-progress age inside the stalled
+    # guard's phase bucket — reattribute it as stalled time
+    if exit_event is not None and exit_event.get("code") == 4:
+        age = exit_event.get("age_s")
+        age = float(age) if isinstance(age, (int, float)) else 0.0
+        b = _bucket(exit_event.get("phase"))
+        carve = min(age, buckets[b])
+        buckets[b] -= carve
+        buckets["stalled"] += carve
+    wall = max(t1 - t0, 0.0)
+    ended = any(e["ev"] == "session.end" for e in evs)
+    if exit_event is not None:
+        end = f"exit {exit_event.get('code')}"
+    elif ended:
+        end = "end"
+    else:
+        end = "cut"       # no terminal event: SIGKILL-class death
+    return {
+        "pid": sess["pid"],
+        "prog": prog,
+        "t0": t0, "t1": t1,
+        "wall_s": round(wall, 6),
+        "end": end,
+        "events": len(evs),
+        "phases_s": {k: round(v, 6) for k, v in buckets.items()},
+        "utilization": {k: (round(v / wall, 4) if wall > 0 else 0.0)
+                        for k, v in buckets.items()},
+        "persists": sum(1 for e in evs if e["ev"] == "artifact.persist"),
+        "reused_rows": sum(1 for e in evs if e["ev"] == "resume.reuse"),
+        "retries": sum(1 for e in evs if e["ev"] == "retry.attempt"),
+        "faults": sum(1 for e in evs if e["ev"] == "fault.fire"),
+    }
+
+
+def summarize(path, events: List[dict], torn: int) -> dict:
+    """The machine-readable summary JSON (bench/regen collates it into
+    report.md; chip_session.sh persists it as obs_timeline.json)."""
+    sessions = [analyze_session(s) for s in split_sessions(events)]
+    out = {"ledger": str(path), "events": len(events),
+           "torn_lines": torn, "sessions": sessions}
+    if events:
+        t0, t1 = events[0]["t"], events[-1]["t"]
+        wall = max(t1 - t0, 0.0)
+        totals = dict.fromkeys(BUCKETS, 0.0)
+        for s in sessions:
+            for k, v in s["phases_s"].items():
+                totals[k] += v
+        recorded = sum(totals.values())
+        out["window"] = {
+            "t0": t0, "t1": t1, "wall_s": round(wall, 6),
+            "recorded_s": round(recorded, 6),
+            "phases_s": {k: round(v, 6) for k, v in totals.items()},
+            "utilization": {k: (round(v / recorded, 4)
+                                if recorded > 0 else 0.0)
+                            for k, v in totals.items()},
+        }
+    return out
+
+
+def _fmt_event(e: dict, t0: float) -> str:
+    skip = {"t", "ev", "pid"}
+    detail = " ".join(f"{k}={e[k]}" for k in e if k not in skip)
+    return f"  T+{e['t'] - t0:9.3f}s [{e.get('pid')}] {e['ev']:<18} " \
+           f"{detail}".rstrip()
+
+
+def narrative(events: List[dict], torn: int, summary: dict,
+              max_events: int = 400) -> str:
+    """The human text report: chronological event narrative + the
+    per-session attribution block."""
+    lines = []
+    if not events:
+        return "empty ledger (no parseable events)"
+    t0 = events[0]["t"]
+    lines.append(f"{summary['events']} event(s), {torn} torn line(s), "
+                 f"{len(summary['sessions'])} session(s), "
+                 f"{summary.get('window', {}).get('wall_s', 0.0):.1f} s "
+                 "recorded")
+    shown = events[:max_events]
+    for e in shown:
+        lines.append(_fmt_event(e, t0))
+    if len(events) > len(shown):
+        lines.append(f"  ... {len(events) - len(shown)} more event(s) "
+                     "(raise --max-events)")
+    for s in summary["sessions"]:
+        ph = s["phases_s"]
+        util = " | ".join(f"{k} {ph[k]:.2f}s ({s['utilization'][k]:.0%})"
+                          for k in BUCKETS if ph[k] > 0)
+        lines.append(f"session {s['prog'] or '(shell)'} pid={s['pid']} "
+                     f"T+{s['t0'] - t0:.3f}s..T+{s['t1'] - t0:.3f}s "
+                     f"-> {s['end']}: {util or 'no attributed time'}; "
+                     f"{s['persists']} persist(s), "
+                     f"{s['reused_rows']} reused row(s), "
+                     f"{s['retries']} retry(ies)")
+    return "\n".join(lines)
+
+
+def summary_markdown(summary: dict) -> str:
+    """The per-window utilization table for WINDOW_SUMMARY.md — the
+    satellite contract: the next round's summary is computed from the
+    ledger, never hand-written."""
+    lines = ["## window utilization (flight recorder)", ""]
+    lines.append("| session | wall s | measure | compile | staging "
+                 "| retry | stalled | host | end |")
+    lines.append("|---|---|---|---|---|---|---|---|---|")
+    for s in summary.get("sessions", []):
+        u = s["utilization"]
+        lines.append(
+            f"| {s['prog'] or '(shell)'} (pid {s['pid']}) "
+            f"| {s['wall_s']:.1f} "
+            f"| {u['measure']:.0%} | {u['compile']:.0%} "
+            f"| {u['staging']:.0%} | {u['retrying']:.0%} "
+            f"| {u['stalled']:.0%} | {u['host']:.0%} | {s['end']} |")
+    win = summary.get("window")
+    if win:
+        u = win["utilization"]
+        lines.append("")
+        lines.append(
+            f"window: {win['recorded_s']:.1f} s recorded — "
+            f"measure {u['measure']:.0%}, compile {u['compile']:.0%}, "
+            f"staging {u['staging']:.0%}, retrying {u['retrying']:.0%}, "
+            f"stalled {u['stalled']:.0%}, host {u['host']:.0%}"
+            + (f"; {summary['torn_lines']} torn line(s)"
+               if summary.get("torn_lines") else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    """CLI: reconstruct the session timeline from a ledger (module
+    docstring). Exit 0 with events, 1 on an empty/absent ledger."""
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.obs.timeline",
+        description="Post-mortem timeline + window-utilization metrics "
+                    "from a flight-recorder ledger")
+    p.add_argument("ledger", help="JSONL event ledger (obs/ledger.py)")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="write the machine-readable summary here "
+                        "(atomic; bench/regen collates it)")
+    p.add_argument("--summary-md", action="store_true",
+                   help="print ONLY the WINDOW_SUMMARY.md utilization "
+                        "table")
+    p.add_argument("--quiet", action="store_true",
+                   help="no stdout (use with --json from scripts)")
+    p.add_argument("--max-events", type=int, default=400,
+                   help="narrative event cap (default 400)")
+    ns = p.parse_args(argv)
+    try:
+        events, torn = read_ledger(ns.ledger)
+    except OSError as e:
+        print(f"timeline: cannot read {ns.ledger}: {e}",
+              file=sys.stderr)
+        return 1
+    summary = summarize(ns.ledger, events, torn)
+    if ns.json_out:
+        from tpu_reductions.utils.jsonio import atomic_json_dump
+        atomic_json_dump(ns.json_out, summary)
+    if ns.quiet:
+        pass
+    elif ns.summary_md:
+        print(summary_markdown(summary))
+    else:
+        print(narrative(events, torn, summary,
+                        max_events=ns.max_events))
+        print()
+        print(summary_markdown(summary))
+    return 0 if events else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

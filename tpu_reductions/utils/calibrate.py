@@ -234,6 +234,10 @@ def main(argv=None) -> int:
     ns = p.parse_args(argv)
     from tpu_reductions.config import _apply_platform
     _apply_platform(ns)
+    # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md)
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("utils.calibrate",
+                argv=list(argv) if argv else sys.argv[1:])
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
     maybe_arm_for_tpu()  # no-op off-TPU; exits 3 on a dead relay
     import jax

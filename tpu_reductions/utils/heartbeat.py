@@ -36,6 +36,13 @@ other phase gets TPU_REDUCTIONS_HEARTBEAT_DEADLINE_S (default 120 s;
 guards is deliberately unwatched — an oracle recompute can take
 minutes without ever being able to hang on the tunnel.
 
+Observability seam: every phase TRANSITION (guard enter/exit, tick
+relabel) lands as an `hb.phase` event in the flight recorder
+(obs/ledger.py; free when unarmed) — the raw material the timeline CLI
+(obs/timeline.py) turns into per-phase wall-clock attribution. Plain
+ticks without a phase change emit nothing, so per-iteration marks stay
+event-free.
+
 Chaos seam: every mark update consults the `heartbeat.tick` fault
 point (faults/inject.py). A passive `{"action": "suppress"}` spec
 freezes the mark while the site keeps looping — the deterministic way
@@ -87,6 +94,18 @@ def deadline_for(phase: Optional[str]) -> float:
                       DEFAULT_DEADLINE_S)
 
 
+def _emit_phase(prev: Optional[str], new: Optional[str]) -> None:
+    """One phase-transition event into the flight recorder
+    (obs/ledger.py; free when unarmed). Called OUTSIDE _lock — the
+    ledger reads snapshot() — and never allowed to perturb the mark
+    path: observability failures stay silent here."""
+    try:
+        from tpu_reductions.obs import ledger
+        ledger.emit("hb.phase", phase=new, prev=prev)
+    except Exception:
+        pass
+
+
 def _touch(phase: Optional[str] = None) -> None:
     """One progress mark; the chaos seam (module docstring) can
     suppress it."""
@@ -94,11 +113,16 @@ def _touch(phase: Optional[str] = None) -> None:
     spec = fault_point("heartbeat.tick")
     if spec is not None and spec.get("action") == "suppress":
         return
+    prev = new = None
     with _lock:
         if phase is not None and _phases:
+            prev = _phases[-1]
             _phases[-1] = phase
+            new = phase
         _mark = time.monotonic()
         _beats += 1
+    if new is not None and new != prev:
+        _emit_phase(prev, new)
 
 
 def tick(phase: Optional[str] = None) -> None:
@@ -120,8 +144,11 @@ def guard(phase: str):
     own)."""
     global _depth, _mark, _beats
     with _lock:
+        prev = _phases[-1] if _phases else None
         _depth += 1
         _phases.append(phase)
+    if phase != prev:
+        _emit_phase(prev, phase)
     _touch()
     try:
         yield
@@ -130,8 +157,11 @@ def guard(phase: str):
             _depth = max(0, _depth - 1)
             if _phases:
                 _phases.pop()
+            restored = _phases[-1] if _phases else None
             _mark = time.monotonic()
             _beats += 1
+        if restored != phase:
+            _emit_phase(phase, restored)
 
 
 def snapshot() -> dict:

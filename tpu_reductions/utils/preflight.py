@@ -191,6 +191,11 @@ def _persist(health_file: Optional[str], verdict: str, relay: str,
               "elapsed_s": round(elapsed_s, 2), "ts": time.time(),
               "detail": detail}
     atomic_json_dump(health_file_path(health_file), record)
+    # flight-recorder: the verdict used to live only in the health
+    # file; now it is also part of the run record (obs/timeline.py)
+    from tpu_reductions.obs import ledger
+    ledger.emit("preflight.verdict", verdict=verdict, relay=relay,
+                elapsed_s=round(elapsed_s, 2), detail=detail[:200])
     return record
 
 

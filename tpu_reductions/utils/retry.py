@@ -32,6 +32,7 @@ import os
 import time
 from typing import Callable, Optional
 
+from tpu_reductions.obs import ledger
 from tpu_reductions.utils import heartbeat
 from tpu_reductions.utils.watchdog import relay_alive, tunneled_environment
 
@@ -74,14 +75,25 @@ def retry_device_call(fn: Callable, *, retries: Optional[int] = None,
             with heartbeat.guard(phase):
                 return fn()
         except Exception as e:
+            err = f"{type(e).__name__}: {e}"[:200]
             if not tunneled():
+                ledger.emit("retry.fatal", reason="untunneled",
+                            error=err)
                 raise            # deterministic off-tunnel error
             if not alive():
+                ledger.emit("retry.fatal", reason="relay-dead",
+                            error=err)
                 raise            # dead relay: watchdog territory
             if attempt >= budget:
+                ledger.emit("retry.fatal", reason="budget-exhausted",
+                            attempt=attempt, budget=budget, error=err)
                 raise            # flap outlasted the retry budget
             delay = backoff_s * (2 ** attempt)
             attempt += 1
+            # flight-recorder: retry backoff is postmortem-attributable
+            # time (obs/timeline.py carves delay_s out of host time)
+            ledger.emit("retry.attempt", attempt=attempt, budget=budget,
+                        delay_s=round(delay, 6), error=err)
             if log is not None:
                 log(f"retry: transient device-call failure "
                     f"({type(e).__name__}: {e}); relay answers — "

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_reductions.faults.inject import fault_point
+from tpu_reductions.obs import ledger
 from tpu_reductions.utils import heartbeat
 
 # Per-message bound. 2 GiB messages survived the tunnel, 4 GiB killed
@@ -88,6 +89,12 @@ def device_put_chunked(flat: np.ndarray, rows: int, lanes: int,
     # the hang the watchdog's port probe cannot see — each staged chunk
     # ticks forward progress so only a genuinely stuck transfer goes
     # stale (utils/heartbeat.py; watchdog exit 4)
+    # flight-recorder: staging is untimed on every path (module
+    # docstring), so per-chunk events cost wall-clock only — and the
+    # chunk loop is exactly the region the round-2 postmortems could
+    # never reconstruct (which chunk was in flight when the relay died)
+    ledger.emit("staging.start", nbytes=int(flat.nbytes), rows=rows,
+                lanes=lanes, chunk_bytes=int(chunk_bytes))
     with heartbeat.guard("staging"):
         for r in range(0, full_rows, row_step):
             # chaos hook: the round-2 killer was a relay death mid-
@@ -100,11 +107,15 @@ def device_put_chunked(flat: np.ndarray, rows: int, lanes: int,
                 flat[r * lanes:(r + k) * lanes]).reshape(k, lanes)
             buf = insert(buf, jax.device_put(chunk), jnp.int32(r))
             heartbeat.tick()
+            ledger.emit("staging.chunk", row=r,
+                        rows_done=min(r + k, full_rows),
+                        total_rows=full_rows)
         tail = flat[full_rows * lanes:]
         if tail.size:
             last = np.full((1, lanes), identity, dtype=flat.dtype)
             last[0, :tail.size] = tail
             buf = insert(buf, jax.device_put(last), jnp.int32(full_rows))
+    ledger.emit("staging.end", rows=rows, lanes=lanes)
     return buf
 
 

@@ -24,6 +24,7 @@ from typing import Callable, Dict, Optional
 import jax
 
 from tpu_reductions.faults.inject import fault_point
+from tpu_reductions.obs import ledger
 from tpu_reductions.utils import heartbeat
 
 
@@ -154,6 +155,11 @@ def time_fn(fn: Callable, *args, iterations: int = 100, warmup: int = 1,
                 jax.device_get(result)  # full host materialization trip
             sw.stop()
             heartbeat.tick()
+    # flight-recorder: ONE event after the loop (never inside the
+    # stopwatch windows — the obs overhead contract,
+    # docs/OBSERVABILITY.md)
+    ledger.emit("timing.loop", mode=mode, iterations=iterations,
+                avg_s=round(sw.average_s, 9))
     return result, sw
 
 
@@ -201,13 +207,21 @@ def time_chained(chained_fn, x, k_lo: int, k_hi: int, reps: int = 5,
         with heartbeat.guard(phase):
             t0 = time.perf_counter()
             fetch(chained_fn(x, k))
-            return time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+        # flight-recorder: emitted AFTER the perf_counter window closes
+        # and after the guard exits — trip events must never sit inside
+        # the measured region (docs/OBSERVABILITY.md); both trips of a
+        # slope pay the same (zero) in-window cost either way
+        ledger.emit("chain.trip", k=int(k), trip=trips,
+                    dur_s=round(dt, 9), phase=phase)
+        return dt
 
     run(k_lo)   # warm-up: compile (k is traced — one executable for both)
     run(k_hi)   # warm-up: queue drain at the long trip count
-    for _ in range(reps):
+    for rep in range(reps):
         slope = (run(k_hi) - run(k_lo)) / span
         sw.total_s += slope
         sw.sessions += 1
         sw.samples.append(slope)
+        ledger.emit("chain.slope", rep=rep, slope_s=round(slope, 12))
     return sw

@@ -49,6 +49,7 @@ import threading
 from typing import Optional, Sequence
 
 from tpu_reductions.faults.inject import fault_point
+from tpu_reductions.obs import ledger
 from tpu_reductions.utils import heartbeat
 from tpu_reductions.utils.heartbeat import HANG_EXIT_CODE  # noqa: F401
 #   (re-exported: consumers treat exit 3 = relay dead, exit 4 = hang
@@ -200,10 +201,17 @@ def start_relay_watchdog(interval_s: float = 60.0, grace: int = 3,
                       "in-session, CLAUDE.md); exiting so the step "
                       "harness keeps the artifacts persisted so far"
                       + diag, file=sys.stderr, flush=True)
+                # flight-recorder: the fsync'd exit event IS the death
+                # certificate a postmortem timeline keys on — it must
+                # land before os._exit (obs/ledger.py constraint 1)
+                ledger.emit("watchdog.exit", code=WATCHDOG_EXIT_CODE,
+                            dead_probes=dead,
+                            inconclusive=inconclusive_total)
                 _exit(WATCHDOG_EXIT_CODE)
 
     threading.Thread(target=watch, name="relay-watchdog",
                      daemon=True).start()
+    ledger.emit("watchdog.arm", interval_s=interval_s, grace=grace)
     return stop
 
 
@@ -228,6 +236,13 @@ def _check_hang(relay_verdict: str, ports, _exit) -> None:
           "lease hangs device waits the port probe reports healthy; "
           "exiting 4 so the rows persisted so far survive "
           "(docs/RESILIENCE.md)", file=sys.stderr, flush=True)
+    # flight-recorder death certificate: phase + no-progress age let
+    # the timeline CLI attribute the stall (obs/timeline.py carves
+    # age_s into the 'stalled' bucket)
+    ledger.emit("watchdog.exit", code=HANG_EXIT_CODE,
+                age_s=round(snap["age_s"], 3), phase=snap["phase"],
+                deadline_s=deadline, relay=relay_verdict,
+                beats=snap["beats"])
     _exit(HANG_EXIT_CODE)
 
 
@@ -300,6 +315,8 @@ def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
                   "already dead (pre-JAX probe); device discovery "
                   "itself would hang — exiting before the first jax "
                   "call", file=sys.stderr, flush=True)
+            ledger.emit("watchdog.exit", code=WATCHDOG_EXIT_CODE,
+                        reason="pre-jax dead relay")
             _exit(WATCHDOG_EXIT_CODE)
             return None  # unreachable except under an injected _exit
 
@@ -324,6 +341,9 @@ def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
                       "hangs); refusing to make the first jax call — "
                       "it can only hang forever", file=sys.stderr,
                       flush=True)
+                ledger.emit("watchdog.exit", code=HANG_EXIT_CODE,
+                            reason="preflight health gate",
+                            verdict=verdict)
                 _exit(HANG_EXIT_CODE)
                 return None  # unreachable except under injected _exit
 
@@ -345,5 +365,7 @@ def maybe_arm_for_tpu(interval_s: float = 60.0, grace: int = 3,
     print("relay watchdog: tunneled TPU but the relay is already dead "
           "(two probes); refusing to start device work that can only "
           "hang", file=sys.stderr, flush=True)
+    ledger.emit("watchdog.exit", code=WATCHDOG_EXIT_CODE,
+                reason="arming probes dead")
     _exit(WATCHDOG_EXIT_CODE)
     return None  # unreachable except under an injected _exit (tests)
