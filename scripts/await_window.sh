@@ -83,14 +83,23 @@ fi
 
 probe() {
     # -S skips site init (~2 s in this venv); stdlib sockets only.
-    # Ports come from the same env override the watchdog honors, so
-    # the chaos harness's fake relay (faults/relay.py) is probed by
-    # the identical machinery a real window would use.
+    # The default port list comes from the ONE canonical source
+    # (tpu_reductions/utils/relay_env.py, exec'd by path — no package
+    # import) so this probe cannot drift from the watchdog's; the
+    # TPU_REDUCTIONS_RELAY_PORTS env override the chaos harness points
+    # at its fake relay (faults/relay.py) wins inside env_ports().
+    # An unreadable canonical source counts as "not alive": the watcher
+    # keeps polling (conservative) instead of firing a session from a
+    # broken checkout.
+    RELAY_ENV_PY="$REPO_DIR/tpu_reductions/utils/relay_env.py" \
     python -S -c '
 import os, socket, sys
-ports = [int(p) for p in os.environ.get("TPU_REDUCTIONS_RELAY_PORTS",
-                                        "8082,8083").split(",") if p.strip()]
-for port in ports:
+g = {}
+try:
+    exec(open(os.environ["RELAY_ENV_PY"]).read(), g)
+except OSError:
+    sys.exit(1)
+for port in g["env_ports"]():
     try:
         socket.create_connection(("127.0.0.1", port), timeout=2).close()
         sys.exit(0)

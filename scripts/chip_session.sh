@@ -1,20 +1,27 @@
 #!/usr/bin/env bash
-# One command for a live-chip session, ordered by value-per-minute so a
-# tunnel that re-wedges mid-run still leaves the most important
-# artifacts committed (round-1 VERDICT: "measure early, snapshot
-# mid-round, re-verify at the end"; step list + budgets below at the
-# step invocations). Each step git-commits ONLY its own artifacts
-# before the next starts, and runs under a wall-clock budget (timeout
-# -s INT) so a slow-but-alive stall cannot consume the window. The
-# drivers drain their device queues (results materialize on host), so
-# interrupting BETWEEN steps cannot strand in-flight work.
+# One command for a live-chip session. The step SEQUENCE is no longer a
+# hand-ordered list: the window scheduler (python -m tpu_reductions.sched,
+# docs/SCHEDULER.md) plans value-per-expected-second against the
+# remaining-window estimate and re-plans after every task — a window
+# that opens mid-plan resumes the PLAN (sched_state.json), not a script
+# prefix. This script keeps what must stay shell-side: the JAX-free
+# relay gate, the per-step artifact commits, the wall-clock budget
+# enforcement (timeout -s INT) and the collating exit trap. The
+# pre-scheduler static list survives as fallback_static_session (used
+# only when the scheduler itself cannot run — redlint RED013 waivers
+# mark every hardcoded budget there as the sanctioned exception).
+# The drivers drain their device queues (results materialize on host),
+# so interrupting BETWEEN steps cannot strand in-flight work.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-# Flight-recorder shell emitter (docs/OBSERVABILITY.md): resolved via
-# BASH_SOURCE so lib-mode sourcing (tests) finds it regardless of cwd;
-# a missing helper degrades to a no-op — observability must never be
-# the reason a live window aborts.
+# repo root resolved via BASH_SOURCE so lib-mode sourcing (tests) finds
+# helper files regardless of cwd
+CHIP_SESSION_REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+
+# Flight-recorder shell emitter (docs/OBSERVABILITY.md): a missing
+# helper degrades to a no-op — observability must never be the reason
+# a live window aborts.
 # shellcheck disable=SC1091
 source "$(dirname "${BASH_SOURCE[0]}")/obs_event.sh" 2>/dev/null \
     || obs_event() { :; }
@@ -25,24 +32,32 @@ source "$(dirname "${BASH_SOURCE[0]}")/obs_event.sh" 2>/dev/null \
 # discovery or silently run the wrong platform. Non-tunneled hosts
 # (no relay by construction) always pass.
 # Inline socket probe, NOT an import of tpu_reductions.utils.watchdog:
-# the package __init__ pulls in jax (~2 s, and the axon plugin is the
-# machinery a dead relay hangs) — this gate must stay genuinely
-# JAX-free. Semantics mirror watchdog.tunneled_environment/relay_alive
-# (marker file; any port connecting, or an inconclusive local error,
-# counts as alive), including the TPU_REDUCTIONS_RELAY_MARKER/_PORTS
-# env overrides the chaos harness (faults/relay.py,
-# docs/RESILIENCE.md) points at its fake relay.
+# the package's heavy modules pull in jax (~2 s, and the axon plugin is
+# the machinery a dead relay hangs) — this gate must stay genuinely
+# JAX-free. The canonical port/marker DEFAULTS come from the ONE source
+# (tpu_reductions/utils/relay_env.py), exec'd by path under python -S
+# so no package import happens and the list cannot drift from the
+# watchdog's (ISSUE 5 satellite); the TPU_REDUCTIONS_RELAY_MARKER/
+# _PORTS env overrides the chaos harness points at its fake relay
+# (faults/relay.py, docs/RESILIENCE.md) still win inside env_*().
+# Semantics mirror watchdog.tunneled_environment/relay_alive (marker
+# file; any port connecting, or an inconclusive local error, counts as
+# alive).
 relay_ok() {
     # -S: skip site initialization (~2 s in this venv) — stdlib only
+    RELAY_ENV_PY="$CHIP_SESSION_REPO/tpu_reductions/utils/relay_env.py" \
     python -S -c '
 import os, socket, sys
-marker = os.environ.get("TPU_REDUCTIONS_RELAY_MARKER", "/root/.relay.py")
-if not os.path.exists(marker):
+g = {}
+try:
+    exec(open(os.environ["RELAY_ENV_PY"]).read(), g)
+except OSError:
+    sys.exit(0)   # canonical source unreadable: inconclusive => alive
+                  # (the per-step gates and the watchdog still protect)
+if not os.path.exists(g["env_marker"]()):
     sys.exit(0)      # untunneled host: no relay by construction
-ports = [int(p) for p in os.environ.get("TPU_REDUCTIONS_RELAY_PORTS",
-                                        "8082,8083").split(",") if p.strip()]
 inconclusive = False
-for port in ports:
+for port in g["env_ports"]():
     try:
         socket.create_connection(("127.0.0.1", port), timeout=2).close()
         sys.exit(0)
@@ -52,6 +67,10 @@ for port in ports:
         inconclusive = True
 sys.exit(0 if inconclusive else 3)'
 }
+
+STEP_LAST_RC=0  # the last step's command rc, for the scheduler loop's
+                # --record feedback (step() itself keeps its abort/
+                # continue semantics)
 
 step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
     local name=$1 budget=$2; shift 2
@@ -66,8 +85,8 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
     if [ "$SESSION_RAN" = 0 ]; then
         # the last commit touching the flagship example BEFORE the
         # session's first step: the exit trap regenerates the report
-        # when this moves (step 11 commits its own artifacts, so
-        # worktree dirtiness alone would miss them). Recorded here —
+        # when this moves (the flagship step commits its own artifacts,
+        # so worktree dirtiness alone would miss them). Recorded here —
         # in the cwd the steps commit from — not at source time.
         TPU_RUN_HEAD=$(git log -1 --format=%H -- examples/tpu_run \
                        2>/dev/null || echo none)
@@ -93,6 +112,7 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
     # chip); the 120 s kill-after is the backstop for a process too
     # wedged to honor the interrupt.
     timeout --signal=INT --kill-after=120 "$budget" "$@" || rc=$?
+    STEP_LAST_RC=$rc
     if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
         status=FAILED
         echo "=== chip_session: $name TIMED OUT after ${budget}s (committing any artifacts it DID produce) ==="
@@ -108,10 +128,14 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
     obs_event step.end name="$name" rc="$rc" status="$status"
     # the ledger itself is a per-step artifact: commit it with whatever
     # the step produced, so the postmortem record survives a window
-    # death exactly like the measurement rows do
+    # death exactly like the measurement rows do — and so does the
+    # scheduler's plan state (the plan must resume across windows)
     if [ -n "${TPU_REDUCTIONS_LEDGER:-}" ] \
             && [ -e "${TPU_REDUCTIONS_LEDGER}" ]; then
         arts+=("${TPU_REDUCTIONS_LEDGER}")
+    fi
+    if [ -n "${SCHED_STATE:-}" ] && [ -e "${SCHED_STATE:-}" ]; then
+        arts+=("$SCHED_STATE")
     fi
     # add per artifact, and commit only the ones that exist: one
     # missing path must block neither the add nor the commit of the
@@ -142,7 +166,8 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
         # per-step relay_ok probe above covers instead): the relay
         # cannot come back in-session (CLAUDE.md), so every later
         # on-chip step could only hang — stop here with the artifacts
-        # committed
+        # committed. The scheduler's plan state persists as-is: the
+        # next window's invocation resumes the plan (sched/state.py).
         echo "=== chip_session: ABORT — accelerator gone (rc=3); remaining steps skipped ==="
         exit 3
     fi
@@ -156,6 +181,9 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
 SESSION_RAN=0   # set by step(): an abort BEFORE any step must not
                 # collate a "window summary" out of stale artifacts
 TPU_RUN_HEAD="" # recorded by the first step() call (see there)
+SCHED_STATE=${TPU_REDUCTIONS_SCHED_STATE:-sched_state.json}
+SCHED_ARGS=${TPU_REDUCTIONS_SCHED_ARGS:-}   # tests inject --tasks/--platform
+SCHED_TASKS_RUN=0   # scheduled steps completed (fallback guard)
 summarize_on_exit() {
     [ "$SESSION_RAN" = 1 ] || return 0
     # Offline evidence collation FIRST (pure disk work — safe after the
@@ -163,13 +191,14 @@ summarize_on_exit() {
     # rows measured at the flagship contract seed the grid cache, and
     # if anything under examples/tpu_run changed this window (seeded
     # cells, curve cells from a budget-cut flagship step whose own
-    # report regeneration never ran — step 11 COMMITS those cells
-    # itself, so the dirty-worktree test alone would miss them; the
-    # recorded pre-session commit hash catches the committed case) the
-    # report is re-collated from disk and committed. Both calls carry
-    # the same budget discipline as the steps: the trap usually runs
-    # with the relay dead, and an import stall here would pin the
+    # report regeneration never ran — the flagship step COMMITS those
+    # cells itself, so the dirty-worktree test alone would miss them;
+    # the recorded pre-session commit hash catches the committed case)
+    # the report is re-collated from disk and committed. Both calls
+    # carry the same budget discipline as the steps: the trap usually
+    # runs with the relay dead, and an import stall here would pin the
     # watcher instead of re-arming it.
+    # redlint: disable=RED013 -- exit-trap collation cap (offline, no device): not a window plan
     timeout 300 python -m tpu_reductions.bench.seed_cache \
         double_spot.json int_op_spot_k6.json BENCH_doubles.json \
         --grid-dir examples/tpu_run/single_chip || true
@@ -183,9 +212,16 @@ summarize_on_exit() {
         timeout 120 python -m tpu_reductions.obs.timeline "$TPU_REDUCTIONS_LEDGER" --json examples/tpu_run/obs_timeline.json --quiet \
             || true
     fi
+    # the scheduler's plan-vs-actual record travels WITH the evidence:
+    # regen folds it into report.md (bench/regen.py; ISSUE 5 satellite)
+    if [ -s "$SCHED_STATE" ]; then
+        cp -f -- "$SCHED_STATE" examples/tpu_run/sched_state.json \
+            2>/dev/null || true
+    fi
     if [ -n "$(git status --porcelain -- examples/tpu_run)" ] \
             || [ "$(git log -1 --format=%H -- examples/tpu_run)" \
                  != "$TPU_RUN_HEAD" ]; then
+        # redlint: disable=RED013 -- exit-trap collation cap (offline, no device): not a window plan
         timeout 600 python -m tpu_reductions.bench.regen \
             examples/tpu_run || true
         git add -- examples/tpu_run \
@@ -200,7 +236,8 @@ summarize_on_exit() {
         || true
     # the per-window utilization table is COMPUTED from the ledger
     # (obs/timeline.py --summary-md), never hand-written — appended so
-    # the summary commit below carries it
+    # the summary commit below carries it; with a scheduler run in the
+    # ledger it now includes the per-task planned/actual/skipped table
     if [ -n "${TPU_REDUCTIONS_LEDGER:-}" ] \
             && [ -s "${TPU_REDUCTIONS_LEDGER}" ]; then
         echo >> WINDOW_SUMMARY.md
@@ -214,11 +251,186 @@ summarize_on_exit() {
     fi
 }
 
+# The scheduler-driven session (the round-5 tentpole): ask the planner
+# for one value-ranked pick at a time, run it through the SAME step()
+# machinery (relay gate, budget, artifact commits), feed the outcome
+# back (--record) so the duration priors update online, replan. The
+# plan state (sched_state.json) persists every decision atomically —
+# a watchdog exit 3/4 or a flap mid-task resumes the plan, not the
+# script (docs/SCHEDULER.md).
+# Returns 0 when the plan runs dry, 20 when the scheduler ITSELF is
+# broken (caller falls back to the static list — but only if no
+# scheduled task ran yet: a mid-plan fallback would re-measure).
+run_scheduled_session() {
+    local nexttext rc t_start elapsed
+    while :; do
+        nexttext=$(PYTHONPATH="$CHIP_SESSION_REPO${PYTHONPATH:+:$PYTHONPATH}" \
+                   python -m tpu_reductions.sched --next --emit=shell \
+                       --state="$SCHED_STATE" $SCHED_ARGS ;) && rc=0 || rc=$?
+        if [ "$rc" -eq 10 ]; then
+            echo "=== chip_session: scheduler plan complete ==="
+            return 0
+        fi
+        if [ "$rc" -ne 0 ] || [ -z "$nexttext" ]; then
+            echo "=== chip_session: scheduler --next failed (rc=$rc) ===" >&2
+            return 20
+        fi
+        eval "$nexttext" || return 20
+        t_start=$(date +%s)
+        # shellcheck disable=SC2086 -- artifact list is word-split on purpose
+        step "$SCHED_TASK_NAME" "$SCHED_TASK_BUDGET" $SCHED_TASK_ARTIFACTS -- \
+            bash -c "$SCHED_TASK_CMD"
+        elapsed=$(( $(date +%s) - t_start ))
+        SCHED_TASKS_RUN=$((SCHED_TASKS_RUN + 1))
+        # outcome feedback: priors sharpen online; a failed record must
+        # not kill the session (the next --next reconciles from the
+        # task's own artifacts)
+        PYTHONPATH="$CHIP_SESSION_REPO${PYTHONPATH:+:$PYTHONPATH}" \
+        python -m tpu_reductions.sched --record "$SCHED_TASK_SLUG" \
+            --rc="$STEP_LAST_RC" --elapsed="$elapsed" \
+            --state="$SCHED_STATE" $SCHED_ARGS || true
+        if [ "$STEP_LAST_RC" -eq 4 ]; then
+            # heartbeat hang (utils/watchdog.py exit 4): the chip is
+            # stalled/wedged with live ports — an un-settled task would
+            # be re-picked immediately and hang again; stop here, the
+            # plan resumes next window (rc 3 aborts inside step())
+            echo "=== chip_session: ABORT — heartbeat hang (rc=4); plan resumes next window ==="
+            obs_event session.abort reason=hang-exit-4
+            exit 4
+        fi
+    done
+}
+
+# ---------------------------------------------------------------------------
+# The pre-scheduler static list (round-5 ordering), kept ONLY as the
+# no-scheduler fallback. Budgets here are the sanctioned RED013
+# exception (waivers below); their live copies are sched/tasks.py's
+# budget_s fields, which the fallback must mirror. Never extended:
+# new measurement units go in the registry.
+# ---------------------------------------------------------------------------
+fallback_static_session() {
+    # pipefail INSIDE each bash -c: the child shell does not inherit
+    # the outer setting, and without it a crashed python is masked by
+    # tee/tail
+    # redlint: disable=RED013 -- no-scheduler fallback path: the static budget mirrors sched/tasks.py firstrow
+    step "first row" 300 FIRSTROW.json BENCH_snapshot.json BENCH_doubles.json -- \
+        python -m tpu_reductions.bench.firstrow
+
+    # BENCH_SKIP_PROBE: relay_ok just verified the relay seconds ago;
+    # the probe subprocess would re-pay a full jax init (~30-40 s of
+    # window) to learn the same thing. BENCH_DOUBLES=0 when a COMPLETE
+    # f64 scoreboard with a VERIFIED row landed THIS SESSION (mtime vs
+    # FIRSTROW_T0) — re-measuring rows written seconds ago would spend
+    # window minutes on redundant rows (round-5 ADVICE).
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py headline_bench
+    step "headline bench" 240 BENCH_live.json BENCH_snapshot.json BENCH_doubles.json -- \
+        bash -c 'set -o pipefail; d=1; \
+                 if grep -q "\"complete\": true" BENCH_doubles.json 2>/dev/null \
+                    && grep -q "\"status\": \"PASSED\"" BENCH_doubles.json 2>/dev/null \
+                    && [ "$(stat -c %Y BENCH_doubles.json)" -ge "${FIRSTROW_T0%.*}" ]; then d=0; fi; \
+                 BENCH_SKIP_PROBE=1 BENCH_DOUBLES=$d python bench.py | tee BENCH_live.json'
+
+    # all-device f64 (ops/dd_reduce.device_finish_pairs): the DOUBLE
+    # SUM/MIN/MAX scoreboard; --chainreps=5 matches sweep.FLAGSHIP_GRID
+    # so these rows seed the flagship grid's resume cache at session
+    # exit (seed_cache)
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py double_spot
+    step "double scoreboard" 300 double_spot.json -- \
+        python -m tpu_reductions.bench.spot --type=double \
+            --methods=SUM,MIN,MAX --n=16777216 --iterations=256 \
+            --chainreps=5 --out=double_spot.json
+
+    # --out persists per rung (partial until the deciding HBM rung
+    # lands): a budget cut or relay death mid-ladder keeps the VMEM rung
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py calibrate_ladder
+    step "calibration ladder" 240 calibration_live.json -- \
+        python -m tpu_reductions.utils.calibrate --ladder \
+            --chainspan 256 --reps 7 --out=calibration_live.json
+
+    # every never-lowered kernel surface compiles+runs once at tiny n
+    # BEFORE the races that depend on it
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py smoke
+    step "lowering smoke" 420 smoke.json -- \
+        python -m tpu_reductions.bench.smoke --out=smoke.json
+
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py hbm26
+    step "hbm regime race 2^26" 420 tune_hbm.json -- \
+        python -m tpu_reductions.bench.autotune --method=SUM --type=int \
+            --n=67108864 --grid=hbm --comparator --out=tune_hbm.json
+
+    # 2^27 was round 2's weakest HBM point (621 vs 779 GB/s)
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py hbm27
+    step "hbm regime race 2^27" 420 tune_hbm27.json -- \
+        python -m tpu_reductions.bench.autotune --method=SUM --type=int \
+            --n=134217728 --grid=hbm --comparator --out=tune_hbm27.json
+
+    # MIN trailed SUM by 23% in round 2 with no recorded cause; rc
+    # accumulates across the probes so a crash of the first is not
+    # masked by a clean second
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py int_op_parity
+    step "int op parity probe" 420 \
+            int_op_spot_k7.json int_op_spot_k6.json int_op_spot_xla.json -- \
+        bash -c 'rc=0; \
+                 python -m tpu_reductions.bench.spot --type=int \
+                     --methods=SUM,MIN,MAX --n=16777216 --kernel=7 \
+                     --threads=384 --iterations=256 --chainreps=5 \
+                     --out=int_op_spot_k7.json || rc=$?; \
+                 python -m tpu_reductions.bench.spot --type=int \
+                     --methods=SUM,MIN,MAX --n=16777216 --kernel=6 \
+                     --threads=512 --iterations=256 --chainreps=5 \
+                     --out=int_op_spot_k6.json || rc=$?; \
+                 python -m tpu_reductions.bench.spot --type=int \
+                     --methods=SUM,MIN,MAX --n=16777216 --backend=xla \
+                     --iterations=256 --chainreps=5 \
+                     --out=int_op_spot_xla.json || rc=$?; \
+                 exit $rc'
+
+    # bf16's first on-chip rows (round-3 weak #5)
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py bf16_spot
+    step "bf16 existence spot" 180 bf16_spot.json -- \
+        python -m tpu_reductions.bench.spot --type=bfloat16 \
+            --methods=SUM,MIN,MAX --n=16777216 --iterations=256 \
+            --chainreps=5 --out=bf16_spot.json
+
+    # kernel 9 (MXU) in both regimes (2^24 VMEM-resident, 2^26 HBM)
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py mxu_f32
+    step "mxu race f32" 420 tune_mxu_f32.json tune_mxu_f32_hbm.json -- \
+        bash -c 'rc=0; \
+                 python -m tpu_reductions.bench.autotune --method=SUM \
+                     --type=float --n=16777216 --iterations=256 --grid=mxu \
+                     --comparator --out=tune_mxu_f32.json || rc=$?; \
+                 python -m tpu_reductions.bench.autotune --method=SUM \
+                     --type=float --n=67108864 --grid=mxu \
+                     --comparator --out=tune_mxu_f32_hbm.json || rc=$?; \
+                 exit $rc'
+
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py mxu_bf16
+    step "mxu race bf16" 300 tune_mxu_bf16.json -- \
+        python -m tpu_reductions.bench.autotune --method=SUM --type=bfloat16 \
+            --n=16777216 --iterations=256 --grid=mxu --comparator \
+            --out=tune_mxu_bf16.json
+
+    # 5+ slope reps so the round-2 single-rep 22.7 TB/s k7/384 claim
+    # gets a quotable repeat-averaged confirmation (or a retraction)
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py fine_race
+    step "fine tile race" 420 tune_fine.json -- \
+        python -m tpu_reductions.bench.autotune --method=SUM --type=int \
+            --n=16777216 --iterations=256 --chainreps=7 --grid=fine \
+            --out=tune_fine.json
+
+    # 3 h: the long tail (hazard cells last), and the watcher re-arms
+    # on abort — a flagship that wedges slow-but-alive must not pin the
+    # watcher past the round
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py flagship
+    step "flagship experiment" 10800 examples/tpu_run -- \
+        bash scripts/run_tpu_experiment.sh examples/tpu_run
+}
+
 # Sourceable-lib mode: `CHIP_SESSION_LIB=1 source scripts/chip_session.sh`
-# stops here with relay_ok/step/summarize_on_exit defined — the
-# rehearsal tests (tests/test_chip_session.py) drive the step machinery
-# against toy commands in a temp repo, so a bash bug is found off-chip,
-# not in a live window.
+# stops here with relay_ok/step/summarize_on_exit/run_scheduled_session
+# defined — the rehearsal tests (tests/test_chip_session.py) drive the
+# step machinery against toy commands in a temp repo, so a bash bug is
+# found off-chip, not in a live window.
 if [ "${CHIP_SESSION_LIB:-0}" = 1 ]; then
     return 0 2>/dev/null || exit 0
 fi
@@ -239,157 +451,30 @@ if ! relay_ok; then
     exit 3
 fi
 
-# pipefail INSIDE each bash -c: the child shell does not inherit the
-# outer setting, and without it a crashed python is masked by tee/tail
-#
-# Round-5 ordering = round-4 ordering with a step 0 in front (the
-# round-4 verdict's do-this #3: first persisted row below the observed
-# ~6-minute flap length). Every step carries a wall-clock budget sized
-# so steps 0-3 land inside ~12 minutes even if each exhausts it:
-#   0. first row (300 s): one init, crowned candidate, reduced reps;
-#      int row + partial snapshot target < 90 s, then the f64
-#      scoreboard at the flagship contract
-#   1. fresh BENCH row (240 s)
-#   2. DOUBLE scoreboard (300 s — THE gap: beat 92.77 GB/s on-chip)
-#   3. calibration ladder (240 s; trust gate for everything after)
-#   4. lowering smoke (420 s): tiny-n compile+run of k9, k10@{2,4,8},
-#      big-tile k8, dd pair paths — a systematic Mosaic failure costs
-#      seconds here instead of the window's middle (verdict weak #3)
-#   5+6. HBM-regime races at 2^26 and the 2^27 weak point
-#   7. int op-parity probe (MIN vs SUM vs MAX, same geometry)
-#   8. bf16 existence spot (weak #5: the dtype's first on-chip rows)
-#   9+10. kernel-9 MXU races, f32 + bf16
-#   11. fine tile race (7-rep repeat confirmation)
-#   12. flagship experiment (3 h; re-verified int curve + bf16/f64
-#       curves + the 2^30 hazard cells last; DOUBLE rows land in the
-#       report's flagship table via sweep_all)
-# Step 0 (round-4 verdict do-this #3): the minimal path from "relay
-# answers" to "verified row on disk" — ONE process, ONE jax init, the
-# crowned candidate only at reduced slope reps, persisted + snapshotted
-# the moment it verifies, then the f64 scoreboard at the flagship-grid
-# contract. FIRSTROW_T0 = the session-start epoch: every firstrow
-# stage logs T+x.xs against it and the timeline lands inside
-# FIRSTROW.json, so every window (and every rehearsal) commits its own
-# time-to-first-artifact measurement. Target: int row < 90 s.
+# FIRSTROW_T0 = the session-start epoch: every firstrow stage logs
+# T+x.xs against it and the timeline lands inside FIRSTROW.json, so
+# every window (and every rehearsal) commits its own time-to-first-
+# artifact measurement (round-4 verdict do-this #3; target: int row
+# < 90 s). The scheduler's value model guarantees firstrow is the
+# first pick of a fresh plan (sched/tasks.py).
 export FIRSTROW_T0
 FIRSTROW_T0=$(date +%s.%N)
-step "first row" 300 FIRSTROW.json BENCH_snapshot.json BENCH_doubles.json -- \
-    python -m tpu_reductions.bench.firstrow
 
-# BENCH_SKIP_PROBE: relay_ok just verified the relay seconds ago; the
-# probe subprocess would re-pay a full jax init (~30-40 s of window)
-# to learn the same thing. A wedged-but-ports-open tunnel (the rare
-# case the probe exists for) is bounded by this step's budget instead.
-# BENCH_DOUBLES=0 when step 0 already landed a COMPLETE f64 scoreboard
-# THIS SESSION with at least one VERIFIED row (grep + an
-# mtime-vs-FIRSTROW_T0 check: a complete scoreboard committed by a
-# PREVIOUS window must not suppress this window's fresh rows, and an
-# all-FAILED/WAIVED step-0 scoreboard — e.g. a flap mid-dd-compile —
-# must not suppress step 1's fresh attempt either; round-5 ADVICE) —
-# re-measuring a scoreboard of verified rows written seconds ago would
-# spend window minutes on redundant rows.
-step "headline bench" 240 BENCH_live.json BENCH_snapshot.json BENCH_doubles.json -- \
-    bash -c 'set -o pipefail; d=1; \
-             if grep -q "\"complete\": true" BENCH_doubles.json 2>/dev/null \
-                && grep -q "\"status\": \"PASSED\"" BENCH_doubles.json 2>/dev/null \
-                && [ "$(stat -c %Y BENCH_doubles.json)" -ge "${FIRSTROW_T0%.*}" ]; then d=0; fi; \
-             BENCH_SKIP_PROBE=1 BENCH_DOUBLES=$d python bench.py | tee BENCH_live.json'
-
-# all-device f64 (ops/dd_reduce.device_finish_pairs): the DOUBLE
-# SUM/MIN/MAX scoreboard — expected near the INT roof fraction instead
-# of the transfer-bound 0.9 GB/s round 2 measured through the tunnel
-# --chainreps=5 matches sweep.FLAGSHIP_GRID exactly, so these rows
-# seed the flagship grid's resume cache at session exit (seed_cache)
-# and replace the 0.87-0.90 GB/s legacy DOUBLE rows in the report even
-# when the window never reaches the 3 h flagship step
-step "double scoreboard" 300 double_spot.json -- \
-    python -m tpu_reductions.bench.spot --type=double \
-        --methods=SUM,MIN,MAX --n=16777216 --iterations=256 \
-        --chainreps=5 --out=double_spot.json
-
-# --out persists per rung (partial until the deciding HBM rung lands):
-# a budget cut or relay death mid-ladder keeps the VMEM rung
-step "calibration ladder" 240 calibration_live.json -- \
-    python -m tpu_reductions.utils.calibrate --ladder \
-        --chainspan 256 --reps 7 --out=calibration_live.json
-
-# every never-lowered kernel surface compiles+runs once at tiny n
-# BEFORE the races that depend on it; the manifest (committed even on
-# failure) tells the session log which race rows are live
-step "lowering smoke" 420 smoke.json -- \
-    python -m tpu_reductions.bench.smoke --out=smoke.json
-
-# does any Pallas geometry close the 5-8% gap to XLA in the HBM regime?
-# kernel 10 races its DMA pipeline depth — the knob it exists for
-step "hbm regime race 2^26" 420 tune_hbm.json -- \
-    python -m tpu_reductions.bench.autotune --method=SUM --type=int \
-        --n=67108864 --grid=hbm --comparator --out=tune_hbm.json
-
-# 2^27 was round 2's weakest HBM point (621 vs 779 GB/s)
-step "hbm regime race 2^27" 420 tune_hbm27.json -- \
-    python -m tpu_reductions.bench.autotune --method=SUM --type=int \
-        --n=134217728 --grid=hbm --comparator --out=tune_hbm27.json
-
-# MIN trailed SUM by 23% in round 2 (5002.6 vs 6497.2 GB/s) with no
-# recorded cause: measure all three ops at the two winning geometries
-# rc accumulates across the two probes: a crash of the first must not
-# be masked by a clean second (the same masking the pipefail note above
-# guards against, at the command level)
-step "int op parity probe" 420 \
-        int_op_spot_k7.json int_op_spot_k6.json int_op_spot_xla.json -- \
-    bash -c 'rc=0; \
-             python -m tpu_reductions.bench.spot --type=int \
-                 --methods=SUM,MIN,MAX --n=16777216 --kernel=7 \
-                 --threads=384 --iterations=256 --chainreps=5 \
-                 --out=int_op_spot_k7.json || rc=$?; \
-             python -m tpu_reductions.bench.spot --type=int \
-                 --methods=SUM,MIN,MAX --n=16777216 --kernel=6 \
-                 --threads=512 --iterations=256 --chainreps=5 \
-                 --out=int_op_spot_k6.json || rc=$?; \
-             python -m tpu_reductions.bench.spot --type=int \
-                 --methods=SUM,MIN,MAX --n=16777216 --backend=xla \
-                 --iterations=256 --chainreps=5 \
-                 --out=int_op_spot_xla.json || rc=$?; \
-             exit $rc'
-
-# bf16's FIRST on-chip rows (round-3 weak #5: an advertised dtype with
-# zero hardware evidence): one cheap fixed-geometry scoreboard well
-# before the k9/flagship steps that would otherwise carry it ~70 min
-# into a window. 2 B/element stream, f32 accumulator — the "~2x int32
-# elements/s" claim gets its measurement here.
-step "bf16 existence spot" 180 bf16_spot.json -- \
-    python -m tpu_reductions.bench.spot --type=bfloat16 \
-        --methods=SUM,MIN,MAX --n=16777216 --iterations=256 \
-        --chainreps=5 --out=bf16_spot.json
-
-# kernel 9 (MXU) has never lowered on-chip; rank it against the VPU
-# winners in both regimes (2^24 VMEM-resident, 2^26 HBM-bound)
-step "mxu race f32" 420 tune_mxu_f32.json tune_mxu_f32_hbm.json -- \
-    bash -c 'rc=0; \
-             python -m tpu_reductions.bench.autotune --method=SUM \
-                 --type=float --n=16777216 --iterations=256 --grid=mxu \
-                 --comparator --out=tune_mxu_f32.json || rc=$?; \
-             python -m tpu_reductions.bench.autotune --method=SUM \
-                 --type=float --n=67108864 --grid=mxu \
-                 --comparator --out=tune_mxu_f32_hbm.json || rc=$?; \
-             exit $rc'
-
-step "mxu race bf16" 300 tune_mxu_bf16.json -- \
-    python -m tpu_reductions.bench.autotune --method=SUM --type=bfloat16 \
-        --n=16777216 --iterations=256 --grid=mxu --comparator \
-        --out=tune_mxu_bf16.json
-
-# 5+ slope reps so the round-2 single-rep 22.7 TB/s k7/384 claim gets a
-# quotable repeat-averaged confirmation (or a retraction)
-step "fine tile race" 420 tune_fine.json -- \
-    python -m tpu_reductions.bench.autotune --method=SUM --type=int \
-        --n=16777216 --iterations=256 --chainreps=7 --grid=fine \
-        --out=tune_fine.json
-
-# 3 h: the long tail, and the watcher re-arms on abort — a flagship
-# that wedges slow-but-alive must not pin the watcher past the round
-step "flagship experiment" 10800 examples/tpu_run -- \
-    bash scripts/run_tpu_experiment.sh examples/tpu_run
+run_scheduled_session && sched_rc=0 || sched_rc=$?
+if [ "$sched_rc" -eq 20 ]; then
+    if [ "$SCHED_TASKS_RUN" -gt 0 ]; then
+        # mid-plan scheduler failure: falling back would re-measure
+        # the tasks the plan already ran — abort instead; the watcher
+        # re-arms and the next invocation resumes the plan
+        echo "=== chip_session: scheduler failed mid-plan; aborting (plan state persisted) ==="
+        obs_event session.abort reason=scheduler-failed-midplan
+        exit 1
+    fi
+    echo "=== chip_session: scheduler unavailable; falling back to the static step list ==="
+    obs_event session.fallback reason=scheduler-unavailable
+    fallback_static_session
+fi
 
 obs_event session.end prog=chip_session
 echo "=== chip_session: done ==="
+exit 0

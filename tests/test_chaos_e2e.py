@@ -317,6 +317,134 @@ def test_chaos_sigkill_midbatch_ledger_has_zero_torn_lines(tmp_path):
     assert timeline_main([str(led)]) == 0
 
 
+# ---------------------------------------------------------------------------
+# Scheduler-under-chaos (ISSUE 5 satellite): the plan-and-execute layer
+# (tpu_reductions/sched/) must survive the same deaths the per-task
+# resume already does — a relay death mid-task (executor exit 3) and a
+# stall-with-live-ports (exit 4) both persist the PLAN, a re-invocation
+# resumes it, completed tasks are never re-measured (artifacts stay
+# byte-identical), and the final row sets equal an uninterrupted
+# control run's.
+# ---------------------------------------------------------------------------
+
+def _sched_tasks_file(tmp_path):
+    """Two real spot tasks: 'quick' (one method) and 'batch' (three
+    methods — the chaos fault plans target its second method)."""
+    base = ("python -m tpu_reductions.bench.spot --platform=cpu "
+            "--type=int --n=16384 --iterations=8 --chainreps=2 ")
+    spec = [
+        {"name": "quick", "value": 10, "budget_s": 60,
+         "command": base + "--methods=SUM --out=quick.json",
+         "artifacts": ["quick.json"], "done_artifact": "quick.json"},
+        {"name": "batch", "value": 5, "budget_s": 60,
+         "command": base + "--methods=SUM,MIN,MAX --out=batch.json",
+         "artifacts": ["batch.json"], "done_artifact": "batch.json"},
+    ]
+    (tmp_path / "sched_tasks.json").write_text(json.dumps(spec))
+
+
+def _sched_exec(tmp_path, env):
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpu_reductions.sched",
+         "--tasks=sched_tasks.json", "--state=sched_state.json"],
+        env={**env, "PYTHONPATH": str(REPO)}, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _sched_state(tmp_path):
+    return json.loads((tmp_path / "sched_state.json").read_text())
+
+
+def test_chaos_sched_relay_death_midplan_resumes_without_remeasuring(
+        tmp_path):
+    """Executor exit 3: the relay dies while task 'batch' wedges
+    mid-method. The plan state persists ('quick' done, 'batch'
+    aborted), the executor propagates the watchdog's code, and the
+    re-invocation finishes ONLY the remaining work: quick.json is
+    byte-identical afterwards, batch's banked SUM row is reused, and
+    the final row set equals an uninterrupted control run's."""
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    _sched_tasks_file(tmp_path)
+    with FakeRelay() as relay:
+        env = _chaos_env(relay, marker, faults={
+            "bench.run": {"after": 1, "action": "stall", "seconds": 120}})
+        proc = _sched_exec(tmp_path, env)
+        _wait_for_rows(tmp_path / "batch.json", 1)   # SUM banked
+        relay.force("refuse")
+        rc = proc.wait(timeout=90)
+        stderr = proc.stderr.read()
+        assert rc == 3, f"expected executor exit 3, got {rc}: {stderr}"
+        st = _sched_state(tmp_path)
+        assert st["complete"] is False
+        assert st["tasks"]["quick"]["status"] == "done"
+        assert st["tasks"]["batch"]["status"] == "aborted"
+        quick_bytes = (tmp_path / "quick.json").read_bytes()
+        interrupted = json.loads((tmp_path / "batch.json").read_text())
+        assert [r["method"] for r in interrupted["rows"]] == ["SUM"]
+
+        # window 2: relay back, no faults — the PLAN resumes
+        relay.force("accept")
+        time.sleep(0.15)
+        proc2 = _sched_exec(tmp_path, _chaos_env(relay, marker))
+        rc2 = proc2.wait(timeout=90)
+        assert rc2 == 0, proc2.stderr.read()
+        st2 = _sched_state(tmp_path)
+        assert st2["complete"] is True
+        assert st2["tasks"]["batch"]["status"] == "done"
+        # zero re-measurement of the completed unit
+        assert (tmp_path / "quick.json").read_bytes() == quick_bytes
+        resumed = json.loads((tmp_path / "batch.json").read_text())
+        assert resumed["rows"][0] == interrupted["rows"][0]  # banked row
+
+        # uninterrupted control: identical final row sets
+        control_dir = tmp_path / "control"
+        control_dir.mkdir()
+        _sched_tasks_file(control_dir)
+        proc3 = _sched_exec(control_dir, _chaos_env(relay, marker))
+        assert proc3.wait(timeout=90) == 0, proc3.stderr.read()
+        control = json.loads((control_dir / "batch.json").read_text())
+    assert [(r["method"], r["status"]) for r in resumed["rows"]] \
+        == [(r["method"], r["status"]) for r in control["rows"]]
+    assert resumed["complete"] == control["complete"] is True
+
+
+def test_chaos_sched_stall_exit4_midplan_resumes(tmp_path):
+    """Executor exit 4: the relay flips to `stall` (ports answer,
+    nothing serviced) while 'batch' wedges — the task's heartbeat
+    trigger exits 4, the executor propagates it with the plan
+    persisted, and the re-invocation completes the plan without
+    repeating 'quick'."""
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    _sched_tasks_file(tmp_path)
+    with FakeRelay() as relay:
+        env = _chaos_env(relay, marker, faults={
+            "bench.run": {"after": 1, "action": "stall", "seconds": 120}})
+        env["TPU_REDUCTIONS_HEARTBEAT_DEADLINE_S"] = "5.0"
+        env["TPU_REDUCTIONS_HEARTBEAT_COMPILE_DEADLINE_S"] = "60"
+        proc = _sched_exec(tmp_path, env)
+        _wait_for_rows(tmp_path / "batch.json", 1)
+        relay.force("stall")
+        rc = proc.wait(timeout=90)
+        assert rc == 4, proc.stderr.read()
+        st = _sched_state(tmp_path)
+        assert st["complete"] is False
+        assert st["tasks"]["quick"]["status"] == "done"
+        assert st["tasks"]["batch"]["status"] == "aborted"
+        quick_bytes = (tmp_path / "quick.json").read_bytes()
+
+        relay.force("accept")
+        time.sleep(0.15)
+        proc2 = _sched_exec(tmp_path, _chaos_env(relay, marker))
+        assert proc2.wait(timeout=90) == 0, proc2.stderr.read()
+    st2 = _sched_state(tmp_path)
+    assert st2["complete"] is True
+    assert (tmp_path / "quick.json").read_bytes() == quick_bytes
+    final = json.loads((tmp_path / "batch.json").read_text())
+    assert [r["method"] for r in final["rows"]] == ["SUM", "MIN", "MAX"]
+
+
 def _git(root, *args):
     subprocess.run(["git", *args], cwd=root, check=True,
                    capture_output=True)

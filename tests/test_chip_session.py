@@ -129,20 +129,46 @@ def test_summarize_on_exit_requires_a_step_and_commits_summary(tmp_path):
     assert "Window summary (auto-collated at session exit)" in _log(repo)
 
 
-def test_session_budgets_keep_the_first_steps_inside_a_short_window():
-    """The round-3 weak-#2 contract, pinned: every step carries a
-    numeric wall-clock budget, and the first three (headline bench,
-    DOUBLE scoreboard, calibration ladder) sum to at most 13 minutes —
-    the worst case when every one exhausts its budget; typical runs
-    land well inside 10."""
+def _fallback_body():
+    text = SCRIPT.read_text()
+    start = text.index("fallback_static_session()")
+    # the function body ends at the next unindented closing brace
+    return text[start:text.index("\n}", start)]
+
+
+def test_session_is_scheduler_driven_with_static_fallback():
+    """The round-5 tentpole, pinned: the live path routes every step
+    through the scheduler (--next/--record against sched_state.json),
+    and the hand-ordered list survives ONLY as the no-scheduler
+    fallback — guarded so a mid-plan scheduler failure can never
+    re-measure completed tasks by falling back."""
+    text = SCRIPT.read_text()
+    assert "run_scheduled_session" in text
+    assert "tpu_reductions.sched --next --emit=shell" in text
+    assert "tpu_reductions.sched --record" in text
+    # the scheduler loop's step call takes the PLANNED budget, never a
+    # literal
+    assert 'step "$SCHED_TASK_NAME" "$SCHED_TASK_BUDGET"' in text
+    assert "fallback_static_session" in text
+    assert '"$SCHED_TASKS_RUN" -gt 0' in text   # mid-plan guard
+    # a hang (exit 4) must stop the loop, not re-pick the hung task
+    assert "STEP_LAST_RC" in text and "exit 4" in text
+
+
+def test_fallback_budgets_keep_the_first_steps_inside_a_short_window():
+    """The round-3 weak-#2 contract, pinned on the FALLBACK list (the
+    scheduler's budgets live in sched/tasks.py and are pinned by
+    tests/test_sched.py): every fallback step carries a numeric
+    wall-clock budget, the first four sum inside a short window, and
+    every budget carries its RED013 waiver (the sanctioned exception)."""
     import re
 
-    text = SCRIPT.read_text().replace("\\\n", " ")
+    body = _fallback_body().replace("\\\n", " ")
     budgets = [int(m.group(1)) for m in
                re.finditer(r"^\s*step ['\"][^'\"]+['\"] (\d+) ",
-                           text, re.M)]
-    steps = len(re.findall(r"^\s*step ['\"]", text, re.M))
-    assert len(budgets) == steps, "a step is missing its budget"
+                           body, re.M)]
+    steps = len(re.findall(r"^\s*step ['\"]", body, re.M))
+    assert len(budgets) == steps, "a fallback step is missing its budget"
     assert len(budgets) >= 10          # the full value-ordered session
     assert sum(budgets[:4]) <= 18 * 60, (
         f"first four budgets sum to {sum(budgets[:4])}s — a short "
@@ -151,20 +177,46 @@ def test_session_budgets_keep_the_first_steps_inside_a_short_window():
     # the flagship long tail must still be bounded (watcher re-arm
     # depends on the session eventually exiting)
     assert max(budgets) <= 4 * 3600
+    assert body.count("redlint: disable=RED013") == steps
+
+
+def test_fallback_budgets_mirror_the_scheduler_registry():
+    """The fallback list and sched/tasks.py must not drift: same step
+    titles, same budgets, same order as the registry's static fields."""
+    import re
+
+    from tpu_reductions.sched.tasks import SESSION_TASKS
+
+    body = _fallback_body().replace("\\\n", " ")
+    pairs = [(m.group(1), int(m.group(2))) for m in
+             re.finditer(r"^\s*step ['\"]([^'\"]+)['\"] (\d+) ",
+                         body, re.M)]
+    expected = [(t.title, int(t.budget_s)) for t in SESSION_TASKS]
+    assert pairs == expected
 
 
 def test_session_step0_is_firstrow_with_t0_export():
-    """Round-4 verdict do-this #3, pinned: the FIRST on-chip step is the
-    minimal firstrow path (one init, persisted < 90 s target), with
-    FIRSTROW_T0 exported at session start so the committed timeline
-    measures from 'relay answered', not from python's first line."""
+    """Round-4 verdict do-this #3, pinned: firstrow is the top
+    value-per-second pick of a fresh plan (sched/tasks.py) AND the
+    fallback's first step, with FIRSTROW_T0 exported before the
+    scheduler loop so the committed timeline measures from 'relay
+    answered', not from python's first line."""
+    from tpu_reductions.sched.priors import Priors
+    from tpu_reductions.sched.tasks import SESSION_TASKS
+
+    pri = Priors()
+    ratios = {t.name: t.value / pri.estimate(t) for t in SESSION_TASKS}
+    assert max(ratios, key=ratios.get) == "firstrow"
+
     text = SCRIPT.read_text()
-    first_step = text.index("step \"")
-    assert text.index("step \"first row\"") == first_step, (
-        "firstrow must be the session's first step")
-    assert text.index("FIRSTROW_T0=$(date") < first_step
+    body = _fallback_body()
+    assert body.index('step "first row"') == body.index('step "'), (
+        "firstrow must be the fallback's first step")
+    assert text.index("FIRSTROW_T0=$(date") \
+        < text.index("run_scheduled_session && sched_rc")
     assert "tpu_reductions.bench.firstrow" in text
-    # step 1 must not re-measure a scoreboard step 0 completed
+    # the headline bench must not re-measure a scoreboard firstrow
+    # completed (both the registry command and the fallback carry it)
     assert "BENCH_DOUBLES=$d" in text
 
 
@@ -226,6 +278,106 @@ def test_exit_trap_collates_evidence_committed_by_a_step(tmp_path):
     assert (repo / "examples/tpu_run/report.md").is_file()
     md = (repo / "examples/tpu_run/report.md").read_text()
     assert "150.0" in md
+
+
+def _toy_sched_tasks(repo):
+    import json
+
+    (repo / "toy_tasks.json").write_text(json.dumps([
+        {"name": "alpha", "title": "toy alpha", "value": 10,
+         "budget_s": 30,
+         "command": "printf '{\"complete\": true}' > a.json",
+         "artifacts": ["a.json"], "done_artifact": "a.json"},
+        {"name": "beta", "title": "toy beta", "value": 5, "budget_s": 30,
+         "command": "printf '{\"complete\": true}' > b.json",
+         "artifacts": ["b.json"], "done_artifact": "b.json"},
+    ]))
+
+
+def test_scheduler_loop_drives_steps_and_commits_plan_state(tmp_path):
+    """The tentpole acceptance for the shell side: chip_session's
+    scheduler loop pulls picks from `python -m tpu_reductions.sched
+    --next`, runs each through the SAME step machinery (per-step
+    commits), records outcomes, and ends with the plan complete and
+    sched_state.json committed alongside the artifacts."""
+    import json
+
+    repo_root = str(SCRIPT.parent.parent)
+    body = (
+        f"export PYTHONPATH='{repo_root}'\n"
+        "export TPU_REDUCTIONS_SCHED_ARGS='--tasks=toy_tasks.json'\n"
+        "SCHED_ARGS=$TPU_REDUCTIONS_SCHED_ARGS\n"
+        "run_scheduled_session; echo LOOP_RC=$?\n")
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _toy_sched_tasks(repo)
+    script = (
+        "set -u\n"
+        "export CHIP_SESSION_LIB=1\n"
+        f"source '{SCRIPT}'\n"
+        f"cd '{repo}'\n"
+        "git init -q . && git config user.email t@t && git config user.name t\n"
+        "git commit -q --allow-empty -m root\n"
+        "relay_ok() { return 0; }\n" + body)
+    r = subprocess.run(["bash", "-c", script], capture_output=True,
+                       text=True, timeout=120)
+    assert "LOOP_RC=0" in r.stdout, r.stdout + r.stderr
+    log = _log(repo)
+    assert "On-chip artifacts: toy alpha" in log
+    assert "On-chip artifacts: toy beta" in log
+    state = json.loads((repo / "sched_state.json").read_text())
+    assert state["complete"] is True
+    assert state["tasks"]["alpha"]["status"] == "done"
+    assert state["tasks"]["beta"]["status"] == "done"
+    # the plan state is committed per step like the ledger is
+    show = subprocess.run(["git", "-C", str(repo), "log",
+                           "--name-only", "--oneline"],
+                          capture_output=True, text=True).stdout
+    assert "sched_state.json" in show
+
+
+def test_scheduler_loop_rc3_aborts_and_plan_resumes(tmp_path):
+    """Window-death handoff in the shell loop: a task exiting 3 aborts
+    the session via step() (artifacts + plan state committed); the
+    NEXT session invocation resumes the plan and runs only the
+    remaining task."""
+    import json
+
+    repo_root = str(SCRIPT.parent.parent)
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    (repo / "toy_tasks.json").write_text(json.dumps([
+        {"name": "alpha", "title": "toy alpha", "value": 10,
+         "budget_s": 30,
+         "command": "echo r >> a.runs; printf '{\"complete\": true}' "
+                    "> a.json",
+         "artifacts": ["a.json"], "done_artifact": "a.json"},
+        {"name": "dies", "title": "toy dies", "value": 5, "budget_s": 30,
+         "command": "[ -e window2 ] || exit 3; "
+                    "printf '{\"complete\": true}' > d.json",
+         "artifacts": ["d.json"], "done_artifact": "d.json"},
+    ]))
+    script = (
+        "set -u\n"
+        "export CHIP_SESSION_LIB=1\n"
+        f"source '{SCRIPT}'\n"
+        f"cd '{repo}'\n"
+        "git init -q . && git config user.email t@t && git config user.name t\n"
+        "git commit -q --allow-empty -m root\n"
+        "relay_ok() { return 0; }\n"
+        f"export PYTHONPATH='{repo_root}'\n"
+        "SCHED_ARGS='--tasks=toy_tasks.json'\n"
+        "( run_scheduled_session ); echo WINDOW1_RC=$?\n"
+        "touch window2\n"
+        "( run_scheduled_session ); echo WINDOW2_RC=$?\n")
+    r = subprocess.run(["bash", "-c", script], capture_output=True,
+                       text=True, timeout=120)
+    assert "WINDOW1_RC=3" in r.stdout, r.stdout + r.stderr
+    assert "WINDOW2_RC=0" in r.stdout, r.stdout + r.stderr
+    state = json.loads((repo / "sched_state.json").read_text())
+    assert state["complete"] is True
+    # alpha ran exactly once across both windows (zero re-measurement)
+    assert (repo / "a.runs").read_text().count("r") == 1
 
 
 def test_exit_trap_skips_collation_when_nothing_changed(tmp_path):

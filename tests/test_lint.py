@@ -377,6 +377,56 @@ def test_red012_waivable_with_reason(tmp_path):
                             name="utils/fixture.py")) == []
 
 
+# ---------------------------------------------------------------- RED013
+
+
+def test_red013_flags_budget_literals_outside_registry(tmp_path):
+    src = (
+        "STEP_BUDGET_S = 300\n"
+        "flagship_budget = 3 * 3600\n"
+        "def run(t):\n"
+        "    launch(t, budget_s=420)\n"
+    )
+    findings = _lint_src(tmp_path, src, name="utils/fixture.py")
+    assert _rules(findings) == ["RED013"] * 3
+    assert "sched/tasks.py" in findings[0].message
+
+
+def test_red013_whitelists_sched_registry_and_non_literals(tmp_path):
+    # the registry is THE sanctioned home of budget literals
+    src = "BUDGET_S = 300\nTask = dict(budget_s=420)\n"
+    assert _rules(_lint_src(tmp_path, src,
+                            name="sched/tasks.py")) == []
+    # a budget flowing from data (the planner/executor pattern) is fine
+    src2 = ("def run(task):\n"
+            "    b = float(task.budget_s)\n"
+            "    launch(task, budget_s=b)\n")
+    assert _rules(_lint_src(tmp_path, src2, name="utils/fixture.py")) == []
+
+
+def test_red013_flags_shell_step_budgets_and_bench_timeouts(tmp_path):
+    src = (
+        "#!/bin/bash\n"
+        'step "first row" 300 FIRSTROW.json -- python -m x\n'
+        "timeout 600 python -m tpu_reductions.bench.regen out/\n"
+        # the scheduler loop's variable budget is the sanctioned form
+        'step "$SCHED_TASK_NAME" "$SCHED_TASK_BUDGET" $A -- bash -c "$C"\n'
+        # timeouts around non-measurement commands are out of scope
+        "timeout 120 python -m tpu_reductions.obs.timeline led.jsonl\n"
+    )
+    findings = _lint_src(tmp_path, src, name="scripts/fixture.sh")
+    assert _rules(findings) == ["RED013"] * 2
+    assert all("sched/tasks.py" in f.message for f in findings)
+
+
+def test_red013_shell_waiver_marks_the_fallback_path(tmp_path):
+    src = (
+        "#!/bin/bash\n"
+        "# redlint: disable=RED013 -- no-scheduler fallback path\n"
+        'step "first row" 300 FIRSTROW.json -- python -m x\n')
+    assert _rules(_lint_src(tmp_path, src, name="scripts/fixture.sh")) == []
+
+
 # ---------------------------------------------------------------- RED008
 
 
@@ -500,6 +550,7 @@ def test_cli_positive_fixture_per_rule_exits_nonzero(tmp_path):
                                    "    return jax.devices()\n"),
         "RED012": ("utils/r12.py",
                    "print('{\"t\": 1, \"ev\": \"a.b\", \"pid\": 1}')\n"),
+        "RED013": ("r13.py", "WINDOW_BUDGET_S = 300\n"),
     }
     for rule, (name, src) in fixtures.items():
         f = tmp_path / name

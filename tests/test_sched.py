@@ -1,0 +1,642 @@
+"""Window scheduler (tpu_reductions/sched/): registry, priors, planner,
+plan state, executor and CLI contracts.
+
+The acceptance surface (ISSUE 5): a cpu rehearsal completes a full
+plan; a SIGKILL mid-plan followed by re-invocation finishes the
+remaining tasks without repeating any completed unit; --plan-only
+prints a stable table; hazard tasks are strictly last; the plan state
+resumes under the Checkpoint-style meta contract. Everything here runs
+off-device — the planner is jax-free by construction.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_reductions.sched import executor, planner, tasks as tasks_mod
+from tpu_reductions.sched.priors import (DEFAULT_WINDOW_S, Priors,
+                                         scan_history)
+from tpu_reductions.sched.state import (PlanState,
+                                        plan_vs_actual_markdown)
+from tpu_reductions.sched.tasks import (SESSION_TASKS, Task,
+                                        artifact_complete, by_name,
+                                        registry, registry_hash,
+                                        rehearsal_excluded)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _task(name, value=10.0, budget=60.0, **kw):
+    kw.setdefault("command", "true")
+    kw.setdefault("artifacts", ())
+    return Task(name=name, title=kw.pop("title", name), value=value,
+                budget_s=budget, **kw)
+
+
+def _state(tmp_path, name="state.json", **kw):
+    return PlanState(str(tmp_path / name), {"registry": "t"}, **kw)
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_session_registry_slugs_unique_and_budgets_positive():
+    index = by_name(SESSION_TASKS)
+    assert len(index) == len(SESSION_TASKS)
+    for t in SESSION_TASKS:
+        assert t.budget_s > 0 and t.value > 0
+        for r in t.requires:
+            assert r in index, f"{t.name} requires unknown {r}"
+
+
+def test_session_registry_firstrow_dominates_and_flagship_is_hazard():
+    index = by_name(SESSION_TASKS)
+    ratios = {t.name: t.value / t.budget_s for t in SESSION_TASKS}
+    assert max(ratios, key=ratios.get) == "firstrow"
+    assert index["flagship"].hazard and index["flagship"].chip_only
+
+
+def test_rehearsal_registry_drops_chip_only_and_swaps_commands():
+    cpu = registry(platform="cpu")
+    names = {t.name for t in cpu}
+    assert "flagship" not in names and "headline_bench" not in names
+    assert "firstrow" in names
+    fr = by_name(cpu)["firstrow"]
+    assert "--platform=cpu" in fr.command
+    excluded = {t.name for t in rehearsal_excluded(platform="cpu")}
+    assert "flagship" in excluded
+    # live profile keeps the session commands untouched
+    live = by_name(registry())
+    assert "--platform" not in live["firstrow"].command
+
+
+def test_registry_hash_stable_and_content_sensitive():
+    a = registry_hash(SESSION_TASKS)
+    assert a == registry_hash(tuple(SESSION_TASKS))
+    b = registry_hash([_task("x")])
+    assert a != b
+
+
+def test_tasks_file_roundtrip(tmp_path):
+    f = tmp_path / "tasks.json"
+    f.write_text(json.dumps([
+        {"name": "a", "value": 2, "budget_s": 5, "command": "true",
+         "artifacts": ["a.json"], "done_artifact": "a.json"},
+        {"name": "h", "hazard": True, "command": "true"}]))
+    loaded = tasks_mod.load_tasks_file(str(f))
+    assert [t.name for t in loaded] == ["a", "h"]
+    assert loaded[0].done_artifact == "a.json"
+    assert loaded[1].hazard
+    f.write_text(json.dumps({"not": "a list"}))
+    with pytest.raises(ValueError):
+        tasks_mod.load_tasks_file(str(f))
+
+
+def test_artifact_complete_predicate(tmp_path):
+    p = tmp_path / "art.json"
+    t0 = time.time() - 10
+    assert not artifact_complete(str(p), t0)          # absent
+    p.write_text('{"complete": false}')
+    assert not artifact_complete(str(p), t0)          # incomplete
+    p.write_text('{"complete": true}')
+    assert artifact_complete(str(p), t0)              # fresh + complete
+    assert not artifact_complete(str(p), time.time() + 10)  # stale vs t0
+    p.write_text("{truncated")
+    assert not artifact_complete(str(p), t0)          # torn: re-measure
+
+
+# --------------------------------------------------------------- priors
+
+
+def _ledger(tmp_path, events, name="hist.jsonl"):
+    f = tmp_path / name
+    f.write_text("".join(json.dumps(e) + "\n" for e in events))
+    return str(f)
+
+
+def test_priors_learn_step_durations_and_sched_done(tmp_path):
+    led = _ledger(tmp_path, [
+        {"t": 100.0, "ev": "step.start", "pid": 1, "name": "first row"},
+        {"t": 142.0, "ev": "step.end", "pid": 1, "name": "first row"},
+        {"t": 150.0, "ev": "sched.done", "pid": 1, "task": "smoke",
+         "actual_s": 33.0},
+    ])
+    pri = Priors.from_ledgers([led])
+    fr = by_name(SESSION_TASKS)["firstrow"]
+    sm = by_name(SESSION_TASKS)["smoke"]
+    assert pri.estimate(fr) == pytest.approx(42.0)   # via step title
+    assert pri.estimate(sm) == pytest.approx(33.0)   # via slug
+    # no history for the ladder: static budget fallback
+    cal = by_name(SESSION_TASKS)["calibrate_ladder"]
+    assert pri.estimate(cal) == cal.budget_s
+
+
+def test_priors_online_observation_wins(tmp_path):
+    pri = Priors()
+    t = _task("x", budget=100.0)
+    assert pri.estimate(t) == 100.0
+    pri.observe("x", 7.0)
+    assert pri.estimate(t) == 7.0
+
+
+def test_priors_window_model_clusters_and_defaults(tmp_path):
+    # two windows: 0..300 and 10000..10060, split by the >30 min gap
+    led = _ledger(tmp_path, [
+        {"t": 0.0, "ev": "session.start", "pid": 1},
+        {"t": 300.0, "ev": "watchdog.exit", "pid": 1, "code": 3},
+        {"t": 10000.0, "ev": "session.start", "pid": 2},
+        {"t": 10060.0, "ev": "session.end", "pid": 2},
+    ])
+    h = scan_history([led])
+    assert sorted(h["windows"]) == [60.0, 300.0]
+    pri = Priors(h)
+    assert pri.window_quantile(0.5) in (60.0, 300.0)
+    # no history: the round-4 flap prior
+    assert Priors().window_quantile() == DEFAULT_WINDOW_S
+    # remaining never negative
+    assert Priors().remaining_s(window_t0=0.0, now=1e9) == 0.0
+
+
+def test_priors_skip_unreadable_history(tmp_path):
+    pri = Priors.from_ledgers([str(tmp_path / "absent.jsonl"), ""])
+    assert pri.window_quantile() == DEFAULT_WINDOW_S
+
+
+# -------------------------------------------------------------- planner
+
+
+def test_planner_orders_by_value_per_second_hazard_last(tmp_path):
+    ts = [_task("slow-big", value=100, budget=100),
+          _task("fast-small", value=10, budget=5),
+          _task("haz", value=1000, budget=10, hazard=True)]
+    p = planner.plan(ts, _state(tmp_path), Priors(), now=0.0)
+    names = [e.task.name for e in p.entries]
+    # fast-small: 2.0/s beats slow-big: 1.0/s; hazard LAST despite the
+    # overwhelming value score
+    assert names == ["fast-small", "slow-big", "haz"]
+
+
+def test_planner_skips_settled_and_fresh_artifacts(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    ts = [_task("done-art", done_artifact="done.json"),
+          _task("settled"), _task("open")]
+    st = _state(tmp_path)
+    # written AFTER the window opened: fresh-complete => skip
+    (tmp_path / "done.json").write_text('{"complete": true}')
+    st.record_done("settled", 0, 1.0, "done")
+    p = planner.plan(ts, st, Priors())
+    assert [e.task.name for e in p.entries] == ["open"]
+    assert ("done-art", "artifact-complete") in p.skips
+
+
+def test_planner_requires_gates_until_attempted(tmp_path):
+    ts = [_task("race", value=1000, budget=10, requires=("smoke",)),
+          _task("smoke", value=1, budget=100)]
+    st = _state(tmp_path)
+    p = planner.plan(ts, st, Priors(), now=0.0)
+    # race outranks smoke by ratio but is requires-blocked behind it
+    assert [e.task.name for e in p.entries] == ["smoke", "race"]
+    st.record_done("smoke", 1, 5.0, "failed")   # attempted counts
+    p2 = planner.plan(ts, st, Priors(), now=0.0)
+    assert [e.task.name for e in p2.entries] == ["race"]
+
+
+def test_planner_missing_prereq_outside_registry_does_not_deadlock(tmp_path):
+    ts = [_task("race", requires=("not-in-registry",))]
+    p = planner.plan(ts, _state(tmp_path), Priors(), now=0.0)
+    assert [e.task.name for e in p.entries] == ["race"]
+
+
+def test_planner_fits_against_remaining_window(tmp_path):
+    ts = [_task("a", value=10, budget=100),
+          _task("b", value=5, budget=100),
+          _task("c", value=1, budget=300)]
+    st = _state(tmp_path)
+    pri = Priors({"durations": {}, "windows": [250.0]})
+    p = planner.plan(ts, st, pri, now=st.window_t0)
+    fits = {e.task.name: e.fits for e in p.entries}
+    assert fits == {"a": True, "b": True, "c": False}
+    assert p.remaining_s == pytest.approx(250.0)
+    # the table renders every entry + the remaining estimate
+    table = planner.render_table(p)
+    assert "a" in table and "no" in table and "250.0 s" in table
+
+
+# ----------------------------------------------------------- plan state
+
+
+def test_state_resumes_incomplete_and_keeps_window_t0(tmp_path):
+    st = _state(tmp_path)
+    st.record_done("a", 0, 2.0, "done")
+    t0 = st.window_t0
+    st2 = _state(tmp_path)
+    assert st2.window_t0 == pytest.approx(t0, abs=0.01)
+    assert st2.settled("a")
+
+
+def test_state_meta_mismatch_and_complete_plan_start_fresh(tmp_path):
+    st = _state(tmp_path)
+    st.record_done("a", 0, 2.0, "done")
+    other = PlanState(str(tmp_path / "state.json"), {"registry": "OTHER"})
+    assert not other.settled("a")          # contract mismatch: fresh
+    st3 = _state(tmp_path, name="s2.json")
+    st3.record_done("a", 0, 2.0, "done")
+    st3.finalize()
+    st4 = _state(tmp_path, name="s2.json")
+    assert not st4.settled("a")            # complete: fresh window
+
+
+def test_state_reconcile_settles_or_drops_stale_picks(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    finished = _task("finished", done_artifact="fin.json")
+    died = _task("died", done_artifact="died.json")
+    st = _state(tmp_path)
+    st.record_pick(finished, 5.0)
+    st.record_pick(died, 5.0)
+    (tmp_path / "fin.json").write_text('{"complete": true}')
+    st2 = _state(tmp_path)                  # the re-invocation
+    fixed = st2.reconcile([finished, died])
+    assert fixed == ["finished"]
+    assert st2.settled("finished") and not st2.attempted("died")
+
+
+def test_state_readonly_never_writes(tmp_path):
+    path = tmp_path / "ro.json"
+    PlanState(str(path), {"registry": "t"}, readonly=True)
+    assert not path.exists()
+
+
+def test_plan_vs_actual_markdown_renders(tmp_path):
+    st = _state(tmp_path)
+    st.record_pick(_task("a"), 12.0)
+    st.record_done("a", 0, 3.5, "done")
+    st.record_skip("b", "chip-only")
+    md = plan_vs_actual_markdown(json.loads(
+        (tmp_path / "state.json").read_text()))
+    assert "| a | 12.0 | 3.5 | done |" in md
+    assert "skipped (chip-only)" in md
+    assert "plan state: interrupted" in md
+
+
+# ------------------------------------------------------------- executor
+
+
+def _run_recorded(calls, rc_map=None):
+    def _run(task, env=None, budget_s=None):
+        calls.append(task.name)
+        return (rc_map or {}).get(task.name, 0)
+    return _run
+
+
+def test_executor_runs_plan_in_ratio_order_and_finalizes(tmp_path):
+    ts = [_task("slow", value=10, budget=100),
+          _task("fast", value=10, budget=5)]
+    st = _state(tmp_path)
+    calls = []
+    rc = executor.run_plan(ts, st, Priors(), _run=_run_recorded(calls))
+    assert rc == 0 and calls == ["fast", "slow"]
+    data = json.loads((tmp_path / "state.json").read_text())
+    assert data["complete"] is True
+    assert all(v["status"] == "done" for v in data["tasks"].values())
+
+
+def test_executor_window_death_persists_and_resumes(tmp_path):
+    ts = [_task("a", value=10, budget=5), _task("b", value=5, budget=5),
+          _task("c", value=1, budget=5)]
+    calls = []
+    rc = executor.run_plan(ts, _state(tmp_path), Priors(),
+                           _run=_run_recorded(calls, {"b": 3}))
+    assert rc == 3 and calls == ["a", "b"]
+    data = json.loads((tmp_path / "state.json").read_text())
+    assert data["complete"] is False
+    assert data["tasks"]["b"]["status"] == "aborted"
+    # next window: a stays done (zero re-measurement), b re-runs
+    calls2 = []
+    rc2 = executor.run_plan(ts, _state(tmp_path), Priors(),
+                            _run=_run_recorded(calls2))
+    assert rc2 == 0 and calls2 == ["b", "c"]
+
+
+def test_executor_budget_cut_and_failure_do_not_stop_the_plan(tmp_path):
+    ts = [_task("a", value=10, budget=5), _task("b", value=5, budget=5),
+          _task("c", value=1, budget=5)]
+    calls = []
+    rc = executor.run_plan(ts, _state(tmp_path), Priors(),
+                           _run=_run_recorded(calls, {"a": 124, "b": 1}))
+    assert rc == 0 and calls == ["a", "b", "c"]
+    data = json.loads((tmp_path / "state.json").read_text())
+    assert data["tasks"]["a"]["status"] == "budget-cut"
+    assert data["tasks"]["b"]["status"] == "failed"
+    assert data["tasks"]["c"]["status"] == "done"
+
+
+def test_executor_records_chip_only_exclusions(tmp_path):
+    ts = [_task("a")]
+    st = _state(tmp_path)
+    rc = executor.run_plan(ts, st, Priors(),
+                           excluded=[_task("chipper", chip_only=True)],
+                           _run=_run_recorded([]))
+    assert rc == 0
+    data = json.loads((tmp_path / "state.json").read_text())
+    assert data["tasks"]["chipper"] == {"status": "skipped",
+                                        "reason": "chip-only"}
+
+
+def test_run_task_budget_interrupts_int_first(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_REDUCTIONS_SCHED_KILL_GRACE_S", "5")
+    trace = tmp_path / "trace"
+    t = _task("stall", budget=1.0, command=(
+        f"trap 'echo INT >> {trace}; exit 0' INT; "
+        f"echo start >> {trace}; sleep 30"))
+    t0 = time.monotonic()
+    rc = executor.run_task(t)
+    assert rc == 124
+    assert time.monotonic() - t0 < 10
+    assert "INT" in trace.read_text()   # drain-first: SIGINT delivered
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _sched(args, cwd, env=None, timeout=60):
+    e = {**os.environ, "PYTHONPATH": str(REPO),
+         # host-agnostic: a tunneled dev box with a dead real relay
+         # must not trip the executor's between-task gate in tests
+         "TPU_REDUCTIONS_RELAY_MARKER": str(Path(cwd) / "no-relay")}
+    e.pop("TPU_REDUCTIONS_LEDGER", None)
+    e.update(env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_reductions.sched", *args],
+        cwd=str(cwd), env=e, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def test_cli_plan_only_is_stable_and_writes_nothing(tmp_path):
+    r1 = _sched(["--plan-only", "--platform=cpu"], tmp_path)
+    r2 = _sched(["--plan-only", "--platform=cpu"], tmp_path)
+    assert r1.returncode == 0, r1.stderr
+    assert r1.stdout == r2.stdout
+    assert "firstrow" in r1.stdout
+    assert "chip-only" in r1.stdout          # exclusions are visible
+    assert list(tmp_path.iterdir()) == []    # no state, no artifacts
+
+
+def test_cli_plan_only_full_profile_keeps_hazard_last(tmp_path):
+    r = _sched(["--plan-only"], tmp_path)
+    assert r.returncode == 0, r.stderr
+    rows = [ln for ln in r.stdout.splitlines()
+            if ln.strip() and ln.split()[0].isdigit()]
+    assert rows[-1].split()[1] == "flagship"
+    assert "[hazard:last]" in rows[-1]
+    assert rows[0].split()[1] == "firstrow"
+
+
+TOY = [
+    {"name": "alpha", "value": 10, "budget_s": 30,
+     "command": "echo r >> alpha.runs; printf '{\"complete\": true}' "
+                "> a.json",
+     "artifacts": ["a.json"], "done_artifact": "a.json"},
+    {"name": "beta", "value": 5, "budget_s": 30,
+     "command": "echo r >> beta.runs; printf '{\"complete\": true}' "
+                "> b.json",
+     "artifacts": ["b.json"], "done_artifact": "b.json"},
+]
+
+
+def _write_toy(tmp_path, tasks=None):
+    f = tmp_path / "tasks.json"
+    f.write_text(json.dumps(tasks if tasks is not None else TOY))
+    return f
+
+
+def test_cli_full_run_completes_toy_plan_and_ledgers(tmp_path):
+    _write_toy(tmp_path)
+    led = tmp_path / "led.jsonl"
+    r = _sched(["--tasks=tasks.json", "--state=st.json"], tmp_path,
+               env={"TPU_REDUCTIONS_LEDGER": str(led)})
+    assert r.returncode == 0, r.stderr
+    st = json.loads((tmp_path / "st.json").read_text())
+    assert st["complete"] is True
+    evs = [json.loads(ln)["ev"] for ln in led.read_text().splitlines()]
+    for ev in ("sched.plan", "sched.pick", "sched.done", "sched.replan"):
+        assert ev in evs, f"missing {ev}: {evs}"
+    # every emitted name is registered grammar (lint/grammar.py)
+    from tpu_reductions.lint.grammar import SCHED_EVENTS
+    assert set(e for e in evs if e.startswith("sched.")) <= set(
+        SCHED_EVENTS)
+
+
+def test_cli_sigkill_midplan_resume_repeats_nothing(tmp_path):
+    """THE acceptance scenario: SIGKILL the executor mid-plan; the
+    re-invocation finishes the remaining tasks without repeating any
+    completed unit."""
+    toy = [dict(TOY[0]),
+           {"name": "beta", "value": 5, "budget_s": 30,
+            # exec: the stall IS the task process — killing it leaves
+            # no orphan shell that could still write b.json and race
+            # the resume
+            "command": "echo r >> beta.runs; "
+                       "[ -e window2 ] || exec sleep 37; "
+                       "printf '{\"complete\": true}' > b.json",
+            "artifacts": ["b.json"], "done_artifact": "b.json"}]
+    _write_toy(tmp_path, toy)
+    env = {**os.environ, "PYTHONPATH": str(REPO),
+           "TPU_REDUCTIONS_RELAY_MARKER": str(tmp_path / "no-relay")}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_reductions.sched",
+         "--tasks=tasks.json", "--state=st.json"],
+        cwd=str(tmp_path), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 20
+    st_path = tmp_path / "st.json"
+    while time.monotonic() < deadline:
+        try:
+            st = json.loads(st_path.read_text())
+            if st["tasks"].get("beta", {}).get("status") == "picked":
+                break
+        except (OSError, ValueError, KeyError):
+            pass
+        time.sleep(0.05)
+    else:
+        proc.kill()
+        pytest.fail("beta never got picked")
+    time.sleep(0.2)                     # let beta's stall start
+    os.kill(proc.pid, signal.SIGKILL)   # the no-cleanup death shape
+    proc.wait(timeout=10)
+    subprocess.run(["pkill", "-INT", "-f", "sleep 37"], check=False)
+    (tmp_path / "window2").write_text("")
+    r = _sched(["--tasks=tasks.json", "--state=st.json"], tmp_path)
+    assert r.returncode == 0, r.stderr
+    st = json.loads(st_path.read_text())
+    assert st["complete"] is True
+    # alpha ran exactly once across both invocations; beta re-ran
+    assert (tmp_path / "alpha.runs").read_text().count("r") == 1
+    assert (tmp_path / "beta.runs").read_text().count("r") == 2
+
+
+def test_cli_next_record_loop_drives_plan_to_exit_10(tmp_path):
+    _write_toy(tmp_path)
+    seen = []
+    for _ in range(5):
+        r = _sched(["--next", "--emit=shell", "--tasks=tasks.json",
+                    "--state=st.json"], tmp_path)
+        if r.returncode == 10:
+            break
+        assert r.returncode == 0, r.stderr
+        # run the pick exactly the way run_scheduled_session does:
+        # eval the emitted assignments, then bash -c the command
+        (tmp_path / "next.env").write_text(r.stdout)
+        run = subprocess.run(
+            ["bash", "-c",
+             'eval "$(cat next.env)"; echo "$SCHED_TASK_SLUG"; '
+             'bash -c "$SCHED_TASK_CMD"'],
+            cwd=str(tmp_path), capture_output=True, text=True)
+        assert run.returncode == 0, run.stderr
+        slug = run.stdout.strip().splitlines()[0]
+        seen.append(slug)
+        rec = _sched(["--record", slug, "--rc=0", "--elapsed=1",
+                      "--tasks=tasks.json", "--state=st.json"], tmp_path)
+        assert rec.returncode == 0, rec.stderr
+    else:
+        pytest.fail(f"plan never completed; picks: {seen}")
+    assert seen == ["alpha", "beta"]
+    assert json.loads((tmp_path / "st.json").read_text())["complete"]
+
+
+def test_cli_exclusive_modes_usage_error(tmp_path):
+    r = _sched(["--plan-only", "--next"], tmp_path)
+    assert r.returncode == 2
+
+
+# ------------------------------------------------- timeline integration
+
+
+def test_timeline_sched_summary_and_summary_md(tmp_path):
+    from tpu_reductions.obs.timeline import (read_ledger, sched_summary,
+                                             summarize,
+                                             summary_markdown)
+    led = _ledger(tmp_path, [
+        {"t": 1.0, "ev": "session.start", "pid": 9, "prog": "sched"},
+        {"t": 1.1, "ev": "sched.plan", "pid": 9, "tasks": ["a", "b"]},
+        {"t": 1.2, "ev": "sched.skip", "pid": 9, "task": "c",
+         "reason": "chip-only"},
+        {"t": 1.3, "ev": "sched.pick", "pid": 9, "task": "a",
+         "est_s": 30.0, "value": 10},
+        {"t": 5.0, "ev": "sched.done", "pid": 9, "task": "a", "rc": 0,
+         "actual_s": 3.7, "planned_s": 30.0, "status": "done"},
+        {"t": 5.1, "ev": "sched.replan", "pid": 9},
+        {"t": 5.2, "ev": "sched.pick", "pid": 9, "task": "b",
+         "est_s": 10.0, "value": 5},
+        {"t": 6.0, "ev": "session.end", "pid": 9},
+    ])
+    events, torn = read_ledger(led)
+    sched = sched_summary(events)
+    assert sched["replans"] == 1
+    by_task = {r["task"]: r for r in sched["tasks"]}
+    assert by_task["a"]["planned_s"] == 30.0
+    assert by_task["a"]["actual_s"] == 3.7
+    assert by_task["a"]["status"] == "done"
+    assert by_task["b"]["status"] == "picked"   # died mid-task: visible
+    assert by_task["c"]["status"] == "skipped"
+    md = summary_markdown(summarize(led, events, torn))
+    assert "plan vs actual (scheduler)" in md
+    assert "| a | 30.0 | 3.7 | done |" in md
+    assert "skipped (chip-only)" in md
+    # a ledger without scheduler events keeps the old table unchanged
+    led2 = _ledger(tmp_path, [
+        {"t": 1.0, "ev": "session.start", "pid": 9}], name="plain.jsonl")
+    events2, torn2 = read_ledger(led2)
+    assert sched_summary(events2) is None
+    assert "plan vs actual" not in summary_markdown(
+        summarize(led2, events2, torn2))
+
+
+def test_regen_folds_plan_vs_actual_into_report(tmp_path):
+    """ISSUE 5 satellite: the exit trap drops sched_state.json next to
+    the evidence; regen folds the plan-vs-actual table into report.md."""
+    out = tmp_path / "run"
+    (out / "single_chip" / "raw_output").mkdir(parents=True)
+    row = {"method": "SUM", "dtype": "int32", "n": 1 << 24,
+           "backend": "pallas", "kernel": 6, "gbps": 100.0,
+           "avg_s": 1e-3, "iterations": 256, "status": "PASSED",
+           "timing": "chained", "threads": 512, "max_blocks": 64,
+           "chain_reps": 5}
+    (out / "single_chip" / "raw_output" / "run-int32-SUM-0.json"
+     ).write_text(json.dumps(row))
+    (out / "sched_state.json").write_text(json.dumps({
+        "complete": False, "window_t0": 1.0,
+        "tasks": {"firstrow": {"status": "done", "planned_s": 300,
+                               "actual_s": 61.2, "picked_at": 2.0}}}))
+    from tpu_reductions.bench.regen import regenerate
+    assert regenerate(out, log=lambda m: None)
+    md = (out / "report.md").read_text()
+    assert "plan vs actual (scheduler)" in md
+    assert "firstrow" in md and "61.2" in md
+
+
+def test_cli_sched_task_fault_point_exit_midplan_resumes(tmp_path):
+    """The scheduler's own chaos seam (faults/inject.py `sched.task`):
+    a scripted os._exit between the second pick and its launch is the
+    deterministic executor-death — the re-invocation resumes the plan
+    with the first task still done."""
+    _write_toy(tmp_path)
+    r = _sched(["--tasks=tasks.json", "--state=st.json"], tmp_path,
+               env={"TPU_REDUCTIONS_FAULTS": json.dumps(
+                   {"sched.task": {"after": 1, "action": "exit",
+                                   "code": 9}})})
+    assert r.returncode == 9
+    st = json.loads((tmp_path / "st.json").read_text())
+    assert st["complete"] is False
+    assert st["tasks"]["alpha"]["status"] == "done"
+    assert "beta" not in st["tasks"]       # died before the pick record
+    r2 = _sched(["--tasks=tasks.json", "--state=st.json"], tmp_path)
+    assert r2.returncode == 0, r2.stderr
+    assert (tmp_path / "alpha.runs").read_text().count("r") == 1
+    assert (tmp_path / "beta.runs").read_text().count("r") == 1
+
+
+@pytest.mark.slow
+def test_full_cpu_rehearsal_plan_completes(tmp_path):
+    """ISSUE 5 acceptance: `python -m tpu_reductions.sched
+    --platform=cpu` completes a full rehearsal plan off-chip — every
+    rehearsal task done, every chip-only task recorded skipped."""
+    led = tmp_path / "led.jsonl"
+    r = _sched(["--platform=cpu", "--state=st.json"], tmp_path,
+               env={"TPU_REDUCTIONS_LEDGER": str(led)}, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    st = json.loads((tmp_path / "st.json").read_text())
+    assert st["complete"] is True
+    statuses = {k: v["status"] for k, v in st["tasks"].items()}
+    assert statuses["flagship"] == "skipped"
+    done = [k for k, v in statuses.items() if v == "done"]
+    assert "firstrow" in done and "smoke" in done
+    # the rehearsal's evidence artifacts exist and are complete
+    assert json.loads((tmp_path / "FIRSTROW.json").read_text())[
+        "complete"] is True
+
+
+# ------------------------------------------------------ jax-free import
+
+
+def test_sched_cli_is_jax_free(tmp_path):
+    """The planner must work — and stay instant — while the relay is
+    dead: importing the whole sched package (and running --plan-only)
+    must never import jax."""
+    code = (
+        "import sys\n"
+        "import tpu_reductions.sched.executor, tpu_reductions.sched\n"
+        "import tpu_reductions.sched.planner, tpu_reductions.sched.priors\n"
+        "import tpu_reductions.sched.state, tpu_reductions.sched.tasks\n"
+        "import tpu_reductions.sched.__main__\n"
+        "assert 'jax' not in sys.modules, 'sched pulled in jax'\n")
+    r = subprocess.run([sys.executable, "-c", code],
+                       env={**os.environ, "PYTHONPATH": str(REPO)},
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr

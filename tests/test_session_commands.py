@@ -146,6 +146,23 @@ STEPS = [
      ["--method=SUM", "--type=int", "--n=65536", "--iterations=4",
       "--chainreps=2", "--grid=fine", "--out=tune_fine.json"],
      "tune_fine.json"),
+    # the window scheduler's shell interface (run_scheduled_session):
+    # one pick + one outcome record per loop iteration
+    # (docs/SCHEDULER.md); rehearsed against the real registry's cpu
+    # profile so a renamed flag fails here, not in a live window
+    ('python -m tpu_reductions.sched --next --emit=shell '
+     '--state="$SCHED_STATE" $SCHED_ARGS',
+     "tpu_reductions.sched.__main__",
+     ["--next", "--emit=shell", "--state=sched_state.json",
+      "--platform=cpu"],
+     None),
+    ('python -m tpu_reductions.sched --record "$SCHED_TASK_SLUG" '
+     '--rc="$STEP_LAST_RC" --elapsed="$elapsed" --state="$SCHED_STATE" '
+     "$SCHED_ARGS",
+     "tpu_reductions.sched.__main__",
+     ["--record", "firstrow", "--rc=0", "--elapsed=1",
+      "--state=sched_state.json", "--platform=cpu"],
+     None),
     # flight-recorder collation (session exit trap): the machine
     # summary for bench/regen, and the WINDOW_SUMMARY.md table — the
     # rehearsal synthesizes a tiny ledger first (see the timeline

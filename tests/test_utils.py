@@ -141,6 +141,25 @@ def test_bulk_mode_median_falls_back_to_per_iteration_average():
     assert abs(sw.median_s - sw.average_s) < 1e-12
 
 
+def test_calibrate_cli_runs_with_default_argv(tmp_path):
+    """Bare `python -m tpu_reductions.utils.calibrate` regression pin:
+    the argv=None path reads sys.argv (the ledger arm's argv record) —
+    in-process tests always pass argv explicitly, which masked a
+    NameError that would have crashed the live ladder step (found by
+    the scheduler's cpu rehearsal, ISSUE 5)."""
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    r = subprocess.run(
+        [_sys.executable, "-m", "tpu_reductions.utils.calibrate",
+         "--platform=cpu", "--n", "16384", "--iters", "2", "--reps",
+         "1", "--chainspan", "4"],
+        capture_output=True, text=True, timeout=120,
+        cwd=str(Path(__file__).parents[1]))
+    assert r.returncode == 0, r.stderr
+
+
 def test_calibrate_ladder_cli_json_shape(capsys):
     """--ladder: two rungs, the HBM-bound (last) rung decides the
     verdict (docs/TIMING.md: VMEM-resident verdicts are vacuous on

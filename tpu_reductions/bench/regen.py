@@ -167,6 +167,22 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                 "(obs_timeline.json)")
         except (OSError, ValueError, KeyError, TypeError) as e:
             log(f"regen: obs_timeline.json unusable ({e}); skipped")
+    # the scheduler's plan-vs-actual record (ISSUE 5 satellite): the
+    # chip_session exit trap copies the plan state next to the
+    # evidence; fold it in so every window's report says what the
+    # planner promised vs what it delivered
+    sched_file = out / "sched_state.json"
+    if sched_file.exists():
+        try:
+            from tpu_reductions.sched.state import plan_vs_actual_markdown
+            sched_state = json.loads(sched_file.read_text())
+            with open(paths["md"], "a") as f:
+                f.write("\n" + plan_vs_actual_markdown(sched_state)
+                        + "\n")
+            log("regen: appended plan-vs-actual table "
+                "(sched_state.json)")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: sched_state.json unusable ({e}); skipped")
     pdf = generate_pdf(out, platform=platform,
                        data={"avgs": {}, "single_chip": sc or None,
                              "calibration": cal,

@@ -34,7 +34,10 @@ Registered fault points: `watchdog.probe`, `staging.chunk`,
 utils/heartbeat.py), `preflight.probe` (fired in the sacrificial
 discovery subprocess BEFORE its jax import — a scripted `stall` there
 is how a wedged device lease is rehearsed without a device,
-utils/preflight.py). docs/RESILIENCE.md keeps the list.
+utils/preflight.py), `sched.task` (between the window scheduler's pick
+and its launch, sched/executor.py — a scripted `exit` is the
+deterministic "executor died mid-plan" the plan-resume contract is
+tested against). docs/RESILIENCE.md keeps the list.
 
 Counters are process-global and monotonic; `reset()` re-arms them for
 in-process tests (subprocesses start fresh by construction).

@@ -76,6 +76,14 @@ EVENT_KEY = '"ev":'
 # watchdog.exit, ...) — obs/ledger.py validates every emit against this
 EVENT_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_]+)*$")
 
+# the window scheduler's typed events (tpu_reductions/sched/,
+# docs/SCHEDULER.md) — registered HERE like every other machine-parsed
+# row so the producers (sched/executor.py, sched/__main__.py) and the
+# consumer (obs/timeline.py's plan-vs-actual attribution) share one
+# vocabulary and cannot drift
+SCHED_EVENTS = ("sched.plan", "sched.pick", "sched.skip", "sched.done",
+                "sched.replan")
+
 # one complete ledger line, either producer
 EVENT_ROW_RE = re.compile(
     r'^\{"t": [0-9]+(?:\.[0-9]+)?, "ev": "[a-z][a-z0-9_.]*", '

@@ -40,6 +40,9 @@ OBS_WHITELIST = ("obs/ledger.py",)
 # RED012 polices the runtime/measurement packages where event-shaped
 # lines would otherwise leak out as prints
 OBS_SCOPE_DIRS = ("utils", "bench", "obs", "faults")
+# RED013: wall-clock budgets / step orderings live in the scheduler's
+# task registry and nowhere else (ISSUE 5; docs/SCHEDULER.md)
+SCHED_WHITELIST = ("sched/tasks.py",)
 
 # RED006 applies to the measured packages only: every public surface in
 # ops/ and bench/ must carry its reference citation (PARITY.md).
@@ -151,6 +154,7 @@ def check_python(rel_posix: str, source: str) -> List[RawFinding]:
     out += _red010(rel_posix, ctx)
     out += _red011(rel_posix, ctx)
     out += _red012(rel_posix, ctx)
+    out += _red013(rel_posix, ctx)
     # nested timing scopes can double-report the same call site
     return sorted(set(out), key=lambda f: (f.line, f.rule, f.message))
 
@@ -515,6 +519,58 @@ def _red011(rel: str, ctx: _FileContext) -> List[RawFinding]:
                     "utils.watchdog.maybe_arm_for_tpu (or run the "
                     "utils.preflight gate) BEFORE the first backend "
                     "touch"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED013 — hardcoded wall-clock budgets outside the scheduler's task
+# registry (sched/tasks.py). Four rounds died replaying a static,
+# hand-budgeted step prefix (ISSUE 5): the window plan is the
+# scheduler's job now (value/expected-second knapsack against learned
+# priors, docs/SCHEDULER.md), and a literal budget constant anywhere
+# else is a second, drifting copy of the plan. The shell half (step
+# orderings / step budgets in scripts/*.sh) lives in lint/shell.py;
+# the static fallback path in chip_session.sh carries reason-waivers.
+# --------------------------------------------------------------------------
+
+_BUDGET_KEYWORDS = {"budget", "budget_s", "budget_seconds"}
+
+
+def _numeric_literal(node: ast.AST) -> bool:
+    """A compile-time numeric expression: 300, 3.5, -2, 10 * 60."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool)
+    if isinstance(node, ast.UnaryOp):
+        return _numeric_literal(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _numeric_literal(node.left) and _numeric_literal(node.right)
+    return False
+
+
+def _red013(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    if _suffix_match(rel, SCHED_WHITELIST):
+        return []
+    out = []
+    msg = ("hardcoded wall-clock budget outside the scheduler registry "
+           "(sched/tasks.py) — static budgets replay the same dead "
+           "prefix every window; route the plan through "
+           "python -m tpu_reductions.sched (docs/SCHEDULER.md)")
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            value = node.value
+            if value is None or not _numeric_literal(value):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and "budget" in t.id.lower():
+                    out.append(RawFinding("RED013", node.lineno, msg))
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg and kw.arg.lower() in _BUDGET_KEYWORDS \
+                        and _numeric_literal(kw.value):
+                    out.append(RawFinding("RED013", node.lineno, msg))
     return out
 
 

@@ -1,10 +1,18 @@
-"""redlint shell sub-pass — RED008 over session scripts.
+"""redlint shell sub-pass — RED008 + RED013 over session scripts.
 
-A SIGKILLed process with in-flight device work can wedge the remote
-chip machine-wide (CLAUDE.md; scripts/chip_session.sh:77): session
+RED008: a SIGKILLed process with in-flight device work can wedge the
+remote chip machine-wide (CLAUDE.md; scripts/chip_session.sh): session
 scripts must reap INT-first with a drain wait and may escalate past
-SIGTERM only behind an explicit waiver. Line-based, not AST — shell
-quoting is undecidable anyway, and every hit deserves human eyes.
+SIGTERM only behind an explicit waiver.
+
+RED013 (shell half; python half in lint/rules.py): hardcoded step
+budgets / measurement timeouts outside the scheduler's task registry
+(sched/tasks.py) — the static, hand-ordered step list is what cost
+four rounds their windows (ISSUE 5); chip_session.sh's no-scheduler
+fallback path carries the sanctioned reason-waivers.
+
+Line-based, not AST — shell quoting is undecidable anyway, and every
+hit deserves human eyes.
 """
 
 from __future__ import annotations
@@ -25,10 +33,19 @@ _SIGKILL_RE = re.compile(
     r"[^#\n]*\bSIGKILL\b"
     r"))")
 
+# a step invocation with a LITERAL budget ("step 'name' 300 ..."):
+# the hardcoded step-ordering/budget pattern the scheduler replaces —
+# a variable budget (step "$NAME" "$BUDGET") is the sanctioned loop
+_STEP_BUDGET_RE = re.compile(r"^\s*step\s+[\"'][^\"']+[\"']\s+[0-9]+\b")
+# a literal timeout wrapped around a measurement entry point
+_TIMEOUT_BENCH_RE = re.compile(
+    r"\btimeout\b[^#\n]*\s[0-9]+\s[^#\n]*python\s+-m\s+"
+    r"tpu_reductions\.bench\b")
+
 
 def check_shell(rel_posix: str, source: str) -> List[RawFinding]:
-    """RED008: flag KILL-signal sends in shell scripts. Comment-only
-    lines are skipped (prose about SIGKILL is doctrine, not a send)."""
+    """RED008 + RED013 over one shell script (module docstring).
+    Comment-only lines are skipped (prose is doctrine, not code)."""
     out: List[RawFinding] = []
     for i, line in enumerate(source.splitlines(), start=1):
         code = line.split("#", 1)[0]  # strip trailing comment prose
@@ -41,4 +58,12 @@ def check_shell(rel_posix: str, source: str) -> List[RawFinding]:
                 "mid-device-queue can wedge the remote chip; reap "
                 "INT-first with a drain wait "
                 "(scripts/supervise_watcher.sh discipline)"))
+        if _STEP_BUDGET_RE.search(code) or _TIMEOUT_BENCH_RE.search(code):
+            out.append(RawFinding(
+                "RED013", i,
+                "hardcoded wall-clock budget / step ordering in a "
+                "session script — the window plan belongs to the "
+                "scheduler registry (sched/tasks.py; python -m "
+                "tpu_reductions.sched); waive only on the sanctioned "
+                "no-scheduler fallback path (docs/SCHEDULER.md)"))
     return out

@@ -169,12 +169,57 @@ def analyze_session(sess: dict) -> dict:
     }
 
 
+def sched_summary(events: List[dict]) -> Optional[dict]:
+    """Plan-vs-actual attribution from the scheduler's typed events
+    (sched.plan/pick/skip/done/replan — lint/grammar.py SCHED_EVENTS;
+    tpu_reductions/sched/). One record per task in first-pick order:
+    planned vs actual seconds and the settled status, plus the replan
+    count — the committed answer to 'what did the planner promise and
+    what did the window deliver'. None when no scheduler ran."""
+    tasks: dict = {}
+    order: List[str] = []
+    replans = 0
+    for e in events:
+        ev = e["ev"]
+        if ev not in ("sched.pick", "sched.done", "sched.skip",
+                      "sched.replan", "sched.plan"):
+            continue
+        if ev == "sched.replan":
+            replans += 1
+            continue
+        if ev == "sched.plan":
+            continue
+        name = e.get("task")
+        if not isinstance(name, str):
+            continue
+        if name not in tasks:
+            tasks[name] = {"task": name, "planned_s": None,
+                           "actual_s": None, "status": None}
+            order.append(name)
+        rec = tasks[name]
+        if ev == "sched.pick":
+            rec["planned_s"] = e.get("est_s")
+            rec["status"] = rec["status"] or "picked"
+        elif ev == "sched.done":
+            rec["actual_s"] = e.get("actual_s")
+            rec["status"] = e.get("status") or "done"
+        elif ev == "sched.skip":
+            rec["status"] = "skipped"
+            rec["reason"] = e.get("reason")
+    if not tasks:
+        return None
+    return {"tasks": [tasks[n] for n in order], "replans": replans}
+
+
 def summarize(path, events: List[dict], torn: int) -> dict:
     """The machine-readable summary JSON (bench/regen collates it into
     report.md; chip_session.sh persists it as obs_timeline.json)."""
     sessions = [analyze_session(s) for s in split_sessions(events)]
     out = {"ledger": str(path), "events": len(events),
            "torn_lines": torn, "sessions": sessions}
+    sched = sched_summary(events)
+    if sched is not None:
+        out["sched"] = sched
     if events:
         t0, t1 = events[0]["t"], events[-1]["t"]
         wall = max(t1 - t0, 0.0)
@@ -259,6 +304,29 @@ def summary_markdown(summary: dict) -> str:
             f"stalled {u['stalled']:.0%}, host {u['host']:.0%}"
             + (f"; {summary['torn_lines']} torn line(s)"
                if summary.get("torn_lines") else ""))
+    sched = summary.get("sched")
+    if sched:
+        # the scheduler's plan-vs-actual record (ISSUE 5 satellite):
+        # per task, what the planner promised vs what the window
+        # delivered — skipped tasks carry their reason
+        lines.append("")
+        lines.append("### plan vs actual (scheduler)")
+        lines.append("")
+        lines.append("| task | planned s | actual s | status |")
+        lines.append("|---|---|---|---|")
+        for rec in sched["tasks"]:
+            status = rec.get("status") or "?"
+            if status == "skipped" and rec.get("reason"):
+                status = f"skipped ({rec['reason']})"
+            planned = rec.get("planned_s")
+            actual = rec.get("actual_s")
+            lines.append(
+                f"| {rec['task']} "
+                f"| {planned if planned is not None else '-'} "
+                f"| {actual if actual is not None else '-'} "
+                f"| {status} |")
+        lines.append("")
+        lines.append(f"{sched['replans']} replan(s)")
     return "\n".join(lines)
 
 

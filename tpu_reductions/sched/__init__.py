@@ -1,0 +1,46 @@
+"""sched — value-per-second planning for flappy chip windows.
+
+Four rounds of live evidence died to the same structural fact: the
+session plan was a FIXED, hand-ordered step list with static budgets
+(scripts/chip_session.sh), while the resource it spends — the tunnel
+relay's live window — lasts minutes and dies without warning
+(CLAUDE.md; round 4's flap was ~6 min). A window that opens mid-list
+replayed the same prefix every time; a flap mid-step wasted whatever
+the static ordering put first. The reference faced the same scarce-
+allocation problem — a sweep harness extracting a full bandwidth
+surface from rationed Blue Gene/L cluster slots (SURVEY.md §0.3, the
+mpi/submit_all.sh SLURM scripts) — and answered it with a harness, not
+a hand list; "memory-efficient array redistribution" (PAPERS.md, Zhang
+et al. 2021) makes the same move explicit: plan data movement against
+a cost model exactly when the resource is the bottleneck.
+
+This package converts the last three PRs' death-proofing (resume,
+watchdog, heartbeat, preflight, flight recorder) into evidence-per-
+minute:
+
+  * `sched.tasks`    — the registry of measurement units (firstrow,
+    scoreboards, races, smoke, ladder, flagship/hazard cells), each
+    with a value score, a completion predicate over the existing
+    bench/resume artifacts, a hazard flag and a static budget. The ONE
+    sanctioned home of wall-clock budgets and step orderings (redlint
+    RED013).
+  * `sched.priors`   — duration priors learned from committed flight-
+    recorder ledgers (step/sched events) + a window-length quantile
+    model from recorded flap history, updated online as tasks finish.
+  * `sched.planner`  — the greedy value/expected-second knapsack
+    against the remaining-window estimate.
+  * `sched.state`    — the crash-safe plan state (utils/jsonio atomic
+    persists under a Checkpoint-style meta contract): an exit-3/exit-4
+    re-invocation resumes the PLAN, not the script.
+  * `sched.executor` — plan-and-execute loop; each task runs as a
+    subprocess under the existing heartbeat/watchdog/preflight
+    machinery, re-planned after every task.
+
+CLI: `python -m tpu_reductions.sched` (docs/SCHEDULER.md).
+scripts/chip_session.sh drives its step sequence through `--next` /
+`--record` so its relay gate, per-step commits and exit trap stay in
+charge of the shell side.
+
+EVERY module in this package is jax-free by construction: planning
+must keep working — and stay instant — while the relay is dead.
+"""

@@ -1,0 +1,198 @@
+"""Plan-and-execute loop: spend the window, persist every decision.
+
+Each picked task runs as a SUBPROCESS (`bash -c <task.command>`) so the
+existing protection stack applies unchanged: the task's own entry point
+arms the flight recorder + watchdog (`maybe_arm_for_tpu` — socket gate,
+preflight wedge gate, heartbeat hang trigger) and persists its rows per
+the bench/resume discipline. The executor itself NEVER imports jax: a
+dead relay can hang the axon plugin, and the planner must keep working
+exactly then.
+
+Budget enforcement mirrors scripts/chip_session.sh's
+`timeout --signal=INT --kill-after=120`: SIGINT first to the task's
+process group (python raises KeyboardInterrupt; per-row persistence and
+the drivers' queue drains run — killing mid-device-queue can wedge the
+chip, CLAUDE.md), escalating to SIGTERM and only then a hard kill after
+the grace (TPU_REDUCTIONS_SCHED_KILL_GRACE_S compresses it for tests).
+
+Window-death contract: a task exiting 3 (dead relay) or 4 (hang — both
+from utils/watchdog.py) ends the window: the plan state persists the
+abort and the executor exits with the SAME code, so the watcher layer
+(scripts/await_window.sh) re-arms exactly as it does for a died
+session — and the next invocation RESUMES the plan (sched/state.py).
+Between tasks the executor re-probes the relay (pure sockets,
+utils/watchdog.relay_alive) the way chip_session's per-step gate does.
+
+Every decision is a typed ledger event — `sched.plan`, `sched.pick`,
+`sched.skip`, `sched.done`, `sched.replan` (registered in
+lint/grammar.py, attributed by obs/timeline.py) — so every window
+commits a plan-vs-actual record.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+from tpu_reductions.faults.inject import fault_point
+from tpu_reductions.obs import ledger
+from tpu_reductions.sched import planner
+from tpu_reductions.sched.priors import Priors
+from tpu_reductions.sched.state import PlanState
+from tpu_reductions.sched.tasks import Task
+from tpu_reductions.utils.watchdog import (HANG_EXIT_CODE,
+                                           WATCHDOG_EXIT_CODE,
+                                           relay_alive,
+                                           tunneled_environment)
+
+WINDOW_DEATH_CODES = (WATCHDOG_EXIT_CODE, HANG_EXIT_CODE)
+PLAN_COMPLETE_RC = 0
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+def _log(msg: str) -> None:
+    print(f"sched: {msg}", file=sys.stderr, flush=True)
+
+
+def run_task(task: Task, budget_s: Optional[float] = None,
+             env: Optional[dict] = None) -> int:
+    """One task subprocess under the INT-first budget discipline
+    (module docstring); returns its exit code (124 = budget cut, the
+    `timeout` convention chip_session's step() already maps)."""
+    budget = float(budget_s if budget_s is not None else task.budget_s)
+    grace = _env_float("TPU_REDUCTIONS_SCHED_KILL_GRACE_S", 120.0)
+    proc = subprocess.Popen(["bash", "-c", task.command],
+                            env=env, start_new_session=True)
+    try:
+        return proc.wait(timeout=budget)
+    except subprocess.TimeoutExpired:
+        pass
+    _log(f"task {task.name} hit its {budget:.0f}s budget: SIGINT "
+         "(drain-first discipline)")
+    for sig, wait_s in ((signal.SIGINT, grace),
+                        (signal.SIGTERM, grace / 4 + 1)):
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            break
+        try:
+            proc.wait(timeout=wait_s)
+            break
+        except subprocess.TimeoutExpired:
+            continue
+    if proc.poll() is None:
+        # the backstop for a process too wedged to honor the interrupt
+        # (chip_session's --kill-after analog); nothing on the chip can
+        # still be in flight through a relay this dead
+        proc.kill()
+        proc.wait()
+    return 124
+
+
+def _status_for(rc: int) -> str:
+    if rc == 0:
+        return "done"
+    if rc in WINDOW_DEATH_CODES:
+        return "aborted"
+    if rc == 124:
+        return "budget-cut"
+    return "failed"
+
+
+def record_skips(p: planner.Plan, state: PlanState) -> None:
+    """Persist + emit the artifact-skips a planning pass discovered
+    (planning is pure; recording happens here, once per skip)."""
+    for name, reason in p.skips:
+        ledger.emit("sched.skip", task=name, reason=reason)
+        state.record_skip(name, reason)
+
+
+def emit_plan(p: planner.Plan, replan: bool) -> None:
+    ledger.emit("sched.replan" if replan else "sched.plan",
+                tasks=[e.task.name for e in p.entries],
+                est_s=[round(e.est_s, 1) for e in p.entries],
+                remaining_s=round(p.remaining_s, 1))
+
+
+def run_plan(tasks: Sequence[Task], state: PlanState, priors: Priors,
+             excluded: Sequence[Task] = (),
+             env: Optional[dict] = None,
+             _run=run_task) -> int:
+    """The loop: reconcile -> plan -> pick -> run -> record -> replan,
+    until the plan runs dry (finalize, exit 0) or the window dies
+    (exit 3/4, plan state resumable). `_run` is injectable for
+    tests."""
+    for t in excluded:
+        if not state.attempted(t.name):
+            ledger.emit("sched.skip", task=t.name, reason="chip-only")
+            state.record_skip(t.name, "chip-only")
+    reconciled = state.reconcile(tasks)
+    for name in reconciled:
+        _log(f"task {name} reconciled: its artifact completed before "
+             "the last death; not re-measured")
+    env = dict(env if env is not None else os.environ)
+    # the window epoch doubles as FIRSTROW_T0 for task commands that
+    # reference it (headline_bench's doubles-suppression mtime check)
+    env.setdefault("FIRSTROW_T0", f"{state.window_t0:.2f}")
+    replan = False
+    while True:
+        p = planner.plan(tasks, state, priors)
+        record_skips(p, state)
+        emit_plan(p, replan)
+        replan = True
+        entry = p.next_entry
+        if entry is None:
+            state.finalize()
+            _log("plan complete: every task settled or skipped")
+            return PLAN_COMPLETE_RC
+        if tunneled_environment() and not relay_alive():
+            # chip_session's between-steps gate, executor edition: the
+            # relay died between tasks — stop with the plan resumable
+            _log("relay dead between tasks; plan state persisted for "
+                 "the next window")
+            ledger.emit("sched.done", task=entry.task.name,
+                        status="not-started", reason="relay-dead")
+            return WATCHDOG_EXIT_CODE
+        # chaos seam (faults/inject.py): the `sched.task` point fires
+        # between pick and launch — a scripted raise/stall/exit here is
+        # the deterministic spelling of "the executor died mid-plan"
+        fault_point("sched.task")
+        ledger.emit("sched.pick", task=entry.task.name,
+                    est_s=round(entry.est_s, 1),
+                    value=entry.task.value,
+                    fits=entry.fits)
+        state.record_pick(entry.task, entry.est_s)
+        if not entry.fits:
+            _log(f"pick {entry.task.name} does not fit the remaining-"
+                 f"window estimate ({p.remaining_s:.0f}s) — running "
+                 "anyway: the relay answering is a fact, the estimate "
+                 "is a model")
+        t0 = time.monotonic()
+        rc = _run(entry.task, env=env)
+        actual = time.monotonic() - t0
+        status = _status_for(rc)
+        if rc not in WINDOW_DEATH_CODES:
+            # an aborted task's duration is the WINDOW's length, not
+            # the task's — feeding it to the priors would teach the
+            # planner that dying is fast
+            priors.observe(entry.task.name, actual)
+        state.record_done(entry.task.name, rc, actual, status)
+        ledger.emit("sched.done", task=entry.task.name, rc=rc,
+                    actual_s=round(actual, 3),
+                    planned_s=round(entry.est_s, 1), status=status)
+        _log(f"task {entry.task.name}: {status} rc={rc} "
+             f"({actual:.1f}s vs {entry.est_s:.1f}s planned)")
+        if rc in WINDOW_DEATH_CODES:
+            _log(f"window death (rc={rc}); plan state persisted — "
+                 "re-invocation resumes the remaining tasks")
+            return rc

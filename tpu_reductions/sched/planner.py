@@ -1,0 +1,125 @@
+"""Greedy value/expected-second knapsack against the remaining window.
+
+The planning rule, in value order (module sched docstring has the why):
+
+  1. a task already settled this window (state), or whose completion
+     artifact is fresh-complete (tasks.artifact_complete), leaves the
+     plan — re-measuring costs live minutes and buys nothing;
+  2. `requires` gates eligibility on the prerequisite having been
+     ATTEMPTED this window (smoke vets lowering surfaces before the
+     races that depend on them — a FAILED smoke still vetted);
+  3. hazard tasks (4 GiB staging cells, the relay's proven killer) are
+     eligible only once every non-hazard task is settled or planned —
+     "hazard cells stay last" is an invariant, not a weight;
+  4. everything else orders by value / expected-duration (sched/
+     priors.py), the greedy knapsack: each entry is marked `fits`
+     against the cumulative remaining-window estimate, but the TOP
+     pick is always runnable — a pessimistic window prior must never
+     idle an alive window (the estimate is a model; the relay
+     answering right now is a fact).
+
+Replanning is just calling plan() again: it is a pure function of
+(registry, state, priors, now).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from tpu_reductions.sched.priors import Priors
+from tpu_reductions.sched.state import PlanState
+from tpu_reductions.sched.tasks import Task, artifact_complete
+
+
+@dataclass(frozen=True)
+class PlanEntry:
+    """One planned pick: the task plus the estimates that ranked it."""
+    task: Task
+    est_s: float
+    ratio: float          # value / est_s — the greedy key
+    fits: bool            # inside the cumulative remaining estimate
+    cumulative_s: float
+
+
+@dataclass(frozen=True)
+class Plan:
+    """The ordered plan + the artifact-skips discovered while planning
+    (the caller records them: planning is pure, recording is not)."""
+    entries: List[PlanEntry]
+    remaining_s: float
+    skips: List[tuple]    # (task_name, reason)
+
+    @property
+    def next_entry(self) -> Optional[PlanEntry]:
+        return self.entries[0] if self.entries else None
+
+
+def plan(tasks: Sequence[Task], state: PlanState, priors: Priors,
+         now: Optional[float] = None) -> Plan:
+    """Build the current plan (module docstring has the rules)."""
+    now = time.time() if now is None else now
+    remaining = priors.remaining_s(state.window_t0, now)
+    skips: List[tuple] = []
+    open_tasks: List[Task] = []
+    for t in tasks:
+        if state.settled(t.name):
+            continue
+        if t.done_artifact and artifact_complete(t.done_artifact,
+                                                 state.window_t0):
+            skips.append((t.name, "artifact-complete"))
+            continue
+        open_tasks.append(t)
+    attempted_or_skipped = {t.name for t in tasks
+                            if state.attempted(t.name)}
+    attempted_or_skipped.update(name for name, _ in skips)
+    in_registry = {t.name for t in tasks}
+
+    def eligible(t: Task) -> bool:
+        # a prerequisite absent from the active registry (--only
+        # filter, rehearsal profile) can never be attempted — it must
+        # not deadlock the tasks behind it
+        return all(r in attempted_or_skipped or r not in in_registry
+                   for r in t.requires)
+
+    normal = [t for t in open_tasks if not t.hazard and eligible(t)]
+    # requires-blocked tasks still belong in the printed plan (after
+    # their prerequisites); order the pools separately then concatenate
+    blocked = [t for t in open_tasks if not t.hazard and not eligible(t)]
+    hazard = [t for t in open_tasks if t.hazard]
+
+    def ranked(pool: Sequence[Task]) -> List[Task]:
+        return sorted(pool, key=lambda t: (-t.value / max(
+            priors.estimate(t), 1e-9), -t.value, t.name))
+
+    ordered = ranked(normal) + ranked(blocked) + ranked(hazard)
+    entries: List[PlanEntry] = []
+    cum = 0.0
+    for t in ordered:
+        est = priors.estimate(t)
+        cum += est
+        entries.append(PlanEntry(task=t, est_s=est,
+                                 ratio=t.value / max(est, 1e-9),
+                                 fits=cum <= remaining,
+                                 cumulative_s=cum))
+    return Plan(entries=entries, remaining_s=remaining, skips=skips)
+
+
+def render_table(p: Plan) -> str:
+    """The --plan-only table: stable for a given (registry, priors,
+    state) — the acceptance contract prints it twice and diffs."""
+    lines = [f"{'#':>2} {'task':<18} {'value':>7} {'est s':>8} "
+             f"{'val/s':>8} {'cum s':>8} fits"]
+    for i, e in enumerate(p.entries):
+        flag = "yes" if e.fits else "no"
+        if e.task.hazard:
+            flag += " [hazard:last]"
+        lines.append(f"{i:>2} {e.task.name:<18} {e.task.value:>7.0f} "
+                     f"{e.est_s:>8.1f} {e.ratio:>8.3f} "
+                     f"{e.cumulative_s:>8.1f} {flag}")
+    for name, reason in p.skips:
+        lines.append(f"   {name:<18} -- skipped: {reason}")
+    lines.append(f"remaining-window estimate: {p.remaining_s:.1f} s "
+                 f"({len(p.entries)} task(s) planned)")
+    return "\n".join(lines)

@@ -1,0 +1,167 @@
+"""Duration priors + window-length model, learned from ledger history.
+
+The static step budgets of scripts/chip_session.sh encode what a step
+is ALLOWED to take; planning needs what it WILL take. Both answers are
+already on disk: every window since PR 4 commits a flight-recorder
+ledger (obs/ledger.py) whose `step.start`/`step.end` pairs time each
+step and whose `sched.done` events (this PR) time each planned task,
+and the ledger's own event-time clusters record how long the relay's
+live windows actually lasted (round 4: ~6 min — CLAUDE.md). This
+module turns that history into:
+
+  * `estimate(task)` — median observed duration for the task (keyed by
+    slug, falling back to the chip_session step title the pre-scheduler
+    ledgers used), else the registry's static budget_s — the cold-start
+    fallback the ISSUE requires. Durations observed THIS window
+    (`observe`, fed by the executor as tasks finish) take precedence:
+    the online update.
+  * `window_quantile(q)` / `remaining_s(window_t0)` — a quantile model
+    over recorded window lengths (event clusters split at
+    WINDOW_GAP_S); with no history the prior is the observed round-4
+    flap (DEFAULT_WINDOW_S). remaining_s never goes below zero — the
+    planner treats an outlived estimate as "every further second is a
+    bonus" and keeps picking by ratio (sched/planner.py).
+
+Purely offline: reads JSONL files, touches no device, imports no jax.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from tpu_reductions.obs.timeline import read_ledger
+from tpu_reductions.sched.tasks import Task
+
+# the one observed full flap length (round 4, 2026-07-31: relay up
+# 03:43Z, dead ~03:49Z) — the cold-start window prior
+DEFAULT_WINDOW_S = 360.0
+# event-time gap that splits ledger history into distinct windows: the
+# watcher polls every ~20 s while idle, so anything past 30 min of
+# silence is a new window, not a slow step
+WINDOW_GAP_S = 1800.0
+
+
+def scan_history(paths: Iterable[str]) -> dict:
+    """Parse ledger files into {'durations': {name: [s, ...]},
+    'windows': [s, ...]}. Unreadable/empty files are skipped — history
+    is an optimization, never a failure."""
+    durations: Dict[str, List[float]] = {}
+    windows: List[float] = []
+    for path in paths:
+        if not path or not os.path.exists(path):
+            continue
+        try:
+            events, _torn = read_ledger(path)
+        except OSError:
+            continue
+        if not events:
+            continue
+        _scan_durations(events, durations)
+        windows.extend(_cluster_windows(events))
+    return {"durations": durations, "windows": windows}
+
+
+def _scan_durations(events: Sequence[dict],
+                    durations: Dict[str, List[float]]) -> None:
+    """step.start/step.end pairs (pre-scheduler sessions, keyed by the
+    step title) and sched.done events (which carry their own actual_s)
+    both feed the same sample pool."""
+    pending: Dict[str, float] = {}
+    for e in events:
+        ev = e.get("ev")
+        if ev == "step.start" and isinstance(e.get("name"), str):
+            pending[e["name"]] = e["t"]
+        elif ev == "step.end" and isinstance(e.get("name"), str):
+            t0 = pending.pop(e["name"], None)
+            if t0 is not None and e["t"] > t0:
+                durations.setdefault(e["name"], []).append(e["t"] - t0)
+        elif ev == "sched.done" and isinstance(e.get("task"), str):
+            a = e.get("actual_s")
+            if isinstance(a, (int, float)) and a > 0:
+                durations.setdefault(e["task"], []).append(float(a))
+
+
+def _cluster_windows(events: Sequence[dict]) -> List[float]:
+    """Window lengths from event-time clusters: consecutive events more
+    than WINDOW_GAP_S apart start a new window. Zero-length clusters
+    (a lone probe event) are dropped — they are watcher heartbeats,
+    not windows."""
+    out: List[float] = []
+    start = prev = events[0]["t"]
+    for e in events[1:]:
+        if e["t"] - prev > WINDOW_GAP_S:
+            if prev > start:
+                out.append(prev - start)
+            start = e["t"]
+        prev = e["t"]
+    if prev > start:
+        out.append(prev - start)
+    return out
+
+
+def _median(vals: Sequence[float]) -> float:
+    s = sorted(vals)
+    mid = len(s) // 2
+    return s[mid] if len(s) % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def _quantile(vals: Sequence[float], q: float) -> float:
+    s = sorted(vals)
+    if not s:
+        raise ValueError("quantile of empty history")
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+class Priors:
+    """The planner's cost model: per-task duration estimates + the
+    remaining-window estimate, updated online as tasks finish."""
+
+    def __init__(self, history: Optional[dict] = None) -> None:
+        history = history or {"durations": {}, "windows": []}
+        self._durations: Dict[str, List[float]] = {
+            k: list(v) for k, v in history.get("durations", {}).items()}
+        self._windows: List[float] = list(history.get("windows", []))
+        self._online: Dict[str, float] = {}
+
+    @classmethod
+    def from_ledgers(cls, paths: Iterable[str]) -> "Priors":
+        """Build from committed ledger histories (CLI default:
+        obs_ledger.jsonl in the cwd; --history adds more)."""
+        return cls(scan_history(paths))
+
+    def observe(self, name: str, seconds: float) -> None:
+        """Online update: a task finished this window — its actual
+        duration becomes the sharpest estimate for a re-pick (retries
+        after a budget cut) and joins the sample pool for any ledger
+        scan a LATER window performs."""
+        if seconds > 0:
+            self._online[name] = seconds
+            self._durations.setdefault(name, []).append(seconds)
+
+    def estimate(self, task: Task) -> float:
+        """Expected duration: this window's observation, else the
+        history median (slug first, then the chip_session step title
+        the pre-scheduler ledgers keyed on), else the static budget."""
+        if task.name in self._online:
+            return self._online[task.name]
+        for key in (task.name, task.title):
+            samples = self._durations.get(key)
+            if samples:
+                return _median(samples)
+        return float(task.budget_s)
+
+    def window_quantile(self, q: float = 0.5) -> float:
+        """The window-length model: quantile of recorded flap history,
+        DEFAULT_WINDOW_S when no history exists."""
+        if not self._windows:
+            return DEFAULT_WINDOW_S
+        return _quantile(self._windows, q)
+
+    def remaining_s(self, window_t0: float, now: float,
+                    q: float = 0.5) -> float:
+        """Expected seconds left in THIS window (never negative: an
+        outlived window keeps planning — every further second is a
+        bonus, see module docstring)."""
+        return max(0.0, self.window_quantile(q) - max(0.0, now - window_t0))

@@ -3,16 +3,35 @@
 TPU-idiomatic equivalents of the reference's vendored support libraries
 (SURVEY.md §2.3): cutil timers, shrUtils logging, shrQATest harness, and the
 MPI side's rdtsc + MT19937 header.
+
+Re-exports resolve LAZILY (PEP 562): `utils.timing` imports jax at
+module scope, and the light consumers — the scheduler CLI
+(tpu_reductions/sched/, one process per plan step in a live window),
+the lint pass, the watchdog's socket probes — must not pay a
+multi-second jax import just to reach jsonio/heartbeat/relay_env,
+which are deliberately stdlib-only.
 """
 
-from tpu_reductions.utils.qa import QAStatus, qa_start, qa_finish, qa_exit
-from tpu_reductions.utils.timing import Stopwatch, TimerRegistry, time_fn
-from tpu_reductions.utils.logging import BenchLogger, throughput_line, collective_row
-from tpu_reductions.utils.rng import host_data, rank_seed_key
+_EXPORTS = {
+    "QAStatus": "tpu_reductions.utils.qa",
+    "qa_start": "tpu_reductions.utils.qa",
+    "qa_finish": "tpu_reductions.utils.qa",
+    "qa_exit": "tpu_reductions.utils.qa",
+    "Stopwatch": "tpu_reductions.utils.timing",
+    "TimerRegistry": "tpu_reductions.utils.timing",
+    "time_fn": "tpu_reductions.utils.timing",
+    "BenchLogger": "tpu_reductions.utils.logging",
+    "throughput_line": "tpu_reductions.utils.logging",
+    "collective_row": "tpu_reductions.utils.logging",
+    "host_data": "tpu_reductions.utils.rng",
+    "rank_seed_key": "tpu_reductions.utils.rng",
+}
 
-__all__ = [
-    "QAStatus", "qa_start", "qa_finish", "qa_exit",
-    "Stopwatch", "TimerRegistry", "time_fn",
-    "BenchLogger", "throughput_line", "collective_row",
-    "host_data", "rank_seed_key",
-]
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,60 @@
+"""Canonical relay endpoint defaults — ONE source for every prober.
+
+Three independent probes watch the same tunnel relay: the in-process
+watchdog (utils/watchdog.py), the python-free inline gates of
+scripts/chip_session.sh and scripts/await_window.sh, and the hang-proof
+preflight (utils/preflight.py via the watchdog's resolvers). Until this
+module existed the shell gates hardcoded their own "8082,8083" copy of
+the watchdog's RELAY_PORTS — two spellings of one fact, free to drift
+(ISSUE 5 satellite). Now the default lives HERE and nowhere else:
+
+  * python consumers import `DEFAULT_RELAY_PORTS` /
+    `DEFAULT_RELAY_MARKER` normally (utils/watchdog.py re-exports them
+    as its RELAY_PORTS/RELAY_MARKER for compatibility);
+  * the shell gates, which must stay genuinely JAX-free (a dead relay
+    hangs the axon plugin the package's heavy imports would load),
+    exec THIS FILE by path under `python -S` — stdlib-only, no package
+    `__init__` — and read the same constants (see
+    scripts/chip_session.sh `relay_ok`).
+
+The env overrides (`TPU_REDUCTIONS_RELAY_PORTS`,
+`TPU_REDUCTIONS_RELAY_MARKER` — the chaos harness's seam,
+docs/RESILIENCE.md) still win everywhere; this module only owns the
+DEFAULT they fall back to.
+
+This file must stay stdlib-only and import nothing from the package:
+it is executed standalone by the shell gates.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence, Tuple
+
+# the axon tunnel relay's TCP ports (CLAUDE.md "Hard-won environment
+# facts": `python3 -u /root/.relay.py`, ports 8082..)
+DEFAULT_RELAY_PORTS: Tuple[int, ...] = (8082, 8083)
+# presence of the relay script marks the tunneled environment
+DEFAULT_RELAY_MARKER = "/root/.relay.py"
+
+
+def ports_str(ports: Sequence[int] = DEFAULT_RELAY_PORTS) -> str:
+    """The comma-separated spelling the TPU_REDUCTIONS_RELAY_PORTS env
+    override uses (one formatter so shell and python agree)."""
+    return ",".join(str(p) for p in ports)
+
+
+def env_ports() -> Tuple[int, ...]:
+    """Ports to probe: the TPU_REDUCTIONS_RELAY_PORTS env override when
+    set, else the canonical default."""
+    env = os.environ.get("TPU_REDUCTIONS_RELAY_PORTS")
+    if env:
+        return tuple(int(p) for p in env.split(",") if p.strip())
+    return DEFAULT_RELAY_PORTS
+
+
+def env_marker() -> str:
+    """Marker file: the TPU_REDUCTIONS_RELAY_MARKER env override when
+    set, else the canonical default."""
+    return os.environ.get("TPU_REDUCTIONS_RELAY_MARKER",
+                          DEFAULT_RELAY_MARKER)

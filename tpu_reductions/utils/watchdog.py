@@ -54,13 +54,18 @@ from tpu_reductions.utils import heartbeat
 from tpu_reductions.utils.heartbeat import HANG_EXIT_CODE  # noqa: F401
 #   (re-exported: consumers treat exit 3 = relay dead, exit 4 = hang
 #    with live ports as one watchdog vocabulary)
+from tpu_reductions.utils.relay_env import (DEFAULT_RELAY_MARKER,
+                                            DEFAULT_RELAY_PORTS)
 
-RELAY_PORTS = (8082, 8083)
+# canonical defaults live in utils/relay_env.py — the ONE source the
+# JAX-free shell gates (scripts/chip_session.sh, scripts/
+# await_window.sh) also exec by path, so the port lists cannot drift
+RELAY_PORTS = DEFAULT_RELAY_PORTS
 WATCHDOG_EXIT_CODE = 3
 # presence of the relay script marks the tunneled environment — the
 # only kind of TPU host where "no relay" means "no device"; a real
 # (pod/local) TPU host has no relay and must never be watchdogged
-RELAY_MARKER = "/root/.relay.py"
+RELAY_MARKER = DEFAULT_RELAY_MARKER
 
 
 def resolved_ports(ports: Optional[Sequence[int]] = None
