@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Refresh the committed serving curve (docs/SERVING.md) — off-chip by
+# construction, safe with the relay dead: the loadgen runs the engine
+# on --platform=cpu with the per-launch tunnel RTT modeled through a
+# local chaos relay in `slow` mode, then the curve is folded into the
+# flagship report next to the GB/s tables (bench/regen.py).
+#
+# Usage: bash scripts/run_serving_curve.sh [out.json] [experiment_dir]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="${1:-serving_curve.json}"
+exp="${2:-examples/tpu_run}"
+
+python -m tpu_reductions.serve.loadgen --platform=cpu --clients=8 \
+    --requests=32 --n=65536 --out="$out"
+
+if [ -d "$exp" ]; then
+    cp "$out" "$exp/serving_curve.json"
+    python -m tpu_reductions.bench.regen "$exp"
+fi

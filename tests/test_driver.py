@@ -262,3 +262,107 @@ def test_chained_rows_carry_slope_samples_for_spread(monkeypatch):
                          backend="pallas", threads=256, log_file=None)
     res_f = run_benchmark(cfg_f, logger=BenchLogger(None, None))
     assert res_f.slope_samples_s is None
+
+
+# ---------------------------------------------------------------------------
+# Bugfix sweep (ISSUE 6 satellite): crash_result() and the
+# _PendingResult.finalize() error path were only exercised implicitly
+# through batch/race flows — pin their contracts directly.
+# ---------------------------------------------------------------------------
+
+
+def test_crash_result_row_contract():
+    """crash_result: a raised config becomes a FAILED row that keeps
+    the batch alive — identity preserved, reason truncated, RFC-8259
+    serializable, and never mistaken for a measurement."""
+    import json
+
+    from tpu_reductions.bench.driver import crash_result
+
+    cfg = _cfg(method="MIN", dtype="float32", n=1 << 20, kernel=9,
+               timing="chained")
+    err = ValueError("Mosaic lowering gap: " + "x" * 400)
+    res = crash_result(cfg, err)
+    assert res.status == QAStatus.FAILED and not res.passed
+    assert (res.method, res.dtype, res.n, res.kernel) \
+        == ("MIN", "float32", 1 << 20, 9)
+    assert res.gbps == 0.0 and res.avg_s == 0.0 and res.iterations == 0
+    assert res.timing == "chained"
+    assert res.waived_reason.startswith("ValueError: Mosaic lowering")
+    assert len(res.waived_reason) == 200          # bounded reason
+    d = res.to_dict()
+    # nan oracle fields serialize as null — strict parsers must accept
+    assert d["device_result"] is None and d["oracle_result"] is None
+    json.loads(json.dumps(d))
+    assert d["status"] == "FAILED"
+
+
+def test_crash_result_logs_the_config_identity():
+    from tpu_reductions.bench.driver import crash_result
+
+    lines = []
+
+    class _Log:
+        def log(self, msg):
+            lines.append(msg)
+
+    cfg = _cfg(kernel=7, threads=384)
+    crash_result(cfg, RuntimeError("tunnel reset"), _Log())
+    assert any("kernel=7" in ln and "threads=384" in ln
+               and "tunnel reset" in ln for ln in lines)
+
+
+def test_batch_contains_finalize_error_to_one_config(monkeypatch):
+    """A _PendingResult whose finalize() raises (the materialization/
+    verification half dying — e.g. the relay resetting between the
+    timed loop and the fetch) must become a FAILED row via
+    crash_result, and must NOT take the rest of the batch with it."""
+    from tpu_reductions.bench import driver
+
+    real_run = driver.run_benchmark
+    boom_cfg_n = 2048
+
+    class _Boom(driver._PendingResult):
+        def finalize(self):
+            raise RuntimeError("relay reset during materialization")
+
+    def fake_run(cfg, logger=None, defer=False):
+        assert defer
+        if cfg.n == boom_cfg_n:
+            return _Boom(cfg, "pallas", 0.0, 0.0, None, None, logger)
+        return real_run(cfg, logger=logger, defer=defer)
+
+    monkeypatch.setattr(driver, "run_benchmark", fake_run)
+    cfgs = [_cfg(n=boom_cfg_n), _cfg(n=4096)]
+    seen = []
+    results = driver.run_benchmark_batch(
+        cfgs, logger=BenchLogger(None, None),
+        on_result=lambda cfg, res: seen.append((cfg.n, res.status)))
+    assert results[0].status == QAStatus.FAILED
+    assert "relay reset during materialization" in results[0].waived_reason
+    assert results[1].status == QAStatus.PASSED    # batch survived
+    # the on_result hook saw BOTH rows, crash row included — the seam
+    # sweep's per-cell persistence relies on
+    assert seen == [(2048, QAStatus.FAILED), (4096, QAStatus.PASSED)]
+
+
+def test_batch_contains_dispatch_error_to_one_config(monkeypatch):
+    """The dispatch half of the same containment: run_benchmark itself
+    raising inside the batch loop yields a crash row for that config
+    only (the per-call fail-fast of cutil scoped to the config)."""
+    from tpu_reductions.bench import driver
+
+    real_run = driver.run_benchmark
+
+    def fake_run(cfg, logger=None, defer=False):
+        if cfg.method == "MIN":
+            raise RuntimeError("compile exploded")
+        return real_run(cfg, logger=logger, defer=defer)
+
+    monkeypatch.setattr(driver, "run_benchmark", fake_run)
+    results = driver.run_benchmark_batch(
+        [_cfg(method="MIN"), _cfg(method="MAX")],
+        logger=BenchLogger(None, None))
+    assert results[0].status == QAStatus.FAILED
+    assert "compile exploded" in results[0].waived_reason
+    assert results[1].status == QAStatus.PASSED

@@ -98,6 +98,50 @@ def test_fake_relay_stall_is_wedged_but_ports_open():
                 s.recv(1)   # held open, never answered
 
 
+def test_fake_relay_slow_injects_per_connection_latency():
+    """The `slow` latency-injection mode (ISSUE 6): probes still say
+    alive, but a consumer that waits for service (recv to EOF — the
+    serving engine's transport gate) pays ~delay_s per round-trip."""
+    with FakeRelay([Phase("slow", delay_s=0.3)]) as relay:
+        assert probe_relay(ports=(relay.port,)) == "alive"
+        t0 = time.monotonic()
+        with socket.create_connection(("127.0.0.1", relay.port),
+                                      timeout=2) as s:
+            s.settimeout(5)
+            while s.recv(64):
+                pass                       # drains until the late close
+        held = time.monotonic() - t0
+        assert held >= 0.25, f"slow relay closed after only {held:.3f}s"
+
+
+def test_fake_relay_force_slow_with_explicit_delay():
+    with FakeRelay() as relay:
+        relay.force("slow", delay_s=0.2)
+        time.sleep(0.15)   # let the serve loop observe the new behavior
+        t0 = time.monotonic()
+        with socket.create_connection(("127.0.0.1", relay.port),
+                                      timeout=2) as s:
+            s.settimeout(5)
+            while s.recv(64):
+                pass
+        assert time.monotonic() - t0 >= 0.15
+
+
+def test_schedule_slow_delay_validation():
+    """delay_s is slow-only and must be positive; slow without it gets
+    the documented default hold."""
+    from tpu_reductions.faults.schedule import (DEFAULT_SLOW_DELAY_S,
+                                                load_schedule)
+    ph = load_schedule('[{"behavior": "slow", "delay_s": 0.5}]')[0]
+    assert ph.hold_s == 0.5
+    assert load_schedule('[{"behavior": "slow"}]')[0].hold_s \
+        == DEFAULT_SLOW_DELAY_S
+    with pytest.raises(ValueError):
+        load_schedule('[{"behavior": "accept", "delay_s": 0.5}]')
+    with pytest.raises(ValueError):
+        load_schedule('[{"behavior": "slow", "delay_s": 0}]')
+
+
 # ---------------------------------------------------------------- inject
 
 

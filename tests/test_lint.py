@@ -427,6 +427,41 @@ def test_red013_shell_waiver_marks_the_fallback_path(tmp_path):
     assert _rules(_lint_src(tmp_path, src, name="scripts/fixture.sh")) == []
 
 
+# ---------------------------------------------------------------- RED014
+
+
+def test_red014_flags_device_work_in_serve_outside_executor(tmp_path):
+    src = (
+        "import jax\n"
+        "from tpu_reductions.bench.driver import run_benchmark\n"
+        "def handle(cfg, x):\n"
+        "    run_benchmark(cfg)\n"
+        "    return jax.device_get(x)\n"
+    )
+    findings = _lint_src(tmp_path, src, name="serve/fixture.py")
+    assert _rules(findings).count("RED014") == 3
+    assert "serve/executor.py" in findings[0].message
+
+
+def test_red014_whitelists_executor_and_ignores_other_packages(tmp_path):
+    src = ("import jax\n"
+           "def run(x):\n"
+           "    return jax.device_get(x)\n")
+    # the executor module is THE sanctioned device boundary
+    assert "RED014" not in _rules(_lint_src(tmp_path, src,
+                                            name="serve/executor.py"))
+    # outside serve/ the rule is silent (RED003/RED011 own those trees)
+    assert "RED014" not in _rules(_lint_src(tmp_path, src,
+                                            name="utils/fixture.py"))
+    # jax-free serving code (the engine/batcher shape) is clean
+    clean = ("from tpu_reductions.sched.knapsack import greedy_plan\n"
+             "def plan(batches, budget):\n"
+             "    return greedy_plan([batches], value=len,\n"
+             "                       cost=len, budget_s=budget)\n")
+    assert _rules(_lint_src(tmp_path, clean, name="serve/engine2.py")) \
+        == []
+
+
 # ---------------------------------------------------------------- RED008
 
 
@@ -551,6 +586,9 @@ def test_cli_positive_fixture_per_rule_exits_nonzero(tmp_path):
         "RED012": ("utils/r12.py",
                    "print('{\"t\": 1, \"ev\": \"a.b\", \"pid\": 1}')\n"),
         "RED013": ("r13.py", "WINDOW_BUDGET_S = 300\n"),
+        "RED014": ("serve/r14.py", "import jax\n"
+                                   "def f(x):\n"
+                                   "    return jax.device_get(x)\n"),
     }
     for rule, (name, src) in fixtures.items():
         f = tmp_path / name

@@ -640,3 +640,66 @@ def test_sched_cli_is_jax_free(tmp_path):
                        env={**os.environ, "PYTHONPATH": str(REPO)},
                        capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stderr
+
+
+# ------------------------------------------------------------- knapsack
+# (sched/knapsack.py — the shared greedy core the planner AND the
+#  serving engine's batch scheduler import; the ISSUE 6 satellite)
+
+
+def test_knapsack_orders_by_ratio_then_value_then_tie():
+    from tpu_reductions.sched.knapsack import greedy_plan
+    items = [("a", 10.0, 10.0),    # ratio 1.0
+             ("b", 30.0, 10.0),    # ratio 3.0
+             ("c", 30.0, 10.0),    # ratio 3.0 — tie with b: name order
+             ("d", 5.0, 1.0)]      # ratio 5.0
+    ranked = greedy_plan([items],
+                         value=lambda it: it[1],
+                         cost=lambda it: it[2],
+                         budget_s=100.0,
+                         tie_key=lambda it: it[0])
+    assert [r.item[0] for r in ranked] == ["d", "b", "c", "a"]
+    assert ranked[0].ratio == pytest.approx(5.0)
+
+
+def test_knapsack_marks_fits_on_one_cumulative_line_across_pools():
+    from tpu_reductions.sched.knapsack import greedy_plan
+    pool1 = [("p1", 10.0, 5.0)]
+    pool2 = [("p2", 10.0, 5.0), ("p3", 1.0, 5.0)]
+    ranked = greedy_plan([pool1, pool2],
+                         value=lambda it: it[1],
+                         cost=lambda it: it[2],
+                         budget_s=11.0,
+                         tie_key=lambda it: it[0])
+    # pool order is preserved (the planner's tier contract) and the
+    # budget line is shared: 5 + 5 fit, the third does not
+    assert [r.item[0] for r in ranked] == ["p1", "p2", "p3"]
+    assert [r.fits for r in ranked] == [True, True, False]
+    assert ranked[-1].cumulative == pytest.approx(15.0)
+
+
+def test_knapsack_zero_cost_never_divides_by_zero():
+    from tpu_reductions.sched.knapsack import greedy_plan
+    ranked = greedy_plan([[("z", 5.0, 0.0)]],
+                         value=lambda it: it[1],
+                         cost=lambda it: it[2], budget_s=1.0)
+    assert ranked[0].fits and ranked[0].ratio > 0
+
+
+def test_planner_uses_shared_knapsack_semantics():
+    """The planner rewrite (ISSUE 6 satellite) must preserve PR 5's
+    ordering exactly: ratio-ranked normal pool, requires-blocked after,
+    hazard strictly last, one cumulative fits line."""
+    ts = [_task("cheap_valuable", value=100.0, budget=10.0),
+          _task("expensive", value=100.0, budget=1000.0),
+          _task("gated", value=500.0, budget=10.0,
+                requires=("expensive",)),
+          _task("bomb", value=900.0, budget=10.0, hazard=True)]
+    state = PlanState(None, {"registry": registry_hash(ts)}, now=1000.0)
+    plan = planner.plan(ts, state, Priors(), now=1000.0)
+    names = [e.task.name for e in plan.entries]
+    assert names == ["cheap_valuable", "expensive", "gated", "bomb"]
+    # shared budget line: cumulative is monotone across the tiers
+    cums = [e.cumulative_s for e in plan.entries]
+    assert cums == sorted(cums)
+    assert plan.entries[0].ratio == pytest.approx(10.0)

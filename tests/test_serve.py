@@ -1,0 +1,465 @@
+"""Serving engine unit/integration coverage (tpu_reductions/serve/):
+coalescing correctness, admission control, deadlines, drain, the
+shared knapsack round planner, per-request trace attribution, and the
+loadgen/server CLIs — all on the 8-device virtual CPU platform
+(tests/conftest.py)."""
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpu_reductions.obs import ledger
+from tpu_reductions.ops import oracle
+from tpu_reductions.serve.coalesce import (Batch, CostModel, coalesce,
+                                           plan_round)
+from tpu_reductions.serve.engine import ServeEngine
+from tpu_reductions.serve.request import (PendingResponse, ReduceRequest,
+                                          ReduceResponse)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class FakeExecutor:
+    """Deterministic device stand-in: resolves every request with the
+    payload's real oracle value so correctness checks stay honest
+    while no jax executes."""
+
+    def __init__(self, backend="cpu", supports_f64=True, delay_s=0.0,
+                 hold=None, fail_with=None):
+        self.backend = backend
+        self.supports_f64 = supports_f64
+        self.delay_s = delay_s
+        self.hold = hold          # threading.Event: block until set
+        self.fail_with = fail_with
+        self.launches = []
+
+    def capabilities(self):
+        return {"backend": self.backend,
+                "supports_f64": self.supports_f64}
+
+    def run_batch(self, method, dtype, n, seeds):
+        self.launches.append((method, dtype, n, tuple(seeds)))
+        if self.hold is not None:
+            assert self.hold.wait(timeout=30)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail_with is not None:
+            raise self.fail_with
+        out = []
+        from tpu_reductions.utils.rng import host_data
+        for s in seeds:
+            host = oracle.host_reduce(host_data(n, dtype, seed=s), method)
+            v = float(np.asarray(host, dtype=np.float64))
+            out.append({"result": v, "ok": True, "host": v, "diff": 0.0})
+        return out
+
+
+def _engine(**kw):
+    kw.setdefault("executor", FakeExecutor())
+    kw.setdefault("coalesce_window_s", 0.0)
+    return ServeEngine(**kw)
+
+
+def _expect(pending, status, timeout=30):
+    resp = pending.result(timeout=timeout)
+    assert resp.status == status, (resp.status, resp.error)
+    return resp
+
+
+def _payload(n, dtype, seed):
+    """The engine's own payload discipline (serve/executor.py): native
+    filler when the C extension is built, utils.rng fallback."""
+    from tpu_reductions.utils.rng import host_data
+    x = oracle.native_fill(n, dtype, rank=0, seed=seed)
+    return x if x is not None else host_data(n, dtype, seed=seed)
+
+
+def _oracle_value(method, n, dtype, seed):
+    return float(np.asarray(oracle.host_reduce(_payload(n, dtype, seed),
+                                               method),
+                            dtype=np.float64))
+
+
+# ------------------------------------------------------------- requests
+
+
+def test_request_validates_and_normalizes():
+    r = ReduceRequest(method="sum", dtype="int", n=16)
+    assert r.method == "SUM" and r.dtype == "int32"
+    assert r.nbytes == 64
+    with pytest.raises(ValueError):
+        ReduceRequest(method="AVG", dtype="int", n=16)
+    with pytest.raises(ValueError):
+        ReduceRequest(method="SUM", dtype="int", n=0)
+    with pytest.raises(ValueError):
+        ReduceRequest(method="SUM", dtype="int", n=16, deadline_s=0)
+
+
+def test_pending_response_times_out_loudly():
+    p = PendingResponse("r0")
+    with pytest.raises(TimeoutError):
+        p.result(timeout=0.01)
+    p.resolve(ReduceResponse("r0", "ok", "SUM", "int32", 4))
+    assert p.done() and p.result(0.1).ok
+
+
+# ----------------------------------------------------- coalesce + plan
+
+
+def test_coalesce_groups_by_key_and_splits_at_bounds():
+    class A:
+        def __init__(self, m, n=8):
+            self.request = ReduceRequest(method=m, dtype="int", n=n)
+
+    items = [A("SUM"), A("SUM"), A("MIN"), A("SUM"), A("MIN")]
+    batches = coalesce(items, max_batch=2, max_batch_bytes=1 << 20)
+    keys = [(b.key[0], b.size) for b in batches]
+    assert keys == [("SUM", 2), ("SUM", 1), ("MIN", 2)]
+    # byte bound splits too: each request is 32 B, cap at 40 B
+    batches = coalesce([A("SUM") for _ in range(3)], max_batch=8,
+                       max_batch_bytes=40)
+    assert [b.size for b in batches] == [1, 1, 1]
+
+
+def test_plan_round_top_pick_always_launches():
+    cm = CostModel(default_s=1.0)     # pessimistic: nothing "fits"
+
+    class A:
+        def __init__(self, v):
+            self.request = ReduceRequest(method="SUM", dtype="int", n=8,
+                                         value=v)
+
+    batches = [Batch(key=("SUM", "int32", 8), admitted=[A(1.0)]),
+               Batch(key=("SUM", "int32", 8), admitted=[A(5.0)])]
+    launch, defer = plan_round(batches, cost_model=cm,
+                               device_window_s=0.1)
+    assert len(launch) == 1 and len(defer) == 1
+    assert launch[0].value == 5.0     # highest ratio wins the slot
+    # observed durations sharpen the estimate: everything fits now
+    cm.observe(("SUM", "int32", 8), 0.01)
+    launch, defer = plan_round(batches, cost_model=cm,
+                               device_window_s=0.1)
+    assert len(launch) == 2 and not defer
+
+
+# --------------------------------------------------------------- engine
+
+
+def test_single_request_roundtrip_real_executor():
+    eng = ServeEngine(coalesce_window_s=0.0).start()
+    try:
+        resp = _expect(eng.submit(ReduceRequest(
+            method="SUM", dtype="int", n=4096, seed=7)), "ok")
+        assert resp.result == _oracle_value("SUM", 4096, "int32", 7)
+        assert resp.latency_s is not None and resp.batch_size == 1
+    finally:
+        eng.stop()
+
+
+def test_concurrent_compatible_requests_coalesce_into_one_launch():
+    ex = FakeExecutor()
+    eng = _engine(executor=ex)
+    pends = [eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                      n=1024, seed=i))
+             for i in range(6)]
+    eng.start()            # submissions queued pre-start: one gather
+    try:
+        for p in pends:
+            r = _expect(p, "ok")
+            assert r.batch_size == 6
+        assert len(ex.launches) == 1
+        assert ex.launches[0][:3] == ("SUM", "int32", 1024)
+        assert ex.launches[0][3] == tuple(range(6))
+    finally:
+        eng.stop()
+
+
+def test_mixed_traffic_batches_per_key_all_verified():
+    eng = ServeEngine(coalesce_window_s=0.0)
+    reqs = [("SUM", 0), ("MIN", 1), ("SUM", 2), ("MAX", 3), ("MIN", 4)]
+    pends = [(m, s, eng.submit(ReduceRequest(method=m, dtype="int",
+                                             n=2048, seed=s)))
+             for m, s in reqs]
+    eng.start()
+    try:
+        for m, s, p in pends:
+            r = _expect(p, "ok")
+            assert r.result == _oracle_value(m, 2048, "int32", s), (m, s)
+    finally:
+        eng.stop()
+
+
+def test_queue_full_rejects_with_explicit_response():
+    hold = threading.Event()
+    eng = _engine(executor=FakeExecutor(hold=hold), max_queue=2)
+    eng.start()
+    try:
+        first = eng.submit(ReduceRequest(method="SUM", dtype="int", n=8))
+        time.sleep(0.2)       # worker picks it up and blocks in-launch
+        queued = [eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                           n=8)) for _ in range(2)]
+        rej = eng.submit(ReduceRequest(method="SUM", dtype="int", n=8))
+        r = _expect(rej, "rejected", timeout=5)
+        assert "queue full" in r.error
+        hold.set()
+        for p in [first, *queued]:
+            _expect(p, "ok")
+    finally:
+        hold.set()
+        eng.stop()
+
+
+def test_admission_rejects_oversize_payload():
+    eng = _engine(max_request_bytes=1024)
+    r = _expect(eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                         n=1 << 20)), "rejected",
+                timeout=5)
+    assert "relay hazard" in r.error
+    eng.stop()
+
+
+def test_admission_rejects_f64_on_incapable_backend():
+    eng = _engine(executor=FakeExecutor(backend="tpu",
+                                        supports_f64=False))
+    r = _expect(eng.submit(ReduceRequest(method="SUM", dtype="double",
+                                         n=64)), "rejected", timeout=5)
+    assert "float64" in r.error and "dd" in r.error
+    eng.stop()
+
+
+def test_deadline_expires_in_queue_and_post_execution():
+    hold = threading.Event()
+    eng = _engine(executor=FakeExecutor(hold=hold))
+    eng.start()
+    try:
+        blocker = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                           n=8))
+        time.sleep(0.2)
+        doomed = eng.submit(ReduceRequest(method="MIN", dtype="int",
+                                          n=8, deadline_s=0.05))
+        time.sleep(0.2)       # deadline passes while queued
+        hold.set()
+        _expect(blocker, "ok")
+        r = _expect(doomed, "expired", timeout=5)
+        assert "deadline" in r.error
+    finally:
+        hold.set()
+        eng.stop()
+    # post-execution expiry: the launch itself outlives the deadline
+    eng2 = _engine(executor=FakeExecutor(delay_s=0.3))
+    eng2.start()
+    try:
+        r = _expect(eng2.submit(ReduceRequest(
+            method="SUM", dtype="int", n=8, deadline_s=0.05)),
+            "expired", timeout=5)
+        assert "deadline" in r.error
+    finally:
+        eng2.stop()
+
+
+def test_executor_crash_contained_to_batch_engine_keeps_serving():
+    boom = FakeExecutor(fail_with=RuntimeError("lowering gap"))
+    eng = _engine(executor=boom)
+    eng.start()
+    try:
+        r = _expect(eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                             n=8)), "error")
+        assert "lowering gap" in r.error
+        boom.fail_with = None          # next batch is healthy
+        _expect(eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                         n=8)), "ok")
+    finally:
+        eng.stop()
+
+
+def test_stop_without_drain_sheds_queue_with_explicit_responses():
+    hold = threading.Event()
+    eng = _engine(executor=FakeExecutor(hold=hold))
+    eng.start()
+    inflight = eng.submit(ReduceRequest(method="SUM", dtype="int", n=8))
+    time.sleep(0.2)       # worker blocks inside the executor
+    queued = [eng.submit(ReduceRequest(method="MIN", dtype="int", n=8))
+              for _ in range(3)]
+    threading.Timer(0.3, hold.set).start()   # release the in-flight
+    eng.stop(drain=False)                    # batch mid-stop
+    for p in queued:
+        r = _expect(p, "shed", timeout=5)
+        assert "engine-stopped" in r.error
+    _expect(inflight, "ok")                  # in-flight work finishes
+    late = eng.submit(ReduceRequest(method="SUM", dtype="int", n=8))
+    r = _expect(late, "rejected", timeout=5)
+    assert "stopped" in r.error
+
+
+def test_stop_with_drain_completes_queue():
+    hold = threading.Event()
+    eng = _engine(executor=FakeExecutor(hold=hold))
+    eng.start()
+    pends = [eng.submit(ReduceRequest(method="SUM", dtype="int", n=8))
+             for _ in range(4)]
+    threading.Timer(0.2, hold.set).start()
+    eng.stop(drain=True)
+    for p in pends:
+        _expect(p, "ok", timeout=5)
+
+
+def test_engine_events_trace_request_lifecycle(tmp_path):
+    led = tmp_path / "ledger.jsonl"
+    ledger.arm(str(led))
+    try:
+        eng = _engine()
+        pends = [eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                          n=512, seed=i))
+                 for i in range(3)]
+        eng.start()
+        for p in pends:
+            _expect(p, "ok")
+        eng.stop()
+    finally:
+        ledger.disarm()
+    from tpu_reductions.lint.grammar import EVENT_ROW_RE
+    lines = led.read_text().splitlines()
+    assert lines and all(EVENT_ROW_RE.match(ln) for ln in lines)
+    evs = [json.loads(ln) for ln in lines]
+    names = [e["ev"] for e in evs]
+    for expected in ("serve.start", "serve.enqueue", "serve.coalesce",
+                     "serve.launch", "serve.verify", "serve.respond",
+                     "serve.stop"):
+        assert expected in names, expected
+    # the coalesce event names every member request
+    co = next(e for e in evs if e["ev"] == "serve.coalesce")
+    assert co["size"] == 3 and len(co["reqs"]) == 3
+
+
+def test_timeline_attributes_per_request_latency(tmp_path):
+    led = tmp_path / "ledger.jsonl"
+    ledger.arm(str(led))
+    try:
+        eng = _engine()
+        pends = [eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                          n=512, seed=i))
+                 for i in range(4)]
+        eng.start()
+        for p in pends:
+            _expect(p, "ok")
+        eng.stop()
+    finally:
+        ledger.disarm()
+    from tpu_reductions.obs.timeline import (read_ledger, summarize,
+                                             summary_markdown)
+    events, torn = read_ledger(led)
+    assert torn == 0
+    summary = summarize(led, events, torn)
+    sv = summary["serve"]
+    assert sv["requests"] == 4 and sv["by_status"] == {"ok": 4}
+    assert sv["batches"] == 1 and sv["mean_batch"] == 4.0
+    assert sv["latency_s"]["p99"] >= sv["latency_s"]["p50"] > 0
+    md = summary_markdown(summary)
+    assert "serving (per-request attribution)" in md
+    assert "ok latency p50" in md
+
+
+# ------------------------------------------------------------ knapsack
+
+
+def test_prewarm_compiles_buckets_through_executor():
+    ex = FakeExecutor()
+    eng = _engine(executor=ex)
+    eng.prewarm("SUM", "int", 256, up_to_batch=5)
+    assert [len(launch[3]) for launch in ex.launches] == [1, 2, 4, 8]
+
+
+# ----------------------------------------------------------------- CLIs
+
+
+def test_loadgen_cli_commits_curve_and_coalesces(tmp_path):
+    out = tmp_path / "curve.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_reductions.serve.loadgen",
+         "--platform=cpu", "--clients=4", "--requests=6", "--n=8192",
+         "--launch-latency-ms=5", f"--out={out}"],
+        capture_output=True, text=True, cwd=str(REPO), timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    data = json.loads(out.read_text())
+    assert data["complete"] is True
+    rows = {r["mode"]: r for r in data["rows"]}
+    assert set(rows) == {"coalesced", "sequential"}
+    for r in rows.values():
+        assert r["requests"] == 24 and r["ok"] == 24
+        assert r["rps"] > 0 and r["p99_ms"] >= r["p50_ms"] > 0
+    # the acceptance comparison: fused launches amortize the per-launch
+    # transport RTT that single-request launches pay each time
+    assert rows["coalesced"]["mean_batch"] > 1.0
+    assert rows["sequential"]["mean_batch"] == 1.0
+    assert rows["coalesced"]["rps"] > rows["sequential"]["rps"]
+    assert "coalescing speedup" in proc.stdout
+
+
+def test_loadgen_resumes_interrupted_artifact(tmp_path):
+    """The unified-resume contract (bench/resume.py) on the curve
+    artifact: a complete:false prior with matching meta reuses its
+    mode row instead of re-measuring."""
+    out = tmp_path / "curve.json"
+    args = [sys.executable, "-m", "tpu_reductions.serve.loadgen",
+            "--platform=cpu", "--clients=2", "--requests=2", "--n=4096",
+            "--launch-latency-ms=0", f"--out={out}"]
+    proc = subprocess.run([*args, "--modes=coalesced"],
+                          capture_output=True, text=True, cwd=str(REPO),
+                          timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    partial = json.loads(out.read_text())
+    # single-mode run finalizes complete:true; rewrite as interrupted
+    partial["complete"] = False
+    out.write_text(json.dumps(partial))
+    prior_row = partial["rows"][0]
+    proc2 = subprocess.run(args, capture_output=True, text=True,
+                           cwd=str(REPO), timeout=300)
+    assert proc2.returncode == 0, proc2.stderr
+    assert "resumed from prior artifact" in proc2.stderr
+    final = json.loads(out.read_text())
+    assert final["complete"] is True
+    rows = {r["mode"]: r for r in final["rows"]}
+    assert rows["coalesced"] == prior_row          # byte-identical reuse
+    assert "sequential" in rows                    # fresh measurement
+
+
+def test_server_tcp_roundtrip(tmp_path):
+    port_file = tmp_path / "port"
+    server = subprocess.Popen(
+        [sys.executable, "-m", "tpu_reductions.serve",
+         "--platform=cpu", "--port=0", f"--port-file={port_file}",
+         "--max-seconds=60"],
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        deadline = time.monotonic() + 60
+        while not port_file.exists():
+            assert time.monotonic() < deadline, "server never bound"
+            assert server.poll() is None, server.stderr.read()
+            time.sleep(0.05)
+        port = int(port_file.read_text().strip())
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=30) as s:
+            f = s.makefile("r")
+            s.sendall((json.dumps({"method": "SUM", "type": "int",
+                                   "n": 4096, "seed": 7}) + "\n")
+                      .encode())
+            resp = json.loads(f.readline())
+            assert resp["status"] == "ok", resp
+            assert resp["result"] == _oracle_value("SUM", 4096,
+                                                   "int32", 7)
+            # malformed line gets an explicit rejection, not a cut
+            s.sendall(b'{"type": "int"}\n')
+            resp2 = json.loads(f.readline())
+            assert resp2["status"] == "rejected"
+            assert "malformed" in resp2["error"]
+    finally:
+        server.terminate()
+        server.wait(timeout=30)

@@ -1,0 +1,295 @@
+"""Serving-engine chaos (ISSUE 6 satellite): relay flap mid-serving
+drains in-flight work and sheds the queue with explicit per-request
+error responses (no hang, no torn ledger lines), a restarted engine
+serves fresh traffic, and the relay's `slow` latency-injection mode
+(faults/relay.py) drives deadline expiry deterministically — the full
+story reconstructable by obs/timeline.py."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpu_reductions.faults.relay import FakeRelay
+from tpu_reductions.faults.schedule import Phase
+from tpu_reductions.obs import ledger
+from tpu_reductions.serve.coalesce import CostModel
+from tpu_reductions.serve.engine import ServeEngine
+from tpu_reductions.serve.request import ReduceRequest
+from tpu_reductions.serve.transport import RelayTransport
+
+
+def _engine(relay, **kw):
+    """An engine whose per-launch transport gate is bound to the fake
+    relay (no env mutation: the explicit-ports seam of
+    serve/transport.py)."""
+    kw.setdefault("coalesce_window_s", 0.0)
+    return ServeEngine(transport=RelayTransport(ports=(relay.port,),
+                                                assume_tunneled=True,
+                                                drain=True,
+                                                connect_timeout_s=0.5),
+                       **kw)
+
+
+class _CountingExecutor:
+    """Real-value-free executor: chaos tests exercise the transport and
+    shedding paths, not the reduction. `hold` (a threading.Event set on
+    the instance) blocks the NEXT run_batch until released — the
+    deterministic way to pin a batch in flight."""
+
+    def __init__(self, delay_s=0.0):
+        self.delay_s = delay_s
+        self.batches = 0
+        self.hold = None
+
+    def capabilities(self):
+        return {"backend": "cpu", "supports_f64": True}
+
+    def run_batch(self, method, dtype, n, seeds):
+        self.batches += 1
+        hold, self.hold = self.hold, None
+        if hold is not None:
+            assert hold.wait(timeout=30)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [{"result": 0.0, "ok": True, "host": 0.0, "diff": 0.0}
+                for _ in seeds]
+
+
+def test_relay_death_midserving_sheds_and_restart_serves(tmp_path):
+    """THE serving chaos pipeline: traffic flows, the relay flips dead,
+    the doomed batch gets explicit error responses and the queue sheds
+    — every pending request resolves, nothing hangs — and once the
+    relay flaps back a restarted engine serves fresh traffic. The
+    whole narrative lands in one ledger with zero torn lines."""
+    led = tmp_path / "ledger.jsonl"
+    ledger.arm(str(led))
+    try:
+        with FakeRelay() as relay:
+            ex = _CountingExecutor(delay_s=0.15)
+            # pessimistic cost model + tiny round window: mixed-key
+            # rounds launch ONE batch and defer the rest back to the
+            # queue — so the flap catches work both in-launch (error
+            # path) and queued (shed path) deterministically
+            eng = _engine(relay, executor=ex,
+                          cost_model=CostModel(default_s=1.0),
+                          device_window_s=0.01)
+            eng.start()
+            # healthy traffic first
+            ok = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                          n=64))
+            assert ok.result(timeout=30).status == "ok"
+            # pin the next batch in flight PAST its transport gate,
+            # then flip the relay dead underneath it — the round-2
+            # death shape, serving-shaped
+            release = threading.Event()
+            ex.hold = release
+            inflight = eng.submit(ReduceRequest(method="SUM",
+                                                dtype="int", n=64))
+            deadline = time.monotonic() + 30
+            while ex.batches < 2:        # gate passed, executor entered
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            relay.force("refuse")        # the flap
+            queued = [eng.submit(ReduceRequest(method=m, dtype="int",
+                                               n=64))
+                      for m in ("MIN", "MIN", "MAX", "MAX")]
+            release.set()
+            # EVERY pending request must resolve, promptly, explicitly
+            resolved = [p.result(timeout=30) for p in [inflight, *queued]]
+            statuses = [r.status for r in resolved]
+            # the in-flight batch was already past its gate: it
+            # completes; the MIN batch dies loudly at the next gate
+            # (error) and the deferred MAX work sheds with the queue
+            assert statuses[0] == "ok", statuses
+            assert statuses[1:3] == ["error", "error"], statuses
+            assert statuses[3:] == ["shed", "shed"], statuses
+            for r in resolved[1:]:
+                assert r.error and ("relay" in r.error
+                                    or "relay-dead" in r.error)
+            # the engine is still alive: it rejects nothing at
+            # admission (queue empty) and the next flap window serves
+            relay.force("accept")
+            from tpu_reductions.utils.watchdog import probe_relay
+            deadline = time.monotonic() + 30
+            while probe_relay(ports=(relay.port,),
+                              timeout_s=0.3) != "alive":
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            again = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                             n=64))
+            assert again.result(timeout=30).status == "ok"
+            eng.stop()
+
+            # restarted engine (the satellite's fresh-traffic clause)
+            eng2 = _engine(relay, executor=_CountingExecutor())
+            eng2.start()
+            fresh = eng2.submit(ReduceRequest(method="MAX", dtype="int",
+                                              n=64))
+            assert fresh.result(timeout=30).status == "ok"
+            eng2.stop()
+    finally:
+        ledger.disarm()
+
+    # ---- ledger reconstruction: zero torn lines, full narrative ----
+    from tpu_reductions.lint.grammar import EVENT_ROW_RE
+    from tpu_reductions.obs.timeline import read_ledger, summarize
+    lines = led.read_text().splitlines()
+    assert lines and all(EVENT_ROW_RE.match(ln) for ln in lines)
+    events, torn = read_ledger(led)
+    assert torn == 0
+    names = [e["ev"] for e in events]
+    assert "serve.shed" in names
+    shed = next(e for e in events if e["ev"] == "serve.shed")
+    assert shed["reason"] == "relay-dead" and shed["count"] >= 1
+    sv = summarize(led, events, torn)["serve"]
+    assert sv["shed_episodes"] >= 1
+    assert sv["by_status"].get("shed", 0) >= 1
+    assert sv["by_status"].get("ok", 0) >= 3
+    # every enqueued request got a terminal response (the no-hang
+    # contract, machine-checked)
+    assert sv["responses"] >= sv["requests"]
+
+
+def test_slow_relay_expires_deadlines_deterministically():
+    """The latency-injection satellite end to end: the relay's `slow`
+    behavior holds each transport round-trip for delay_s, so a request
+    whose deadline is shorter than the injected latency MUST expire —
+    and one with a generous deadline MUST still serve. No wall-clock
+    racing: the delay is scripted, not sampled."""
+    with FakeRelay([Phase("slow", delay_s=0.4)]) as relay:
+        eng = _engine(relay, executor=_CountingExecutor())
+        eng.start()
+        try:
+            doomed = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                              n=64, deadline_s=0.1))
+            r = doomed.result(timeout=30)
+            assert r.status == "expired", (r.status, r.error)
+            assert "deadline" in r.error
+            served = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                              n=64, deadline_s=10.0))
+            r2 = served.result(timeout=30)
+            assert r2.status == "ok"
+            # the injected latency is visible in the serving latency
+            assert r2.latency_s >= 0.4
+        finally:
+            eng.stop()
+
+
+def test_slow_relay_backlog_sheds_at_admission():
+    """Queue-full admission under injected latency: with every launch
+    held to the relay's per-connection delay, a burst beyond the
+    bounded queue depth is rejected at the front door — load shedding,
+    not queue growth."""
+    with FakeRelay([Phase("slow", delay_s=0.3)]) as relay:
+        eng = _engine(relay, executor=_CountingExecutor(), max_queue=2)
+        eng.start()
+        try:
+            first = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                             n=64))
+            time.sleep(0.1)          # in flight, holding at the gate
+            burst = [eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                              n=64)) for _ in range(4)]
+            statuses = sorted(p.result(timeout=30).status
+                              for p in [first, *burst])
+            assert statuses.count("rejected") >= 2, statuses
+            rejected = [p.result(0) for p in burst
+                        if p.result(0).status == "rejected"]
+            assert all("queue full" in r.error for r in rejected)
+            assert statuses.count("ok") >= 1
+        finally:
+            eng.stop()
+
+
+def test_serve_batch_fault_point_contains_crash():
+    """The serve.batch chaos seam (faults/inject.py): a scripted raise
+    inside the executor surfaces as explicit error responses on that
+    batch only — the engine keeps serving (crash containment at batch
+    grain, the bench's crash_result discipline)."""
+    import os
+
+    from tpu_reductions.faults import inject
+    from tpu_reductions.serve.executor import BatchExecutor
+    plan = {"serve.batch": {"after": 1, "times": 1, "action": "raise"}}
+    os.environ["TPU_REDUCTIONS_FAULTS"] = json.dumps(plan)
+    inject.reset()
+    try:
+        eng = ServeEngine(executor=BatchExecutor(),
+                          coalesce_window_s=0.0)
+        eng.start()
+        ok1 = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                       n=512))
+        assert ok1.result(timeout=30).status == "ok"
+        boom = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                        n=512))
+        r = boom.result(timeout=30)
+        assert r.status == "error" and "injected fault" in r.error
+        ok2 = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                       n=512))
+        assert ok2.result(timeout=30).status == "ok"
+        eng.stop()
+    finally:
+        os.environ.pop("TPU_REDUCTIONS_FAULTS", None)
+        inject.reset()
+
+
+def test_engine_under_concurrent_load_with_flap_resolves_everything():
+    """Load + flap soak, bounded: concurrent client threads drive
+    traffic while the relay flips dead and back; every single request
+    resolves to a terminal status within the timeout (the no-hang
+    acceptance, exercised under real concurrency)."""
+    with FakeRelay() as relay:
+        eng = _engine(relay, executor=_CountingExecutor(delay_s=0.01))
+        eng.start()
+        results = []
+        lock = threading.Lock()
+
+        def client(cid):
+            for i in range(10):
+                p = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                             n=64, seed=cid * 100 + i))
+                try:
+                    r = p.result(timeout=30)
+                except TimeoutError:          # the one forbidden outcome
+                    r = None
+                with lock:
+                    results.append(r)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        relay.force("refuse")
+        time.sleep(0.2)
+        relay.force("accept")
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        eng.stop()
+    assert len(results) == 40
+    assert all(r is not None for r in results), "a request hung"
+    statuses = {r.status for r in results}
+    assert statuses <= {"ok", "error", "shed", "expired", "rejected"}
+    assert "ok" in statuses          # traffic flowed around the flap
+
+
+def test_restarted_engine_after_stop_is_independent():
+    """Engine instances share nothing but the executor's jit cache: a
+    stopped engine's state cannot leak into its successor (the
+    restart-serves-fresh-traffic clause, minus the relay)."""
+    ex = _CountingExecutor()
+    e1 = ServeEngine(executor=ex, coalesce_window_s=0.0)
+    e1.start()
+    assert e1.submit(ReduceRequest(method="SUM", dtype="int",
+                                   n=64)).result(30).status == "ok"
+    e1.stop()
+    r = e1.submit(ReduceRequest(method="SUM", dtype="int", n=64))
+    assert r.result(5).status == "rejected"
+    e2 = ServeEngine(executor=ex, coalesce_window_s=0.0)
+    e2.start()
+    assert e2.submit(ReduceRequest(method="SUM", dtype="int",
+                                   n=64)).result(30).status == "ok"
+    assert e2.stats["rejected"] == 0
+    e2.stop()

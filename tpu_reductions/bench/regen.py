@@ -183,6 +183,20 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                 "(sched_state.json)")
         except (OSError, ValueError, KeyError, TypeError) as e:
             log(f"regen: sched_state.json unusable ({e}); skipped")
+    # the serving curve (ISSUE 6): requests/s + p50/p99 at N concurrent
+    # clients, committed by serve/loadgen.py — the throughput-under-
+    # load table next to GB/s
+    sv_file = out / "serving_curve.json"
+    if sv_file.exists():
+        try:
+            from tpu_reductions.serve.loadgen import curve_markdown
+            sv = json.loads(sv_file.read_text())
+            with open(paths["md"], "a") as f:
+                f.write("\n" + curve_markdown(sv) + "\n")
+            log("regen: appended serving-curve table "
+                "(serving_curve.json)")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: serving_curve.json unusable ({e}); skipped")
     pdf = generate_pdf(out, platform=platform,
                        data={"avgs": {}, "single_chip": sc or None,
                              "calibration": cal,

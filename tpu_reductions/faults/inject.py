@@ -37,7 +37,10 @@ is how a wedged device lease is rehearsed without a device,
 utils/preflight.py), `sched.task` (between the window scheduler's pick
 and its launch, sched/executor.py — a scripted `exit` is the
 deterministic "executor died mid-plan" the plan-resume contract is
-tested against). docs/RESILIENCE.md keeps the list.
+tested against), and `serve.batch` (one coalesced serving launch,
+serve/executor.py — a scripted `raise` proves the engine contains a
+batch crash to explicit error responses, tests/test_serve_chaos.py).
+docs/RESILIENCE.md keeps the list.
 
 Counters are process-global and monotonic; `reset()` re-arms them for
 in-process tests (subprocesses start fresh by construction).

@@ -56,6 +56,7 @@ class FakeRelay:
         self._host = host
         self._want_port = port
         self._forced: Optional[str] = None
+        self._forced_delay: Optional[float] = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -102,14 +103,17 @@ class FakeRelay:
 
     # -- test control -------------------------------------------------
 
-    def force(self, behavior: str) -> None:
+    def force(self, behavior: str,
+              delay_s: Optional[float] = None) -> None:
         """Override the schedule with a fixed behavior from now on —
         the deterministic flip tests use instead of racing wall-clock
-        phases ('refuse' the moment the artifact under test lands)."""
-        if behavior not in ("accept", "refuse", "stall"):
+        phases ('refuse' the moment the artifact under test lands).
+        `delay_s` sets the per-connection hold of a forced 'slow'."""
+        if behavior not in ("accept", "refuse", "stall", "slow"):
             raise ValueError(f"unknown behavior {behavior!r}")
         with self._lock:
             self._forced = behavior
+            self._forced_delay = delay_s
 
     @property
     def behavior(self) -> str:
@@ -120,6 +124,30 @@ class FakeRelay:
             return self._phases[self._phase_i].behavior
 
     # -- internals ----------------------------------------------------
+
+    def _current_delay(self) -> float:
+        """The per-connection hold in force for `slow` (forced delay,
+        else the current phase's, else the schedule default)."""
+        from tpu_reductions.faults.schedule import DEFAULT_SLOW_DELAY_S
+        with self._lock:
+            if self._forced == "slow":
+                return self._forced_delay if self._forced_delay \
+                    is not None else DEFAULT_SLOW_DELAY_S
+            ph = self._phases[self._phase_i]
+        return ph.hold_s if ph.behavior == "slow" \
+            else DEFAULT_SLOW_DELAY_S
+
+    def _slow_close(self, conn: socket.socket, delay_s: float) -> None:
+        """Hold one slow connection for delay_s (stop-aware), then
+        close it — 'serviced, late'."""
+        deadline = time.monotonic() + delay_s
+        while time.monotonic() < deadline and not self._stop.is_set():
+            time.sleep(min(_TICK_S, max(0.0,
+                                        deadline - time.monotonic())))
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def _bind(self) -> socket.socket:
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -181,6 +209,15 @@ class FakeRelay:
                 self._phase_conns += 1
             if behavior == "stall":
                 self._held.append(conn)   # wedged-but-ports-open
+            elif behavior == "slow":
+                # latency injection: hold delay_s, then service (close)
+                # — each connection gets its own timer thread so a slow
+                # relay is slow per round-trip, not serialized across
+                # concurrent probers
+                self._held.append(conn)
+                threading.Thread(target=self._slow_close,
+                                 args=(conn, self._current_delay()),
+                                 daemon=True).start()
             else:
                 try:
                     conn.close()

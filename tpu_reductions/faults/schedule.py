@@ -13,7 +13,16 @@ A schedule is a JSON list of phases the relay steps through in order:
              (the dead-relay signature both round-2 windows hit);
     stall  — connections complete but are held open and never serviced
              (the wedged-but-ports-open tunnel chip_session.sh's budget
-             discipline exists for: probes say alive, work hangs).
+             discipline exists for: probes say alive, work hangs);
+    slow   — latency injection (ISSUE 6): connections complete but are
+             held for `delay_s` before closing — a relay that services
+             everything, late. Port probes still say alive; a consumer
+             that waits for service (the serving engine's transport
+             gate, serve/transport.py) pays `delay_s` per round-trip,
+             which is how load tests exercise deadline expiry and
+             shedding deterministically.
+* `delay_s` (slow only, default 0.25): per-connection hold before the
+  relay closes the connection.
 * phase advance (optional, at most one of):
     duration_s   — advance after this much wall time;
     connections  — advance after this many observed connection attempts
@@ -32,7 +41,10 @@ import json
 import os
 from typing import List, Sequence, Union
 
-BEHAVIORS = ("accept", "refuse", "stall")
+BEHAVIORS = ("accept", "refuse", "stall", "slow")
+
+# per-connection hold of a `slow` phase that names no delay_s
+DEFAULT_SLOW_DELAY_S = 0.25
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,6 +54,7 @@ class Phase:
     behavior: str
     duration_s: float | None = None
     connections: int | None = None
+    delay_s: float | None = None
 
     def __post_init__(self):
         if self.behavior not in BEHAVIORS:
@@ -59,6 +72,17 @@ class Phase:
         if self.connections is not None and self.connections <= 0:
             raise ValueError(f"connections must be > 0, got "
                              f"{self.connections}")
+        if self.delay_s is not None and self.behavior != "slow":
+            raise ValueError("delay_s is the 'slow' behavior's knob; a "
+                             f"'{self.behavior}' phase must not set it")
+        if self.delay_s is not None and self.delay_s <= 0:
+            raise ValueError(f"delay_s must be > 0, got {self.delay_s}")
+
+    @property
+    def hold_s(self) -> float:
+        """The effective per-connection hold of a slow phase."""
+        return self.delay_s if self.delay_s is not None \
+            else DEFAULT_SLOW_DELAY_S
 
 
 def load_schedule(src: Union[str, os.PathLike, Sequence]) -> List[Phase]:
@@ -82,7 +106,8 @@ def load_schedule(src: Union[str, os.PathLike, Sequence]) -> List[Phase]:
         if not isinstance(p, dict):
             raise ValueError(f"phase {i}: expected an object, got "
                              f"{type(p).__name__}")
-        unknown = set(p) - {"behavior", "duration_s", "connections"}
+        unknown = set(p) - {"behavior", "duration_s", "connections",
+                            "delay_s"}
         if unknown:
             raise ValueError(f"phase {i}: unknown key(s) "
                              f"{sorted(unknown)}")

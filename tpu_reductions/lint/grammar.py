@@ -84,6 +84,15 @@ EVENT_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_]+)*$")
 SCHED_EVENTS = ("sched.plan", "sched.pick", "sched.skip", "sched.done",
                 "sched.replan")
 
+# the serving engine's typed events (tpu_reductions/serve/,
+# docs/SERVING.md) — the per-request distributed trace: enqueue ->
+# coalesce -> launch -> verify -> respond (+ shed and the engine
+# lifecycle brackets). Producer: serve/engine.py via obs/ledger.emit;
+# consumer: obs/timeline.py's per-request latency attribution
+SERVE_EVENTS = ("serve.start", "serve.enqueue", "serve.coalesce",
+                "serve.launch", "serve.verify", "serve.respond",
+                "serve.shed", "serve.stop")
+
 # one complete ledger line, either producer
 EVENT_ROW_RE = re.compile(
     r'^\{"t": [0-9]+(?:\.[0-9]+)?, "ev": "[a-z][a-z0-9_.]*", '

@@ -1,4 +1,4 @@
-"""redlint Python rules RED001-RED007 + RED010-RED012 — one AST walk
+"""redlint Python rules RED001-RED007 + RED010-RED014 — one AST walk
 per file.
 
 Each rule encodes one CLAUDE.md "hard-won environment fact" (or the
@@ -39,10 +39,13 @@ JSONIO_WHITELIST = ("utils/jsonio.py",)
 OBS_WHITELIST = ("obs/ledger.py",)
 # RED012 polices the runtime/measurement packages where event-shaped
 # lines would otherwise leak out as prints
-OBS_SCOPE_DIRS = ("utils", "bench", "obs", "faults")
+OBS_SCOPE_DIRS = ("utils", "bench", "obs", "faults", "serve", "sched")
 # RED013: wall-clock budgets / step orderings live in the scheduler's
 # task registry and nowhere else (ISSUE 5; docs/SCHEDULER.md)
 SCHED_WHITELIST = ("sched/tasks.py",)
+# RED014: the serving layer's device boundary — every launch flows
+# through the admission-controlled executor (ISSUE 6; docs/SERVING.md)
+SERVE_EXECUTOR_WHITELIST = ("serve/executor.py",)
 
 # RED006 applies to the measured packages only: every public surface in
 # ops/ and bench/ must carry its reference citation (PARITY.md).
@@ -155,6 +158,7 @@ def check_python(rel_posix: str, source: str) -> List[RawFinding]:
     out += _red011(rel_posix, ctx)
     out += _red012(rel_posix, ctx)
     out += _red013(rel_posix, ctx)
+    out += _red014(rel_posix, ctx)
     # nested timing scopes can double-report the same call site
     return sorted(set(out), key=lambda f: (f.line, f.rule, f.message))
 
@@ -571,6 +575,52 @@ def _red013(rel: str, ctx: _FileContext) -> List[RawFinding]:
                 if kw.arg and kw.arg.lower() in _BUDGET_KEYWORDS \
                         and _numeric_literal(kw.value):
                     out.append(RawFinding("RED013", node.lineno, msg))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED014 — device work in serve/ outside the executor module. The
+# serving engine's whole contract (bounded queue, per-request
+# deadlines, shed-not-hang — docs/SERVING.md) holds only if every
+# device launch flows through the admission-controlled path in
+# serve/executor.py: a direct run_benchmark / jax call from the
+# engine, batcher, transport or loadgen bypasses admission control,
+# deadline accounting AND the retry/heartbeat wrapping the executor
+# carries — the serving analog of RED011's "never touch the backend
+# before the gates".
+# --------------------------------------------------------------------------
+
+_SERVE_DEVICE_CALLS = {"run_benchmark", "run_benchmark_batch",
+                       "device_get", "device_put", "block_until_ready",
+                       "device_put_chunked", "maybe_chunked_stage"}
+
+
+def _red014(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    parts = rel.split("/")
+    if "serve" not in parts[:-1] or \
+            _suffix_match(rel, SERVE_EXECUTOR_WHITELIST):
+        return []
+    out = []
+    msg = ("device work inside serve/ outside serve/executor.py — all "
+           "launches must flow through the admission-controlled "
+           "executor path (bounded queue, deadlines, retry/heartbeat; "
+           "docs/SERVING.md)")
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            if any(n.name == "jax" or n.name.startswith("jax.")
+                   for n in node.names):
+                out.append(RawFinding("RED014", node.lineno,
+                                      f"jax import: {msg}"))
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                out.append(RawFinding("RED014", node.lineno,
+                                      f"jax import: {msg}"))
+        elif isinstance(node, ast.Call):
+            name = _attr_chain(node.func).rsplit(".", 1)[-1]
+            if name in _SERVE_DEVICE_CALLS:
+                out.append(RawFinding("RED014", node.lineno,
+                                      f"{name}(): {msg}"))
     return out
 
 

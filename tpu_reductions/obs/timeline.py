@@ -211,6 +211,51 @@ def sched_summary(events: List[dict]) -> Optional[dict]:
     return {"tasks": [tasks[n] for n in order], "replans": replans}
 
 
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def serve_summary(events: List[dict]) -> Optional[dict]:
+    """Per-request latency attribution from the serving engine's typed
+    events (serve.enqueue/coalesce/launch/verify/respond —
+    lint/grammar.py SERVE_EVENTS; tpu_reductions/serve/). The post-hoc
+    answer ISSUE 6 requires: how many requests, how they resolved,
+    where their milliseconds went (queued vs in-launch — the engine
+    stamps queue_s/latency_s on every respond event), and how hard
+    coalescing worked (batches, mean size). None when no engine ran."""
+    enq = [e for e in events if e["ev"] == "serve.enqueue"]
+    responds = [e for e in events if e["ev"] == "serve.respond"]
+    launches = [e for e in events if e["ev"] == "serve.launch"]
+    sheds = [e for e in events if e["ev"] == "serve.shed"]
+    if not enq and not responds:
+        return None
+    by_status: dict = {}
+    for e in responds:
+        s = e.get("status") or "?"
+        by_status[s] = by_status.get(s, 0) + 1
+    out = {"requests": len(enq), "responses": len(responds),
+           "by_status": by_status, "batches": len(launches),
+           "shed_episodes": len(sheds)}
+    sizes = [e["size"] for e in launches
+             if isinstance(e.get("size"), int)]
+    if sizes:
+        out["mean_batch"] = round(sum(sizes) / len(sizes), 2)
+    ok_lat = sorted(e["latency_s"] for e in responds
+                    if e.get("status") == "ok"
+                    and isinstance(e.get("latency_s"), (int, float)))
+    if ok_lat:
+        out["latency_s"] = {"p50": round(_percentile(ok_lat, 0.5), 6),
+                            "p99": round(_percentile(ok_lat, 0.99), 6)}
+    queued = sorted(e["queue_s"] for e in responds
+                    if isinstance(e.get("queue_s"), (int, float)))
+    if queued:
+        out["queue_s"] = {"p50": round(_percentile(queued, 0.5), 6),
+                          "p99": round(_percentile(queued, 0.99), 6)}
+    return out
+
+
 def summarize(path, events: List[dict], torn: int) -> dict:
     """The machine-readable summary JSON (bench/regen collates it into
     report.md; chip_session.sh persists it as obs_timeline.json)."""
@@ -220,6 +265,9 @@ def summarize(path, events: List[dict], torn: int) -> dict:
     sched = sched_summary(events)
     if sched is not None:
         out["sched"] = sched
+    serve = serve_summary(events)
+    if serve is not None:
+        out["serve"] = serve
     if events:
         t0, t1 = events[0]["t"], events[-1]["t"]
         wall = max(t1 - t0, 0.0)
@@ -327,6 +375,31 @@ def summary_markdown(summary: dict) -> str:
                 f"| {status} |")
         lines.append("")
         lines.append(f"{sched['replans']} replan(s)")
+    serve = summary.get("serve")
+    if serve:
+        # the serving engine's per-request record (ISSUE 6): request
+        # counts by terminal status + the latency split the respond
+        # events carry
+        lines.append("")
+        lines.append("### serving (per-request attribution)")
+        lines.append("")
+        statuses = ", ".join(f"{k}: {v}" for k, v
+                             in sorted(serve["by_status"].items())) \
+            or "-"
+        lines.append(f"{serve['requests']} request(s), "
+                     f"{serve['responses']} response(s) ({statuses}); "
+                     f"{serve['batches']} launch(es)"
+                     + (f", mean batch {serve['mean_batch']}"
+                        if serve.get("mean_batch") else "")
+                     + (f", {serve['shed_episodes']} shed episode(s)"
+                        if serve.get("shed_episodes") else ""))
+        lat, q = serve.get("latency_s"), serve.get("queue_s")
+        if lat:
+            lines.append(
+                f"ok latency p50 {lat['p50'] * 1e3:.2f} ms / "
+                f"p99 {lat['p99'] * 1e3:.2f} ms"
+                + (f"; queued p50 {q['p50'] * 1e3:.2f} ms / "
+                   f"p99 {q['p99'] * 1e3:.2f} ms" if q else ""))
     return "\n".join(lines)
 
 

@@ -1,0 +1,89 @@
+"""Greedy value/expected-cost ranking — the ONE knapsack core.
+
+PR 5's window planner (sched/planner.py) ranks measurement tasks by
+value per expected second against the remaining-window estimate; the
+serving engine (tpu_reductions/serve/, ISSUE 6) ranks coalesced
+request batches by value per expected device-second against a
+per-round device-time window. Same algorithm, different nouns — so it
+lives here ONCE, parameterized by (value, expected-cost, budget), and
+both schedulers import it instead of forking it (the ISSUE 6
+satellite contract).
+
+Properties both callers rely on:
+
+  * **Pure.** No clocks, no I/O, no globals: `greedy_plan` is a
+    function of its arguments, so replanning is just calling it again
+    (the sched/planner.py doctrine) and the serve batcher can plan
+    every round without synchronization.
+  * **jax-free.** sched/ plans with the relay dead; serve/ admits
+    while the device is busy. Neither may pay a jax import
+    (redlint RED014 additionally bans device work in serve/ outside
+    its executor module).
+  * **Top pick always runnable.** `fits` is advisory: a pessimistic
+    cost model must never idle an alive window / an idle device — the
+    caller launches the top entry regardless (sched/planner.py rule 4;
+    serve/coalesce.plan_round applies the same rule to batches).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Iterable, List, Sequence
+
+# guard against a zero/negative cost estimate blowing up the ratio:
+# the same floor sched/planner.py has always used
+_MIN_COST = 1e-9
+
+
+@dataclass(frozen=True)
+class Ranked:
+    """One planned pick: the item plus the estimates that ranked it."""
+    item: object
+    cost: float           # expected cost (seconds, for both callers)
+    ratio: float          # value / cost — the greedy key
+    fits: bool            # inside the cumulative budget
+    cumulative: float     # running cost total up to and including this
+
+
+def rank_order(items: Iterable, *, value: Callable[[object], float],
+               cost: Callable[[object], float],
+               tie_key: Callable[[object], object] = str) -> List:
+    """Order one pool by descending value/cost ratio (value, then
+    tie_key break ties deterministically — the planner's stable-table
+    contract)."""
+    return sorted(items,
+                  key=lambda it: (-value(it) / max(cost(it), _MIN_COST),
+                                  -value(it), tie_key(it)))
+
+
+def mark_fits(ordered: Sequence, *, value: Callable[[object], float],
+              cost: Callable[[object], float],
+              budget_s: float) -> List[Ranked]:
+    """Annotate an already-ordered sequence with cumulative cost and
+    the fits flag against `budget_s` (one shared budget line across the
+    whole sequence, however many pools it was ordered from)."""
+    out: List[Ranked] = []
+    cum = 0.0
+    for it in ordered:
+        c = cost(it)
+        cum += c
+        out.append(Ranked(item=it, cost=c,
+                          ratio=value(it) / max(c, _MIN_COST),
+                          fits=cum <= budget_s, cumulative=cum))
+    return out
+
+
+def greedy_plan(pools: Sequence[Iterable], *,
+                value: Callable[[object], float],
+                cost: Callable[[object], float],
+                budget_s: float,
+                tie_key: Callable[[object], object] = str
+                ) -> List[Ranked]:
+    """The full greedy knapsack: rank each pool independently by
+    value/cost, concatenate pools in the order given (the planner's
+    normal -> requires-blocked -> hazard tiers; serve passes a single
+    pool), and mark fits against one cumulative budget."""
+    ordered = [it for pool in pools
+               for it in rank_order(pool, value=value, cost=cost,
+                                    tie_key=tie_key)]
+    return mark_fits(ordered, value=value, cost=cost, budget_s=budget_s)

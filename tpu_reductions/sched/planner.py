@@ -19,7 +19,10 @@ The planning rule, in value order (module sched docstring has the why):
      answering right now is a fact).
 
 Replanning is just calling plan() again: it is a pure function of
-(registry, state, priors, now).
+(registry, state, priors, now). The ranking itself is the shared
+greedy knapsack core (sched/knapsack.py — ISSUE 6 generalized it out
+of this module so the serving engine's batch scheduler and this
+planner import ONE implementation).
 """
 
 from __future__ import annotations
@@ -28,6 +31,7 @@ import time
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
+from tpu_reductions.sched.knapsack import greedy_plan
 from tpu_reductions.sched.priors import Priors
 from tpu_reductions.sched.state import PlanState
 from tpu_reductions.sched.tasks import Task, artifact_complete
@@ -89,20 +93,14 @@ def plan(tasks: Sequence[Task], state: PlanState, priors: Priors,
     blocked = [t for t in open_tasks if not t.hazard and not eligible(t)]
     hazard = [t for t in open_tasks if t.hazard]
 
-    def ranked(pool: Sequence[Task]) -> List[Task]:
-        return sorted(pool, key=lambda t: (-t.value / max(
-            priors.estimate(t), 1e-9), -t.value, t.name))
-
-    ordered = ranked(normal) + ranked(blocked) + ranked(hazard)
-    entries: List[PlanEntry] = []
-    cum = 0.0
-    for t in ordered:
-        est = priors.estimate(t)
-        cum += est
-        entries.append(PlanEntry(task=t, est_s=est,
-                                 ratio=t.value / max(est, 1e-9),
-                                 fits=cum <= remaining,
-                                 cumulative_s=cum))
+    ranked = greedy_plan([normal, blocked, hazard],
+                         value=lambda t: t.value,
+                         cost=priors.estimate,
+                         budget_s=remaining,
+                         tie_key=lambda t: t.name)
+    entries = [PlanEntry(task=r.item, est_s=r.cost, ratio=r.ratio,
+                         fits=r.fits, cumulative_s=r.cumulative)
+               for r in ranked]
     return Plan(entries=entries, remaining_s=remaining, skips=skips)
 
 

@@ -1,0 +1,41 @@
+"""Reduction-as-a-service: the async multi-tenant serving layer.
+
+bench/driver.py's measure-verify-report loop is one-shot and
+single-tenant; this package is the persistent serving form the ROADMAP
+north star asks for (docs/SERVING.md): an engine that accepts
+reduction requests (op x dtype x payload), coalesces compatible
+concurrent requests into fused stacked device launches, schedules
+mixed traffic with the shared value/expected-cost knapsack
+(sched/knapsack.py) against a per-round device-time window, and
+executes through an admission-controlled path with bounded queue
+depth, per-request deadlines, and graceful load shedding — rejecting
+or shedding instead of wedging, the serving-shaped spelling of the
+relay doctrine every bench entry point already follows.
+
+Module map (redlint RED014 enforces the device boundary):
+
+  request.py   typed request/response surface + the future-like slot
+               (jax-free)
+  transport.py per-launch relay gate: dead-relay detection + the
+               chaos relay's `slow` latency injection (jax-free)
+  coalesce.py  batch formation + knapsack round planning + the online
+               duration cost model (jax-free)
+  engine.py    the serving core: admission -> queue -> coalesce ->
+               plan -> launch -> verify -> respond (jax-free)
+  executor.py  the ONLY device-touching module: fused stacked
+               launches with retry/heartbeat, oracle verification
+  loadgen.py   closed-loop load generator + the committed
+               requests/s + p50/p99 serving curve
+  __main__.py  `python -m tpu_reductions.serve` — the TCP JSON-lines
+               front end
+
+Every request transition lands in the flight recorder as a `serve.*`
+event (lint/grammar.py SERVE_EVENTS); `python -m
+tpu_reductions.obs.timeline` attributes per-request latency post-hoc
+(docs/OBSERVABILITY.md).
+"""
+
+from tpu_reductions.serve.request import (ReduceRequest, ReduceResponse,
+                                          TransportDead)
+
+__all__ = ["ReduceRequest", "ReduceResponse", "TransportDead"]

@@ -1,0 +1,135 @@
+"""`python -m tpu_reductions.serve` — the TCP JSON-lines front end.
+
+One request object per line, one response line back, over a local TCP
+socket (the transport is deliberately minimal: the engine is the
+product, the socket is a demo-grade front door the loadgen's
+--connect mode and shell rehearsals drive):
+
+    {"method": "SUM", "type": "int", "n": 65536, "seed": 1,
+     "deadline_s": 2.0}
+ ->
+    {"request_id": "r000000", "status": "ok", "result": 8355840.0,
+     "latency_s": 0.0021, ...}
+
+Entry-point doctrine, same as every bench CLI: the flight recorder and
+the watchdog arm together before any backend touch
+(docs/OBSERVABILITY.md; utils/watchdog.py), so a relay death under
+live traffic resolves to watchdog vocabulary (exit 3/4) with every
+already-answered request's trace in the ledger — and the engine itself
+sheds, never hangs (serve/engine.py).
+
+CLI:
+    python -m tpu_reductions.serve [--port 0] [--port-file PATH] \
+        [--platform cpu] [--max-seconds S] [engine knobs]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socketserver
+import sys
+import threading
+import time
+
+from tpu_reductions.config import _apply_platform
+
+
+def _make_handler(engine, request_timeout_s: float):
+    from tpu_reductions.serve.request import ReduceRequest
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            for raw in self.rfile:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    spec = json.loads(raw)
+                    req = ReduceRequest(
+                        method=spec["method"],
+                        dtype=spec.get("type", spec.get("dtype", "int")),
+                        n=int(spec.get("n", 1 << 16)),
+                        seed=int(spec.get("seed", 0)),
+                        deadline_s=spec.get("deadline_s"),
+                        value=float(spec.get("value", 1.0)))
+                except (KeyError, TypeError, ValueError) as e:
+                    resp = {"status": "rejected",
+                            "error": f"malformed request: {e}"}
+                else:
+                    try:
+                        resp = engine.submit(req).result(
+                            timeout=request_timeout_s).to_dict()
+                    except TimeoutError as e:
+                        resp = {"status": "error", "error": str(e)}
+                self.wfile.write((json.dumps(resp) + "\n").encode())
+                self.wfile.flush()
+
+    return Handler
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def main(argv=None) -> int:
+    """CLI entry (module docstring): start the engine, serve JSON
+    lines until --max-seconds (or interrupt), drain on the way out."""
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.serve",
+        description="Reduction-as-a-service: TCP JSON-lines front end "
+                    "over the async serving engine (docs/SERVING.md)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = ephemeral (printed + --port-file)")
+    p.add_argument("--port-file", default=None,
+                   help="write the bound port here once listening")
+    p.add_argument("--max-queue", type=int, default=64)
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--coalesce-window-ms", type=float, default=5.0)
+    p.add_argument("--device-window-ms", type=float, default=250.0)
+    p.add_argument("--request-timeout-s", type=float, default=600.0,
+                   help="per-connection wait bound on one response")
+    p.add_argument("--max-seconds", type=float, default=None,
+                   help="total runtime bound (default: until killed)")
+    p.add_argument("--platform", default=None, choices=("cpu", "tpu"))
+    ns = p.parse_args(argv)
+    _apply_platform(ns)
+
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("serve", argv=list(argv) if argv else sys.argv[1:])
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()   # a server hung on a dead relay serves nothing
+
+    from tpu_reductions.serve.engine import ServeEngine
+    engine = ServeEngine(
+        max_queue=ns.max_queue, max_batch=ns.max_batch,
+        coalesce_window_s=ns.coalesce_window_ms / 1e3,
+        device_window_s=ns.device_window_ms / 1e3).start()
+
+    server = _Server((ns.host, ns.port),
+                     _make_handler(engine, ns.request_timeout_s))
+    port = server.server_address[1]
+    print(f"serving on {ns.host}:{port}", flush=True)
+    if ns.port_file:
+        from tpu_reductions.utils.jsonio import atomic_text_dump
+        atomic_text_dump(ns.port_file, f"{port}\n")
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        if ns.max_seconds is None:
+            while True:
+                time.sleep(0.5)
+        else:
+            time.sleep(ns.max_seconds)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        engine.stop(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

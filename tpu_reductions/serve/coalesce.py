@@ -1,0 +1,136 @@
+"""Batch formation + round planning + the online duration cost model.
+
+Coalescing rule (docs/SERVING.md): concurrent requests are compatible
+iff they share (method, dtype, n) — exactly the key under which one
+stacked (k, n) device call computes all k results in a single launch
+(serve/executor.py). A batch is bounded twice: by `max_batch` rows
+(the executor's jit-bucket ceiling) and by `max_batch_bytes` of
+stacked payload (the 512 MiB single-message relay-hazard bound of
+utils/staging.py, applied at batch-formation time so a coalesced
+launch can never reconstruct the round-2 killer).
+
+Mixed traffic is scheduled by the shared greedy knapsack
+(sched/knapsack.py — the ISSUE 6 generalization): each batch's value
+is the sum of its requests' values, its cost is the `CostModel`'s
+expected device-seconds for its key, and the budget is the engine's
+per-round device-time window. Batches that don't fit defer to the
+next round (where new arrivals may coalesce into them); the top pick
+always launches — an idle device must never wait on a pessimistic
+estimate (the planner's always-runnable rule).
+
+`CostModel` is the serving-grain analog of sched/priors.py: an
+exponentially-weighted moving average of observed launch durations per
+batch key, updated online as batches finish — the Zhang-et-al
+cost-model role (PAPERS.md 2112.01075) at request granularity.
+
+jax-free (redlint RED014): planning never touches the device.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Tuple
+
+from tpu_reductions.sched.knapsack import greedy_plan
+
+BatchKey = Tuple[str, str, int]          # (method, dtype, n)
+
+_batch_ids = itertools.count()
+
+
+@dataclass
+class Batch:
+    """One fused launch unit: compatible admitted requests in arrival
+    order. `admitted` items are the engine's internal records (each
+    carries .request, .request_id, deadlines — serve/engine.py)."""
+
+    key: BatchKey
+    admitted: List = field(default_factory=list)
+    batch_id: str = field(
+        default_factory=lambda: f"b{next(_batch_ids):05d}")
+
+    @property
+    def size(self) -> int:
+        return len(self.admitted)
+
+    @property
+    def value(self) -> float:
+        return sum(a.request.value for a in self.admitted)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.request.nbytes for a in self.admitted)
+
+
+def coalesce(admitted: Sequence, *, max_batch: int,
+             max_batch_bytes: int) -> List[Batch]:
+    """Group admitted requests into batches by key, preserving arrival
+    order within a key, splitting at the row and byte bounds."""
+    by_key: Dict[BatchKey, List] = {}
+    order: List[BatchKey] = []
+    for a in admitted:
+        r = a.request
+        key = (r.method, r.dtype, r.n)
+        if key not in by_key:
+            by_key[key] = []
+            order.append(key)
+        by_key[key].append(a)
+    batches: List[Batch] = []
+    for key in order:
+        cur = Batch(key=key)
+        for a in by_key[key]:
+            if cur.size >= max_batch or \
+                    (cur.size and cur.nbytes + a.request.nbytes
+                     > max_batch_bytes):
+                batches.append(cur)
+                cur = Batch(key=key)
+            cur.admitted.append(a)
+        if cur.size:
+            batches.append(cur)
+    return batches
+
+
+class CostModel:
+    """EWMA expected device-seconds per batch key (module docstring).
+    `default_s` is the cold-start prior — deliberately modest, so an
+    unobserved key neither hogs nor starves the round window."""
+
+    def __init__(self, *, alpha: float = 0.3,
+                 default_s: float = 0.02) -> None:
+        if not 0 < alpha <= 1:
+            raise ValueError("alpha must be in (0, 1]")
+        self._alpha = alpha
+        self._default_s = default_s
+        self._est: Dict[BatchKey, float] = {}
+
+    def estimate(self, key: BatchKey) -> float:
+        return self._est.get(key, self._default_s)
+
+    def observe(self, key: BatchKey, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        prev = self._est.get(key)
+        self._est[key] = seconds if prev is None else \
+            (1 - self._alpha) * prev + self._alpha * seconds
+
+
+def plan_round(batches: Sequence[Batch], *, cost_model: CostModel,
+               device_window_s: float
+               ) -> Tuple[List[Batch], List[Batch]]:
+    """One scheduling round: (launch_now, defer). Ranking is the
+    shared knapsack (sched/knapsack.greedy_plan); the top pick always
+    launches even when nothing 'fits' the window."""
+    if not batches:
+        return [], []
+    ranked = greedy_plan([batches],
+                         value=lambda b: b.value,
+                         cost=lambda b: cost_model.estimate(b.key),
+                         budget_s=device_window_s,
+                         tie_key=lambda b: b.batch_id)
+    launch = [r.item for r in ranked if r.fits]
+    if not launch:
+        launch = [ranked[0].item]
+    chosen = {id(b) for b in launch}
+    defer = [r.item for r in ranked if id(r.item) not in chosen]
+    return launch, defer

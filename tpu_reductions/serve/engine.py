@@ -1,0 +1,376 @@
+"""The serving core: admission -> queue -> coalesce -> plan -> launch
+-> verify -> respond.
+
+One `ServeEngine` is a persistent multi-tenant service over the
+single-chip reduction machinery (docs/SERVING.md has the architecture;
+this docstring has the invariants):
+
+  * **Admission control.** `submit` resolves instantly with
+    status `rejected` when the request is unservable (bounded queue
+    full, payload over the per-request byte cap — the relay-hazard
+    bound, float64 on a backend that cannot carry it, engine
+    stopped). An admitted request WILL resolve: every code path ends
+    in exactly one terminal response (the no-hang contract).
+  * **Coalescing.** Per round, queued requests group by
+    (method, dtype, n) into fused stacked launches
+    (serve/coalesce.py); mixed traffic ranks by the shared knapsack
+    against `device_window_s` of expected device time, deferred
+    batches re-queue ahead of newer arrivals.
+  * **Deadlines.** `deadline_s` is relative to submission; it is
+    checked at gather, immediately before launch, and at response
+    time — a result that arrives late is `expired`, not silently
+    stale (the serving spelling of "a WAIVED row is not a PASSED
+    row").
+  * **Shedding, not wedging.** A dead relay at the transport gate
+    (serve/transport.py) fails the doomed batch with explicit
+    `error` responses and sheds the entire queue with explicit `shed`
+    responses; the engine keeps running, so a relay that flaps back
+    finds it serving (the round-4 flap model). `stop(drain=True)`
+    finishes in-flight work and sheds the rest the same way.
+  * **Every transition is traced.** serve.* events
+    (lint/grammar.py SERVE_EVENTS) land in the flight recorder;
+    obs/timeline.py reconstructs per-request latency post-hoc.
+
+The engine itself is jax-free (redlint RED014): all device work flows
+through serve/executor.py, constructed lazily on first use.
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional
+
+from tpu_reductions.obs import ledger
+from tpu_reductions.serve.coalesce import (Batch, CostModel, coalesce,
+                                           plan_round)
+from tpu_reductions.serve.request import (PendingResponse, ReduceRequest,
+                                          ReduceResponse, TransportDead)
+from tpu_reductions.serve.transport import RelayTransport
+
+# per-request payload cap: one coalesced launch must never be able to
+# reconstruct the 4 GiB single-message relay killer (round 2, twice;
+# utils/staging.py's chunk threshold is the same 512 MiB line)
+DEFAULT_MAX_REQUEST_BYTES = 512 << 20
+
+
+@dataclass
+class _Admitted:
+    """Engine-internal record of one admitted request."""
+
+    request: ReduceRequest
+    request_id: str
+    pending: PendingResponse
+    t_enqueue: float                     # monotonic
+    t_deadline: Optional[float]          # monotonic absolute, or None
+    t_launch: Optional[float] = None
+    batch_size: Optional[int] = None
+
+    def expired(self, now: float) -> bool:
+        return self.t_deadline is not None and now > self.t_deadline
+
+
+class ServeEngine:
+    """The multi-tenant serving engine (module docstring)."""
+
+    def __init__(self, *, max_queue: int = 64, max_batch: int = 32,
+                 coalesce_window_s: float = 0.005,
+                 device_window_s: float = 0.25,
+                 max_request_bytes: int = DEFAULT_MAX_REQUEST_BYTES,
+                 executor=None, transport=None,
+                 cost_model: Optional[CostModel] = None) -> None:
+        if max_queue <= 0 or max_batch <= 0:
+            raise ValueError("max_queue/max_batch must be positive")
+        self._max_queue = max_queue
+        self._max_batch = max_batch
+        self._coalesce_window_s = coalesce_window_s
+        self._device_window_s = device_window_s
+        self._max_request_bytes = max_request_bytes
+        self._executor = executor          # lazy BatchExecutor when None
+        self._transport = transport if transport is not None \
+            else RelayTransport()
+        self._cost_model = cost_model or CostModel()
+        self._queue: Deque[_Admitted] = deque()
+        self._cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self._stopped = False
+        self._ids = itertools.count()
+        self.stats: Dict[str, float] = {
+            "submitted": 0, "ok": 0, "error": 0, "rejected": 0,
+            "expired": 0, "shed": 0, "batches": 0, "batched_requests": 0}
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "ServeEngine":
+        """Start the worker; requests submitted before start() queue up
+        and are served once it runs (the test seam for deterministic
+        coalescing)."""
+        if self._thread is not None:
+            return self
+        ledger.emit("serve.start", max_queue=self._max_queue,
+                    max_batch=self._max_batch,
+                    coalesce_window_s=self._coalesce_window_s,
+                    device_window_s=self._device_window_s)
+        self._thread = threading.Thread(target=self._run,
+                                        name="serve-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Shut down: with drain, the worker finishes the batch in
+        flight and sheds everything still queued with explicit `shed`
+        responses; without, shedding happens immediately. Idempotent."""
+        with self._cond:
+            if self._stopped and self._thread is None:
+                return
+            self._stopping = True
+            if not drain:
+                self._shed_locked("engine-stopped")
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        with self._cond:
+            self._shed_locked("engine-stopped")
+            self._stopped = True
+        ledger.emit("serve.stop", **{k: int(v)
+                                     for k, v in self.stats.items()})
+
+    def prewarm(self, method: str, dtype: str, n: int,
+                up_to_batch: int = 1) -> None:
+        """Compile-cache warming through the sanctioned executor path:
+        run one tiny launch per jit bucket (1, 2, 4, ... up_to_batch)
+        for the key, so serving traffic never pays a trace/compile
+        inside a measured or deadline-bound window (the .jax_cache
+        doctrine, serving-shaped; ROADMAP item 5's cold-start story).
+        Call before start() or while the engine is idle."""
+        k = 1
+        while True:
+            self._ensure_executor().run_batch(method, dtype, n,
+                                              list(range(k)))
+            if k >= up_to_batch:
+                return
+            k <<= 1
+
+    # -- admission ----------------------------------------------------
+
+    def submit(self, request: ReduceRequest) -> PendingResponse:
+        """Admit or reject one request; always returns a
+        PendingResponse (rejections come back already resolved)."""
+        rid = f"r{next(self._ids):06d}"
+        pending = PendingResponse(rid)
+        self.stats["submitted"] += 1
+        reason = self._admission_reason(request)
+        if reason is not None:
+            self.stats["rejected"] += 1
+            resp = ReduceResponse(rid, "rejected", request.method,
+                                  request.dtype, request.n, error=reason)
+            ledger.emit("serve.respond", req=rid, status="rejected",
+                        reason=reason)
+            pending.resolve(resp)
+            return pending
+        now = time.monotonic()
+        adm = _Admitted(request=request, request_id=rid, pending=pending,
+                        t_enqueue=now,
+                        t_deadline=(now + request.deadline_s
+                                    if request.deadline_s else None))
+        with self._cond:
+            self._queue.append(adm)
+            depth = len(self._queue)
+            self._cond.notify_all()
+        ledger.emit("serve.enqueue", req=rid, method=request.method,
+                    dtype=request.dtype, n=request.n, depth=depth)
+        return pending
+
+    def _admission_reason(self, request: ReduceRequest) -> Optional[str]:
+        if self._stopping or self._stopped:
+            return "engine-stopped"
+        if request.nbytes > self._max_request_bytes:
+            return (f"payload {request.nbytes} B exceeds the "
+                    f"{self._max_request_bytes} B per-request cap "
+                    "(single-message relay hazard; utils/staging.py)")
+        if request.dtype == "float64":
+            caps = self._capabilities()
+            if not caps.get("supports_f64", False):
+                return ("float64 unservable on this backend "
+                        f"({caps.get('backend', '?')}): device f64 is "
+                        "the dd pair path's job (ops/dd_reduce.py)")
+        with self._cond:
+            if len(self._queue) >= self._max_queue:
+                return f"queue full (depth {len(self._queue)})"
+        return None
+
+    def _capabilities(self) -> dict:
+        try:
+            return self._ensure_executor().capabilities()
+        except Exception as e:                    # capability probe
+            return {"backend": f"error: {e}",     # failure: reject f64,
+                    "supports_f64": False}        # keep serving 32-bit
+
+    def _ensure_executor(self):
+        if self._executor is None:
+            from tpu_reductions.serve.executor import BatchExecutor
+            self._executor = BatchExecutor()
+        return self._executor
+
+    # -- responses ----------------------------------------------------
+
+    def _respond(self, adm: _Admitted, status: str, *,
+                 result: Optional[float] = None,
+                 error: Optional[str] = None) -> None:
+        now = time.monotonic()
+        latency = now - adm.t_enqueue
+        queue_s = (adm.t_launch - adm.t_enqueue) if adm.t_launch else None
+        self.stats[status] = self.stats.get(status, 0) + 1
+        r = adm.request
+        resp = ReduceResponse(adm.request_id, status, r.method, r.dtype,
+                              r.n, result=result,
+                              error=error[:200] if error else None,
+                              latency_s=round(latency, 6),
+                              queue_s=(round(queue_s, 6)
+                                       if queue_s is not None else None),
+                              batch_size=adm.batch_size)
+        fields = {"req": adm.request_id, "status": status,
+                  "latency_s": resp.latency_s, "queue_s": resp.queue_s,
+                  "batch_size": adm.batch_size}
+        if error:
+            fields["reason"] = error[:120]
+        ledger.emit("serve.respond", **fields)
+        adm.pending.resolve(resp)
+
+    def _shed_locked(self, reason: str) -> None:
+        """Shed every queued request with an explicit response (caller
+        holds the lock for the queue swap; responses resolve outside
+        any device path so this can never block)."""
+        if not self._queue:
+            return
+        doomed = list(self._queue)
+        self._queue.clear()
+        ledger.emit("serve.shed", count=len(doomed), reason=reason)
+        for adm in doomed:
+            self._respond(adm, "shed", error=reason)
+
+    # -- the worker ---------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopping:
+                    self._cond.wait(timeout=0.05)
+                if self._stopping and not self._queue:
+                    return
+            # bounded gather window: let a concurrent burst coalesce
+            if self._coalesce_window_s > 0:
+                time.sleep(self._coalesce_window_s)
+            with self._cond:
+                taken = list(self._queue)
+                self._queue.clear()
+            try:
+                self._serve_round(taken)
+            except Exception as e:
+                # the worker must never die silently: contain, respond,
+                # keep serving
+                print(f"serve.engine: round failed "
+                      f"({type(e).__name__}: {e}); requests get "
+                      "error responses", file=sys.stderr, flush=True)
+                for adm in taken:
+                    if not adm.pending.done():
+                        self._respond(adm, "error",
+                                      error=f"{type(e).__name__}: {e}")
+            with self._cond:
+                if self._stopping and not self._queue:
+                    return
+
+    def _serve_round(self, taken: List[_Admitted]) -> None:
+        now = time.monotonic()
+        live: List[_Admitted] = []
+        for adm in taken:
+            if adm.expired(now):
+                self._respond(adm, "expired",
+                              error="deadline passed in queue")
+            else:
+                live.append(adm)
+        if not live:
+            return
+        batches = coalesce(live, max_batch=self._max_batch,
+                           max_batch_bytes=self._max_request_bytes)
+        launch, defer = plan_round(batches, cost_model=self._cost_model,
+                                   device_window_s=self._device_window_s)
+        for b in launch:
+            ledger.emit("serve.coalesce", batch=b.batch_id,
+                        method=b.key[0], dtype=b.key[1], n=b.key[2],
+                        size=b.size,
+                        reqs=[a.request_id for a in b.admitted])
+        if defer:
+            # deferred batches keep their place ahead of new arrivals
+            with self._cond:
+                self._queue.extendleft(reversed(
+                    [a for b in defer for a in b.admitted]))
+        for b in launch:
+            self._launch(b)
+
+    def _launch(self, batch: Batch) -> None:
+        now = time.monotonic()
+        live = []
+        for adm in batch.admitted:
+            if adm.expired(now):
+                self._respond(adm, "expired",
+                              error="deadline passed before launch")
+            else:
+                live.append(adm)
+        if not live:
+            return
+        method, dtype, n = batch.key
+        est = self._cost_model.estimate(batch.key)
+        ledger.emit("serve.launch", batch=batch.batch_id, size=len(live),
+                    method=method, dtype=dtype, n=n,
+                    est_s=round(est, 6))
+        t0 = time.monotonic()
+        for adm in live:
+            adm.t_launch = t0
+            adm.batch_size = len(live)
+        try:
+            self._transport.gate()
+            results = self._ensure_executor().run_batch(
+                method, dtype, n, [a.request.seed for a in live])
+        except TransportDead as e:
+            # the serving exit-3: fail the doomed batch loudly, shed
+            # the queue, keep running for the next flap window
+            for adm in live:
+                self._respond(adm, "error", error=f"relay dead: {e}")
+            with self._cond:
+                self._shed_locked("relay-dead")
+            return
+        except Exception as e:
+            # crash contained to the batch (bench/driver.crash_result
+            # discipline): one broken key must not take the service
+            for adm in live:
+                self._respond(adm, "error",
+                              error=f"{type(e).__name__}: {e}")
+            return
+        dt = time.monotonic() - t0
+        self._cost_model.observe(batch.key, dt)
+        self.stats["batches"] += 1
+        self.stats["batched_requests"] += len(live)
+        ok_count = sum(1 for r in results if r["ok"])
+        ledger.emit("serve.verify", batch=batch.batch_id,
+                    ok=ok_count, failed=len(live) - ok_count,
+                    exec_s=round(dt, 6))
+        now = time.monotonic()
+        for adm, res in zip(live, results):
+            if adm.expired(now):
+                self._respond(adm, "expired",
+                              error="deadline passed before response")
+            elif res["ok"]:
+                self._respond(adm, "ok", result=res["result"])
+            else:
+                self._respond(adm, "error",
+                              error=(f"verification failed: device "
+                                     f"{res['result']!r} vs oracle "
+                                     f"{res['host']!r} "
+                                     f"(diff {res['diff']:g})"))

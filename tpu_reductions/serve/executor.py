@@ -1,0 +1,142 @@
+"""The ONE device-touching module of the serving layer (RED014).
+
+A coalesced batch of k compatible requests (same method/dtype/n)
+executes as ONE stacked device call: payloads stack into a (k, n)
+array, rows pad to the next power of two with the op's monoid
+identity (ops/registry.py — identity rows cannot perturb any result),
+and a single jitted row-reduce produces all k scalars. This is
+run_benchmark_batch's machinery (bench/driver.py: many configs, one
+process, dispatch amortized) reduced to its serving essence — the
+whole point of coalescing is that k requests pay one dispatch, one
+trace-cache lookup and one transfer instead of k.
+
+Bucketed padding keeps the jit cache small: every batch size k serves
+from one of log2(max_batch)+1 executables per (method, dtype, n)
+instead of one per k — the serving analog of the compile-budget
+doctrine (a recompile through the tunnel costs 20-40 s; CLAUDE.md).
+
+Device failures flow through the same classification as the bench:
+`utils/retry.py` retries transient flaps under a heartbeat guard and
+re-raises dead-relay/deterministic errors to the engine's
+shed/containment path. Verification is the bench's own oracle
+(ops/oracle.py), per request, against each request's deterministic
+payload.
+
+All jax imports are local to the methods: constructing a
+BatchExecutor is free and jax-free (the engine builds one eagerly;
+only the first capability query or launch pays backend init — after
+the entry point's watchdog/preflight gates have run).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from tpu_reductions.faults.inject import fault_point
+
+
+def _bucket(k: int) -> int:
+    """Next power of two >= k (the jit-cache bucketing contract)."""
+    b = 1
+    while b < k:
+        b <<= 1
+    return b
+
+
+@functools.lru_cache(maxsize=8)
+def _jit_row_reduce(method: str):
+    """One jitted stacked row-reduce per op; jax's own trace cache
+    fans it out per (dtype, padded-k, n) shape — the template fan-out
+    role of ops/registry.py's jit retracing, bucketed by _bucket."""
+    import jax
+
+    from tpu_reductions.ops.registry import get_op
+    op = get_op(method)
+    return jax.jit(lambda x: op.jnp_reduce(x, axis=1))
+
+
+class BatchExecutor:
+    """Fused stacked launches for the serving engine (module
+    docstring). The engine calls exactly two things: `capabilities()`
+    (admission's dtype gate) and `run_batch(...)`."""
+
+    def __init__(self) -> None:
+        self._caps: Optional[dict] = None
+
+    def capabilities(self) -> dict:
+        """{'backend': str, 'supports_f64': bool}, resolved lazily and
+        cached — admission only pays backend discovery when a request
+        actually needs the answer (float64), and only after the entry
+        point's pre-JAX gates have run (utils/watchdog.py RED011
+        doctrine)."""
+        if self._caps is None:
+            import jax
+            backend = jax.default_backend()
+            # float64 on the TPU device wedges the axon tunnel
+            # machine-wide (CLAUDE.md); off-TPU it additionally needs
+            # x64 already enabled — the serving engine never toggles
+            # global jax state mid-traffic (utils/x64.py is the bench's
+            # scoped exception, unusable under concurrent tenants)
+            supports_f64 = backend != "tpu" and \
+                bool(jax.config.jax_enable_x64)
+            self._caps = {"backend": backend,
+                          "supports_f64": supports_f64}
+        return self._caps
+
+    def run_batch(self, method: str, dtype: str, n: int,
+                  seeds: List[int]) -> List[Dict]:
+        """Execute one coalesced batch; returns one dict per request
+        (in seed order): {'result', 'ok', 'host', 'diff'}. Raises on
+        device failure after the retry wrapper's classification — the
+        engine contains the crash to the batch (the crash_result
+        discipline of bench/driver.py, response-shaped)."""
+        from tpu_reductions.ops import oracle as oracle_mod
+        from tpu_reductions.ops.registry import get_op
+        from tpu_reductions.utils.retry import retry_device_call
+        from tpu_reductions.utils.rng import host_data
+
+        # chaos hook: one coalesced launch = one interruptible unit,
+        # the serving analog of bench.run (faults/inject.py;
+        # docs/RESILIENCE.md fault-point table)
+        fault_point("serve.batch")
+
+        op = get_op(method)
+        payloads = []
+        for seed in seeds:
+            x = oracle_mod.native_fill(n, dtype, rank=0, seed=seed)
+            if x is None:
+                x = host_data(n, dtype, rank=0, seed=seed)
+            payloads.append(x)
+        k = len(payloads)
+        kb = _bucket(k)
+        stacked = np.stack(payloads)
+        if kb > k:
+            pad = np.full((kb - k, n), op.identity(stacked.dtype),
+                          dtype=stacked.dtype)
+            stacked = np.concatenate([stacked, pad])
+
+        fn = _jit_row_reduce(method)
+
+        def launch():
+            import jax
+            # jit ingests the host array directly (one bounded
+            # transfer: admission + the batcher's byte cap keep every
+            # stacked payload under the 512 MiB single-message bound)
+            return np.asarray(jax.device_get(fn(stacked)))
+
+        vals = retry_device_call(launch, phase="serve")[:k]
+
+        out: List[Dict] = []
+        for i, seed in enumerate(seeds):
+            host = oracle_mod.host_reduce(payloads[i], method)
+            ok, diff = oracle_mod.verify(vals[i], host, method, dtype, n)
+            out.append({
+                "result": float(np.asarray(vals[i], dtype=np.float64)),
+                "ok": bool(ok),
+                "host": float(np.asarray(host, dtype=np.float64)),
+                "diff": float(diff),
+            })
+        return out

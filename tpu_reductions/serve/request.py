@@ -1,0 +1,137 @@
+"""Typed request/response surface of the serving engine.
+
+One `ReduceRequest` is one tenant's ask: reduce an `n`-element payload
+of `dtype` with `method`, optionally within `deadline_s`. The payload
+itself is generated engine-side from the request's seed (the same
+deterministic host fillers the bench uses, utils/rng.py /
+ops/oracle.native_fill) so a request is a few bytes on the wire while
+the serving path still moves and verifies real data.
+
+jax-free by construction: admission control, queueing and scheduling
+must all work with the relay dead (redlint RED014 bans device work in
+serve/ outside serve/executor.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+import numpy as np
+
+from tpu_reductions.config import DTYPE_ALIASES, METHODS
+
+# terminal response statuses — the engine's whole vocabulary. Every
+# submitted request resolves to exactly one of these (the no-hang
+# contract of docs/SERVING.md):
+#   ok        executed, verified, result attached
+#   error     executed path failed (device error, verification failure,
+#             dead relay mid-launch) — the reason is in .error
+#   rejected  refused at admission (queue full, oversize, unservable
+#             dtype, engine stopped) — never entered the queue
+#   expired   the per-request deadline passed before a result existed
+#   shed      dropped by load shedding (relay death, engine drain)
+STATUSES = ("ok", "error", "rejected", "expired", "shed")
+
+
+class TransportDead(RuntimeError):
+    """The relay refuses on every probe port at launch time: the
+    serving analog of the watchdog's exit-3 verdict. The engine
+    responds to the doomed batch, sheds the queue with explicit
+    per-request responses, and keeps running — a later window's
+    traffic finds the transport gate green again (faults/relay.py's
+    flap model)."""
+
+
+@dataclasses.dataclass
+class ReduceRequest:
+    """One reduction request (validated at construction — a malformed
+    request never reaches the queue)."""
+
+    method: str
+    dtype: str
+    n: int
+    seed: int = 0
+    deadline_s: Optional[float] = None   # relative to submission
+    value: float = 1.0                   # scheduling weight (knapsack)
+
+    def __post_init__(self) -> None:
+        self.method = self.method.upper()
+        if self.method not in METHODS:
+            raise ValueError(f"method must be one of {METHODS}, "
+                             f"got {self.method!r}")
+        if self.dtype not in DTYPE_ALIASES:
+            raise ValueError(f"unknown dtype {self.dtype!r}")
+        self.dtype = DTYPE_ALIASES[self.dtype]
+        if self.n <= 0:
+            raise ValueError("n must be positive")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError("deadline_s must be positive (or None)")
+        if self.value <= 0:
+            raise ValueError("value must be positive")
+
+    @property
+    def nbytes(self) -> int:
+        """Payload size — what admission's byte cap and the batcher's
+        per-launch byte bound meter (the 512 MiB relay-hazard doctrine
+        of utils/staging.py, applied at the front door)."""
+        return self.n * np.dtype(self.dtype).itemsize
+
+
+@dataclasses.dataclass
+class ReduceResponse:
+    """One terminal outcome. `latency_s` is submit-to-response wall
+    clock; `queue_s` is the admission-to-launch share of it (the
+    split obs/timeline.py also reconstructs from serve.* events)."""
+
+    request_id: str
+    status: str
+    method: str
+    dtype: str
+    n: int
+    result: Optional[float] = None
+    error: Optional[str] = None
+    latency_s: Optional[float] = None
+    queue_s: Optional[float] = None
+    batch_size: Optional[int] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+    def to_dict(self) -> dict:
+        """JSON-ready (the TCP front end's response line)."""
+        return dataclasses.asdict(self)
+
+
+class PendingResponse:
+    """The future-like slot `ServeEngine.submit` returns: resolved
+    exactly once, waitable with a timeout. Thread-safe — the engine
+    worker resolves, any client thread waits."""
+
+    def __init__(self, request_id: str) -> None:
+        self.request_id = request_id
+        self._event = threading.Event()
+        self._response: Optional[ReduceResponse] = None
+
+    def resolve(self, response: ReduceResponse) -> None:
+        """Engine-side: attach the terminal response (first resolution
+        wins; a second is a bug upstream and is ignored rather than
+        clobbering what a client may already have read)."""
+        if self._response is None:
+            self._response = response
+            self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ReduceResponse:
+        """Block until resolved. Raises TimeoutError instead of
+        returning None — a caller that forgets the timeout sees a loud
+        failure, never a silent null response."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request {self.request_id} unresolved "
+                               f"after {timeout}s")
+        assert self._response is not None
+        return self._response

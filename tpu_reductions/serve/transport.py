@@ -1,0 +1,132 @@
+"""Per-launch transport gate: dead-relay detection + latency injection.
+
+On the tunneled box every device call crosses the relay, and the
+relay's three failure modes (dead / slow / stalled — docs/RESILIENCE.md
+fault model) all land AHEAD of the launch from the engine's point of
+view. The gate makes that explicit: before each coalesced launch the
+engine performs one bounded relay round-trip —
+
+  * connection refused on every probe port -> `TransportDead`: the
+    engine sheds instead of dispatching work that can only hang
+    (the serving spelling of watchdog exit 3);
+  * the chaos relay's `slow` behavior (faults/relay.py) holds the
+    accepted connection for `delay_s` before closing — draining to EOF
+    makes that latency land HERE, deterministically, which is how load
+    tests exercise deadline expiry and shedding without wall-clock
+    races (the ISSUE 6 latency-injection satellite);
+  * a stalled relay (accepts, never closes) is bounded by `read_cap_s`
+    — the gate returns and the heartbeat/watchdog machinery owns any
+    longer stall (exit-4 territory), so the gate itself can never be
+    the hang.
+
+Untunneled hosts (no relay marker) skip the gate entirely: a plain
+`--platform=cpu` run pays nothing. Chaos tests opt in by pointing
+`TPU_REDUCTIONS_RELAY_MARKER` / `TPU_REDUCTIONS_RELAY_PORTS` at a
+FakeRelay, like every other relay consumer.
+
+Drain-to-EOF is only performed when `TPU_REDUCTIONS_RELAY_PORTS` is
+overridden (i.e. the stack is pointed at a scriptable relay): the real
+relay's protocol does not promise to close probe connections, so
+against the default ports the gate degrades to the same cheap
+connect-probe `utils/watchdog.probe_relay` uses.
+
+jax-free (redlint RED014): the gate is pure sockets.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Optional
+
+from tpu_reductions.utils import heartbeat
+from tpu_reductions.utils.watchdog import resolved_ports, tunneled_environment
+
+from tpu_reductions.serve.request import TransportDead
+
+
+class RelayTransport:
+    """The engine's default transport gate (module docstring)."""
+
+    def __init__(self, *, connect_timeout_s: float = 2.0,
+                 read_cap_s: float = 5.0,
+                 drain: Optional[bool] = None,
+                 ports: Optional[tuple] = None,
+                 assume_tunneled: bool = False) -> None:
+        """`drain=None` (default) drains to EOF only when the relay
+        ports are env-overridden (a scriptable relay is in play);
+        True/False force it either way — tests pass True. `ports` +
+        `assume_tunneled` bind the gate to an explicit relay (the
+        loadgen's modeled-RTT mode) without touching the process
+        environment."""
+        self._connect_timeout_s = connect_timeout_s
+        self._read_cap_s = read_cap_s
+        self._drain = drain
+        self._ports = tuple(ports) if ports is not None else None
+        self._assume_tunneled = assume_tunneled
+
+    def _should_drain(self) -> bool:
+        if self._drain is not None:
+            return self._drain
+        if self._ports is not None:
+            return True
+        return bool(os.environ.get("TPU_REDUCTIONS_RELAY_PORTS"))
+
+    def _gated(self) -> bool:
+        return self._assume_tunneled or tunneled_environment()
+
+    def _resolved_ports(self):
+        return self._ports if self._ports is not None \
+            else resolved_ports()
+
+    def gate(self) -> float:
+        """One bounded relay round-trip; returns the seconds it cost
+        (the injected latency, when a `slow` relay is in play). Raises
+        TransportDead when every probe port refuses. Untunneled: free.
+
+        Runs under a heartbeat guard so a stall here is watched like
+        any other transport wait (utils/heartbeat.py)."""
+        if not self._gated():
+            return 0.0
+        t0 = time.monotonic()
+        inconclusive = False
+        with heartbeat.guard("serve"):
+            for port in self._resolved_ports():
+                try:
+                    with socket.create_connection(
+                            ("127.0.0.1", port),
+                            timeout=self._connect_timeout_s) as s:
+                        if self._should_drain():
+                            s.settimeout(self._read_cap_s)
+                            try:
+                                while s.recv(1024):
+                                    heartbeat.tick()
+                            except (socket.timeout, TimeoutError):
+                                # stalled relay: bounded here; longer
+                                # stalls are exit-4 territory
+                                pass
+                            except OSError:
+                                pass
+                    return time.monotonic() - t0
+                except (ConnectionRefusedError, ConnectionResetError,
+                        socket.timeout, TimeoutError):
+                    continue
+                except OSError:
+                    # EMFILE-class local degradation says nothing about
+                    # the relay (the probe_relay asymmetry): treat as
+                    # passable, never as dead
+                    inconclusive = True
+        if inconclusive:
+            return time.monotonic() - t0
+        raise TransportDead(
+            "relay refuses on every probe port "
+            f"({','.join(map(str, self._resolved_ports()))})")
+
+
+class NullTransport:
+    """A gate that never gates — the explicit opt-out for in-process
+    tests that want the engine without any relay semantics."""
+
+    def gate(self) -> float:
+        return 0.0
