@@ -385,6 +385,14 @@ fallback_static_session() {
                      --out=int_op_spot_xla.json || rc=$?; \
                  exit $rc'
 
+    # first on-chip evidence for the streaming pipeline that erases
+    # the 4 GiB staging hazard (ISSUE 7; docs/STREAMING.md)
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py stream_probe
+    step "streaming pipeline probe" 300 stream_probe.json -- \
+        python -m tpu_reductions.bench.stream --method=SUM --type=int \
+            --n=268435456 --chunk-bytes=67108864 --sync-every=4 \
+            --out=stream_probe.json
+
     # bf16's first on-chip rows (round-3 weak #5)
     # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py bf16_spot
     step "bf16 existence spot" 180 bf16_spot.json -- \

@@ -5,17 +5,20 @@
 # local chaos relay in `slow` mode, then the curve is folded into the
 # flagship report next to the GB/s tables (bench/regen.py).
 #
+# The ONE committed copy lives in the experiment dir (PR 6 left a
+# duplicate at the repo root; bench/regen.py only ever reads the
+# experiment dir's copy, so the loadgen now writes there directly).
+#
 # Usage: bash scripts/run_serving_curve.sh [out.json] [experiment_dir]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-out="${1:-serving_curve.json}"
 exp="${2:-examples/tpu_run}"
+out="${1:-$exp/serving_curve.json}"
 
 python -m tpu_reductions.serve.loadgen --platform=cpu --clients=8 \
     --requests=32 --n=65536 --out="$out"
 
 if [ -d "$exp" ]; then
-    cp "$out" "$exp/serving_curve.json"
     python -m tpu_reductions.bench.regen "$exp"
 fi

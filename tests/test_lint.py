@@ -462,6 +462,47 @@ def test_red014_whitelists_executor_and_ignores_other_packages(tmp_path):
         == []
 
 
+# ---------------------------------------------------------------- RED015
+
+
+def test_red015_flags_oneshot_jnp_ingestion_in_measured_dirs(tmp_path):
+    src = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def stage(x_np):\n"
+        "    return jnp.asarray(x_np)\n"
+        "def stage2(x_np):\n"
+        "    return jnp.array(x_np)\n"
+    )
+    for scope in ("ops/fixture.py", "bench/fixture.py",
+                  "serve/fixture.py", "utils/fixture.py"):
+        findings = _lint_src(tmp_path, src, name=scope)
+        assert _rules(findings).count("RED015") == 2, scope
+    hit = next(f for f in _lint_src(tmp_path, src, name="ops/fx2.py")
+               if f.rule == "RED015")
+    assert "utils/staging.py" in hit.message
+
+
+def test_red015_whitelists_staging_and_stream_and_honors_waiver(tmp_path):
+    src = ("import jax.numpy as jnp\n"
+           "def stage(x_np):\n"
+           "    return jnp.asarray(x_np)\n")
+    # the two sanctioned bounded-transfer homes
+    assert "RED015" not in _rules(_lint_src(tmp_path, src,
+                                            name="utils/staging.py"))
+    assert "RED015" not in _rules(_lint_src(tmp_path, src,
+                                            name="ops/stream.py"))
+    # outside the measured packages the rule is silent
+    assert "RED015" not in _rules(_lint_src(tmp_path, src,
+                                            name="fixture.py"))
+    waived = ("import jax.numpy as jnp\n"
+              "def stage(x_np):\n"
+              "    # redlint: disable=RED015 -- 4 KiB fixture payload\n"
+              "    return jnp.asarray(x_np)\n")
+    assert "RED015" not in _rules(_lint_src(tmp_path, waived,
+                                            name="ops/fixture.py"))
+
+
 # ---------------------------------------------------------------- RED008
 
 
@@ -589,6 +630,9 @@ def test_cli_positive_fixture_per_rule_exits_nonzero(tmp_path):
         "RED014": ("serve/r14.py", "import jax\n"
                                    "def f(x):\n"
                                    "    return jax.device_get(x)\n"),
+        "RED015": ("ops/r15.py", "import jax.numpy as jnp\n"
+                                 "def f(x_np):\n"
+                                 "    return jnp.asarray(x_np)\n"),
     }
     for rule, (name, src) in fixtures.items():
         f = tmp_path / name

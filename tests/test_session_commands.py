@@ -108,6 +108,13 @@ STEPS = [
       "--backend=xla", "--iterations=8", "--chainreps=2",
       "--out=int_op_spot_xla.json"],
      "int_op_spot_xla.json"),
+    ("python -m tpu_reductions.bench.stream --method=SUM --type=int "
+     "--n=268435456 --chunk-bytes=67108864 --sync-every=4 "
+     "--out=stream_probe.json",
+     "tpu_reductions.bench.stream",
+     ["--method=SUM", "--type=int", "--n=65536", "--chunk-bytes=16384",
+      "--sync-every=2", "--out=stream_probe.json"],
+     "stream_probe.json"),
     ("python -m tpu_reductions.bench.spot --type=bfloat16 "
      "--methods=SUM,MIN,MAX --n=16777216 --iterations=256 "
      "--chainreps=5 --out=bf16_spot.json",

@@ -1,0 +1,338 @@
+"""Streaming pipeline coverage (ops/stream.py, bench/stream.py,
+ops/oracle.IncrementalOracle): chunk-plan invariants, op x dtype
+parity against the one-shot oracle (ragged tails, int32 wraparound
+across chunk boundaries, the f64 dd pair path), checkpoint/resume
+byte-identity, the probe CLI's artifact contract, and the timeline
+CLI's overlap-efficiency summary (docs/STREAMING.md)."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from tpu_reductions.ops import oracle as oracle_mod
+from tpu_reductions.ops.stream import (ChunkPlan, StreamReducer,
+                                       iter_chunks,
+                                       partial_from_jsonable,
+                                       partial_to_jsonable, plan_chunks,
+                                       run_stream)
+from tpu_reductions.utils.rng import host_data
+
+DTYPES = ("int32", "float32", "float64", "bfloat16")
+METHODS = ("SUM", "MIN", "MAX")
+
+
+def _host_oracle(x, method, dtype):
+    x = np.asarray(x, np.float64) if dtype == "float64" else x
+    return oracle_mod.host_reduce(x, method)
+
+
+# ---------------------------------------------------------------- plan
+
+
+def test_plan_chunks_respects_bound_and_pow2_blocks():
+    for dtype in DTYPES:
+        itemsize = 4 if dtype == "float64" else np.dtype(dtype).itemsize
+        for bound in (4096, 65536, 1 << 20):
+            p = plan_chunks(10_000_000, dtype, bound)
+            assert p.chunk_elems * itemsize <= bound or \
+                p.chunk_elems == 1024      # the one-block floor
+            blocks = p.chunk_elems // 1024
+            assert blocks & (blocks - 1) == 0       # power of two
+            assert p.num_chunks == -(-10_000_000 // p.chunk_elems)
+
+
+def test_plan_chunk_span_covers_payload_exactly_once():
+    p = plan_chunks(5000, "int32", 4096)
+    spans = [p.chunk_span(i) for i in range(p.num_chunks)]
+    assert spans[0][0] == 0 and spans[-1][1] == 5000
+    for (a, b), (c, d) in zip(spans, spans[1:]):
+        assert b == c
+    with pytest.raises(IndexError):
+        p.chunk_span(p.num_chunks)
+
+
+def test_plan_rejects_nonpositive_n():
+    with pytest.raises(ValueError):
+        plan_chunks(0, "int32")
+
+
+# ------------------------------------------------- op x dtype parity
+
+
+@pytest.mark.parametrize("method", METHODS)
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_streamed_matches_oneshot_oracle_with_ragged_tail(method, dtype):
+    """The tentpole property: chunked double-buffered accumulation ==
+    the one-shot oracle for every op x dtype, with a ragged last chunk
+    (n deliberately not a multiple of the chunk size)."""
+    n = 4999
+    x = host_data(n, dtype)
+    res = run_stream(x, method, chunk_bytes=4096, sync_every=2)
+    assert res.num_chunks > 2          # genuinely multi-chunk + ragged
+    host = _host_oracle(x, method, dtype)
+    ok, diff = oracle_mod.verify(res.value, host, method, dtype, n)
+    assert ok, (method, dtype, res.value, host, diff)
+
+
+@pytest.mark.parametrize("method", METHODS)
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_incremental_oracle_matches_oneshot(method, dtype):
+    n = 4999
+    x = host_data(n, dtype)
+    plan = plan_chunks(n, dtype, 4096)
+    inc = oracle_mod.IncrementalOracle(method, dtype)
+    for c in iter_chunks(x, plan):
+        inc.update(c)
+    host = _host_oracle(x, method, dtype)
+    ok, diff = oracle_mod.verify(inc.value(), host, method, dtype, n)
+    assert ok, (method, dtype, inc.value(), host, diff)
+    assert inc.count == n
+
+
+def test_int32_sum_wraps_mod_2_32_across_chunk_boundaries():
+    """Values big enough that the running total wraps multiple times
+    MID-STREAM: the streamed device value, the incremental oracle and
+    the one-shot oracle must all agree on the wrapped int32."""
+    n = 20_000
+    x = np.full(n, 2**30 - 17, dtype=np.int32)
+    host = oracle_mod.host_reduce(x, "SUM")
+    res = run_stream(x, "SUM", chunk_bytes=8192, sync_every=4)
+    assert int(res.value) == int(host)
+    inc = oracle_mod.IncrementalOracle("SUM", "int32")
+    for c in iter_chunks(x, plan_chunks(n, "int32", 8192)):
+        inc.update(c)
+    assert int(inc.value()) == int(host)
+    # sanity: it actually wrapped (the unwrapped sum is way past 2^31)
+    assert int(x.astype(np.int64).sum()) > 2**33
+
+
+def test_f64_dd_pair_minmax_exact_with_negatives():
+    """MIN/MAX stream as order-preserving int32 key pairs — bit-exact,
+    full range, negatives included (ops/dd_reduce.py encoding at chunk
+    grain)."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(scale=1e12, size=3000).astype(np.float64)
+    for method, ref in (("MIN", x.min()), ("MAX", x.max())):
+        res = run_stream(x, method, chunk_bytes=4096)
+        assert float(res.value) == float(ref)
+
+
+def test_incremental_oracle_state_roundtrips_through_json():
+    x = host_data(3000, "float32")
+    plan = plan_chunks(3000, "float32", 4096)
+    inc = oracle_mod.IncrementalOracle("SUM", "float32")
+    chunks = list(iter_chunks(x, plan))
+    for c in chunks[:2]:
+        inc.update(c)
+    revived = oracle_mod.IncrementalOracle.from_state(
+        json.loads(json.dumps(inc.state())))
+    for c in chunks[2:]:
+        inc.update(c)
+        revived.update(c)
+    assert float(inc.value()) == float(revived.value())
+
+
+# ------------------------------------------------------ resume / state
+
+
+@pytest.mark.parametrize("dtype", ("int32", "float32", "float64"))
+def test_resume_from_checkpoint_is_byte_identical(dtype):
+    """A stream restarted from a persisted partial (JSON round-trip
+    included) folds only the remaining chunks and lands the EXACT
+    final value of an uninterrupted run — the resume contract
+    docs/STREAMING.md promises."""
+    n = 30_000
+    x = host_data(n, dtype)
+    full = run_stream(x, "SUM", chunk_bytes=8192, sync_every=3)
+    caps = []
+    run_stream(x, "SUM", chunk_bytes=8192, sync_every=3,
+               on_sync=lambda d, p: caps.append(
+                   (d, json.loads(json.dumps(partial_to_jsonable(p))))))
+    assert len(caps) >= 2
+    done, spec = caps[0]
+    resumed = run_stream(x, "SUM", chunk_bytes=8192, sync_every=3,
+                         start_chunk=done,
+                         init_partial=partial_from_jsonable(spec))
+    assert float(np.asarray(resumed.value, np.float64)) \
+        == float(np.asarray(full.value, np.float64))
+    assert resumed.resumed_from == done
+
+
+def test_stream_reducer_holds_at_most_two_chunks():
+    """The bounded-memory contract: the driver loop keeps exactly the
+    in-flight chunk and the prefetched next one (plus the 4 KiB
+    accumulator) — run_stream never stages more than one chunk ahead."""
+    n = 50_000
+    x = host_data(n, "int32")
+    r = StreamReducer("SUM", "int32", n, chunk_bytes=4096)
+    live = []
+    orig_stage = r.stage
+
+    def counting_stage(flat, index):
+        live.append(index)
+        return orig_stage(flat, index)
+
+    r.stage = counting_stage
+    res = run_stream(x, "SUM", reducer=r, sync_every=4)
+    assert res.chunks_done == r.plan.num_chunks
+    # stage(i) is called exactly once per chunk, in order: the loop
+    # structure can only hold chunk i (folding) and i+1 (in flight)
+    assert live == list(range(r.plan.num_chunks))
+
+
+# ------------------------------------------------------------ the CLI
+
+
+def test_stream_cli_commits_artifact_with_overlap_metrics(tmp_path):
+    from tpu_reductions.bench.stream import main
+    out = tmp_path / "stream.json"
+    rc = main(["--method=SUM", "--type=int", "--n=65536",
+               "--chunk-bytes=16384", "--sync-every=2",
+               "--serial-baseline", f"--out={out}"])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert data["complete"] is True
+    assert data["mode"] == "stream"
+    final = next(r for r in data["rows"] if r.get("final"))
+    assert final["status"] == "PASSED"
+    assert final["max_resident_chunks"] == 2
+    for k in ("gbps_sustained", "chunks_per_s", "stream_wall_s",
+              "serial_wall_s", "overlap_efficiency"):
+        assert isinstance(final[k], (int, float)), k
+    assert final["result"] == final["oracle"]   # int32: exact
+    # sync checkpoints carry partial + oracle state (the resume rows)
+    syncs = [r for r in data["rows"] if not r.get("final")]
+    assert syncs and all("partial" in r and "oracle" in r for r in syncs)
+
+
+def test_stream_cli_resumes_interrupted_artifact(tmp_path, monkeypatch):
+    """An InjectedFault mid-stream leaves an incomplete artifact with
+    the measured checkpoints; the re-invocation restores the latest
+    one (never re-staging earlier chunks) and the final value equals
+    an uninterrupted control's exactly."""
+    from tpu_reductions.bench.stream import main
+    from tpu_reductions.faults import inject
+
+    out = tmp_path / "stream.json"
+    args = ["--method=SUM", "--type=int", "--n=65536",
+            "--chunk-bytes=16384", "--sync-every=1", f"--out={out}"]
+    monkeypatch.setenv("TPU_REDUCTIONS_FAULTS", json.dumps(
+        {"stream.chunk": {"after": 2, "action": "raise"}}))
+    inject.reset()
+    with pytest.raises(inject.InjectedFault):
+        main(args)
+    monkeypatch.delenv("TPU_REDUCTIONS_FAULTS")
+    inject.reset()
+    interrupted = json.loads(out.read_text())
+    assert interrupted["complete"] is False
+    banked = [r["chunks_done"] for r in interrupted["rows"]]
+    assert banked == [1, 2]
+
+    rc = main(args)
+    assert rc == 0
+    resumed = json.loads(out.read_text())
+    final = next(r for r in resumed["rows"] if r.get("final"))
+    assert final["resumed_from"] == 2
+    assert final["status"] == "PASSED"
+
+    control = tmp_path / "control.json"
+    rc = main(["--method=SUM", "--type=int", "--n=65536",
+               "--chunk-bytes=16384", "--sync-every=1",
+               f"--out={control}"])
+    assert rc == 0
+    cfinal = next(r for r in
+                  json.loads(control.read_text())["rows"]
+                  if r.get("final"))
+    assert cfinal["result"] == final["result"]   # byte-identical value
+
+
+def test_driver_stream_mode_passes_qa(tmp_path, capsys):
+    from tpu_reductions.bench.driver import main
+    rc = main(["--method=MIN", "--type=float", "--n=32768", "--stream",
+               "--chunk-bytes=16384",
+               f"--logfile={tmp_path / 'red.txt'}"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "&&&& tpu_reductions PASSED" in out
+    assert "Throughput =" in out        # the canonical line still lands
+
+
+# ------------------------------------------------------- observability
+
+
+def test_stream_events_land_in_ledger_and_timeline_summary(tmp_path,
+                                                           monkeypatch):
+    from tpu_reductions.bench.stream import main
+    from tpu_reductions.obs import ledger as ledger_mod
+    from tpu_reductions.obs.timeline import read_ledger, summarize
+
+    led = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER", str(led))
+    try:
+        rc = main(["--method=SUM", "--type=int", "--n=65536",
+                   "--chunk-bytes=16384", "--sync-every=2",
+                   "--serial-baseline",
+                   f"--out={tmp_path / 'stream.json'}"])
+    finally:
+        ledger_mod.disarm()
+    assert rc == 0
+    events, torn = read_ledger(led)
+    assert torn == 0
+    names = [e["ev"] for e in events]
+    for ev in ("stream.start", "stream.chunk", "stream.sync",
+               "stream.serial", "stream.overlap", "stream.end"):
+        assert ev in names, ev
+    # every emitted stream.* name is registered grammar
+    from tpu_reductions.lint.grammar import STREAM_EVENTS
+    assert set(n for n in names if n.startswith("stream.")) \
+        <= set(STREAM_EVENTS)
+    summary = summarize(led, events, torn)
+    st = summary["stream"]
+    assert st["streams"] >= 1 and st["chunks"] >= 4 and st["syncs"] >= 2
+    assert isinstance(st["overlap_efficiency"], float)
+    assert st["gbps_sustained"] > 0 and st["chunks_per_s"] > 0
+    # and the human summary renders the streaming section
+    from tpu_reductions.obs.timeline import summary_markdown
+    md = summary_markdown(summary)
+    assert "streaming pipeline" in md and "overlap efficiency" in md
+
+
+def test_stream_summary_none_without_stream_events():
+    from tpu_reductions.obs.timeline import stream_summary
+    assert stream_summary([{"t": 1.0, "ev": "session.start",
+                            "pid": 1}]) is None
+
+
+# ------------------------------------------------------- staging knobs
+
+
+def test_chunk_knobs_unify_env_flag_and_default(monkeypatch):
+    from tpu_reductions.config import (stage_chunk_bytes,
+                                       stage_threshold_bytes)
+    monkeypatch.delenv("TPU_REDUCTIONS_STAGE_CHUNK_BYTES",
+                       raising=False)
+    monkeypatch.delenv("TPU_REDUCTIONS_STAGE_THRESHOLD_BYTES",
+                       raising=False)
+    assert stage_chunk_bytes() == 256 << 20
+    assert stage_threshold_bytes() == 512 << 20
+    assert stage_chunk_bytes(1024) == 1024      # flag wins
+    monkeypatch.setenv("TPU_REDUCTIONS_STAGE_CHUNK_BYTES", "8192")
+    assert stage_chunk_bytes() == 8192
+    assert stage_chunk_bytes(4096) == 4096      # flag still wins
+    assert stage_threshold_bytes() == 16384     # threshold tracks 2x
+    monkeypatch.setenv("TPU_REDUCTIONS_STAGE_THRESHOLD_BYTES", "50000")
+    assert stage_threshold_bytes() == 50000
+    # the streaming plan reads the same knob
+    assert plan_chunks(1 << 20, "int32").chunk_elems * 4 <= 8192
+
+
+def test_put_chunk_async_refuses_oversize_chunk(monkeypatch):
+    from tpu_reductions.utils.staging import put_chunk_async
+    monkeypatch.setenv("TPU_REDUCTIONS_STAGE_CHUNK_BYTES", "4096")
+    big = np.zeros((64, 128), np.int32)         # 32 KiB >> 4 KiB bound
+    with pytest.raises(ValueError, match="relay"):
+        put_chunk_async(big)
+    small = np.zeros((8, 128), np.int32)
+    assert np.asarray(put_chunk_async(small)).shape == (8, 128)

@@ -1,0 +1,160 @@
+"""Chaos e2e for the streaming pipeline (ISSUE 7 satellite;
+docs/STREAMING.md resume semantics): a scripted relay flap kills a
+real `bench.stream` subprocess mid-stream via the real watchdog
+(exit 3) with the partial-accumulator checkpoint persisted; the
+re-invocation resumes from the last verified chunk (never re-staging
+earlier ones) and lands a final result byte-identical to an
+uninterrupted control run's."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_reductions.faults.relay import FakeRelay
+
+REPO = Path(__file__).resolve().parent.parent
+STREAM_ARGS = ["--platform=cpu", "--method=SUM", "--type=int",
+               "--n=65536", "--chunk-bytes=16384", "--sync-every=1"]
+
+
+def _chaos_env(relay, marker, *, faults=None, ledger=None):
+    env = {**os.environ,
+           "TPU_REDUCTIONS_CHAOS_ARM": "1",
+           "TPU_REDUCTIONS_RELAY_MARKER": str(marker),
+           "TPU_REDUCTIONS_RELAY_PORTS": str(relay.port),
+           "TPU_REDUCTIONS_WATCHDOG_INTERVAL_S": "0.1",
+           "TPU_REDUCTIONS_WATCHDOG_GRACE": "2",
+           "TPU_REDUCTIONS_HEALTH_FILE": str(Path(marker).parent
+                                             / "health.json")}
+    env.pop("TPU_REDUCTIONS_FAULTS", None)
+    env.pop("TPU_REDUCTIONS_LEDGER", None)
+    if faults is not None:
+        env["TPU_REDUCTIONS_FAULTS"] = json.dumps(faults)
+    if ledger is not None:
+        env["TPU_REDUCTIONS_LEDGER"] = str(ledger)
+    return env
+
+
+def _stream(out: Path, env):
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpu_reductions.bench.stream",
+         *STREAM_ARGS, f"--out={out}"],
+        env=env, cwd=str(REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _wait_for_sync_rows(out: Path, k: int, timeout_s: float = 30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            rows = json.loads(out.read_text()).get("rows", [])
+            if sum(1 for r in rows if "partial" in r) >= k:
+                return rows
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    pytest.fail(f"timed out waiting for {k} checkpoint row(s) in {out}")
+
+
+def test_relay_flap_midstream_exit3_then_resume_byte_identical(tmp_path):
+    """The acceptance pipeline for the streaming surface: relay dies
+    while a chunk fold wedges -> watchdog exit 3 with the last
+    verified partial on disk -> re-invocation resumes from it (zero
+    re-staged chunks before the checkpoint) -> final result equals an
+    uninterrupted control's byte-for-byte."""
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    out = tmp_path / "stream.json"
+    led = tmp_path / "ledger.jsonl"
+    with FakeRelay() as relay:
+        # chunk 3 wedges in its device window while the relay dies
+        # underneath it — the round-2 mid-payload death shape
+        env = _chaos_env(relay, marker, ledger=led, faults={
+            "stream.chunk": {"after": 3, "action": "stall",
+                             "seconds": 120}})
+        proc = _stream(out, env)
+        _wait_for_sync_rows(out, 2)     # >= 2 checkpoints banked
+        relay.force("refuse")
+        rc = proc.wait(timeout=60)
+        stderr = proc.stderr.read()
+        assert rc == 3, f"expected watchdog exit 3, got {rc}: {stderr}"
+        interrupted = json.loads(out.read_text())
+        assert interrupted["complete"] is False
+        banked = [r["chunks_done"] for r in interrupted["rows"]
+                  if "partial" in r]
+        assert banked and banked == sorted(banked)
+        last = banked[-1]
+        assert last >= 2                # checkpoints survived the death
+
+        # window 2: relay back, no faults — resume from the checkpoint
+        relay.force("accept")
+        time.sleep(0.15)
+        proc2 = _stream(out, _chaos_env(relay, marker, ledger=led))
+        rc2 = proc2.wait(timeout=60)
+        stderr2 = proc2.stderr.read()
+        assert rc2 == 0, stderr2
+        assert "resumed from checkpoint at chunk" in stderr2
+        resumed = json.loads(out.read_text())
+        assert resumed["complete"] is True
+        final = next(r for r in resumed["rows"] if r.get("final"))
+        assert final["resumed_from"] == last
+        assert final["status"] == "PASSED"
+
+        # uninterrupted control: byte-identical final value
+        out2 = tmp_path / "control.json"
+        proc3 = _stream(out2, _chaos_env(relay, marker))
+        assert proc3.wait(timeout=60) == 0, proc3.stderr.read()
+        control = json.loads(out2.read_text())
+    cfinal = next(r for r in control["rows"] if r.get("final"))
+    assert final["result"] == cfinal["result"]
+    assert final["oracle"] == cfinal["oracle"]
+    assert resumed["complete"] == control["complete"] is True
+
+    # flight-recorder narrative: the resumed stream declares its
+    # start_chunk, and the death window's last act is the banked sync
+    from tpu_reductions.obs.timeline import read_ledger, summarize
+    events, torn = read_ledger(led)
+    assert torn == 0
+    starts = [e["start_chunk"] for e in events
+              if e["ev"] == "stream.start"]
+    assert starts[0] == 0 and last in starts
+    summary = summarize(led, events, torn)
+    assert summary["stream"]["resumed"] >= 1
+
+
+def test_stall_midstream_heartbeat_exit4_checkpoints_survive(tmp_path):
+    """The stalled-relay variant (ports answer, nothing serviced): the
+    stream's heartbeat guard draws exit 4 — not a forever-hang — and
+    the checkpoints persisted before the stall resume cleanly."""
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    out = tmp_path / "stream.json"
+    with FakeRelay() as relay:
+        env = _chaos_env(relay, marker, faults={
+            "stream.chunk": {"after": 3, "action": "stall",
+                             "seconds": 120}})
+        env["TPU_REDUCTIONS_HEARTBEAT_DEADLINE_S"] = "5.0"
+        env["TPU_REDUCTIONS_HEARTBEAT_COMPILE_DEADLINE_S"] = "60"
+        proc = _stream(out, env)
+        _wait_for_sync_rows(out, 2)
+        relay.force("stall")            # wedged-but-ports-open
+        rc = proc.wait(timeout=60)
+        stderr = proc.stderr.read()
+        assert rc == 4, f"expected heartbeat exit 4, got {rc}: {stderr}"
+        assert "HANG" in stderr
+        interrupted = json.loads(out.read_text())
+        assert interrupted["complete"] is False
+
+        relay.force("accept")
+        time.sleep(0.15)
+        proc2 = _stream(out, _chaos_env(relay, marker))
+        assert proc2.wait(timeout=60) == 0, proc2.stderr.read()
+    resumed = json.loads(out.read_text())
+    assert resumed["complete"] is True
+    final = next(r for r in resumed["rows"] if r.get("final"))
+    assert final["status"] == "PASSED" and final["resumed_from"] >= 2

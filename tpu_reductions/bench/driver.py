@@ -601,6 +601,25 @@ def main(argv=None) -> int:
     maybe_arm_for_tpu()
     logger = _make_logger(cfg)
 
+    if cfg.stream:
+        # --stream: the double-buffered chunked pipeline replaces the
+        # stage-then-reduce flow — bounded device memory, no single-
+        # message relay hazard, sustained rates (ops/stream.py,
+        # docs/STREAMING.md); shares the probe CLI's core so the two
+        # spellings cannot diverge (bench/stream.py)
+        from tpu_reductions.bench.stream import run_stream_benchmark
+        row = run_stream_benchmark(
+            cfg.method, cfg.dtype, cfg.n, seed=cfg.seed,
+            chunk_bytes=cfg.chunk_bytes, verify=cfg.verify,
+            log=logger.log)
+        logger.log_master(throughput_line(
+            row["gbps_sustained"], row["stream_wall_s"], cfg.n,
+            devices=1, workgroup=cfg.threads))
+        logger.log(f"streamed {row['num_chunks']} chunk(s): "
+                   f"{row['gbps_sustained']} GB/s sustained, "
+                   f"{row['chunks_per_s']} chunks/s")
+        return qa_finish(name, QAStatus[row["status"]])
+
     if shmoo:
         # Implemented, unlike the reference's stub (reduction.cpp:577-580).
         from tpu_reductions.bench.sweep import run_shmoo

@@ -1,0 +1,316 @@
+"""L5: the streaming-pipeline probe — sustained GB/s + chunks/s, with
+mid-stream resume and a serial stage-then-reduce comparator.
+
+The reference's benchmark shape is stage-everything, then time the
+loop (reduction.cpp:721-745); its scariest inheritance here was the
+4 GiB single-message staging hazard (round 2 killed two live windows
+inside it). This instrument measures the replacement (ops/stream.py,
+docs/STREAMING.md): bounded chunks, host->device transfer
+double-buffered against on-device accumulation, the running partial
+fetched periodically as the honest materialization point — so the
+probe reports a SUSTAINED pipeline rate (GB/s over wall-clock to final
+materialization, chunks/s cadence), not a per-launch number the
+platform's fake-fast sync would corrupt (CLAUDE.md; docs/TIMING.md).
+
+Resume (the live-window contract, bench/resume.py): every periodic
+partial fetch persists a checkpoint row — the device partial
+(ops/stream.partial_to_jsonable) plus the incremental oracle state
+(ops/oracle.IncrementalOracle) — so a relay flap mid-stream loses at
+most `sync_every` chunks: the re-invocation restores the last verified
+partial and folds ONLY the remaining chunks, and because the fold
+sequence over chunk boundaries is identical either way, the resumed
+final value is byte-identical to an uninterrupted run's
+(tests/test_stream_chaos.py proves it against a scripted flap).
+
+`--serial-baseline` stages ALL chunks first, then folds, then fetches
+— the reference's serial shape on identical chunk executables — and
+reports overlap_efficiency = serial_wall / streamed_wall, the
+acceptance number of the streaming pipeline (also folded into the
+timeline CLI's machine summary from the stream.* ledger events,
+obs/timeline.py). Off-chip instrument for the comparator: its per-chunk
+staging forces completion with a 1-element fetch, which on the tunnel
+would pay an RTT per chunk.
+
+CLI:
+    python -m tpu_reductions.bench.stream --method=SUM --type=int \
+        --n=268435456 [--chunk-bytes=16777216 --sync-every=8] \
+        [--serial-baseline] [--platform=cpu] --out=stream_probe.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+from tpu_reductions.config import (DTYPE_ALIASES, METHODS,
+                                   _apply_platform, stage_chunk_bytes)
+
+
+def _payload(n: int, dtype: str, seed: int) -> np.ndarray:
+    """The benchmark payload (reduction.cpp:698-705 analog), native
+    filler when built."""
+    from tpu_reductions.ops import oracle as oracle_mod
+    from tpu_reductions.utils.rng import host_data
+    x = oracle_mod.native_fill(n, dtype, rank=0, seed=seed)
+    if x is None:
+        x = host_data(n, dtype, rank=0, seed=seed)
+    return x
+
+
+def run_serial_baseline(flat: np.ndarray, method: str, *,
+                        chunk_bytes: Optional[int] = None) -> dict:
+    """The comparator: the reference's stage-then-reduce shape
+    (reduction.cpp:721-745) on the SAME chunk geometry and fold
+    executables as the pipeline — every chunk staged to completion
+    first (forced by a 1-element fetch), then folded, then the final
+    materialization. The only variable left between this and
+    run_stream is the overlap."""
+    import jax
+
+    from tpu_reductions.ops.stream import StreamReducer
+    from tpu_reductions.utils import heartbeat
+
+    r = StreamReducer(method, str(flat.dtype), flat.size,
+                      chunk_bytes=chunk_bytes)
+    flat = np.ravel(flat)
+    t0 = time.monotonic()
+    with heartbeat.guard("stream"):
+        r.restore(None)
+        staged = []
+        for i in range(r.plan.num_chunks):
+            s = r.stage(flat, i)
+            # force the transfer to completion before the next stage:
+            # strictly serial staging, no pipeline
+            probe = s[0] if isinstance(s, tuple) else s
+            np.asarray(jax.device_get(probe[:1, :1]))
+            staged.append(s)
+            heartbeat.tick()
+        for s in staged:
+            r.fold(s)
+        partial = r.partial()
+    wall = time.monotonic() - t0
+    value = r.finish(partial)
+    from tpu_reductions.obs import ledger
+    row = {"wall_s": wall,
+           "gbps": (flat.nbytes / wall) / 1e9 if wall > 0 else None,
+           "value": float(np.asarray(value, np.float64))}
+    ledger.emit("stream.serial", wall_s=round(wall, 6),
+                chunks=r.plan.num_chunks,
+                gbps=round(row["gbps"], 4) if row["gbps"] else None)
+    return row
+
+
+def run_stream_benchmark(method: str, dtype: str, n: int, *,
+                         seed: int = 0,
+                         chunk_bytes: Optional[int] = None,
+                         sync_every: int = 8,
+                         verify: bool = True,
+                         serial_baseline: bool = False,
+                         out: Optional[str] = None,
+                         log=print) -> dict:
+    """Run one streamed reduction end to end — payload gen, resume
+    lookup, the double-buffered pipeline with checkpoint persistence,
+    oracle verdict, optional serial comparator — and return the final
+    summary row. Shared by this module's CLI and the driver's --stream
+    mode (bench/driver.py), so the two spellings cannot diverge.
+
+    No reference analog (TPU-native).
+    """
+    from tpu_reductions.bench.resume import Checkpoint
+    from tpu_reductions.ops import oracle as oracle_mod
+    from tpu_reductions.ops.stream import (StreamReducer, iter_chunks,
+                                           partial_from_jsonable,
+                                           partial_to_jsonable,
+                                           run_stream)
+
+    dtype = DTYPE_ALIASES[dtype]
+    reducer = StreamReducer(method, dtype, n, chunk_bytes=chunk_bytes)
+    plan = reducer.plan
+    sync_every = max(1, int(sync_every))
+    # the resume meta contract: a checkpointed partial is only valid
+    # under the exact same plan/oracle configuration
+    meta = {"mode": "stream", "method": reducer.method, "dtype": dtype,
+            "n": n, "seed": seed, "chunk_elems": plan.chunk_elems,
+            "chunk_bytes": plan.chunk_bytes, "sync_every": sync_every,
+            "verify": bool(verify)}
+    ck = Checkpoint(out, meta,
+                    key_fn=lambda r: ("final" if r.get("final")
+                                      else "sync", r.get("chunks_done")))
+
+    # resume: the latest persisted sync checkpoint under this meta
+    start_chunk = 0
+    init_partial = None
+    oracle = oracle_mod.IncrementalOracle(reducer.method, dtype) \
+        if verify else None
+    resumed_row = None
+    candidates = sorted({plan.num_chunks,
+                         *range(sync_every, plan.num_chunks,
+                                sync_every)}, reverse=True)
+    for done in candidates:
+        row = ck.resume(("sync", done),
+                        reusable=lambda r: "partial" in r)
+        if row is not None:
+            resumed_row = row
+            break
+    if resumed_row is not None:
+        start_chunk = int(resumed_row["chunks_done"])
+        init_partial = partial_from_jsonable(resumed_row["partial"])
+        if verify and resumed_row.get("oracle"):
+            oracle = oracle_mod.IncrementalOracle.from_state(
+                resumed_row["oracle"])
+        ck.add(resumed_row)      # carry the banked checkpoint forward
+        log(f"stream: resumed from checkpoint at chunk {start_chunk}/"
+            f"{plan.num_chunks} (interrupted run; partial reused, "
+            "chunks before it never re-staged)")
+
+    x = _payload(n, dtype, seed)
+
+    oracle_s = [0.0]             # host-verification time carved out of
+    last_done = [start_chunk]    # the pipeline wall-clock (module doc)
+
+    def on_sync(done, partial):
+        t0 = time.monotonic()
+        if oracle is not None:
+            for c in iter_chunks(x, plan, last_done[0]):
+                oracle.update(c)
+                last_done[0] += 1
+                if last_done[0] >= done:
+                    break
+        row = {"chunks_done": done,
+               "partial": partial_to_jsonable(partial)}
+        if oracle is not None:
+            row["oracle"] = oracle.state()
+        ck.add(row)
+        oracle_s[0] += time.monotonic() - t0
+
+    res = run_stream(x, reducer.method, sync_every=sync_every,
+                     start_chunk=start_chunk, init_partial=init_partial,
+                     on_sync=on_sync, reducer=reducer)
+    # the pipeline rate excludes the host-oracle + checkpoint-persist
+    # time spent inside sync callbacks — verification overhead, not
+    # pipeline; both comparators exclude it identically
+    stream_wall_s = max(res.wall_s - oracle_s[0], 1e-9)
+    gbps = (res.nbytes / stream_wall_s) / 1e9
+    chunks_per_s = (res.chunks_done - res.resumed_from) / stream_wall_s
+
+    status = "PASSED"
+    oracle_val = None
+    diff = None
+    if oracle is not None:
+        ok, diff = oracle_mod.verify(res.value, oracle.value(),
+                                     reducer.method, dtype, n)
+        oracle_val = float(np.asarray(oracle.value(), np.float64))
+        status = "PASSED" if ok else "FAILED"
+
+    final = {"final": True, "chunks_done": res.chunks_done,
+             "num_chunks": plan.num_chunks,
+             "chunk_elems": plan.chunk_elems,
+             "resumed_from": res.resumed_from,
+             "result": float(np.asarray(res.value, np.float64)),
+             "oracle": oracle_val, "diff": diff, "status": status,
+             "gbps_sustained": round(gbps, 4),
+             "chunks_per_s": round(chunks_per_s, 4),
+             "stream_wall_s": round(stream_wall_s, 6),
+             "oracle_wall_s": round(oracle_s[0], 6),
+             "max_resident_chunks": 2}
+
+    if serial_baseline and start_chunk == 0:
+        serial = run_serial_baseline(x, reducer.method,
+                                     chunk_bytes=chunk_bytes)
+        eff = serial["wall_s"] / stream_wall_s \
+            if stream_wall_s > 0 else None
+        final["serial_wall_s"] = round(serial["wall_s"], 6)
+        final["serial_gbps"] = round(serial["gbps"], 4) \
+            if serial["gbps"] else None
+        final["overlap_efficiency"] = round(eff, 4) if eff else None
+        from tpu_reductions.obs import ledger
+        ledger.emit("stream.overlap",
+                    stream_wall_s=final["stream_wall_s"],
+                    serial_wall_s=final["serial_wall_s"],
+                    efficiency=final["overlap_efficiency"])
+    elif serial_baseline:
+        log("stream: serial baseline skipped (resumed run: the "
+            "streamed wall-clock covers only the remaining chunks and "
+            "would not be comparable)")
+
+    ck.add(final)
+    ck.finalize()
+    return final
+
+
+def main(argv=None) -> int:
+    """CLI entry (module docstring): one streamed reduction, one
+    resumable JSON artifact — the --shmoo/--qatest role of the
+    reference main (reduction.cpp:84-204) for the streaming surface."""
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.bench.stream",
+        description="Streaming-pipeline probe: double-buffered chunked "
+                    "reduction with sustained-GB/s + chunks/s metrics, "
+                    "mid-stream resume, and a serial stage-then-reduce "
+                    "comparator (docs/STREAMING.md)")
+    p.add_argument("--method", type=str, default=None,
+                   help="SUM|MIN|MAX (required, reduction.cpp:124-128)")
+    p.add_argument("--type", dest="dtype", type=str, default="int")
+    p.add_argument("--n", type=int, default=1 << 26)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--chunk-bytes", dest="chunk_bytes", type=int,
+                   default=None,
+                   help="Per-chunk byte bound (default: the unified "
+                        "TPU_REDUCTIONS_STAGE_CHUNK_BYTES knob, else "
+                        "256 MiB — config.stage_chunk_bytes)")
+    p.add_argument("--sync-every", dest="sync_every", type=int, default=8,
+                   help="Chunks between honest partial materializations "
+                        "(= the resume-checkpoint grain; default 8)")
+    p.add_argument("--serial-baseline", action="store_true",
+                   help="Also run the serial stage-then-reduce "
+                        "comparator and report overlap_efficiency "
+                        "(off-chip instrument)")
+    p.add_argument("--no-verify", dest="verify", action="store_false",
+                   help="Skip the incremental host oracle")
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"))
+    p.add_argument("--out", type=str, default=None)
+    ns = p.parse_args(argv)
+    if ns.method is None:
+        p.error("--method={SUM|MIN|MAX} is required "
+                "(reference exits too: reduction.cpp:124-128)")
+    if ns.method.upper() not in METHODS:
+        p.error(f"--method must be one of {METHODS}, got {ns.method!r}")
+    if ns.dtype not in DTYPE_ALIASES:
+        p.error(f"unknown --type {ns.dtype!r}")
+    if ns.n <= 0:
+        p.error("--n must be positive")
+    _apply_platform(ns)
+
+    # flight recorder + watchdog/preflight gates BEFORE any backend
+    # touch (docs/OBSERVABILITY.md; RED011 doctrine)
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("bench.stream", argv=list(argv) if argv else sys.argv[1:])
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()
+
+    def log(msg):
+        print(msg, file=sys.stderr)
+
+    row = run_stream_benchmark(
+        ns.method, ns.dtype, ns.n, seed=ns.seed,
+        chunk_bytes=ns.chunk_bytes, sync_every=ns.sync_every,
+        verify=ns.verify, serial_baseline=ns.serial_baseline,
+        out=ns.out, log=log)
+    eff = row.get("overlap_efficiency")
+    print(f"{row['num_chunks']} chunk(s) x {row['chunk_elems']} elems: "
+          f"{row['gbps_sustained']} GB/s sustained, "
+          f"{row['chunks_per_s']} chunks/s"
+          + (f", overlap x{eff}" if eff else "")
+          + f" [{row['status']}]")
+    if ns.out:
+        print(f"wrote {ns.out}")
+    return 0 if row["status"] == "PASSED" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,56 @@ DTYPE_ALIASES = {
 METHODS = ("SUM", "MIN", "MAX")
 BACKENDS = ("auto", "pallas", "xla")
 
+# ---------------------------------------------------------------------------
+# Host->device transfer bounds — ONE home for the chunk-size doctrine.
+#
+# 2 GiB single messages survived the tunnel relay, 4 GiB killed it twice
+# (round 2; utils/staging.py module docstring has the history). These
+# were two hardcoded constants in utils/staging.py; they now live here so
+# the env knob (TPU_REDUCTIONS_STAGE_CHUNK_BYTES), the CLI flag
+# (--chunk-bytes) and the defaults agree by construction
+# (docs/RESILIENCE.md env-knob table).
+# ---------------------------------------------------------------------------
+
+# Per-message bound: 256 MiB keeps a wide margin under the 4 GiB killer
+# while adding only ~16 messages per surviving GiB.
+DEFAULT_STAGE_CHUNK_BYTES = 256 << 20
+# Payloads at or under this stage in ONE message (no reason to multiply
+# round-trips for the common case). Default: 2x the chunk bound.
+DEFAULT_STAGE_THRESHOLD_BYTES = 512 << 20
+
+
+def _env_bytes(name: str) -> Optional[int]:
+    import os
+    try:
+        v = int(os.environ[name])
+        return v if v > 0 else None
+    except (KeyError, ValueError):
+        return None
+
+
+def stage_chunk_bytes(override: Optional[int] = None) -> int:
+    """The effective per-message host->device chunk bound: explicit
+    argument (the --chunk-bytes flag), else the
+    TPU_REDUCTIONS_STAGE_CHUNK_BYTES env override, else the 256 MiB
+    default. The single source every staging/streaming path reads."""
+    if override is not None and override > 0:
+        return int(override)
+    return _env_bytes("TPU_REDUCTIONS_STAGE_CHUNK_BYTES") \
+        or DEFAULT_STAGE_CHUNK_BYTES
+
+
+def stage_threshold_bytes(override: Optional[int] = None) -> int:
+    """The single-message staging threshold: payloads above it must
+    chunk. Explicit argument, else TPU_REDUCTIONS_STAGE_THRESHOLD_BYTES,
+    else 2x the effective chunk bound (which preserves the historical
+    256/512 MiB pair at defaults and keeps the pair coherent when only
+    the chunk knob moves)."""
+    if override is not None and override > 0:
+        return int(override)
+    return _env_bytes("TPU_REDUCTIONS_STAGE_THRESHOLD_BYTES") \
+        or 2 * stage_chunk_bytes()
+
 # Kernel ids: the reference kept only kernel 6 live and emptied 0-5
 # (reduction_kernel.cu:278-289). We map 6 -> single-pass fold-accumulator
 # Pallas kernel, 7 -> two-pass partials Pallas kernel, 8-10 ->
@@ -106,6 +156,12 @@ class ReduceConfig:
                                      # (robust to tunnel sync stalls)
     iterations_explicit: bool = False   # user set --iterations (chained
                                         # shmoo: treat as a span bound)
+    stream: bool = False             # --stream: double-buffered chunked
+                                     # streaming pipeline (ops/stream.py)
+                                     # instead of stage-then-reduce
+    chunk_bytes: Optional[int] = None   # --chunk-bytes override of the
+                                        # staging/streaming chunk bound
+                                        # (stage_chunk_bytes above)
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
@@ -129,6 +185,8 @@ class ReduceConfig:
             raise ValueError("chain_reps must be positive")
         if self.stat not in ("mean", "median"):
             raise ValueError(f"stat must be mean|median, got {self.stat!r}")
+        if self.chunk_bytes is not None and self.chunk_bytes <= 0:
+            raise ValueError("chunk_bytes must be positive")
 
     @property
     def nbytes(self) -> int:
@@ -294,6 +352,17 @@ def build_single_chip_parser() -> argparse.ArgumentParser:
                    help="Per-iteration statistic feeding GB/s: mean = "
                         "cutGetAverageTimerValue parity; median = robust "
                         "to interconnect/tunnel sync stalls")
+    p.add_argument("--stream", action="store_true",
+                   help="Streaming pipeline mode (ops/stream.py): chunked "
+                        "host->device staging double-buffered against "
+                        "on-device accumulation — bounded device memory, "
+                        "no single-message relay hazard, sustained-GB/s + "
+                        "chunks/s metrics (docs/STREAMING.md)")
+    p.add_argument("--chunk-bytes", dest="chunk_bytes", type=int,
+                   default=None,
+                   help="Per-message host->device chunk bound override "
+                        "(default: TPU_REDUCTIONS_STAGE_CHUNK_BYTES env, "
+                        "else 256 MiB — config.stage_chunk_bytes)")
     return p
 
 
@@ -326,7 +395,7 @@ def parse_single_chip(argv=None):
         device=ns.device, log_file=ns.log_file, master_log=ns.master_log,
         qatest=ns.qatest, verify=ns.verify, trace_dir=ns.trace_dir,
         check=ns.check, timing=ns.timing, chain_reps=ns.chain_reps,
-        stat=ns.stat,
+        stat=ns.stat, stream=ns.stream, chunk_bytes=ns.chunk_bytes,
     )
     _apply_platform(ns)
     if ns.shmoo and not 0 < ns.shmoo_min <= ns.shmoo_max:

@@ -37,10 +37,14 @@ is how a wedged device lease is rehearsed without a device,
 utils/preflight.py), `sched.task` (between the window scheduler's pick
 and its launch, sched/executor.py — a scripted `exit` is the
 deterministic "executor died mid-plan" the plan-resume contract is
-tested against), and `serve.batch` (one coalesced serving launch,
+tested against), `serve.batch` (one coalesced serving launch,
 serve/executor.py — a scripted `raise` proves the engine contains a
-batch crash to explicit error responses, tests/test_serve_chaos.py).
-docs/RESILIENCE.md keeps the list.
+batch crash to explicit error responses, tests/test_serve_chaos.py),
+and `stream.chunk` (one chunk of the streaming pipeline,
+ops/stream.run_stream — a scripted `stall` mid-stream rehearses the
+round-2 relay-death-mid-payload shape against the partial-accumulator
+checkpoint, tests/test_stream_chaos.py). docs/RESILIENCE.md keeps the
+list.
 
 Counters are process-global and monotonic; `reset()` re-arms them for
 in-process tests (subprocesses start fresh by construction).

@@ -86,12 +86,23 @@ SCHED_EVENTS = ("sched.plan", "sched.pick", "sched.skip", "sched.done",
 
 # the serving engine's typed events (tpu_reductions/serve/,
 # docs/SERVING.md) — the per-request distributed trace: enqueue ->
-# coalesce -> launch -> verify -> respond (+ shed and the engine
-# lifecycle brackets). Producer: serve/engine.py via obs/ledger.emit;
-# consumer: obs/timeline.py's per-request latency attribution
+# coalesce -> launch -> verify -> respond (+ shed, the engine
+# lifecycle brackets, and serve.stream for oversized requests routed
+# through the streaming pipeline). Producer: serve/engine.py via
+# obs/ledger.emit; consumer: obs/timeline.py's per-request latency
+# attribution
 SERVE_EVENTS = ("serve.start", "serve.enqueue", "serve.coalesce",
                 "serve.launch", "serve.verify", "serve.respond",
-                "serve.shed", "serve.stop")
+                "serve.shed", "serve.stop", "serve.stream")
+
+# the streaming pipeline's typed events (ops/stream.py +
+# bench/stream.py; docs/STREAMING.md) — start -> per-chunk fold ->
+# periodic honest materialization (sync) -> end, plus the serial
+# comparator (stream.serial) and the overlap verdict (stream.overlap).
+# Consumer: obs/timeline.py's stream_summary (overlap-efficiency
+# attribution in the --json machine summary)
+STREAM_EVENTS = ("stream.start", "stream.chunk", "stream.sync",
+                 "stream.serial", "stream.overlap", "stream.end")
 
 # one complete ledger line, either producer
 EVENT_ROW_RE = re.compile(

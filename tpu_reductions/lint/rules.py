@@ -46,6 +46,12 @@ SCHED_WHITELIST = ("sched/tasks.py",)
 # RED014: the serving layer's device boundary — every launch flows
 # through the admission-controlled executor (ISSUE 6; docs/SERVING.md)
 SERVE_EXECUTOR_WHITELIST = ("serve/executor.py",)
+# RED015: one-shot host->device ingestion (jnp.asarray / jnp.array of a
+# host payload) is the staging-bypass footgun — the bounded-transfer
+# homes are utils/staging.py (chunked one-shot) and ops/stream.py (the
+# double-buffered pipeline); ISSUE 7, docs/STREAMING.md
+STAGE_INGEST_WHITELIST = ("utils/staging.py", "ops/stream.py")
+STAGE_INGEST_SCOPE_DIRS = ("ops", "bench", "serve", "utils", "parallel")
 
 # RED006 applies to the measured packages only: every public surface in
 # ops/ and bench/ must carry its reference citation (PARITY.md).
@@ -159,6 +165,7 @@ def check_python(rel_posix: str, source: str) -> List[RawFinding]:
     out += _red012(rel_posix, ctx)
     out += _red013(rel_posix, ctx)
     out += _red014(rel_posix, ctx)
+    out += _red015(rel_posix, ctx)
     # nested timing scopes can double-report the same call site
     return sorted(set(out), key=lambda f: (f.line, f.rule, f.message))
 
@@ -621,6 +628,43 @@ def _red014(rel: str, ctx: _FileContext) -> List[RawFinding]:
             if name in _SERVE_DEVICE_CALLS:
                 out.append(RawFinding("RED014", node.lineno,
                                       f"{name}(): {msg}"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED015 — one-shot jnp.asarray / jnp.array ingestion of host payloads
+# outside the bounded-transfer modules (utils/staging.py, ops/stream.py).
+# A bare jnp.asarray of a host array is an UNbounded single-message
+# host->device transfer — the exact spelling that, at 4 GiB, killed both
+# round-2 relay windows (RED003 already fences jax.device_put; this
+# closes the jnp spelling of the same staging bypass). Small fixture
+# payloads and already-on-device values carry reason-waivers (ISSUE 7;
+# docs/STREAMING.md).
+# --------------------------------------------------------------------------
+
+_INGEST_CALLS = {"jnp.asarray", "jnp.array",
+                 "jax.numpy.asarray", "jax.numpy.array"}
+
+
+def _red015(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    if _suffix_match(rel, STAGE_INGEST_WHITELIST):
+        return []
+    parts = rel.split("/")
+    if not (set(STAGE_INGEST_SCOPE_DIRS) & set(parts[:-1])):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                _attr_chain(node.func) in _INGEST_CALLS:
+            out.append(RawFinding(
+                "RED015", node.lineno,
+                f"{_attr_chain(node.func)} outside utils/staging.py / "
+                "ops/stream.py — a one-shot jnp ingestion of a host "
+                "payload is an unbounded single-message transfer (the "
+                "4 GiB relay killer's spelling); route through "
+                "utils.staging (bounded chunks) or ops/stream.py (the "
+                "double-buffered pipeline), or waive with the payload's "
+                "size bound as the reason"))
     return out
 
 

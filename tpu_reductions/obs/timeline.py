@@ -256,6 +256,47 @@ def serve_summary(events: List[dict]) -> Optional[dict]:
     return out
 
 
+def stream_summary(events: List[dict]) -> Optional[dict]:
+    """Streaming-pipeline attribution from the stream.* typed events
+    (lint/grammar.py STREAM_EVENTS; ops/stream.py + bench/stream.py).
+    The committed answer to the ISSUE-7 acceptance question: how many
+    chunks streamed at what sustained rate, how often the honest
+    partial materialized, whether any stream resumed mid-payload, and
+    — when the serial comparator ran — the overlap efficiency
+    (serial stage-then-reduce wall-clock over streamed wall-clock;
+    > 1 means transfer/compute overlap paid off). None when no stream
+    ran."""
+    starts = [e for e in events if e["ev"] == "stream.start"]
+    ends = [e for e in events if e["ev"] == "stream.end"]
+    if not starts and not ends:
+        return None
+    out = {
+        "streams": len(starts),
+        "chunks": sum(1 for e in events if e["ev"] == "stream.chunk"),
+        "syncs": sum(1 for e in events if e["ev"] == "stream.sync"),
+        "resumed": sum(1 for e in starts
+                       if isinstance(e.get("start_chunk"), int)
+                       and e["start_chunk"] > 0),
+    }
+    rates = [e["gbps"] for e in ends
+             if isinstance(e.get("gbps"), (int, float))]
+    if rates:
+        out["gbps_sustained"] = round(max(rates), 4)
+    cps = [e["chunks_per_s"] for e in ends
+           if isinstance(e.get("chunks_per_s"), (int, float))]
+    if cps:
+        out["chunks_per_s"] = round(max(cps), 4)
+    overlaps = [e for e in events if e["ev"] == "stream.overlap"]
+    if overlaps:
+        last = overlaps[-1]
+        for key in ("stream_wall_s", "serial_wall_s"):
+            if isinstance(last.get(key), (int, float)):
+                out[key] = last[key]
+        if isinstance(last.get("efficiency"), (int, float)):
+            out["overlap_efficiency"] = last["efficiency"]
+    return out
+
+
 def summarize(path, events: List[dict], torn: int) -> dict:
     """The machine-readable summary JSON (bench/regen collates it into
     report.md; chip_session.sh persists it as obs_timeline.json)."""
@@ -268,6 +309,9 @@ def summarize(path, events: List[dict], torn: int) -> dict:
     serve = serve_summary(events)
     if serve is not None:
         out["serve"] = serve
+    stream = stream_summary(events)
+    if stream is not None:
+        out["stream"] = stream
     if events:
         t0, t1 = events[0]["t"], events[-1]["t"]
         wall = max(t1 - t0, 0.0)
@@ -400,6 +444,28 @@ def summary_markdown(summary: dict) -> str:
                 f"p99 {lat['p99'] * 1e3:.2f} ms"
                 + (f"; queued p50 {q['p50'] * 1e3:.2f} ms / "
                    f"p99 {q['p99'] * 1e3:.2f} ms" if q else ""))
+    stream = summary.get("stream")
+    if stream:
+        # the streaming pipeline's record (ISSUE 7): chunk throughput,
+        # honest-sync cadence, resume count, and — when the serial
+        # comparator ran — the overlap-efficiency verdict
+        lines.append("")
+        lines.append("### streaming pipeline")
+        lines.append("")
+        lines.append(
+            f"{stream['streams']} stream(s), {stream['chunks']} "
+            f"chunk(s), {stream['syncs']} honest sync(s)"
+            + (f", {stream['resumed']} resumed mid-payload"
+               if stream.get("resumed") else "")
+            + (f"; sustained {stream['gbps_sustained']} GB/s"
+               if stream.get("gbps_sustained") is not None else "")
+            + (f", {stream['chunks_per_s']} chunks/s"
+               if stream.get("chunks_per_s") is not None else ""))
+        if stream.get("overlap_efficiency") is not None:
+            lines.append(
+                f"overlap efficiency x{stream['overlap_efficiency']} "
+                f"(serial {stream.get('serial_wall_s', '?')} s vs "
+                f"streamed {stream.get('stream_wall_s', '?')} s)")
     return "\n".join(lines)
 
 

@@ -359,6 +359,7 @@ def _make_stage_fn(method: str, tm: int, threads: int, max_blocks: int):
         staged = maybe_chunked_stage(plane2d.ravel(), plane2d.shape[0],
                                      plane2d.shape[1],
                                      plane2d.dtype.type(0))
+        # redlint: disable=RED015 -- single-message path only when maybe_chunked_stage judged the plane under the staging threshold
         return jnp.asarray(plane2d) if staged is None else staged
 
     def stage_fn(x_np):
@@ -499,6 +500,7 @@ def dd_pallas_reduce_f64(x, method: str = "SUM", *, threads: int = 256,
                       dtype=np.float64)
     hi2d, lo2d, (tm, _, _), s = stage_split_padded(x_np, method, threads,
                                                    max_blocks)
+    # redlint: disable=RED015 -- one-shot convenience entry (tests/CPU hosts, docstring contract); the benchmark path stages through _make_stage_fn's bounded put
     acc_hi, acc_lo = dd_pallas_call(jnp.asarray(hi2d), jnp.asarray(lo2d),
                                     method, tm, interpret=interpret)
     return host_finish_pairs(acc_hi, acc_lo, method, scale_exp=s)

@@ -167,6 +167,108 @@ def native_fill(n: int, dtype: str, rank: int = 0, seed: int = 0
     return out
 
 
+class IncrementalOracle:
+    """Chunk-wise host oracle for streamed reductions (ops/stream.py):
+    the same acceptance reference as `host_reduce` (Kahan sum for
+    reals, reduction.cpp:214-227; linear scans for min/max,
+    reduction.cpp:228-249), fed one bounded chunk at a time so a
+    multi-TB streamed payload never needs a second host-resident copy
+    to verify against.
+
+    Per chunk, `update` runs the one-shot oracle (native Kahan at C
+    speed when built) and combines its result into the running state:
+    int32 SUM wraps mod 2^32 exactly like the device accumulator;
+    float SUM carries a Kahan-compensated (total, comp) pair across
+    chunk boundaries so the cross-chunk combine adds no error class the
+    one-shot oracle doesn't have; MIN/MAX keep the running extreme
+    (exact). `state()`/`from_state()` round-trip through JSON — the
+    resume checkpoint carries the oracle alongside the device partial
+    (bench/stream.py), so a resumed stream verifies without re-reading
+    chunks it already consumed. Parity with the one-shot oracle, chunk
+    boundaries included, is proven in tests/test_stream.py.
+    """
+
+    def __init__(self, method: str, dtype: str) -> None:
+        self.method = method.upper()
+        if self.method not in ("SUM", "MIN", "MAX"):
+            raise ValueError(f"unknown method {method!r}")
+        self.dtype = str(dtype)
+        self.count = 0
+        self._int_total = 0          # int32 SUM: wrapped running total
+        self._sum = 0.0              # float SUM: Kahan pair
+        self._comp = 0.0
+        self._extreme: Optional[float] = None   # MIN/MAX running value
+
+    def update(self, chunk: np.ndarray) -> None:
+        """Fold one host chunk into the running oracle state (module
+        class docstring has the per-class combine rules).
+
+        No reference analog (TPU-native).
+        """
+        if chunk.size == 0:
+            return
+        h = host_reduce(np.asarray(chunk), self.method)
+        self.count += int(chunk.size)
+        if self.method == "SUM":
+            if self.dtype == "int32":
+                # both addends already wrap mod 2^32; their wrapped sum
+                # equals the one-shot wrapped total (associativity of
+                # modular addition — reduction.cpp:748,776-777)
+                self._int_total = int(np.int64(self._int_total)
+                                      + np.int64(np.int32(h))
+                                      & np.int64(0xFFFFFFFF))
+            else:
+                # Knuth two-sum across the chunk boundary: the chunk's
+                # Kahan total joins a Kahan-compensated running pair
+                y = float(h) - self._comp
+                t = self._sum + y
+                self._comp = (t - self._sum) - y
+                self._sum = t
+        else:
+            v = float(h)
+            if self._extreme is None:
+                self._extreme = v
+            elif self.method == "MIN":
+                self._extreme = min(self._extreme, v)
+            else:
+                self._extreme = max(self._extreme, v)
+
+    def value(self):
+        """The oracle value so far, in host_reduce's result conventions
+        (int32 SUM -> np.int32; real SUM -> np.float64; MIN/MAX -> the
+        input dtype) — reduction.cpp:748-780's comparison operand.
+
+        No reference analog (TPU-native).
+        """
+        if self.method == "SUM":
+            if self.dtype == "int32":
+                return np.int64(self._int_total).astype(np.int32)[()]
+            return np.float64(self._sum)
+        if self._extreme is None:
+            raise ValueError("oracle saw no data")
+        return np.dtype(self.dtype).type(self._extreme)
+
+    def state(self) -> dict:
+        """JSON-able snapshot for the stream resume checkpoint
+        (bench/resume rows). No reference analog (TPU-native)."""
+        return {"method": self.method, "dtype": self.dtype,
+                "count": self.count, "int_total": self._int_total,
+                "sum": self._sum, "comp": self._comp,
+                "extreme": self._extreme}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "IncrementalOracle":
+        """Rebuild the oracle a prior (interrupted) stream persisted.
+        No reference analog (TPU-native)."""
+        o = cls(state["method"], state["dtype"])
+        o.count = int(state.get("count", 0))
+        o._int_total = int(state.get("int_total", 0))
+        o._sum = float(state.get("sum", 0.0))
+        o._comp = float(state.get("comp", 0.0))
+        o._extreme = state.get("extreme")
+        return o
+
+
 def verify(device_result, host_result, method: str, dtype: str, n: int
            ) -> tuple[bool, float]:
     """Acceptance check, mirroring reduction.cpp:750-780.

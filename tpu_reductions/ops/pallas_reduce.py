@@ -137,6 +137,7 @@ def stage_padded(x: np.ndarray | jax.Array, tm: int, p: int, t: int,
                                      op.identity(flat.dtype))
         if staged is not None:
             return staged
+    # redlint: disable=RED015 -- reached only when maybe_chunked_stage above judged the payload under the staging threshold (or x is already on device)
     x = jnp.ravel(jnp.asarray(x))
     rows, lanes = padded_2d_shape(x.size, tm, p, t)
     pad = rows * lanes - x.size

@@ -1,0 +1,494 @@
+"""Streaming reduction pipeline: double-buffered host->device staging
+overlapped with on-device accumulation.
+
+The reference stages its whole payload in ONE untimed H2D copy before
+the timed loop (reduction.cpp:721-726) and our port inherited that
+shape — which is exactly why the 4 GiB shmoo cell killed both round-2
+relay windows (utils/staging.py module docstring has the history) and
+why the serving engine capped admissions at 512 MiB. This module
+replaces stage-then-reduce with a pipeline over bounded chunks
+(config.stage_chunk_bytes doctrine), following Zhang et al.
+(arXiv:2112.01075, PAPERS.md): when transport is the bottleneck,
+chunked pipelining that overlaps transfer with compute is the win —
+our tunnel relay IS that bottleneck.
+
+Shape of the pipeline (ROADMAP item 2; docs/STREAMING.md):
+
+  acc  = identity (SUBLANES, LANES) block, resident on device
+  d[0] = put_chunk_async(chunk 0)              # transfer in flight
+  for i in chunks:
+      d[i+1] = put_chunk_async(chunk i+1)      # next transfer launches
+      acc    = fold(acc, d[i])                 # while this fold runs
+      every `sync_every` chunks:
+          partial = device_get(acc)            # ~4 KiB: the honest
+                                               # materialization point
+
+Because jax dispatch is asynchronous, both the put and the fold return
+on dispatch: chunk i+1's host slicing + transfer genuinely overlap
+chunk i's device fold, and at most TWO chunk buffers (plus the tiny
+accumulator block) are resident on device at any instant — an
+arbitrarily large (multi-TB or unbounded) input reduces in O(2 chunks)
+of device memory, and no single message can ever reconstruct the 4 GiB
+relay killer. The periodic `partial` fetch is at once the honest
+timing boundary (CLAUDE.md: per-launch synced timings are bogus on
+this platform; only host materialization is real), the liveness tick
+the heartbeat watchdog keys on, and the resume checkpoint a mid-stream
+relay flap restarts from (bench/stream.py persists it under the
+bench/resume contract).
+
+float64 never touches the device (CLAUDE.md): SUM streams as
+(hi, lo) float32 double-double planes folded with error-free
+transformations, MIN/MAX as order-preserving int32 key pairs — the
+ops/dd_reduce.py encodings, chunk-grain. The streaming SUM split is
+UNscaled (host_split, not host_split_scaled: a per-chunk scale could
+not be combined across chunks), so the f64 SUM range contract is
+|x| < ~3.4e38 — far beyond every benchmark payload (byte/RAND_MAX
+values, reduction.cpp:698-705); MIN/MAX keys are full-range and exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from tpu_reductions.config import stage_chunk_bytes
+from tpu_reductions.ops.registry import ReduceOpSpec, accum_dtype, get_op
+
+# (SUBLANES, LANES) = the 32-bit VPU tile (pallas_guide.md): the
+# accumulator block shape, and the alignment quantum of every chunk
+_SUBLANES = 8
+_LANES = 128
+_BLOCK = _SUBLANES * _LANES
+
+_I32_MAX = np.int32(2**31 - 1)
+_I32_MIN = np.int32(-2**31)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkPlan:
+    """The static chunk geometry of one streamed reduction — part of
+    the resume meta contract (bench/resume.Checkpoint): a partial
+    accumulator checkpointed under one plan must never be resumed
+    under another.
+
+    No reference analog (TPU-native).
+    """
+
+    n: int                 # total payload elements
+    dtype: str
+    chunk_elems: int       # full-chunk element count (BLOCK-aligned,
+    #                        power-of-two block count)
+    num_chunks: int        # ceil(n / chunk_elems)
+    chunk_bytes: int       # the bound chunk_elems was fit under
+
+    @property
+    def chunk_rows(self) -> int:
+        """Staged (rows, LANES) height of one full chunk.
+        No reference analog (TPU-native)."""
+        return self.chunk_elems // _LANES
+
+    def chunk_span(self, index: int) -> tuple[int, int]:
+        """[start, end) element range of chunk `index` (the last chunk
+        is ragged; its staged tail pads with the op identity).
+
+        No reference analog (TPU-native).
+        """
+        if not 0 <= index < self.num_chunks:
+            raise IndexError(f"chunk {index} outside 0..{self.num_chunks}")
+        start = index * self.chunk_elems
+        return start, min(start + self.chunk_elems, self.n)
+
+
+def plan_chunks(n: int, dtype: str, chunk_bytes: Optional[int] = None
+                ) -> ChunkPlan:
+    """Fit the largest power-of-two count of (SUBLANES, LANES) blocks
+    under the per-message bound (config.stage_chunk_bytes — the
+    round-2 relay-hazard doctrine). A power-of-two block count keeps
+    the in-chunk fold a static halving tree on the dd pair path and
+    one retrace-free executable shape everywhere.
+
+    No reference analog (TPU-native).
+    """
+    if n <= 0:
+        raise ValueError("n must be positive")
+    bound = stage_chunk_bytes(chunk_bytes)
+    itemsize = np.dtype(dtype).itemsize
+    if str(dtype) == "float64":
+        # f64 streams as TWO 32-bit planes per chunk (dd pair
+        # encoding): the wire cost per element is unchanged (8 B), but
+        # each plane message must respect the bound on its own
+        itemsize = 4
+    blocks = max(1, bound // (itemsize * _BLOCK))
+    blocks = 1 << (blocks.bit_length() - 1)          # floor to pow2
+    chunk_elems = blocks * _BLOCK
+    num_chunks = -(-n // chunk_elems)
+    return ChunkPlan(n=n, dtype=str(dtype), chunk_elems=chunk_elems,
+                     num_chunks=num_chunks, chunk_bytes=bound)
+
+
+def _jit_fold(method: str, dtype: str, donate: bool):
+    """Jitted (acc, chunk2d) -> acc fold, built once per (method,
+    dtype, donate): the chunk collapses to one (SUBLANES, LANES) block
+    along the leading axis and combines elementwise into the resident
+    accumulator — the grid-stride accumulate of the reference kernel
+    (reduction_kernel.cu:88-98) at chunk grain, with donation so the
+    device never holds two accumulator generations."""
+    import jax
+    import jax.numpy as jnp
+
+    op = get_op(method)
+
+    def fold(acc, chunk2d):
+        folded = op.jnp_reduce(
+            chunk2d.reshape(-1, _SUBLANES, _LANES), axis=0)
+        return op.jnp_combine(acc, folded.astype(acc.dtype))
+
+    return jax.jit(fold, donate_argnums=(0,) if donate else ())
+
+
+def _jit_dd_fold(method: str, donate: bool):
+    """Jitted pair fold for streamed f64: (acc_hi, acc_lo, hi2d, lo2d)
+    -> (acc_hi, acc_lo). In-chunk: a static halving tree of error-free
+    transformations (dd add for SUM, lexicographic key selection for
+    MIN/MAX — ops/dd_reduce.py's kernel arithmetic); cross-chunk: one
+    elementwise pair combine into the resident accumulator blocks. All
+    32-bit, TPU-safe (no f64 anywhere, CLAUDE.md)."""
+    import jax
+
+    from tpu_reductions.ops.dd_reduce import _dd_add, _dd_select
+
+    method = method.upper()
+
+    def fold(acc_hi, acc_lo, hi2d, lo2d):
+        hi = hi2d.reshape(-1, _SUBLANES, _LANES)
+        lo = lo2d.reshape(-1, _SUBLANES, _LANES)
+        while hi.shape[0] > 1:                 # pow2 by plan_chunks
+            h = hi.shape[0] // 2
+            if method == "SUM":
+                hi, lo = _dd_add(hi[:h], lo[:h], hi[h:], lo[h:])
+            else:
+                hi, lo = _dd_select(hi[:h], lo[:h], hi[h:], lo[h:],
+                                    minimum=(method == "MIN"))
+        hi, lo = hi[0], lo[0]
+        if method == "SUM":
+            return _dd_add(acc_hi, acc_lo, hi, lo)
+        return _dd_select(acc_hi, acc_lo, hi, lo,
+                          minimum=(method == "MIN"))
+
+    return jax.jit(fold, donate_argnums=(0, 1) if donate else ())
+
+
+class StreamReducer:
+    """The device half of the streaming pipeline: a persistent
+    (SUBLANES, LANES) on-device partial accumulator (pair of blocks on
+    the f64 dd path) that bounded chunks fold into, with checkpoint/
+    restore at the fetched-partial grain.
+
+    The reference has no analog — its whole payload is device-resident
+    before the first kernel (reduction.cpp:721-726); this class is what
+    removes that requirement. Drive it through `run_stream` (which owns
+    the double-buffer loop, heartbeat, fault points and ledger events)
+    rather than directly.
+    """
+
+    def __init__(self, method: str, dtype: str, n: int, *,
+                 chunk_bytes: Optional[int] = None) -> None:
+        import jax
+
+        self.method = method.upper()
+        self.dtype = str(dtype)
+        self.op: ReduceOpSpec = get_op(self.method)
+        self.plan = plan_chunks(n, self.dtype, chunk_bytes)
+        self.is_dd = self.dtype == "float64"
+        donate = jax.default_backend() == "tpu"
+        if self.is_dd:
+            self._fold = _jit_dd_fold(self.method, donate)
+        else:
+            self._fold = _jit_fold(self.method, self.dtype, donate)
+        self._acc = None       # device block, or (hi, lo) pair
+
+    # -- accumulator lifecycle -----------------------------------------
+
+    def _identity_partial(self) -> "np.ndarray | tuple":
+        if self.is_dd:
+            if self.method == "SUM":
+                z = np.zeros((_SUBLANES, _LANES), np.float32)
+                return z, z.copy()
+            ident = _I32_MAX if self.method == "MIN" else _I32_MIN
+            k = np.full((_SUBLANES, _LANES), ident, np.int32)
+            return k, k.copy()
+        if self.method == "SUM":
+            dt = np.dtype(accum_dtype(self.dtype))
+        else:
+            dt = np.dtype(self.dtype)
+        return np.full((_SUBLANES, _LANES), self.op.identity(dt), dt)
+
+    def restore(self, partial=None) -> None:
+        """Install a partial accumulator on device: None = the op's
+        identity (a fresh stream); otherwise the host-side partial a
+        previous `partial()` fetch produced — the resume-from-last-
+        verified-chunk primitive (bench/stream.py checkpoint rows).
+
+        No reference analog (TPU-native).
+        """
+        from tpu_reductions.utils.staging import put_chunk_async
+        if partial is None:
+            partial = self._identity_partial()
+        if self.is_dd:
+            hi, lo = partial
+            self._acc = (put_chunk_async(np.asarray(hi)),
+                         put_chunk_async(np.asarray(lo)))
+        else:
+            self._acc = put_chunk_async(np.asarray(partial))
+
+    def stage(self, flat: np.ndarray, index: int):
+        """Cut + pad chunk `index` out of the flat host payload and
+        start its (dispatch-async) transfer — the double-buffered half
+        of the reference's one-shot H2D staging (reduction.cpp:721-726).
+        Ragged tails pad with the op's monoid identity (registry.py:
+        identity lanes cannot perturb any result); every chunk ships at
+        the same full-chunk shape so the fold executable never
+        retraces. f64 splits to its two 32-bit planes here (module
+        docstring)."""
+        from tpu_reductions.utils.staging import put_chunk_async
+        start, end = self.plan.chunk_span(index)
+        rows = self.plan.chunk_rows
+        piece = np.ravel(flat)[start:end]
+        if self.is_dd:
+            from tpu_reductions.ops.dd_reduce import (host_key_encode,
+                                                      host_split)
+            piece = np.asarray(piece, np.float64)
+            if self.method == "SUM":
+                hi, lo = host_split(piece)
+                pads = (np.float32(0.0), np.float32(0.0))
+            else:
+                hi, lo = host_key_encode(piece)
+                pads = ((_I32_MAX, _I32_MAX) if self.method == "MIN"
+                        else (_I32_MIN, _I32_MIN))
+            pad = self.plan.chunk_elems - piece.size
+            hi = np.pad(hi, (0, pad), constant_values=pads[0])
+            lo = np.pad(lo, (0, pad), constant_values=pads[1])
+            return (put_chunk_async(hi.reshape(rows, _LANES)),
+                    put_chunk_async(lo.reshape(rows, _LANES)))
+        piece = np.asarray(piece)
+        pad = self.plan.chunk_elems - piece.size
+        if pad:
+            piece = np.pad(piece, (0, pad),
+                           constant_values=self.op.identity(piece.dtype))
+        return put_chunk_async(piece.reshape(rows, _LANES))
+
+    def fold(self, staged) -> None:
+        """Fold one staged chunk into the resident accumulator
+        (dispatch-async; the periodic `partial()` fetch is the
+        completion point) — the grid-stride accumulate
+        (reduction_kernel.cu:88-98) at chunk grain."""
+        assert self._acc is not None, "restore() before fold()"
+        if self.is_dd:
+            hi, lo = staged
+            self._acc = self._fold(self._acc[0], self._acc[1], hi, lo)
+        else:
+            self._acc = self._fold(self._acc, staged)
+
+    def partial(self):
+        """Materialize the running partial on host (~4 KiB) — the
+        honest timing boundary, the heartbeat's forward-progress proof,
+        and the resume checkpoint payload, in one fetch (module
+        docstring).
+
+        No reference analog (TPU-native).
+        """
+        import jax
+        assert self._acc is not None, "restore() before partial()"
+        if self.is_dd:
+            hi = np.asarray(jax.device_get(self._acc[0]))
+            lo = np.asarray(jax.device_get(self._acc[1]))
+            return hi, lo
+        return np.asarray(jax.device_get(self._acc))
+
+    def finish(self, partial=None):
+        """Collapse a fetched partial block to the final scalar on
+        host — the D2H + final-fold tail of the reference flow
+        (reduction.cpp:328-340,377-381), block-sized here because the
+        streamed accumulator IS the partials array. int32 SUM wraps
+        mod 2^32 (np int32 accumulate) to match the device accumulator;
+        f64 decodes through the dd pair finish (bit-exact for MIN/MAX
+        keys)."""
+        if partial is None:
+            partial = self.partial()
+        if self.is_dd:
+            from tpu_reductions.ops.dd_reduce import host_finish_pairs
+            hi, lo = partial
+            return host_finish_pairs(hi, lo, self.method)
+        block = np.asarray(partial)
+        if self.method == "SUM":
+            if block.dtype == np.int32:
+                # exact int64 fold wrapped to int32 == the device's
+                # wrapping int32 accumulator (reduction.cpp:748,776-777)
+                return np.int64(block.sum(dtype=np.int64)
+                                ).astype(np.int32)[()]
+            return np.float64(block.astype(np.float64).sum())
+        return self.op.np_reduce(block)
+
+
+def partial_to_jsonable(partial) -> dict:
+    """Serialize a fetched partial for the resume checkpoint artifact
+    (bench/resume rows are JSON): {'planes': [...], 'dtype': ...} —
+    float planes round-trip exactly (repr-precision floats; i32 keys
+    as ints).
+
+    No reference analog (TPU-native).
+    """
+    planes = list(partial) if isinstance(partial, tuple) \
+        else [np.asarray(partial)]
+    return {"dtype": str(np.asarray(planes[0]).dtype),
+            "planes": [np.asarray(p).ravel().tolist() for p in planes]}
+
+
+def partial_from_jsonable(spec: dict):
+    """Invert partial_to_jsonable back into restore()'s input shape.
+
+    No reference analog (TPU-native).
+    """
+    dt = np.dtype(spec["dtype"])
+    planes = [np.asarray(p, dtype=dt).reshape(_SUBLANES, _LANES)
+              for p in spec["planes"]]
+    return tuple(planes) if len(planes) == 2 else planes[0]
+
+
+@dataclasses.dataclass
+class StreamResult:
+    """Outcome of one streamed reduction (run_stream): the final
+    scalar plus the sustained-rate metrics that replace the per-launch
+    GB/s of the staged benchmark (reduction.cpp:743-745) — wall-clock
+    here runs first-stage to final partial materialization, so the
+    number is honest by construction (module docstring)."""
+
+    value: object                 # np scalar (np.float64 on dd path)
+    chunks_done: int
+    num_chunks: int
+    nbytes: int
+    wall_s: float
+    syncs: int
+    resumed_from: int = 0         # first chunk this run folded
+
+    @property
+    def gbps(self) -> float:
+        """Sustained GB/s over the streamed span (transfer + fold,
+        overlapped — NOT a kernel-only rate). No reference analog
+        (TPU-native)."""
+        return (self.nbytes / self.wall_s) / 1e9 if self.wall_s > 0 \
+            else float("inf")
+
+    @property
+    def chunks_per_s(self) -> float:
+        """Pipeline cadence: chunks folded per second this run.
+        No reference analog (TPU-native)."""
+        done = self.chunks_done - self.resumed_from
+        return done / self.wall_s if self.wall_s > 0 else float("inf")
+
+
+def run_stream(flat: np.ndarray, method: str, *,
+               chunk_bytes: Optional[int] = None,
+               sync_every: int = 8,
+               start_chunk: int = 0,
+               init_partial=None,
+               on_sync=None,
+               reducer: Optional[StreamReducer] = None) -> StreamResult:
+    """Drive the full double-buffered streaming pipeline over a flat
+    host payload (module docstring has the loop shape). This is the
+    ONE sanctioned loop: it owns the `stream.chunk` fault point
+    (faults/inject.py), the heartbeat guard/ticks (a stalled relay
+    mid-stream draws watchdog exit 4, not a hang), and the stream.*
+    flight-recorder events (docs/OBSERVABILITY.md).
+
+    `on_sync(chunks_done, partial, oracle_ready)` fires at every
+    periodic materialization with the fetched partial — bench/stream.py
+    persists it as the resume checkpoint; `start_chunk`/`init_partial`
+    resume a stream from a prior checkpoint (chunks before start_chunk
+    are never re-staged or re-folded). Every fold is sequential over
+    the same chunk boundaries regardless of where a run started, so a
+    resumed stream's final value is byte-identical to an uninterrupted
+    one's.
+
+    The reference's analog is the untimed one-shot stage + timed loop
+    (reduction.cpp:721-745); here staging IS the timed loop, overlapped.
+    """
+    import time
+
+    from tpu_reductions.faults.inject import fault_point
+    from tpu_reductions.obs import ledger
+    from tpu_reductions.utils import heartbeat
+
+    flat = np.ravel(flat)
+    r = reducer or StreamReducer(method, str(flat.dtype), flat.size,
+                                 chunk_bytes=chunk_bytes)
+    plan = r.plan
+    if not 0 <= start_chunk <= plan.num_chunks:
+        raise ValueError(f"start_chunk {start_chunk} outside plan "
+                         f"(0..{plan.num_chunks})")
+    sync_every = max(1, int(sync_every))
+    ledger.emit("stream.start", method=r.method, dtype=r.dtype,
+                n=plan.n, nbytes=int(flat.nbytes),
+                chunk_elems=plan.chunk_elems,
+                num_chunks=plan.num_chunks, start_chunk=start_chunk,
+                sync_every=sync_every)
+    t0 = time.monotonic()
+    partial = None
+    syncs = 0
+    with heartbeat.guard("stream"):
+        r.restore(init_partial)
+        if start_chunk < plan.num_chunks:
+            inflight = r.stage(flat, start_chunk)
+        for i in range(start_chunk, plan.num_chunks):
+            # chaos hook: the relay dying mid-chunk IS the round-2
+            # death shape this pipeline exists to survive
+            # (tests/test_stream_chaos.py drives this point)
+            fault_point("stream.chunk")
+            nxt = r.stage(flat, i + 1) if i + 1 < plan.num_chunks \
+                else None
+            r.fold(inflight)           # overlaps nxt's transfer
+            inflight = nxt
+            heartbeat.tick()
+            done = i + 1
+            ledger.emit("stream.chunk", chunk=i, chunks_done=done,
+                        total=plan.num_chunks)
+            if done % sync_every == 0 or done == plan.num_chunks:
+                partial = r.partial()  # honest materialization point
+                syncs += 1
+                heartbeat.tick()
+                ledger.emit("stream.sync", chunks_done=done,
+                            total=plan.num_chunks,
+                            elapsed_s=round(time.monotonic() - t0, 6))
+                if on_sync is not None:
+                    on_sync(done, partial)
+        if partial is None:            # resumed-at-end degenerate case
+            partial = r.partial()
+    wall = time.monotonic() - t0
+    value = r.finish(partial)
+    span = plan.chunk_span(start_chunk)[0] if start_chunk \
+        < plan.num_chunks else plan.n
+    nbytes = int(flat.nbytes) - span * flat.dtype.itemsize
+    res = StreamResult(value=value, chunks_done=plan.num_chunks,
+                       num_chunks=plan.num_chunks, nbytes=nbytes,
+                       wall_s=wall, syncs=syncs,
+                       resumed_from=start_chunk)
+    ledger.emit("stream.end", chunks=plan.num_chunks,
+                resumed_from=start_chunk, wall_s=round(wall, 6),
+                gbps=round(res.gbps, 4),
+                chunks_per_s=round(res.chunks_per_s, 4))
+    return res
+
+
+def iter_chunks(flat: np.ndarray, plan: ChunkPlan,
+                start: int = 0) -> Sequence[np.ndarray]:
+    """Host-side chunk views under `plan` (the incremental oracle's
+    input grain, ops/oracle.IncrementalOracle) — views, not copies.
+
+    No reference analog (TPU-native).
+    """
+    flat = np.ravel(flat)
+    for i in range(start, plan.num_chunks):
+        s, e = plan.chunk_span(i)
+        yield flat[s:e]

@@ -165,6 +165,25 @@ SESSION_TASKS: Tuple[Task, ...] = (
                     "int_op_spot_xla.json"),
          done_artifact="int_op_spot_xla.json",
          chip_only=True, requires=("smoke",)),
+    Task("stream_probe", "streaming pipeline probe", value=170.0,
+         budget_s=300,
+         # 1 GiB int32 through 64 MiB chunks: 16 chunks of double-
+         # buffered transfer/fold overlap, partial fetched every 4 —
+         # the first on-chip evidence for the pipeline that erases the
+         # 4 GiB staging hazard (ISSUE 7; docs/STREAMING.md). The
+         # serial comparator stays off on chip (its per-chunk forced
+         # fetch pays a tunnel RTT each; overlap efficiency is the
+         # off-chip rehearsal's number)
+         command=("python -m tpu_reductions.bench.stream --method=SUM "
+                  "--type=int --n=268435456 --chunk-bytes=67108864 "
+                  "--sync-every=4 --out=stream_probe.json"),
+         rehearsal_command=("python -m tpu_reductions.bench.stream "
+                            "--method=SUM --type=int --platform=cpu "
+                            "--n=1048576 --chunk-bytes=65536 "
+                            "--sync-every=4 --serial-baseline "
+                            "--out=stream_probe.json"),
+         artifacts=("stream_probe.json",),
+         done_artifact="stream_probe.json"),
     Task("bf16_spot", "bf16 existence spot", value=150.0, budget_s=180,
          command=("python -m tpu_reductions.bench.spot --type=bfloat16 "
                   "--methods=SUM,MIN,MAX --n=16777216 --iterations=256 "

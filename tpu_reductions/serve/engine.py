@@ -69,6 +69,9 @@ class _Admitted:
     t_deadline: Optional[float]          # monotonic absolute, or None
     t_launch: Optional[float] = None
     batch_size: Optional[int] = None
+    streamed: bool = False               # oversized: routed through the
+    #                                      streaming pipeline, never
+    #                                      coalesced (ops/stream.py)
 
     def expired(self, now: float) -> bool:
         return self.t_deadline is not None and now > self.t_deadline
@@ -81,6 +84,8 @@ class ServeEngine:
                  coalesce_window_s: float = 0.005,
                  device_window_s: float = 0.25,
                  max_request_bytes: int = DEFAULT_MAX_REQUEST_BYTES,
+                 stream_oversized: bool = True,
+                 stream_chunk_bytes: Optional[int] = None,
                  executor=None, transport=None,
                  cost_model: Optional[CostModel] = None) -> None:
         if max_queue <= 0 or max_batch <= 0:
@@ -90,6 +95,13 @@ class ServeEngine:
         self._coalesce_window_s = coalesce_window_s
         self._device_window_s = device_window_s
         self._max_request_bytes = max_request_bytes
+        # oversized requests used to be REJECTED at the byte cap (the
+        # cap exists because one coalesced launch must never rebuild
+        # the 4 GiB single-message relay killer); the streaming
+        # pipeline serves them instead in O(2 chunks) of device memory
+        # with every message bounded (ops/stream.py, docs/STREAMING.md)
+        self._stream_oversized = stream_oversized
+        self._stream_chunk_bytes = stream_chunk_bytes
         self._executor = executor          # lazy BatchExecutor when None
         self._transport = transport if transport is not None \
             else RelayTransport()
@@ -178,23 +190,31 @@ class ServeEngine:
         adm = _Admitted(request=request, request_id=rid, pending=pending,
                         t_enqueue=now,
                         t_deadline=(now + request.deadline_s
-                                    if request.deadline_s else None))
+                                    if request.deadline_s else None),
+                        streamed=(request.nbytes
+                                  > self._max_request_bytes))
         with self._cond:
             self._queue.append(adm)
             depth = len(self._queue)
             self._cond.notify_all()
         ledger.emit("serve.enqueue", req=rid, method=request.method,
-                    dtype=request.dtype, n=request.n, depth=depth)
+                    dtype=request.dtype, n=request.n, depth=depth,
+                    streamed=adm.streamed)
         return pending
 
     def _admission_reason(self, request: ReduceRequest) -> Optional[str]:
         if self._stopping or self._stopped:
             return "engine-stopped"
-        if request.nbytes > self._max_request_bytes:
+        oversized = request.nbytes > self._max_request_bytes
+        if oversized and not self._stream_oversized:
             return (f"payload {request.nbytes} B exceeds the "
                     f"{self._max_request_bytes} B per-request cap "
-                    "(single-message relay hazard; utils/staging.py)")
-        if request.dtype == "float64":
+                    "(single-message relay hazard; utils/staging.py) "
+                    "and streaming is disabled")
+        if request.dtype == "float64" and not oversized:
+            # the coalesced stacked launch has no f64 story off-x64;
+            # the streaming pipeline always does (dd pair chunks,
+            # ops/stream.py) — so only the batch path gates here
             caps = self._capabilities()
             if not caps.get("supports_f64", False):
                 return ("float64 unservable on this backend "
@@ -289,12 +309,20 @@ class ServeEngine:
     def _serve_round(self, taken: List[_Admitted]) -> None:
         now = time.monotonic()
         live: List[_Admitted] = []
+        streams: List[_Admitted] = []
         for adm in taken:
             if adm.expired(now):
                 self._respond(adm, "expired",
                               error="deadline passed in queue")
+            elif adm.streamed:
+                streams.append(adm)
             else:
                 live.append(adm)
+        for adm in streams:
+            # oversized requests never coalesce (one stream already
+            # saturates the transfer pipeline); they launch singly
+            # through the streaming path
+            self._launch_stream(adm)
         if not live:
             return
         batches = coalesce(live, max_batch=self._max_batch,
@@ -374,3 +402,54 @@ class ServeEngine:
                                      f"{res['result']!r} vs oracle "
                                      f"{res['host']!r} "
                                      f"(diff {res['diff']:g})"))
+
+    def _launch_stream(self, adm: _Admitted) -> None:
+        """Serve one oversized request through the streaming pipeline
+        (executor.run_stream): same transport gate, deadline checks,
+        crash containment and response vocabulary as a coalesced
+        launch — the request that used to bounce off the byte cap now
+        resolves `ok` while the device never holds more than two
+        chunks of it (docs/STREAMING.md; docs/SERVING.md)."""
+        now = time.monotonic()
+        if adm.expired(now):
+            self._respond(adm, "expired",
+                          error="deadline passed before launch")
+            return
+        r = adm.request
+        ledger.emit("serve.stream", req=adm.request_id, method=r.method,
+                    dtype=r.dtype, n=r.n, nbytes=r.nbytes)
+        t0 = time.monotonic()
+        adm.t_launch = t0
+        adm.batch_size = 1
+        try:
+            self._transport.gate()
+            res = self._ensure_executor().run_stream(
+                r.method, r.dtype, r.n, r.seed,
+                chunk_bytes=self._stream_chunk_bytes)
+        except TransportDead as e:
+            self._respond(adm, "error", error=f"relay dead: {e}")
+            with self._cond:
+                self._shed_locked("relay-dead")
+            return
+        except Exception as e:
+            self._respond(adm, "error",
+                          error=f"{type(e).__name__}: {e}")
+            return
+        dt = time.monotonic() - t0
+        self._cost_model.observe((r.method, r.dtype, r.n), dt)
+        self.stats["batches"] += 1
+        self.stats["batched_requests"] += 1
+        ledger.emit("serve.verify", batch=f"s-{adm.request_id}",
+                    ok=int(res["ok"]), failed=int(not res["ok"]),
+                    exec_s=round(dt, 6))
+        if adm.expired(time.monotonic()):
+            self._respond(adm, "expired",
+                          error="deadline passed before response")
+        elif res["ok"]:
+            self._respond(adm, "ok", result=res["result"])
+        else:
+            self._respond(adm, "error",
+                          error=(f"verification failed: device "
+                                 f"{res['result']!r} vs oracle "
+                                 f"{res['host']!r} "
+                                 f"(diff {res['diff']:g})"))

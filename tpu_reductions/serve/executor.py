@@ -140,3 +140,48 @@ class BatchExecutor:
                 "diff": float(diff),
             })
         return out
+
+    def run_stream(self, method: str, dtype: str, n: int, seed: int,
+                   *, chunk_bytes: Optional[int] = None,
+                   sync_every: int = 8) -> Dict:
+        """Execute ONE oversized request through the streaming
+        pipeline (ops/stream.py; docs/STREAMING.md): bounded chunks
+        double-buffered against on-device accumulation, so the payload
+        that the per-request byte cap used to reject outright — it
+        could reconstruct the 4 GiB single-message relay killer — now
+        serves in O(2 chunks) of device memory with no message ever
+        exceeding the staging bound. Verification is the incremental
+        chunk-wise oracle (ops/oracle.IncrementalOracle), so the host
+        side never needs a second full-payload pass either. Same retry
+        classification and response shape as run_batch."""
+        from tpu_reductions.ops import oracle as oracle_mod
+        from tpu_reductions.ops.stream import (iter_chunks, plan_chunks,
+                                               run_stream)
+        from tpu_reductions.utils.retry import retry_device_call
+        from tpu_reductions.utils.rng import host_data
+
+        fault_point("serve.batch")   # same interruptible-unit hook as
+        #                              a coalesced launch
+
+        x = oracle_mod.native_fill(n, dtype, rank=0, seed=seed)
+        if x is None:
+            x = host_data(n, dtype, rank=0, seed=seed)
+
+        res = retry_device_call(
+            lambda: run_stream(x, method, chunk_bytes=chunk_bytes,
+                               sync_every=sync_every),
+            phase="serve")
+
+        oracle = oracle_mod.IncrementalOracle(method, dtype)
+        for chunk in iter_chunks(x, plan_chunks(n, dtype, chunk_bytes)):
+            oracle.update(chunk)
+        ok, diff = oracle_mod.verify(res.value, oracle.value(),
+                                     method, dtype, n)
+        return {
+            "result": float(np.asarray(res.value, dtype=np.float64)),
+            "ok": bool(ok),
+            "host": float(np.asarray(oracle.value(), dtype=np.float64)),
+            "diff": float(diff),
+            "chunks": res.num_chunks,
+            "gbps": round(res.gbps, 4),
+        }

@@ -34,18 +34,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_reductions.config import stage_chunk_bytes, stage_threshold_bytes
 from tpu_reductions.faults.inject import fault_point
 from tpu_reductions.obs import ledger
 from tpu_reductions.utils import heartbeat
 
-# Per-message bound. 2 GiB messages survived the tunnel, 4 GiB killed
-# it twice; 256 MiB keeps a wide margin while adding only ~16 messages
-# per surviving GiB.
-STAGE_CHUNK_BYTES = 256 << 20
-
-# Payloads at or under this stage in ONE message (the plain jnp.asarray
-# path — no reason to multiply round-trips for the common case).
-CHUNK_THRESHOLD_BYTES = 512 << 20
+# The chunk/threshold bounds (formerly two hardcoded constants here)
+# live in config.py — stage_chunk_bytes() / stage_threshold_bytes() —
+# so the env knob (TPU_REDUCTIONS_STAGE_CHUNK_BYTES), the --chunk-bytes
+# flag and the defaults cannot drift (docs/RESILIENCE.md knob table).
 
 
 @functools.lru_cache(maxsize=2)
@@ -62,7 +59,7 @@ def _insert_fn(donate: bool):
 
 def device_put_chunked(flat: np.ndarray, rows: int, lanes: int,
                        identity, *,
-                       chunk_bytes: int = STAGE_CHUNK_BYTES) -> jax.Array:
+                       chunk_bytes: int | None = None) -> jax.Array:
     """Stage a flat host payload as an identity-padded (rows, lanes)
     device array, transferring at most ~`chunk_bytes` per message.
 
@@ -72,6 +69,7 @@ def device_put_chunked(flat: np.ndarray, rows: int, lanes: int,
     stay far below the int32 ceiling for any physically possible
     payload (a flat element offset would overflow jnp.int32 past 2^31
     elements — and x64 can never be enabled on this platform)."""
+    chunk_bytes = stage_chunk_bytes(chunk_bytes)
     flat = np.ravel(flat)
     if flat.size > rows * lanes:
         raise ValueError(f"payload {flat.size} > staged shape "
@@ -121,11 +119,40 @@ def device_put_chunked(flat: np.ndarray, rows: int, lanes: int,
 
 def maybe_chunked_stage(flat: np.ndarray, rows: int, lanes: int,
                         identity, *,
-                        threshold_bytes: int = CHUNK_THRESHOLD_BYTES,
-                        chunk_bytes: int = STAGE_CHUNK_BYTES):
+                        threshold_bytes: int | None = None,
+                        chunk_bytes: int | None = None):
     """Chunked staging for big host payloads, None for small ones (the
     caller keeps its plain single-message path)."""
-    if not isinstance(flat, np.ndarray) or flat.nbytes <= threshold_bytes:
+    if not isinstance(flat, np.ndarray) or \
+            flat.nbytes <= stage_threshold_bytes(threshold_bytes):
         return None
     return device_put_chunked(flat, rows, lanes, identity,
                               chunk_bytes=chunk_bytes)
+
+
+def put_chunk_async(chunk2d: np.ndarray, *,
+                    chunk_bytes: int | None = None) -> jax.Array:
+    """Dispatch-async host->device put of ONE bounded chunk — the
+    double-buffered staging half of the streaming pipeline
+    (ops/stream.py, docs/STREAMING.md). jax.device_put returns on
+    dispatch, so the transfer of chunk i+1 is in flight while the
+    device is still folding chunk i; the caller's periodic partial
+    fetch is both the completion point and the honest timing boundary
+    (CLAUDE.md: synced per-launch timings are bogus on this platform).
+
+    Refuses oversize chunks loudly instead of quietly re-creating the
+    single-message relay killer this module exists to prevent: the
+    bound is the unified config.stage_chunk_bytes knob, with a small
+    alignment allowance (a chunk padded up to whole (sublane, lane)
+    blocks can legitimately exceed the bound by under one block row).
+    The caller owns heartbeat guards/ticks (a stream loop marks
+    progress per chunk, not per put)."""
+    bound = stage_chunk_bytes(chunk_bytes)
+    allowance = chunk2d.shape[-1] * chunk2d.dtype.itemsize \
+        if chunk2d.ndim else 0
+    if chunk2d.nbytes > bound + 8 * allowance:
+        raise ValueError(
+            f"streaming chunk of {chunk2d.nbytes} B exceeds the "
+            f"{bound} B per-message bound (single-message relay "
+            "hazard; config.stage_chunk_bytes)")
+    return jax.device_put(np.ascontiguousarray(chunk2d))
