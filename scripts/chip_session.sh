@@ -134,6 +134,13 @@ step() {  # step <name> <budget_seconds> <artifact...> -- <cmd...>
             && [ -e "${TPU_REDUCTIONS_LEDGER}" ]; then
         arts+=("${TPU_REDUCTIONS_LEDGER}")
     fi
+    # the compile observatory's per-surface record rides along the same
+    # way: every step's entry point appended its cold/warm observations
+    # (obs/compile.py), and a window death must not lose them
+    if [ -n "${TPU_REDUCTIONS_COMPILE_LEDGER:-}" ] \
+            && [ -e "${TPU_REDUCTIONS_COMPILE_LEDGER}" ]; then
+        arts+=("${TPU_REDUCTIONS_COMPILE_LEDGER}")
+    fi
     if [ -n "${SCHED_STATE:-}" ] && [ -e "${SCHED_STATE:-}" ]; then
         arts+=("$SCHED_STATE")
     fi
@@ -217,6 +224,13 @@ summarize_on_exit() {
     if [ -s "$SCHED_STATE" ]; then
         cp -f -- "$SCHED_STATE" examples/tpu_run/sched_state.json \
             2>/dev/null || true
+    fi
+    # ...and so does the compile observatory's cold/warm record
+    # (ISSUE 8): regen folds the per-surface compile-latency table
+    if [ -n "${TPU_REDUCTIONS_COMPILE_LEDGER:-}" ] \
+            && [ -s "${TPU_REDUCTIONS_COMPILE_LEDGER}" ]; then
+        cp -f -- "$TPU_REDUCTIONS_COMPILE_LEDGER" \
+            examples/tpu_run/compile_ledger.json 2>/dev/null || true
     fi
     if [ -n "$(git status --porcelain -- examples/tpu_run)" ] \
             || [ "$(git log -1 --format=%H -- examples/tpu_run)" \
@@ -386,12 +400,14 @@ fallback_static_session() {
                  exit $rc'
 
     # first on-chip evidence for the streaming pipeline that erases
-    # the 4 GiB staging hazard (ISSUE 7; docs/STREAMING.md)
+    # the 4 GiB staging hazard (ISSUE 7; docs/STREAMING.md); the ONE
+    # committed probe lives in the experiment dir (PR-6 serving_curve
+    # dedup rule), where bench/regen.py folds it into report.md
     # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py stream_probe
-    step "streaming pipeline probe" 300 stream_probe.json -- \
+    step "streaming pipeline probe" 300 examples/tpu_run/stream_probe.json -- \
         python -m tpu_reductions.bench.stream --method=SUM --type=int \
             --n=268435456 --chunk-bytes=67108864 --sync-every=4 \
-            --out=stream_probe.json
+            --out=examples/tpu_run/stream_probe.json
 
     # bf16's first on-chip rows (round-3 weak #5)
     # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py bf16_spot
@@ -451,6 +467,12 @@ trap summarize_on_exit EXIT
 # An explicit env wins (the chaos harness points it at a tmp file).
 : "${TPU_REDUCTIONS_LEDGER:=obs_ledger.jsonl}"
 export TPU_REDUCTIONS_LEDGER
+# The compile observatory's persistent store (obs/compile.py): every
+# step's compiles append their surface/verdict rows here; step()
+# commits it with the step's artifacts and the exit trap copies it
+# next to the flagship evidence for the report fold (ISSUE 8).
+: "${TPU_REDUCTIONS_COMPILE_LEDGER:=compile_ledger.json}"
+export TPU_REDUCTIONS_COMPILE_LEDGER
 obs_event session.start prog=chip_session
 
 if ! relay_ok; then

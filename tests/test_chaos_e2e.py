@@ -60,6 +60,15 @@ def _wait_for_rows(out: Path, n: int, timeout_s: float = 20.0):
     pytest.fail(f"timed out waiting for {n} persisted row(s) in {out}")
 
 
+def _bankable(rows):
+    """(method, verified-or-waived) per row — the equivalence class
+    resume reuse is decided on (bench/resume.default_reusable); the
+    cross-run comparisons below use it because PASSED vs WAIVED is a
+    per-run noise verdict at test scale, never a resume-logic fact."""
+    return [(r["method"], r["status"] in ("PASSED", "WAIVED"))
+            for r in rows]
+
+
 def _spot(out: Path, env, extra=()):
     return subprocess.Popen(
         [sys.executable, "-m", "tpu_reductions.bench.spot",
@@ -107,8 +116,15 @@ def test_chaos_smoke_flap_exit3_then_resume_matches_uninterrupted(tmp_path):
         proc3 = _spot(out2, _chaos_env(relay, marker))
         assert proc3.wait(timeout=60) == 0
         control = json.loads(out2.read_text())
-    assert [(r["method"], r["status"]) for r in resumed["rows"]] \
-        == [(r["method"], r["status"]) for r in control["rows"]]
+    # statuses compare up to the verified-or-waived class the resume
+    # machinery banks on (bench/resume.default_reusable): the chained
+    # WAIVE-on-noise verdict is nondeterministic at this n under host
+    # load (tests/conftest.py stable_chained_timing rationale), so two
+    # INDEPENDENT subprocess runs may draw PASSED vs WAIVED
+    # differently — row identity and bankability are the resume
+    # contract, noise verdicts are not
+    assert _bankable(resumed["rows"]) == _bankable(control["rows"])
+    assert all(ok for _, ok in _bankable(resumed["rows"]))
     assert resumed["complete"] == control["complete"] is True
 
 
@@ -404,8 +420,10 @@ def test_chaos_sched_relay_death_midplan_resumes_without_remeasuring(
         proc3 = _sched_exec(control_dir, _chaos_env(relay, marker))
         assert proc3.wait(timeout=90) == 0, proc3.stderr.read()
         control = json.loads((control_dir / "batch.json").read_text())
-    assert [(r["method"], r["status"]) for r in resumed["rows"]] \
-        == [(r["method"], r["status"]) for r in control["rows"]]
+    # bankability-class comparison, same rationale as the smoke-flap
+    # test above: cross-run status equality is noise-sensitive
+    assert _bankable(resumed["rows"]) == _bankable(control["rows"])
+    assert all(ok for _, ok in _bankable(resumed["rows"]))
     assert resumed["complete"] == control["complete"] is True
 
 

@@ -392,3 +392,31 @@ def test_exit_trap_skips_collation_when_nothing_changed(tmp_path):
     repo, r = _drive(tmp_path, body)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "Window evidence collated" not in _log(repo)
+
+
+def test_step_commits_compile_ledger_alongside_artifacts(tmp_path):
+    """ISSUE 8 satellite: when TPU_REDUCTIONS_COMPILE_LEDGER names the
+    observatory artifact, step() commits it with the step's artifacts
+    (the same ride-along contract the flight-recorder ledger has), and
+    the exit trap copies it next to the flagship evidence."""
+    repo, r = _drive(
+        tmp_path,
+        "export TPU_REDUCTIONS_COMPILE_LEDGER=compile_ledger.json\n"
+        "mkdir -p examples/tpu_run\n"
+        "step 'toy compile' 30 art.json -- bash -c "
+        "'echo data > art.json; "
+        "echo \"{\\\"kind\\\": \\\"compile-observatory\\\"}\" "
+        "> compile_ledger.json'\n"
+        "SESSION_RAN=1\n"
+        "summarize_on_exit\n")
+    assert r.returncode == 0, r.stdout + r.stderr
+    show = subprocess.run(["git", "-C", str(repo), "show",
+                           "--name-only", "HEAD", "--oneline"],
+                          capture_output=True, text=True).stdout
+    # committed with the step (whichever commit it landed in, it must
+    # be tracked)
+    tracked = subprocess.run(["git", "-C", str(repo), "ls-files"],
+                             capture_output=True, text=True).stdout
+    assert "compile_ledger.json" in tracked, show
+    # the exit trap copied it next to the evidence for the regen fold
+    assert (repo / "examples/tpu_run/compile_ledger.json").exists()

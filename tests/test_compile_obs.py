@@ -1,0 +1,295 @@
+"""Compile observatory coverage (ISSUE 8): the cache-fingerprint
+verdict, the span/probe event contract, the persisted per-surface
+ledger and its read model, the instrumented seams, the timeline
+compile section, and the warm CLI's cold->warm acceptance loop."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpu_reductions.obs import compile as obs_compile
+from tpu_reductions.obs import ledger
+from tpu_reductions.utils import compile_cache
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observatory(tmp_path, monkeypatch):
+    """Each test gets its own cache dir + unarmed stores: the module
+    globals (armed CompileLedger, last observation, active cache dir)
+    must never leak between tests."""
+    monkeypatch.delenv("TPU_REDUCTIONS_LEDGER", raising=False)
+    monkeypatch.delenv("TPU_REDUCTIONS_COMPILE_LEDGER", raising=False)
+    monkeypatch.delenv("TPU_REDUCTIONS_NO_COMPILE_CACHE", raising=False)
+    cache_dir = tmp_path / "jc"
+    cache_dir.mkdir()
+    monkeypatch.setattr(compile_cache, "_active_dir", str(cache_dir))
+    ledger.disarm()
+    obs_compile.disarm()
+    yield cache_dir
+    ledger.disarm()
+    obs_compile.disarm()
+
+
+def _lines(path):
+    return [json.loads(line) for line in
+            Path(path).read_text().splitlines() if line.strip()]
+
+
+# ------------------------------------------------- cache fingerprinting
+
+def test_fingerprint_and_verdict(_isolated_observatory):
+    cache = _isolated_observatory
+    before = compile_cache.fingerprint()
+    assert before == frozenset()
+    (cache / "jit_f-abc-cache").write_bytes(b"x")
+    (cache / "jit_f-abc-atime").write_bytes(b"")      # bookkeeping file
+    after = compile_cache.fingerprint()
+    assert after == {"jit_f-abc-cache"}
+    assert compile_cache.verdict(before, after) == "cold"
+    assert compile_cache.verdict(after, after) == "warm"
+    assert compile_cache.verdict(frozenset(), frozenset()) == "untracked"
+
+
+def test_fingerprint_empty_when_disabled(monkeypatch,
+                                         _isolated_observatory):
+    monkeypatch.setenv("TPU_REDUCTIONS_NO_COMPILE_CACHE", "1")
+    assert compile_cache.fingerprint() == frozenset()
+    assert compile_cache.active_dir() is None
+
+
+def test_enable_points_jax_at_the_dir(tmp_path, monkeypatch):
+    import jax
+    assert compile_cache.enable(str(tmp_path / "jc2")) == \
+        str(tmp_path / "jc2")
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / "jc2")
+    # the config.py historical entry delegates here
+    from tpu_reductions.config import enable_compile_cache
+    enable_compile_cache(str(tmp_path / "jc3"))
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / "jc3")
+
+
+# ------------------------------------------------------- span + probe
+
+def test_compile_span_emits_cold_then_warm(tmp_path,
+                                           _isolated_observatory):
+    cache = _isolated_observatory
+    assert ledger.arm(tmp_path / "l.jsonl")
+    with obs_compile.compile_span("k6", rows=8):
+        (cache / "entry-1-cache").write_bytes(b"x")   # compile landed
+    with obs_compile.compile_span("k6", rows=8):
+        pass                                          # served from cache
+    evs = _lines(tmp_path / "l.jsonl")
+    assert [e["ev"] for e in evs] == ["compile.start", "compile.end",
+                                     "compile.start", "compile.end"]
+    ends = [e for e in evs if e["ev"] == "compile.end"]
+    assert ends[0]["verdict"] == "cold" and ends[0]["cache_new"] == 1
+    assert ends[1]["verdict"] == "warm"
+    assert all(e["surface"] == "k6" and e["rows"] == 8 for e in ends)
+    assert obs_compile.last_observation()["verdict"] == "warm"
+
+
+def test_compile_span_records_error_and_reraises(tmp_path,
+                                                 _isolated_observatory):
+    assert ledger.arm(tmp_path / "l.jsonl")
+    store = obs_compile.arm(tmp_path / "cl.json")
+    with pytest.raises(ValueError):
+        with obs_compile.compile_span("k7"):
+            raise ValueError("boom")
+    end = _lines(tmp_path / "l.jsonl")[-1]
+    assert end["ev"] == "compile.end" and "ValueError" in end["error"]
+    # failed compiles never pollute the persisted cold/warm table
+    assert store.rows == []
+
+
+def test_probe_lower_compile_splits_and_hits_cache(tmp_path,
+                                                   monkeypatch):
+    """The real AOT path: a jitted fn probed twice through a real
+    persistent cache — second probe must come back warm with a smaller
+    compile half (the acceptance mechanism at unit scale)."""
+    import jax.numpy as jnp
+    import numpy as np
+    monkeypatch.setattr(compile_cache, "_active_dir", None)
+    assert compile_cache.enable(str(tmp_path / "jc"))
+    monkeypatch.chdir(tmp_path)
+    assert ledger.arm(tmp_path / "l.jsonl")
+    x = np.arange(1024, dtype=np.float32)
+
+    compiled = obs_compile.probe_lower_compile(
+        lambda v: jnp.sum(v * 2), x, surface="xla")
+    assert float(compiled(x)) == pytest.approx(float(x.sum() * 2))
+    obs_compile.probe_lower_compile(
+        lambda v: jnp.sum(v * 2), x, surface="xla")
+    ends = [e for e in _lines(tmp_path / "l.jsonl")
+            if e["ev"] == "compile.end"]
+    assert len(ends) == 2
+    assert ends[0]["verdict"] == "cold"
+    assert ends[1]["verdict"] == "warm"
+    assert ends[0]["lower_s"] >= 0 and ends[0]["compile_s"] > 0
+    assert ends[1]["compile_s"] < ends[0]["compile_s"]
+
+
+# --------------------------------------------- the persisted ledger
+
+def test_compile_ledger_replaces_per_key_and_merges_prior(tmp_path):
+    path = tmp_path / "cl.json"
+    store = obs_compile.CompileLedger(str(path))
+    store.record({"surface": "k6", "platform": "cpu",
+                  "verdict": "cold", "dur_s": 2.0})
+    store.record({"surface": "k6", "platform": "cpu",
+                  "verdict": "cold", "dur_s": 1.8})
+    store.record({"surface": "k6", "platform": "cpu",
+                  "verdict": "warm", "dur_s": 0.1})
+    data = json.loads(path.read_text())
+    assert data["complete"] is False
+    assert len(data["surfaces"]) == 2        # one cold + one warm row
+    cold = next(r for r in data["surfaces"] if r["verdict"] == "cold")
+    assert cold["dur_s"] == 1.8 and cold["count"] == 2
+    store.finalize()
+    assert json.loads(path.read_text())["complete"] is True
+    # a NEW process merges prior rows even from a complete artifact
+    # (the documented deviation: the cache it describes persists too)
+    store2 = obs_compile.CompileLedger(str(path))
+    assert len(store2.rows) == 2
+    store2.record({"surface": "dd", "platform": "cpu",
+                   "verdict": "cold", "dur_s": 3.0})
+    assert len(json.loads(path.read_text())["surfaces"]) == 3
+
+
+def test_arm_prefers_env_then_explicit(tmp_path, monkeypatch):
+    assert obs_compile.arm() is None
+    monkeypatch.setenv("TPU_REDUCTIONS_COMPILE_LEDGER",
+                       str(tmp_path / "env.json"))
+    store = obs_compile.arm()
+    assert store is not None and store.path.endswith("env.json")
+    # bare arm() keeps returning the armed store
+    assert obs_compile.arm() is store
+
+
+def test_compile_model_warmth_and_savings(_isolated_observatory):
+    cache = _isolated_observatory
+    model = obs_compile.CompileModel([
+        {"surface": "k6", "verdict": "cold", "dur_s": 30.0},
+        {"surface": "k6", "verdict": "warm", "dur_s": 2.0},
+        {"surface": "k7", "verdict": "cold", "dur_s": 20.0},
+        {"surface": "k9", "verdict": "warm", "dur_s": 1.0},
+    ])
+    assert model.is_warm("k6")                 # warm row observed
+    assert model.is_warm("k9")
+    # cold-only surface: warm iff the populated cache is still on disk
+    assert not model.is_warm("k7")
+    (cache / "e-cache").write_bytes(b"x")
+    assert model.is_warm("k7")
+    assert model.saved_s(["k6"]) == pytest.approx(28.0)
+    assert model.saved_s(["k6", "k7"]) == pytest.approx(48.0)
+    assert model.status(["k6", "k9"]) == "warm"
+    assert model.status(["k6", "unknown"]) == "mixed"
+    assert model.status(["unknown"]) == "-"
+    assert model.status([]) == "-"
+
+
+def test_compile_model_platform_filter(tmp_path):
+    path = tmp_path / "cl.json"
+    store = obs_compile.CompileLedger(str(path))
+    store.record({"surface": "k6", "platform": "cpu",
+                  "verdict": "warm", "dur_s": 0.1})
+    store.record({"surface": "k7", "platform": "tpu",
+                  "verdict": "warm", "dur_s": 0.2})
+    tpu_model = obs_compile.CompileModel.from_file(str(path),
+                                                   platform="tpu")
+    assert tpu_model.known("k7") and not tpu_model.known("k6")
+
+
+# ------------------------------------------------- instrumented seams
+
+def test_chain_seam_emits_one_compile_event(tmp_path,
+                                            _isolated_observatory):
+    import numpy as np
+
+    from tpu_reductions.ops.chain import make_chained_reduce
+    from tpu_reductions.ops.registry import get_op
+    assert ledger.arm(tmp_path / "l.jsonl")
+    op = get_op("SUM")
+    chained = make_chained_reduce(op.jnp_reduce, op, surface="xla")
+    x2d = np.ones((8, 128), np.int32)
+    chained(x2d, 2)
+    chained(x2d, 3)      # same executable: no second span
+    ends = [e for e in _lines(tmp_path / "l.jsonl")
+            if e["ev"] == "compile.end"]
+    assert len(ends) == 1
+    assert ends[0]["surface"] == "xla" and ends[0]["rows"] == 8
+    assert hasattr(chained, "jitted")      # the warm CLI's AOT handle
+
+
+def test_stream_seam_emits_one_compile_event(tmp_path,
+                                             _isolated_observatory):
+    import numpy as np
+
+    from tpu_reductions.ops.stream import StreamReducer
+    assert ledger.arm(tmp_path / "l.jsonl")
+    r = StreamReducer("SUM", "int32", 4096, chunk_bytes=2048)
+    r.restore(None)
+    flat = np.arange(4096, dtype=np.int32)
+    r.fold(r.stage(flat, 0))
+    r.fold(r.stage(flat, 1))
+    ends = [e for e in _lines(tmp_path / "l.jsonl")
+            if e["ev"] == "compile.end"]
+    assert len(ends) == 1 and ends[0]["surface"] == "stream"
+
+
+def test_serve_seam_emits_once_per_bucket(tmp_path,
+                                          _isolated_observatory):
+    from tpu_reductions.serve import executor as ex
+    assert ledger.arm(tmp_path / "l.jsonl")
+    ex._observed_buckets.clear()
+    b = ex.BatchExecutor()
+    b.run_batch("SUM", "int32", 256, [0])
+    b.run_batch("SUM", "int32", 256, [1])      # same bucket: no span
+    b.run_batch("SUM", "int32", 256, [0, 1])   # bucket 2: new span
+    ends = [e for e in _lines(tmp_path / "l.jsonl")
+            if e["ev"] == "compile.end"]
+    assert [e["batch"] for e in ends] == [1, 2]
+    assert all(e["surface"] == "serve-bucket/sum" for e in ends)
+
+
+# ------------------------------------------------- timeline + report
+
+def test_timeline_compile_section(tmp_path):
+    from tpu_reductions.obs.timeline import (read_ledger, summarize,
+                                             summary_markdown)
+    led = tmp_path / "l.jsonl"
+    with open(led, "w") as f:
+        for e in [
+            {"t": 0.0, "ev": "session.start", "pid": 1, "prog": "x"},
+            {"t": 1.0, "ev": "compile.end", "pid": 1, "surface": "k7",
+             "verdict": "cold", "dur_s": 30.0},
+            {"t": 40.0, "ev": "compile.end", "pid": 1, "surface": "k7",
+             "verdict": "warm", "dur_s": 1.5},
+            {"t": 41.0, "ev": "warm.end", "pid": 1, "cold": 1,
+             "warm": 1, "failed": 0},
+            {"t": 60.0, "ev": "session.end", "pid": 1},
+        ]:
+            f.write(json.dumps(e) + "\n")
+    events, torn = read_ledger(led)
+    summary = summarize(led, events, torn)
+    comp = summary["compile"]
+    assert comp["compiles"] == 2
+    assert comp["compile_s"] == pytest.approx(31.5)
+    assert comp["warm_runs"] == 1
+    rec = comp["surfaces"][0]
+    assert rec["surface"] == "k7" and rec["cold_s"] == 30.0 \
+        and rec["warm_s"] == 1.5 and rec["last_verdict"] == "warm"
+    md = summary_markdown(summary)
+    assert "compile observatory (per-surface cold/warm)" in md
+    assert "| k7 | 30.000 | 1.500 | warm | 2 |" in md
+
+
+def test_compile_markdown_renders_committed_artifact():
+    md = obs_compile.compile_markdown({
+        "complete": True,
+        "surfaces": [{"surface": "k10@4", "platform": "tpu",
+                      "verdict": "cold", "dur_s": 33.2,
+                      "lower_s": 0.4, "compile_s": 32.8, "count": 1}],
+    })
+    assert "| k10@4 | tpu | cold | 0.400 | 32.800 | 33.200 | 1 |" in md
+    assert "observatory: complete" in md

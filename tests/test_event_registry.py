@@ -1,0 +1,104 @@
+"""Event-name drift gate (ISSUE 8 satellite): every literal event name
+emitted anywhere in the tree — python `ledger.emit("...")` call sites
+and shell `obs_event ...` call sites — must be registered in
+lint/grammar.py's event vocabulary (CORE/SHELL/SCHED/SERVE/STREAM/
+COMPILE_EVENTS). The lint fixtures check row SHAPE; this suite checks
+REGISTRATION, so a new seam cannot invent a name the timeline CLI and
+the docs catalogue never heard of."""
+
+import ast
+import re
+from pathlib import Path
+
+from tpu_reductions.lint.grammar import (EVENT_NAME_RE,
+                                         REGISTERED_EVENTS,
+                                         event_registered)
+
+REPO = Path(__file__).resolve().parent.parent
+PY_SCOPES = [REPO / "tpu_reductions", REPO / "bench.py",
+             REPO / "__graft_entry__.py"]
+SHELL_SCOPE = REPO / "scripts"
+
+_SHELL_CALL_RE = re.compile(r"^\s*obs_event\s+([a-z][a-z0-9_.]*)",
+                            re.MULTILINE)
+
+
+def _chain(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _python_emit_sites():
+    """(path, lineno, name) for every emit call with a LITERAL event
+    name. Dynamic names (the spans helper's `name + '.start'`, the
+    ledger CLI's argv passthrough) are out of scope by construction —
+    their inputs are validated at runtime against EVENT_NAME_RE."""
+    out = []
+    files = []
+    for scope in PY_SCOPES:
+        files += sorted(scope.rglob("*.py")) if scope.is_dir() \
+            else [scope]
+    for f in files:
+        tree = ast.parse(f.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _chain(node.func).rsplit(".", 1)[-1] != "emit":
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str):
+                out.append((f.relative_to(REPO), node.lineno,
+                            arg.value))
+    return out
+
+
+def _shell_emit_sites():
+    out = []
+    for f in sorted(SHELL_SCOPE.glob("*.sh")):
+        for m in _SHELL_CALL_RE.finditer(f.read_text()):
+            line = f.read_text()[:m.start()].count("\n") + 1
+            out.append((f.relative_to(REPO), line, m.group(1)))
+    return out
+
+
+def test_every_python_emit_site_is_registered():
+    sites = _python_emit_sites()
+    assert sites, "no emit call sites found — the scanner broke"
+    unregistered = [(str(p), ln, name) for p, ln, name in sites
+                    if not event_registered(name)]
+    assert unregistered == [], (
+        "emit() call sites with event names missing from the "
+        f"lint/grammar.py registry: {unregistered} — add them to the "
+        "matching *_EVENTS tuple (and the docs/OBSERVABILITY.md "
+        "catalogue)")
+
+
+def test_every_shell_emit_site_is_registered():
+    sites = _shell_emit_sites()
+    assert sites, "no obs_event call sites found — the scanner broke"
+    unregistered = [(str(p), ln, name) for p, ln, name in sites
+                    if not event_registered(name)]
+    assert unregistered == [], (
+        "obs_event call sites with event names missing from the "
+        f"lint/grammar.py registry: {unregistered}")
+
+
+def test_registry_names_all_conform_to_the_row_grammar():
+    """The registry itself must stay inside EVENT_NAME_RE — a
+    registered-but-unemittable name would pass the drift gate and then
+    be dropped at runtime by obs/ledger.emit."""
+    bad = sorted(n for n in REGISTERED_EVENTS
+                 if not EVENT_NAME_RE.match(n))
+    assert bad == []
+
+
+def test_registry_has_the_observatory_vocabulary():
+    for name in ("compile.start", "compile.end", "warm.start",
+                 "warm.surface", "warm.end"):
+        assert event_registered(name), name

@@ -377,6 +377,30 @@ def test_red012_waivable_with_reason(tmp_path):
                             name="utils/fixture.py")) == []
 
 
+def test_red012_flags_adhoc_compile_timing_print(tmp_path):
+    # ISSUE 8: an inline compile-duration narration bypasses the
+    # compile observatory's typed events (obs/compile.compile_span)
+    src = ('dt = 1.0\n'
+           'print(f"kernel compiled in {dt:.1f}s")\n')
+    assert "RED012" in _rules(_lint_src(tmp_path, src,
+                                        name="utils/fixture.py"))
+
+
+def test_red012_compile_timing_sanctioned_reporters_and_prose(tmp_path):
+    timed = ('dt = 1.0\n'
+             'print(f"kernel compiled in {dt:.1f}s")\n')
+    # the observatory's own reporters are the sanctioned homes
+    assert "RED012" not in _rules(_lint_src(tmp_path, timed,
+                                            name="bench/warm.py"))
+    assert "RED012" not in _rules(_lint_src(tmp_path, timed,
+                                            name="obs/compile.py"))
+    # prose mentions of compile cost (no duration value against a
+    # unit) stay legal — only timing claims must be typed
+    prose = 'print("first Pallas compile ~20-40 s through the tunnel")\n'
+    assert "RED012" not in _rules(_lint_src(tmp_path, prose,
+                                            name="utils/fixture.py"))
+
+
 # ---------------------------------------------------------------- RED013
 
 

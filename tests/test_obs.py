@@ -415,3 +415,36 @@ def test_bench_metric_event_on_cpu_run(tmp_path, monkeypatch,
     metric = [r for r in _lines(led) if r["ev"] == "bench.metric"]
     assert metric and metric[0]["unit"] == "GB/s"
     assert metric[0]["value"] > 0
+
+
+# ------------------------------------------------------- ledger rotation
+
+def test_ledger_rotation_caps_active_file(tmp_path, monkeypatch):
+    """TPU_REDUCTIONS_LEDGER_MAX_BYTES (ISSUE 8 satellite): the active
+    file rotates whole to `.1` before the cap is crossed, stays
+    crash-safe (every line in BOTH files parses), and the newest events
+    land in the fresh active file."""
+    led = tmp_path / "l.jsonl"
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER_MAX_BYTES", "256")
+    assert ledger.arm(led)
+    for i in range(30):
+        assert ledger.emit("a.b", i=i)
+    rolled = tmp_path / "l.jsonl.1"
+    assert rolled.exists()
+    assert led.stat().st_size <= 256
+    from tpu_reductions.lint.grammar import EVENT_ROW_RE
+    for f in (led, rolled):
+        for raw in f.read_text().splitlines():
+            assert EVENT_ROW_RE.match(raw), raw
+    # the newest event is in the active file, never lost to rotation
+    assert _lines(led)[-1]["i"] == 29
+
+
+def test_ledger_rotation_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPU_REDUCTIONS_LEDGER_MAX_BYTES", raising=False)
+    led = tmp_path / "l.jsonl"
+    assert ledger.arm(led)
+    for i in range(50):
+        assert ledger.emit("a.b", i=i)
+    assert not (tmp_path / "l.jsonl.1").exists()
+    assert len(_lines(led)) == 50

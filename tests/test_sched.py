@@ -228,6 +228,57 @@ def test_planner_fits_against_remaining_window(tmp_path):
     assert "a" in table and "no" in table and "250.0 s" in table
 
 
+def test_priors_shed_compile_seconds_for_warm_tasks(tmp_path):
+    """The ISSUE-8 cold/warm axis: a task whose declared surfaces are
+    all cache-warm gets the static budget minus the cache-banked
+    cold-compile seconds; cold/undeclared tasks keep the full budget,
+    and history medians are never discounted (they embed the compile
+    cost their windows actually paid)."""
+    from tpu_reductions.obs.compile import CompileModel
+    model = CompileModel([
+        {"surface": "k6", "verdict": "cold", "dur_s": 40.0},
+        {"surface": "k6", "verdict": "warm", "dur_s": 2.0},
+    ])
+    pri = Priors(compile_model=model)
+    warm_task = _task("a", budget=100.0, surfaces=("k6",))
+    cold_task = _task("b", budget=100.0, surfaces=("unknown",))
+    plain = _task("c", budget=100.0)
+    assert pri.estimate(warm_task) == pytest.approx(100.0 - 38.0)
+    assert pri.estimate(cold_task) == 100.0
+    assert pri.estimate(plain) == 100.0
+    assert pri.compile_status(warm_task) == "warm"
+    assert pri.compile_status(cold_task) == "-"
+    assert pri.compile_status(plain) == "-"
+    # the floor: a mis-declared surface list cannot zero an estimate
+    huge = CompileModel([
+        {"surface": "k6", "verdict": "cold", "dur_s": 500.0},
+        {"surface": "k6", "verdict": "warm", "dur_s": 1.0},
+    ])
+    assert Priors(compile_model=huge).estimate(warm_task) == \
+        pytest.approx(25.0)
+    # a history median wins over the discount
+    pri2 = Priors({"durations": {"a": [70.0]}, "windows": []},
+                  compile_model=model)
+    assert pri2.estimate(warm_task) == 70.0
+
+
+def test_plan_table_carries_compile_column(tmp_path):
+    from tpu_reductions.obs.compile import CompileModel
+    model = CompileModel([
+        {"surface": "k6", "verdict": "warm", "dur_s": 1.0},
+    ])
+    ts = [_task("a", value=10, budget=100, surfaces=("k6",)),
+          _task("b", value=5, budget=100)]
+    p = planner.plan(ts, _state(tmp_path),
+                     Priors(compile_model=model))
+    by_name_e = {e.task.name: e for e in p.entries}
+    assert by_name_e["a"].compile == "warm"
+    assert by_name_e["b"].compile == "-"
+    table = planner.render_table(p)
+    assert "compile" in table.splitlines()[0]
+    assert "warm" in table
+
+
 # ----------------------------------------------------------- plan state
 
 

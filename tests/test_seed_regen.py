@@ -172,3 +172,30 @@ def test_collect_averages_legacy_pins_threads_and_backend(tmp_path):
     (raw / "run-float64-SUM-2.json").write_text(json.dumps(stray2))
     avgs = collect_averages(tmp_path, log=lambda *a: None)
     assert avgs[("DOUBLE", "SUM")] == 0.87
+
+
+def test_regenerate_folds_stream_and_compile_tables(tmp_path):
+    """ISSUE 8: the committed stream probes (relocated into the
+    experiment dir) and the compile observatory's per-surface table
+    fold into report.md next to the GB/s tables."""
+    out = tmp_path / "exp"
+    raw = out / "single_chip" / "raw_output"
+    raw.mkdir(parents=True)
+    (raw / "run-float64-SUM-0.json").write_text(
+        json.dumps(_grid_row(gbps=150.0)))
+    (out / "stream_probe.json").write_text(json.dumps({
+        "mode": "stream", "method": "SUM", "dtype": "int32",
+        "n": 1 << 26, "complete": True,
+        "rows": [{"final": True, "num_chunks": 16,
+                  "gbps_sustained": 12.5, "chunks_per_s": 3.1,
+                  "overlap_efficiency": 1.4, "status": "PASSED"}]}))
+    (out / "compile_ledger.json").write_text(json.dumps({
+        "kind": "compile-observatory", "version": 1, "complete": True,
+        "surfaces": [{"surface": "k10@4", "platform": "tpu",
+                      "verdict": "cold", "dur_s": 33.2, "count": 1}]}))
+    assert regenerate(out, log=lambda *a: None) is True
+    md = (out / "report.md").read_text()
+    assert "streaming pipeline (committed probes)" in md
+    assert "| stream_probe | SUM/int32 |" in md and "x1.4" in md
+    assert "compile observatory (per-surface cold/warm)" in md
+    assert "k10@4" in md

@@ -110,7 +110,7 @@ STEPS = [
      "int_op_spot_xla.json"),
     ("python -m tpu_reductions.bench.stream --method=SUM --type=int "
      "--n=268435456 --chunk-bytes=67108864 --sync-every=4 "
-     "--out=stream_probe.json",
+     "--out=examples/tpu_run/stream_probe.json",
      "tpu_reductions.bench.stream",
      ["--method=SUM", "--type=int", "--n=65536", "--chunk-bytes=16384",
       "--sync-every=2", "--out=stream_probe.json"],

@@ -1,0 +1,113 @@
+"""bench.warm CLI rehearsal — the ISSUE-8 acceptance pin: a first pass
+produces a resumable compile_ledger.json of cold observations, a
+second invocation of the same instrumented entry point records warm
+verdicts with measurably smaller compile halves, and an interrupted
+pass resumes its banked surfaces."""
+
+import json
+
+import pytest
+
+from tpu_reductions.bench import warm
+from tpu_reductions.obs import compile as obs_compile
+from tpu_reductions.obs import ledger
+from tpu_reductions.utils import compile_cache
+
+# a fast, representative slice of the registry: one Pallas kernel, the
+# XLA chain, the stream fold, the serve bucket
+FAST = "k6,xla,stream,serve-bucket/sum"
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    """Each test runs in its own cwd with its own persistent cache —
+    the repo-level .jax_cache must not leak warmth into the cold
+    assertions."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv("TPU_REDUCTIONS_LEDGER", raising=False)
+    monkeypatch.delenv("TPU_REDUCTIONS_COMPILE_LEDGER", raising=False)
+    monkeypatch.setattr(compile_cache, "default_dir",
+                        lambda: str(tmp_path / "jc"))
+    monkeypatch.setattr(compile_cache, "_active_dir", None)
+    ledger.disarm()
+    obs_compile.disarm()
+    yield
+    ledger.disarm()
+    obs_compile.disarm()
+
+
+def test_warm_cold_then_warm_acceptance(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER",
+                       str(tmp_path / "obs_ledger.jsonl"))
+    assert warm.main(["--platform=cpu", f"--only={FAST}",
+                      "--out=compile_ledger.json"]) == 0
+    data = json.loads((tmp_path / "compile_ledger.json").read_text())
+    assert data["complete"] is True
+    cold = {r["surface"]: r for r in data["surfaces"]
+            if r["verdict"] == "cold"}
+    assert set(cold) == set(FAST.split(","))
+
+    # second invocation: same entry point, fresh probes — every
+    # surface must come back WARM with a smaller compile half
+    obs_compile.disarm()
+    assert warm.main(["--platform=cpu", f"--only={FAST}",
+                      "--out=compile_ledger.json"]) == 0
+    data = json.loads((tmp_path / "compile_ledger.json").read_text())
+    warm_rows = {r["surface"]: r for r in data["surfaces"]
+                 if r["verdict"] == "warm"}
+    assert set(warm_rows) == set(FAST.split(","))
+    for surface, row in warm_rows.items():
+        assert row["compile_s"] < cold[surface]["compile_s"], surface
+
+    # the ledger carries the typed record of both passes
+    evs = [json.loads(line) for line in
+           (tmp_path / "obs_ledger.jsonl").read_text().splitlines()]
+    verdicts = [e["verdict"] for e in evs if e["ev"] == "compile.end"]
+    assert verdicts.count("cold") == len(cold)
+    assert verdicts.count("warm") == len(warm_rows)
+    assert sum(1 for e in evs if e["ev"] == "warm.end") == 2
+
+
+def test_warm_resumes_interrupted_pass(tmp_path):
+    """A compile_ledger.json left complete:false (an interrupted pass)
+    keeps its banked surfaces: the re-invocation probes only the
+    rest — the bench/resume contract, observatory spelling."""
+    store = obs_compile.CompileLedger("compile_ledger.json")
+    store.record({"surface": "k6", "platform": "cpu",
+                  "verdict": "cold", "dur_s": 1.0})
+    # left complete: false — exactly what a mid-pass death leaves
+    assert warm.main(["--platform=cpu", "--only=k6,xla",
+                      "--out=compile_ledger.json"]) == 0
+    data = json.loads((tmp_path / "compile_ledger.json").read_text())
+    surfaces = {(r["surface"], r["verdict"]) for r in data["surfaces"]}
+    # k6's banked cold row survived untouched; xla was probed fresh
+    assert ("k6", "cold") in surfaces
+    k6 = next(r for r in data["surfaces"]
+              if r["surface"] == "k6" and r["verdict"] == "cold")
+    assert k6["dur_s"] == 1.0          # not re-measured
+    assert any(s == "xla" for s, _ in surfaces)
+    assert data["complete"] is True
+
+
+def test_warm_reports_failed_surface_and_continues(tmp_path,
+                                                   monkeypatch):
+    """A surface that cannot lower is reported, not fatal (the report
+    IS the product, like smoke's manifest)."""
+    def boom(n):
+        raise RuntimeError("no lowering for you")
+
+    monkeypatch.setattr(warm, "surfaces",
+                        lambda: [("broken", boom), warm._xla_surface()])
+    assert warm.main(["--platform=cpu",
+                      "--out=compile_ledger.json"]) == 0
+    data = json.loads((tmp_path / "compile_ledger.json").read_text())
+    assert {r["surface"] for r in data["surfaces"]} == {"xla"}
+
+
+def test_warm_all_failed_exits_nonzero(tmp_path, monkeypatch):
+    def boom(n):
+        raise RuntimeError("nope")
+
+    monkeypatch.setattr(warm, "surfaces", lambda: [("broken", boom)])
+    assert warm.main(["--platform=cpu",
+                      "--out=compile_ledger.json"]) == 1

@@ -26,7 +26,8 @@ from typing import Optional
 import numpy as np
 
 from tpu_reductions.config import (KERNEL_MXU, KERNEL_SINGLE_PASS,
-                                   LIVE_KERNELS, ReduceConfig)
+                                   KERNEL_STREAM, LIVE_KERNELS,
+                                   ReduceConfig)
 from tpu_reductions.faults.inject import fault_point
 from tpu_reductions.ops import oracle as oracle_mod
 from tpu_reductions.ops.registry import tolerance
@@ -193,7 +194,7 @@ def _make_chained_fn(cfg: ReduceConfig, backend: str):
     if backend == "xla":
         from tpu_reductions.ops.registry import get_op
         op = get_op(cfg.method)
-        return make_chained_reduce(op.jnp_reduce, op)
+        return make_chained_reduce(op.jnp_reduce, op, surface="xla")
 
     import jax
 
@@ -205,7 +206,8 @@ def _make_chained_fn(cfg: ReduceConfig, backend: str):
         _stage, dd_core, _finish = make_dd_device_reduce(
             cfg.method, cfg.n, threads=cfg.threads,
             max_blocks=cfg.max_blocks)
-        pair_chained = make_chained_reduce(dd_core, get_op(cfg.method))
+        pair_chained = make_chained_reduce(dd_core, get_op(cfg.method),
+                                           surface="dd")
 
         def chained(staged, k):
             hi2d, lo2d, _s = staged
@@ -218,7 +220,12 @@ def _make_chained_fn(cfg: ReduceConfig, backend: str):
         cfg.method, cfg.n, cfg.dtype, threads=cfg.threads,
         max_blocks=cfg.max_blocks, kernel=cfg.kernel,
         cpu_thresh=cfg.cpu_thresh, stream_buffers=cfg.stream_buffers)
-    return make_chained_reduce(core, op)
+    # the compile-observatory surface id (obs/compile.py): kernel 10's
+    # DMA depth is part of the executable's identity, the others are
+    # the kernel number alone
+    surface = (f"k{cfg.kernel}@{cfg.stream_buffers}"
+               if cfg.kernel == KERNEL_STREAM else f"k{cfg.kernel}")
+    return make_chained_reduce(core, op, surface=surface)
 
 
 def _make_logger(cfg: ReduceConfig) -> BenchLogger:

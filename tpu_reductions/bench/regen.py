@@ -197,6 +197,42 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                 "(serving_curve.json)")
         except (OSError, ValueError, KeyError, TypeError) as e:
             log(f"regen: serving_curve.json unusable ({e}); skipped")
+    # the streaming pipeline's committed probes (ISSUE 7 evidence,
+    # ISSUE 8 relocation: the ONE copy lives in the experiment dir —
+    # the PR-6 serving_curve dedup rule applied to stream artifacts)
+    probes = {}
+    for name in ("stream_probe", "stream_hazard"):
+        pf = out / f"{name}.json"
+        if pf.exists():
+            try:
+                probes[name] = json.loads(pf.read_text())
+            except (OSError, ValueError):
+                log(f"regen: {name}.json unusable; skipped")
+    if probes:
+        try:
+            from tpu_reductions.bench.stream import stream_markdown
+            with open(paths["md"], "a") as f:
+                f.write("\n" + stream_markdown(probes) + "\n")
+            log(f"regen: appended streaming-pipeline table "
+                f"({', '.join(sorted(probes))})")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: stream probes unusable ({e}); skipped")
+    # the compile observatory's per-surface cold/warm table (ISSUE 8):
+    # chip_session's exit trap copies compile_ledger.json next to the
+    # evidence; the compile axis ships with the numbers it explains
+    cl_file = out / "compile_ledger.json"
+    if cl_file.exists():
+        try:
+            from tpu_reductions.obs.compile import (compile_markdown,
+                                                    load as load_compile)
+            cl = load_compile(cl_file)
+            if cl is not None:
+                with open(paths["md"], "a") as f:
+                    f.write("\n" + compile_markdown(cl) + "\n")
+                log("regen: appended compile-latency table "
+                    "(compile_ledger.json)")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: compile_ledger.json unusable ({e}); skipped")
     pdf = generate_pdf(out, platform=platform,
                        data={"avgs": {}, "single_chip": sc or None,
                              "calibration": cal,

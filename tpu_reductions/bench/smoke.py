@@ -34,21 +34,28 @@ from tpu_reductions.config import (KERNEL_ELEMENTWISE, KERNEL_MXU,
                                    _apply_platform)
 from tpu_reductions.utils.logging import BenchLogger
 
-# (name, dtype, method, kernel, threads, stream_buffers) — every
-# surface the next window would otherwise lower for the first time
-# inside a race (docs/PERF_NOTES.md hypotheses 1/4/5). The dd pair
+# (name, dtype, method, kernel, threads, stream_buffers, surface) —
+# every surface the next window would otherwise lower for the first
+# time inside a race (docs/PERF_NOTES.md hypotheses 1/4/5). The dd pair
 # cases carry kernel=None: f64 dispatch picks its own pair path, and
 # SUM (two_sum tree) vs MIN (order-preserving key pair) are distinct
-# lowerings.
-CASES: Tuple[Tuple[str, str, str, Optional[int], int, int], ...] = (
-    ("k10 stream depth=2", "int32", "SUM", KERNEL_STREAM, 512, 2),
-    ("k10 stream depth=4", "int32", "SUM", KERNEL_STREAM, 512, 4),
-    ("k10 stream depth=8", "int32", "SUM", KERNEL_STREAM, 512, 8),
-    ("k9 mxu f32", "float32", "SUM", KERNEL_MXU, 256, 4),
-    ("k9 mxu bf16", "bfloat16", "SUM", KERNEL_MXU, 256, 4),
-    ("k8 big-tile t=2048", "int32", "SUM", KERNEL_ELEMENTWISE, 2048, 4),
-    ("dd f64 sum pair-tree", "float64", "SUM", None, 256, 4),
-    ("dd f64 min key-pair", "float64", "MIN", None, 256, 4),
+# lowerings. `surface` is the compile-observatory id the case's chained
+# executable emits under (obs/compile.py, via the driver's chain seam)
+# — the manifest row carries it so the smoke verdicts and the
+# compile_ledger.json cold/warm table join on one vocabulary.
+CASES: Tuple[Tuple[str, str, str, Optional[int], int, int, str], ...] = (
+    ("k10 stream depth=2", "int32", "SUM", KERNEL_STREAM, 512, 2,
+     "k10@2"),
+    ("k10 stream depth=4", "int32", "SUM", KERNEL_STREAM, 512, 4,
+     "k10@4"),
+    ("k10 stream depth=8", "int32", "SUM", KERNEL_STREAM, 512, 8,
+     "k10@8"),
+    ("k9 mxu f32", "float32", "SUM", KERNEL_MXU, 256, 4, "k9"),
+    ("k9 mxu bf16", "bfloat16", "SUM", KERNEL_MXU, 256, 4, "k9"),
+    ("k8 big-tile t=2048", "int32", "SUM", KERNEL_ELEMENTWISE, 2048, 4,
+     "k8"),
+    ("dd f64 sum pair-tree", "float64", "SUM", None, 256, 4, "dd"),
+    ("dd f64 min key-pair", "float64", "MIN", None, 256, 4, "dd"),
 )
 
 
@@ -71,7 +78,7 @@ def run_smoke(n: int = 1 << 20, logger: Optional[BenchLogger] = None,
 
     logger = logger or BenchLogger(None, None)
     rows: List[dict] = []
-    for name, dtype, method, kernel, threads, depth in CASES:
+    for name, dtype, method, kernel, threads, depth, surface in CASES:
         prior = resume(name) if resume is not None else None
         if prior is not None:
             logger.log(f"smoke {name}: resumed from prior manifest")
@@ -92,12 +99,14 @@ def run_smoke(n: int = 1 << 20, logger: Optional[BenchLogger] = None,
             res = retry_device_call(
                 lambda: run_benchmark(cfg, logger=logger),
                 log=logger.log)
-            row = {"name": name, "status": res.status.name,
+            row = {"name": name, "surface": surface,
+                   "status": res.status.name,
                    "ok": res.status.name in ("PASSED", "WAIVED"),
                    "seconds": round(time.perf_counter() - t0, 2),
                    "error": None}
         except Exception as e:   # the manifest IS the product
-            row = {"name": name, "status": "FAILED", "ok": False,
+            row = {"name": name, "surface": surface, "status": "FAILED",
+                   "ok": False,
                    "seconds": round(time.perf_counter() - t0, 2),
                    "error": f"{type(e).__name__}: {e}"[:500]}
         rows.append(row)

@@ -242,6 +242,44 @@ def run_stream_benchmark(method: str, dtype: str, n: int, *,
     return final
 
 
+def stream_markdown(probes: dict) -> str:
+    """The streaming-pipeline table for report.md — pure formatting
+    over committed probe artifacts ({label: parsed stream artifact};
+    bench/regen.py folds examples/tpu_run/stream_probe.json and, when
+    present, stream_hazard.json from the experiment dir — the ISSUE-8
+    relocation of the stray root copies).
+
+    No reference analog (TPU-native).
+    """
+    lines = ["## streaming pipeline (committed probes)", "",
+             "| probe | method/dtype | n | chunks | GB/s sustained "
+             "| chunks/s | overlap | status |",
+             "|---|---|---|---|---|---|---|---|"]
+    any_row = False
+    for label in sorted(probes):
+        data = probes[label]
+        if not isinstance(data, dict):
+            continue
+        final = next((r for r in reversed(data.get("rows", []))
+                      if isinstance(r, dict) and r.get("final")), None)
+        if final is None:
+            continue
+        any_row = True
+        eff = final.get("overlap_efficiency")
+        lines.append(
+            f"| {label} | {data.get('method', '?')}/"
+            f"{data.get('dtype', '?')} | {data.get('n', '?')} "
+            f"| {final.get('num_chunks', '?')} "
+            f"| {final.get('gbps_sustained', '-')} "
+            f"| {final.get('chunks_per_s', '-')} "
+            f"| {f'x{eff}' if eff is not None else '-'} "
+            f"| {final.get('status', '?')} |")
+    if not any_row:
+        lines.append("| (no completed probes) | - | - | - | - | - "
+                     "| - | - |")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     """CLI entry (module docstring): one streamed reduction, one
     resumable JSON artifact — the --shmoo/--qatest role of the

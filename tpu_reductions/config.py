@@ -409,29 +409,13 @@ def parse_single_chip(argv=None):
 
 def enable_compile_cache(path: str | None = None) -> None:
     """Point JAX's persistent compilation cache at a repo-local dir
-    (untracked). Round-4 lesson: the tunnel relay FLAPS — live windows
-    can be minutes long, and a first Pallas compile through the tunnel
-    costs 20-40 s; with the cache, a compile paid in one window is free
-    in the next (and across the session's processes: every chip-session
-    step re-compiles the same programs today). Best-effort by contract:
-    a backend that cannot serialize executables just skips caching (JAX
-    logs it), and any config failure degrades to the uncached behavior
-    we have always had. TPU_REDUCTIONS_NO_COMPILE_CACHE=1 disables.
-    """
-    import os
-    if os.environ.get("TPU_REDUCTIONS_NO_COMPILE_CACHE") == "1":
-        return
-    if path is None:
-        path = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            ".jax_cache")
-    try:
-        import jax
-        jax.config.update("jax_compilation_cache_dir", path)
-    except Exception as e:   # never let cache plumbing fail a run
-        import sys
-        print(f"# compile cache unavailable (non-fatal): {e}",
-              file=sys.stderr)
+    (untracked). The wiring lives in utils/compile_cache.py now — ONE
+    home for the cache-dir plumbing AND the fingerprint introspection
+    the compile observatory reads (obs/compile.py; ISSUE 8) — and this
+    historical entry keeps every `_apply_platform` caller on it.
+    TPU_REDUCTIONS_NO_COMPILE_CACHE=1 disables."""
+    from tpu_reductions.utils.compile_cache import enable
+    enable(path)
 
 
 def _apply_platform(ns) -> None:

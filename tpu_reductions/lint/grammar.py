@@ -104,6 +104,60 @@ SERVE_EVENTS = ("serve.start", "serve.enqueue", "serve.coalesce",
 STREAM_EVENTS = ("stream.start", "stream.chunk", "stream.sync",
                  "stream.serial", "stream.overlap", "stream.end")
 
+# the compile observatory's typed events (obs/compile.py; ISSUE 8 —
+# docs/OBSERVABILITY.md "reading the compile table"): every XLA/Pallas
+# compile bracketed with its surface id, lower/compile split where the
+# surface permits, and the .jax_cache cold/warm verdict
+# (utils/compile_cache.py fingerprints); warm.* brackets the off-chip
+# warming pass (bench/warm.py). Consumer: obs/timeline.py's
+# compile_summary (per-surface cold/warm compile-latency table)
+COMPILE_EVENTS = ("compile.start", "compile.end", "warm.start",
+                  "warm.surface", "warm.end")
+
+# every other typed event the python producers emit (the seam table in
+# docs/OBSERVABILITY.md) — registered HERE so the emitters and the
+# drift gate (tests/test_event_registry.py) share one vocabulary: an
+# emit call site whose name is missing from this module fails tier-1
+CORE_EVENTS = (
+    "session.start", "session.end",                    # obs/ledger.py
+    "hb.phase",                                        # utils/heartbeat.py
+    "staging.start", "staging.chunk", "staging.end",   # utils/staging.py
+    "staging.stage",                                   # bench/driver.py
+    "chain.trip", "chain.slope", "timing.loop",        # utils/timing.py
+    "retry.attempt", "retry.fatal",                    # utils/retry.py
+    "watchdog.arm", "watchdog.exit",                   # utils/watchdog.py
+    "preflight.verdict",                               # utils/preflight.py
+    "resume.decision", "resume.reuse",                 # bench/resume.py
+    "artifact.persist",                                # bench/resume.py
+    "bench.metric", "bench.outage",                    # bench.py
+    "fault.fire",                                      # faults/inject.py
+    "firstrow.mark",                                   # bench/firstrow.py
+    "sweep.cell", "sweep.rank",                        # bench/sweep.py
+)
+
+# the shell producer's vocabulary (scripts/obs_event.sh call sites in
+# scripts/*.sh) — same registry, same drift gate
+SHELL_EVENTS = (
+    "session.start", "session.end", "session.abort", "session.fallback",
+    "step.start", "step.end",
+    "watcher.arm", "watcher.fire", "watcher.session_end",
+    "watcher.rearm", "watcher.defer", "watcher.retire", "watcher.expire",
+    "supervisor.spawn", "supervisor.respawn", "supervisor.retire",
+    "supervisor.defer",
+)
+
+REGISTERED_EVENTS = frozenset(CORE_EVENTS + SHELL_EVENTS + SCHED_EVENTS
+                              + SERVE_EVENTS + STREAM_EVENTS
+                              + COMPILE_EVENTS)
+
+
+def event_registered(name: str) -> bool:
+    """Whether an event name belongs to the registered vocabulary
+    (tests/test_event_registry.py asserts this for every literal emit
+    site in the tree — shape conformance alone let unregistered names
+    drift in)."""
+    return name in REGISTERED_EVENTS
+
 # one complete ledger line, either producer
 EVENT_ROW_RE = re.compile(
     r'^\{"t": [0-9]+(?:\.[0-9]+)?, "ev": "[a-z][a-z0-9_.]*", '
@@ -114,6 +168,24 @@ def looks_like_event(text: str) -> bool:
     """RED012 trigger: does this literal attempt the event-row grammar?
     Pure string logic (same contract as check_literal below)."""
     return EVENT_KEY in text
+
+
+# RED012's compile-timing extension (ISSUE 8 satellite): a printed
+# literal that narrates a compile duration — "compiled in {dt:.1f}s" —
+# is exactly the ad-hoc observation the compile observatory
+# (obs/compile.py) exists to make typed and crash-safe. The pattern
+# wants the word stem AND a duration (an interpolated field or a digit
+# run directly against a seconds unit), so prose mentions of compiles
+# ("first compile ~20-40 s through the tunnel") in logs stay legal
+# while a timing claim must route through compile_span.
+COMPILE_TIMING_RE = re.compile(
+    r"(?i)compil\w*[^\n]*(?:\x00|\d)(?:s|ms|sec(?:ond)?s?)\b")
+
+
+def looks_like_compile_timing(text: str) -> bool:
+    """RED012 trigger #2: does this literal narrate a compile duration
+    inline instead of routing through obs/compile.compile_span?"""
+    return bool(COMPILE_TIMING_RE.search(text))
 
 
 # --------------------------------------------------------------------------

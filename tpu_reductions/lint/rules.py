@@ -40,6 +40,12 @@ OBS_WHITELIST = ("obs/ledger.py",)
 # RED012 polices the runtime/measurement packages where event-shaped
 # lines would otherwise leak out as prints
 OBS_SCOPE_DIRS = ("utils", "bench", "obs", "faults", "serve", "sched")
+# RED012's compile-timing extension (ISSUE 8): inline compile-duration
+# narration is sanctioned only in the observatory itself and the warm
+# CLI's human report — everywhere else the observation must be a typed
+# compile.* event (obs/compile.compile_span)
+COMPILE_TIMING_WHITELIST = ("obs/ledger.py", "obs/compile.py",
+                            "bench/warm.py")
 # RED013: wall-clock budgets / step orderings live in the scheduler's
 # task registry and nowhere else (ISSUE 5; docs/SCHEDULER.md)
 SCHED_WHITELIST = ("sched/tasks.py",)
@@ -697,7 +703,9 @@ def _red012(rel: str, ctx: _FileContext) -> List[RawFinding]:
             continue
         for a in list(node.args) + [kw.value for kw in node.keywords]:
             text = _literal_text(a)
-            if text is not None and grammar.looks_like_event(text):
+            if text is None:
+                continue
+            if grammar.looks_like_event(text):
                 out.append(RawFinding(
                     "RED012", node.lineno,
                     "event-shaped line emitted outside obs/ledger — "
@@ -706,4 +714,14 @@ def _red012(rel: str, ctx: _FileContext) -> List[RawFinding]:
                     "timeline CLI); route through "
                     "tpu_reductions.obs.ledger.emit (or "
                     "scripts/obs_event.sh from shell)"))
+            elif grammar.looks_like_compile_timing(text) and \
+                    not _suffix_match(rel, COMPILE_TIMING_WHITELIST):
+                out.append(RawFinding(
+                    "RED012", node.lineno,
+                    "ad-hoc compile-timing print — compile durations "
+                    "are typed observations now (compile.start/end, "
+                    "lint/grammar.py COMPILE_EVENTS); bracket the "
+                    "compile with tpu_reductions.obs.compile."
+                    "compile_span so the verdict lands in the ledger "
+                    "and the per-surface table, not in a log line"))
     return out

@@ -22,6 +22,11 @@ is the machine-readable record:
     timeline from a ledger, attributes wall-clock per phase, and
     computes window-utilization metrics (text report, summary JSON,
     and the WINDOW_SUMMARY.md markdown table).
+  * `obs.compile` — the compile observatory (ISSUE 8): every
+    XLA/Pallas compile bracketed with its surface id and `.jax_cache`
+    cold/warm verdict (utils/compile_cache fingerprints), persisted
+    per-surface into compile_ledger.json; the scheduler's cold/warm
+    duration priors and the report's compile-latency table read it.
 
 Strictly host-side by contract: instrumentation adds no device work, no
 sync, and never emits inside a timed region (docs/OBSERVABILITY.md has

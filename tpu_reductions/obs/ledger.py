@@ -57,9 +57,20 @@ from tpu_reductions.lint.grammar import EVENT_NAME_RE
 
 ENV_PATH = "TPU_REDUCTIONS_LEDGER"
 ENV_DISABLE = "TPU_REDUCTIONS_OBS_DISABLE"
+# Optional size cap (bytes) with rotate-to-`.1` (docs/RESILIENCE.md):
+# round-5 watch logs showed multi-hour armed sessions appending
+# unboundedly. Rotation is one atomic rename of the full file to
+# `<path>.1` (replacing any previous rollover) followed by a fresh
+# O_APPEND open — the active file stays crash-safe (no truncation, no
+# partial copy), and by-path producers (scripts/obs_event.sh) land in
+# the new file on their next append. A concurrent python writer
+# holding the old fd keeps appending to the rotated file until its own
+# next size check — lines are never lost, only filed under `.1`.
+ENV_MAX_BYTES = "TPU_REDUCTIONS_LEDGER_MAX_BYTES"
 
 _fd: Optional[int] = None
 _path: Optional[str] = None
+_max_bytes: Optional[int] = None
 _session_open = False
 
 
@@ -111,18 +122,25 @@ def arm(path: Optional[str | os.PathLike] = None) -> Optional[str]:
         except OSError:
             pass
     _fd, _path = fd, path
+    global _max_bytes
+    try:
+        _max_bytes = int(os.environ.get(ENV_MAX_BYTES, ""))
+        if _max_bytes <= 0:
+            _max_bytes = None
+    except ValueError:
+        _max_bytes = None
     return path
 
 
 def disarm() -> None:
     """Close the ledger (tests; subprocesses end via session.end)."""
-    global _fd, _path, _session_open
+    global _fd, _path, _session_open, _max_bytes
     if _fd is not None:
         try:
             os.close(_fd)
         except OSError:
             pass
-    _fd, _path, _session_open = None, None, False
+    _fd, _path, _session_open, _max_bytes = None, None, False, None
 
 
 def _current_phase() -> Optional[str]:
@@ -173,6 +191,8 @@ def emit(ev: str, **fields) -> bool:
         for k, v in fields.items():
             rec[str(k)] = _clean(v)
         line = (json.dumps(rec) + "\n").encode("utf-8", "replace")
+        if _max_bytes is not None:
+            _maybe_rotate(len(line))
         os.write(_fd, line)          # ONE write: line-atomic append
         os.fsync(_fd)                # jsonio durability contract
         return True
@@ -183,6 +203,30 @@ def emit(ev: str, **fields) -> bool:
         except Exception:
             pass
         return False
+
+
+def _maybe_rotate(incoming: int) -> None:
+    """Size-capped rotation (ENV_MAX_BYTES header comment): when the
+    next line would push the active file past the cap, rename it whole
+    to `<path>.1` and reopen fresh. Raises nothing the emit wrapper
+    does not already contain; a failed rename just keeps appending to
+    the oversized file (hygiene is best-effort, durability is not)."""
+    global _fd
+    if _fd is None or _path is None or _max_bytes is None:
+        return
+    try:
+        if os.fstat(_fd).st_size + incoming <= _max_bytes:
+            return
+        os.replace(_path, _path + ".1")
+        fd = os.open(_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                     0o644)
+    except OSError:
+        return
+    try:
+        os.close(_fd)
+    except OSError:
+        pass
+    _fd = fd
 
 
 _bad_names: set = set()

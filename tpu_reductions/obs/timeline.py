@@ -297,6 +297,49 @@ def stream_summary(events: List[dict]) -> Optional[dict]:
     return out
 
 
+def compile_summary(events: List[dict]) -> Optional[dict]:
+    """Per-surface compile attribution from the compile observatory's
+    typed events (compile.start/end, warm.* — lint/grammar.py
+    COMPILE_EVENTS; obs/compile.py). The committed answer to the
+    ISSUE-8 acceptance question: which surfaces compiled this window,
+    cold or warm (the .jax_cache verdict), at what cost, and how much
+    of the recorded window went to compiling at all. None when no
+    instrumented compile ran."""
+    ends = [e for e in events if e["ev"] == "compile.end"]
+    if not ends:
+        return None
+    surfaces: dict = {}
+    order: List[str] = []
+    total_s = 0.0
+    for e in ends:
+        s = e.get("surface")
+        if not isinstance(s, str):
+            continue
+        if s not in surfaces:
+            surfaces[s] = {"surface": s, "count": 0, "cold_s": None,
+                           "warm_s": None, "last_verdict": None,
+                           "errors": 0}
+        rec = surfaces[s]
+        rec["count"] += 1
+        d = e.get("dur_s")
+        d = float(d) if isinstance(d, (int, float)) else 0.0
+        total_s += d
+        v = e.get("verdict")
+        if v in ("cold", "warm"):
+            rec[f"{v}_s"] = d
+        rec["last_verdict"] = v
+        if e.get("error"):
+            rec["errors"] += 1
+        if s not in order:
+            order.append(s)
+    out = {"compiles": len(ends), "compile_s": round(total_s, 6),
+           "surfaces": [surfaces[s] for s in order]}
+    warm_runs = sum(1 for e in events if e["ev"] == "warm.end")
+    if warm_runs:
+        out["warm_runs"] = warm_runs
+    return out
+
+
 def summarize(path, events: List[dict], torn: int) -> dict:
     """The machine-readable summary JSON (bench/regen collates it into
     report.md; chip_session.sh persists it as obs_timeline.json)."""
@@ -312,6 +355,9 @@ def summarize(path, events: List[dict], torn: int) -> dict:
     stream = stream_summary(events)
     if stream is not None:
         out["stream"] = stream
+    comp = compile_summary(events)
+    if comp is not None:
+        out["compile"] = comp
     if events:
         t0, t1 = events[0]["t"], events[-1]["t"]
         wall = max(t1 - t0, 0.0)
@@ -466,6 +512,36 @@ def summary_markdown(summary: dict) -> str:
                 f"overlap efficiency x{stream['overlap_efficiency']} "
                 f"(serial {stream.get('serial_wall_s', '?')} s vs "
                 f"streamed {stream.get('stream_wall_s', '?')} s)")
+    comp = summary.get("compile")
+    if comp:
+        # the compile observatory's record (ISSUE 8): per-surface
+        # cold/warm compile latency + the compile share of the window —
+        # the axis the window planner was blind on
+        lines.append("")
+        lines.append("### compile observatory (per-surface cold/warm)")
+        lines.append("")
+        lines.append("| surface | cold s | warm s | last verdict "
+                     "| compiles |")
+        lines.append("|---|---|---|---|---|")
+        for rec in comp["surfaces"]:
+            cold = rec.get("cold_s")
+            warm_v = rec.get("warm_s")
+            lines.append(
+                f"| {rec['surface']} "
+                f"| {f'{cold:.3f}' if cold is not None else '-'} "
+                f"| {f'{warm_v:.3f}' if warm_v is not None else '-'} "
+                f"| {rec.get('last_verdict') or '?'} "
+                f"| {rec['count']}"
+                + (f" ({rec['errors']} error(s))" if rec["errors"]
+                   else "") + " |")
+        recorded = summary.get("window", {}).get("recorded_s") or 0.0
+        share = (f", {comp['compile_s'] / recorded:.0%} of the "
+                 "recorded window" if recorded > 0 else "")
+        lines.append("")
+        lines.append(f"{comp['compiles']} instrumented compile(s), "
+                     f"{comp['compile_s']:.2f} s total{share}"
+                     + (f"; {comp['warm_runs']} warming pass(es)"
+                        if comp.get("warm_runs") else ""))
     return "\n".join(lines)
 
 

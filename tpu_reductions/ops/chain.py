@@ -101,7 +101,8 @@ def auto_chain_span(n: int, dtype: str, *, target_signal_s: float = 6e-3,
                                                            1e-9))))
 
 
-def make_chained_reduce(core: Callable, op: ReduceOpSpec):
+def make_chained_reduce(core: Callable, op: ReduceOpSpec,
+                        surface: str | None = None):
     """Wrap a device-only scalar reduction into `chained(x2d, k) ->
     scalar` running k data-dependent iterations inside one jitted
     program.
@@ -120,6 +121,14 @@ def make_chained_reduce(core: Callable, op: ReduceOpSpec):
     iteration's reduction, so materializing it on the host bounds the
     completion of all k kernel executions.
 
+    `surface` names this executable for the compile observatory
+    (obs/compile.py; default `chain/<op>`): the FIRST call — the one
+    that traces and compiles — is bracketed in a compile_span, so the
+    20-40 s tunnel compile lands in the ledger with its .jax_cache
+    cold/warm verdict. Later calls pay two attribute tests. The span
+    sits entirely inside the warm-up trip (utils/timing.time_chained
+    never uses the first two trips for slopes), so timing doctrine is
+    untouched.
 
     No reference analog (TPU-native).
     """
@@ -152,4 +161,27 @@ def make_chained_reduce(core: Callable, op: ReduceOpSpec):
         _, last = jax.lax.fori_loop(0, k, body, (x2d, init))
         return last
 
-    return jax.jit(chained)
+    jitted = jax.jit(chained)
+    sid = surface or f"chain/{op.name.lower()}"
+    state = {"first": True}
+
+    def chained_observed(x2d, k):
+        if state["first"]:
+            state["first"] = False
+            from tpu_reductions.obs.compile import compile_span
+            plane = x2d[0] if isinstance(x2d, tuple) else x2d
+            shape = tuple(getattr(plane, "shape", ()) or ())
+            with compile_span(sid, op=op.name,
+                              rows=(shape[0] if shape else None),
+                              pair=isinstance(x2d, tuple)):
+                return jitted(x2d, k)
+        return jitted(x2d, k)
+
+    # the warming pass (bench/warm.py) AOT-compiles EXACTLY this
+    # executable — re-jitting the wrapper would warm a different cache
+    # key, so the underlying jit stays reachable (and the one-compile
+    # contract stays testable through the wrapper)
+    chained_observed.jitted = jitted
+    chained_observed.surface = sid
+    chained_observed._cache_size = jitted._cache_size
+    return chained_observed

@@ -208,6 +208,7 @@ class StreamReducer:
         else:
             self._fold = _jit_fold(self.method, self.dtype, donate)
         self._acc = None       # device block, or (hi, lo) pair
+        self._compile_observed = False   # first fold = compile span
 
     # -- accumulator lifecycle -----------------------------------------
 
@@ -283,8 +284,24 @@ class StreamReducer:
         """Fold one staged chunk into the resident accumulator
         (dispatch-async; the periodic `partial()` fetch is the
         completion point) — the grid-stride accumulate
-        (reduction_kernel.cu:88-98) at chunk grain."""
+        (reduction_kernel.cu:88-98) at chunk grain. The FIRST fold is
+        the chunk executable's compile point: it is bracketed in a
+        compile observatory span (obs/compile.py, surface `stream`) so
+        the pipeline's one compile lands in the ledger with its
+        cold/warm cache verdict — later folds pay nothing."""
         assert self._acc is not None, "restore() before fold()"
+        if not self._compile_observed:
+            self._compile_observed = True
+            from tpu_reductions.obs.compile import compile_span
+            with compile_span("stream", op=self.method,
+                              dtype=self.plan.dtype,
+                              chunk_elems=self.plan.chunk_elems,
+                              pair=self.is_dd):
+                self._fold_one(staged)
+            return
+        self._fold_one(staged)
+
+    def _fold_one(self, staged) -> None:
         if self.is_dd:
             hi, lo = staged
             self._acc = self._fold(self._acc[0], self._acc[1], hi, lo)

@@ -74,6 +74,12 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--history", action="append", default=None,
                    help="extra ledger file(s) for duration/window "
                         "priors (default: the active ledger)")
+    p.add_argument("--compile-ledger", dest="compile_ledger",
+                   default=None,
+                   help="compile observatory artifact feeding the "
+                        "cold/warm duration priors (default: "
+                        "TPU_REDUCTIONS_COMPILE_LEDGER, else "
+                        "compile_ledger.json)")
     p.add_argument("--window-quantile", type=float, default=0.5,
                    help="window-length quantile the knapsack plans "
                         "against")
@@ -106,7 +112,15 @@ def _active(ns) -> tuple:
         history.append(env_ledger)
     elif not history:
         history.append("obs_ledger.jsonl")
-    priors = Priors.from_ledgers(history)
+    # the compile observatory's cold/warm axis (ISSUE 8): rows filtered
+    # to the planning platform — a cpu-warm surface says nothing about
+    # the tunnel cache (obs/compile.CompileModel)
+    from tpu_reductions.obs.compile import DEFAULT_LEDGER, ENV_PATH
+    compile_ledger = ns.compile_ledger \
+        or os.environ.get(ENV_PATH) or DEFAULT_LEDGER
+    priors = Priors.from_ledgers(
+        history, compile_ledger=compile_ledger,
+        platform=("cpu" if ns.platform == "cpu" else "tpu"))
     return active, excluded, meta, priors
 
 

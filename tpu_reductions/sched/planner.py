@@ -45,6 +45,9 @@ class PlanEntry:
     ratio: float          # value / est_s — the greedy key
     fits: bool            # inside the cumulative remaining estimate
     cumulative_s: float
+    compile: str = "-"    # cold/warm standing of the task's surfaces
+    #                       (priors.compile_status — the compile
+    #                       observatory's column, ISSUE 8)
 
 
 @dataclass(frozen=True)
@@ -99,7 +102,8 @@ def plan(tasks: Sequence[Task], state: PlanState, priors: Priors,
                          budget_s=remaining,
                          tie_key=lambda t: t.name)
     entries = [PlanEntry(task=r.item, est_s=r.cost, ratio=r.ratio,
-                         fits=r.fits, cumulative_s=r.cumulative)
+                         fits=r.fits, cumulative_s=r.cumulative,
+                         compile=priors.compile_status(r.item))
                for r in ranked]
     return Plan(entries=entries, remaining_s=remaining, skips=skips)
 
@@ -108,14 +112,14 @@ def render_table(p: Plan) -> str:
     """The --plan-only table: stable for a given (registry, priors,
     state) — the acceptance contract prints it twice and diffs."""
     lines = [f"{'#':>2} {'task':<18} {'value':>7} {'est s':>8} "
-             f"{'val/s':>8} {'cum s':>8} fits"]
+             f"{'val/s':>8} {'cum s':>8} {'compile':>7} fits"]
     for i, e in enumerate(p.entries):
         flag = "yes" if e.fits else "no"
         if e.task.hazard:
             flag += " [hazard:last]"
         lines.append(f"{i:>2} {e.task.name:<18} {e.task.value:>7.0f} "
                      f"{e.est_s:>8.1f} {e.ratio:>8.3f} "
-                     f"{e.cumulative_s:>8.1f} {flag}")
+                     f"{e.cumulative_s:>8.1f} {e.compile:>7} {flag}")
     for name, reason in p.skips:
         lines.append(f"   {name:<18} -- skipped: {reason}")
     lines.append(f"remaining-window estimate: {p.remaining_s:.1f} s "

@@ -14,7 +14,14 @@ module turns that history into:
     ledgers used), else the registry's static budget_s — the cold-start
     fallback the ISSUE requires. Durations observed THIS window
     (`observe`, fed by the executor as tasks finish) take precedence:
-    the online update.
+    the online update. The static fallback additionally consults the
+    compile observatory (obs/compile.CompileModel over the committed
+    compile_ledger.json, ISSUE 8): a task whose declared surfaces are
+    ALL cache-warm sheds the cold-compile seconds the cache banked —
+    the budget_s priors were written for cold windows, and charging a
+    warm surface 20-40 s of tunnel compile mis-ranks it in a
+    minutes-long window. History medians are left alone: they already
+    embed whatever compile cost their windows actually paid.
   * `window_quantile(q)` / `remaining_s(window_t0)` — a quantile model
     over recorded window lengths (event clusters split at
     WINDOW_GAP_S); with no history the prior is the observed round-4
@@ -116,20 +123,33 @@ def _quantile(vals: Sequence[float], q: float) -> float:
 
 class Priors:
     """The planner's cost model: per-task duration estimates + the
-    remaining-window estimate, updated online as tasks finish."""
+    remaining-window estimate, updated online as tasks finish, with
+    the compile observatory's cold/warm axis folded into the static
+    fallback (module docstring)."""
 
-    def __init__(self, history: Optional[dict] = None) -> None:
+    def __init__(self, history: Optional[dict] = None,
+                 compile_model=None) -> None:
         history = history or {"durations": {}, "windows": []}
         self._durations: Dict[str, List[float]] = {
             k: list(v) for k, v in history.get("durations", {}).items()}
         self._windows: List[float] = list(history.get("windows", []))
         self._online: Dict[str, float] = {}
+        self._compile = compile_model   # obs/compile.CompileModel
 
     @classmethod
-    def from_ledgers(cls, paths: Iterable[str]) -> "Priors":
+    def from_ledgers(cls, paths: Iterable[str],
+                     compile_ledger: Optional[str] = None,
+                     platform: Optional[str] = None) -> "Priors":
         """Build from committed ledger histories (CLI default:
-        obs_ledger.jsonl in the cwd; --history adds more)."""
-        return cls(scan_history(paths))
+        obs_ledger.jsonl in the cwd; --history adds more) plus, when
+        `compile_ledger` names a committed compile_ledger.json, the
+        observatory's cold/warm model filtered to `platform`'s rows."""
+        model = None
+        if compile_ledger:
+            from tpu_reductions.obs.compile import CompileModel
+            model = CompileModel.from_file(compile_ledger,
+                                           platform=platform)
+        return cls(scan_history(paths), compile_model=model)
 
     def observe(self, name: str, seconds: float) -> None:
         """Online update: a task finished this window — its actual
@@ -143,14 +163,32 @@ class Priors:
     def estimate(self, task: Task) -> float:
         """Expected duration: this window's observation, else the
         history median (slug first, then the chip_session step title
-        the pre-scheduler ledgers keyed on), else the static budget."""
+        the pre-scheduler ledgers keyed on), else the static budget —
+        discounted by the cache-banked compile seconds when every
+        surface the task declares is warm (module docstring; the floor
+        keeps a mis-declared surface list from zeroing an estimate)."""
         if task.name in self._online:
             return self._online[task.name]
         for key in (task.name, task.title):
             samples = self._durations.get(key)
             if samples:
                 return _median(samples)
-        return float(task.budget_s)
+        base = float(task.budget_s)
+        if self._compile is not None and task.surfaces and \
+                self._compile.status(task.surfaces) == "warm":
+            saved = self._compile.saved_s(task.surfaces)
+            if saved > 0:
+                base = max(base - saved, 0.25 * float(task.budget_s))
+        return base
+
+    def compile_status(self, task: Task) -> str:
+        """The task's cold/warm standing for the plan table
+        (sched/planner.render_table): 'warm'/'cold'/'mixed' from the
+        compile observatory, '-' when the task declares no surfaces or
+        no model is loaded."""
+        if self._compile is None or not task.surfaces:
+            return "-"
+        return self._compile.status(task.surfaces)
 
     def window_quantile(self, q: float = 0.5) -> float:
         """The window-length model: quantile of recorded flap history,

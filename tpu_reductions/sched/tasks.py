@@ -50,6 +50,11 @@ class Task:
     chip_only: bool = False         # excluded from cpu rehearsal plans
     requires: Tuple[str, ...] = ()  # must be attempted first
     rehearsal_command: Optional[str] = None   # cpu-scale variant
+    surfaces: Tuple[str, ...] = ()  # compile-observatory surface ids
+    #                                 this task's executables hit
+    #                                 (obs/compile.py): all cache-warm
+    #                                 => the cheap duration prior
+    #                                 (sched/priors.py, ISSUE 8)
 
 
 def artifact_complete(path: str, window_t0: float) -> bool:
@@ -115,13 +120,13 @@ SESSION_TASKS: Tuple[Task, ...] = (
                             f"{_R} --skip-doubles"),
          artifacts=("FIRSTROW.json", "BENCH_snapshot.json",
                     "BENCH_doubles.json"),
-         done_artifact="FIRSTROW.json"),
+         done_artifact="FIRSTROW.json", surfaces=("k7", "dd")),
     Task("headline_bench", "headline bench", value=400.0, budget_s=240,
          command=_HEADLINE_CMD,
          artifacts=("BENCH_live.json", "BENCH_snapshot.json",
                     "BENCH_doubles.json"),
          chip_only=True,   # bench.py is the real-chip round metric
-         requires=("firstrow",)),
+         requires=("firstrow",), surfaces=("k7", "dd")),
     Task("double_spot", "double scoreboard", value=360.0, budget_s=300,
          command=("python -m tpu_reductions.bench.spot --type=double "
                   "--methods=SUM,MIN,MAX --n=16777216 --iterations=256 "
@@ -130,7 +135,7 @@ SESSION_TASKS: Tuple[Task, ...] = (
                             f"--type=double --methods=SUM,MIN,MAX {_R} "
                             "--out=double_spot.json"),
          artifacts=("double_spot.json",),
-         done_artifact="double_spot.json"),
+         done_artifact="double_spot.json", surfaces=("dd",)),
     Task("calibrate_ladder", "calibration ladder", value=260.0,
          budget_s=240,
          command=("python -m tpu_reductions.utils.calibrate --ladder "
@@ -140,31 +145,35 @@ SESSION_TASKS: Tuple[Task, ...] = (
                             "--chainspan 16 --reps 2 "
                             "--out=calibration_live.json"),
          artifacts=("calibration_live.json",),
-         done_artifact="calibration_live.json"),
+         done_artifact="calibration_live.json", surfaces=("xla",)),
     Task("smoke", "lowering smoke", value=240.0, budget_s=420,
          command="python -m tpu_reductions.bench.smoke --out=smoke.json",
          rehearsal_command=("python -m tpu_reductions.bench.smoke "
                             "--platform=cpu --out=smoke.json"),
          artifacts=("smoke.json",),
-         done_artifact="smoke.json"),
+         done_artifact="smoke.json",
+         surfaces=("k8", "k9", "k10@2", "k10@4", "k10@8", "dd")),
     Task("hbm26", "hbm regime race 2^26", value=200.0, budget_s=420,
          command=("python -m tpu_reductions.bench.autotune --method=SUM "
                   "--type=int --n=67108864 --grid=hbm --comparator "
                   "--out=tune_hbm.json"),
          artifacts=("tune_hbm.json",), done_artifact="tune_hbm.json",
-         chip_only=True, requires=("smoke",)),
+         chip_only=True, requires=("smoke",),
+         surfaces=("k8", "k10@2", "k10@4", "k10@8")),
     Task("hbm27", "hbm regime race 2^27", value=180.0, budget_s=420,
          command=("python -m tpu_reductions.bench.autotune --method=SUM "
                   "--type=int --n=134217728 --grid=hbm --comparator "
                   "--out=tune_hbm27.json"),
          artifacts=("tune_hbm27.json",), done_artifact="tune_hbm27.json",
-         chip_only=True, requires=("smoke",)),
+         chip_only=True, requires=("smoke",),
+         surfaces=("k8", "k10@2", "k10@4", "k10@8")),
     Task("int_op_parity", "int op parity probe", value=160.0,
          budget_s=420, command=_INT_OP_CMD,
          artifacts=("int_op_spot_k7.json", "int_op_spot_k6.json",
                     "int_op_spot_xla.json"),
          done_artifact="int_op_spot_xla.json",
-         chip_only=True, requires=("smoke",)),
+         chip_only=True, requires=("smoke",),
+         surfaces=("k6", "k7", "xla")),
     Task("stream_probe", "streaming pipeline probe", value=170.0,
          budget_s=300,
          # 1 GiB int32 through 64 MiB chunks: 16 chunks of double-
@@ -173,17 +182,22 @@ SESSION_TASKS: Tuple[Task, ...] = (
          # 4 GiB staging hazard (ISSUE 7; docs/STREAMING.md). The
          # serial comparator stays off on chip (its per-chunk forced
          # fetch pays a tunnel RTT each; overlap efficiency is the
-         # off-chip rehearsal's number)
+         # off-chip rehearsal's number). The ONE committed probe lives
+         # in the experiment dir (the PR-6 serving_curve dedup rule —
+         # bench/regen.py folds it from there); the rehearsal writes to
+         # its sandbox cwd, which has no examples/ tree
          command=("python -m tpu_reductions.bench.stream --method=SUM "
                   "--type=int --n=268435456 --chunk-bytes=67108864 "
-                  "--sync-every=4 --out=stream_probe.json"),
+                  "--sync-every=4 "
+                  "--out=examples/tpu_run/stream_probe.json"),
          rehearsal_command=("python -m tpu_reductions.bench.stream "
                             "--method=SUM --type=int --platform=cpu "
                             "--n=1048576 --chunk-bytes=65536 "
                             "--sync-every=4 --serial-baseline "
                             "--out=stream_probe.json"),
-         artifacts=("stream_probe.json",),
-         done_artifact="stream_probe.json"),
+         artifacts=("examples/tpu_run/stream_probe.json",),
+         done_artifact="examples/tpu_run/stream_probe.json",
+         surfaces=("stream",)),
     Task("bf16_spot", "bf16 existence spot", value=150.0, budget_s=180,
          command=("python -m tpu_reductions.bench.spot --type=bfloat16 "
                   "--methods=SUM,MIN,MAX --n=16777216 --iterations=256 "
@@ -191,19 +205,20 @@ SESSION_TASKS: Tuple[Task, ...] = (
          rehearsal_command=("python -m tpu_reductions.bench.spot "
                             f"--type=bfloat16 --methods=SUM,MIN,MAX {_R} "
                             "--out=bf16_spot.json"),
-         artifacts=("bf16_spot.json",), done_artifact="bf16_spot.json"),
+         artifacts=("bf16_spot.json",), done_artifact="bf16_spot.json",
+         surfaces=("k6",)),
     Task("mxu_f32", "mxu race f32", value=120.0, budget_s=420,
          command=_MXU_F32_CMD,
          artifacts=("tune_mxu_f32.json", "tune_mxu_f32_hbm.json"),
          done_artifact="tune_mxu_f32_hbm.json",
-         chip_only=True, requires=("smoke",)),
+         chip_only=True, requires=("smoke",), surfaces=("k9",)),
     Task("mxu_bf16", "mxu race bf16", value=100.0, budget_s=300,
          command=("python -m tpu_reductions.bench.autotune --method=SUM "
                   "--type=bfloat16 --n=16777216 --iterations=256 "
                   "--grid=mxu --comparator --out=tune_mxu_bf16.json"),
          artifacts=("tune_mxu_bf16.json",),
          done_artifact="tune_mxu_bf16.json",
-         chip_only=True, requires=("smoke",)),
+         chip_only=True, requires=("smoke",), surfaces=("k9",)),
     Task("fine_race", "fine tile race", value=90.0, budget_s=420,
          command=("python -m tpu_reductions.bench.autotune --method=SUM "
                   "--type=int --n=16777216 --iterations=256 "
@@ -213,12 +228,13 @@ SESSION_TASKS: Tuple[Task, ...] = (
                             "--n=65536 --iterations=16 --chainreps=2 "
                             "--grid=fine --out=tune_fine.json"),
          artifacts=("tune_fine.json",), done_artifact="tune_fine.json",
-         requires=("smoke",)),
+         requires=("smoke",), surfaces=("k6", "k7", "k8")),
     Task("flagship", "flagship experiment", value=300.0, budget_s=10800,
          command="bash scripts/run_tpu_experiment.sh examples/tpu_run",
          artifacts=("examples/tpu_run",),
          hazard=True,       # its tail is the 4 GiB HAZARD_CELLS
-         chip_only=True, requires=("smoke", "calibrate_ladder")),
+         chip_only=True, requires=("smoke", "calibrate_ladder"),
+         surfaces=("k6", "k7", "dd", "xla")),
 )
 
 
@@ -273,7 +289,8 @@ def load_tasks_file(path: str) -> List[Task]:
             done_artifact=spec.get("done_artifact"),
             hazard=bool(spec.get("hazard", False)),
             chip_only=bool(spec.get("chip_only", False)),
-            requires=tuple(spec.get("requires", ()))))
+            requires=tuple(spec.get("requires", ())),
+            surfaces=tuple(spec.get("surfaces", ()))))
     return out
 
 

@@ -46,6 +46,11 @@ def _bucket(k: int) -> int:
     return b
 
 
+# (method, dtype, n, padded-k) keys whose first launch was already
+# bracketed in a compile observatory span (run_batch below)
+_observed_buckets: set = set()
+
+
 @functools.lru_cache(maxsize=8)
 def _jit_row_reduce(method: str):
     """One jitted stacked row-reduce per op; jax's own trace cache
@@ -127,7 +132,21 @@ class BatchExecutor:
             # stacked payload under the 512 MiB single-message bound)
             return np.asarray(jax.device_get(fn(stacked)))
 
-        vals = retry_device_call(launch, phase="serve")[:k]
+        # compile observatory (obs/compile.py): the first launch of a
+        # (method, dtype, n, bucket) key is the bucket's trace+compile
+        # point — engine.prewarm drives exactly these — so it runs
+        # inside a compile_span and lands in the ledger with its
+        # cold/warm cache verdict; steady-state launches pay one set
+        # lookup
+        bucket_key = (method, dtype, n, kb)
+        if bucket_key not in _observed_buckets:
+            _observed_buckets.add(bucket_key)
+            from tpu_reductions.obs.compile import compile_span
+            with compile_span(f"serve-bucket/{method.lower()}",
+                              dtype=dtype, n=n, batch=kb):
+                vals = retry_device_call(launch, phase="serve")[:k]
+        else:
+            vals = retry_device_call(launch, phase="serve")[:k]
 
         out: List[Dict] = []
         for i, seed in enumerate(seeds):

@@ -192,7 +192,7 @@ def calibrate(n: int = 1 << 24, dtype: str = "float32",
     jax.device_get(r)
     roundtrip = time.perf_counter() - t0
 
-    chained = make_chained_reduce(op.jnp_reduce, op)
+    chained = make_chained_reduce(op.jnp_reduce, op, surface="xla")
     sw = time_chained(chained, x2d, k_lo=1, k_hi=1 + chain_span, reps=reps)
     chained_s = sw.median_s
 

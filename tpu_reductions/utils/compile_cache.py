@@ -1,0 +1,137 @@
+"""One home for the persistent XLA compile-cache wiring + introspection.
+
+Every entry point used to get the `.jax_cache/` plumbing through
+`config.enable_compile_cache`, and NOTHING could ask the cache a
+question: the 20-40 s first Pallas tunnel compile (CLAUDE.md) amortizes
+invisibly, so neither the flight recorder nor the window scheduler
+could tell a cold surface from a warm one (ROADMAP item 5). This
+module centralizes both halves:
+
+  * `enable(path=None)` — the one `jax_compilation_cache_dir` wiring
+    (config.enable_compile_cache now delegates here). It also drops the
+    persistence thresholds to zero where the jax version permits, so
+    EVERY executable lands in the cache — without that, sub-second CPU
+    compiles stay uncached and the cold/warm verdict below would be
+    vacuously "cold" off-chip, exactly where the rehearsal needs it.
+  * `fingerprint()` — the set of cache entry names currently on disk.
+    Snapshotting it before/after a compile is the cache-verdict
+    primitive of the compile observatory (obs/compile.py): new entries
+    appeared => the compile was COLD (it had to populate the cache);
+    none appeared over a populated cache => WARM (served from cache or
+    from jax's in-process executable cache).
+
+Import-light by design: no jax import at module load (the scheduler
+reads fingerprints while the relay is dead; obs/ stays jax-free), and
+every jax touch is best-effort — cache plumbing must never fail a run.
+TPU_REDUCTIONS_NO_COMPILE_CACHE=1 disables both wiring and verdicts
+(docs/RESILIENCE.md env-knob table).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import FrozenSet, Optional
+
+ENV_DISABLE = "TPU_REDUCTIONS_NO_COMPILE_CACHE"
+
+# the directory enable() actually armed (None until it runs; verdicts
+# before any enable() fall back to default_dir so offline readers — the
+# scheduler's cold/warm model — see the same cache the runs populate)
+_active_dir: Optional[str] = None
+
+
+def disabled() -> bool:
+    """TPU_REDUCTIONS_NO_COMPILE_CACHE=1: no wiring, no verdicts."""
+    return os.environ.get(ENV_DISABLE) == "1"
+
+
+def default_dir() -> str:
+    """The repo-local untracked `.jax_cache/` (the historical default
+    of config.enable_compile_cache, unchanged — this file sits one
+    package level deeper than config.py, hence the third dirname)."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        ".jax_cache")
+
+
+def active_dir() -> Optional[str]:
+    """The cache directory verdicts read: the armed one, else the
+    default; None when the knob disables caching entirely."""
+    if disabled():
+        return None
+    return _active_dir or default_dir()
+
+
+def enable(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at `path` (default:
+    `.jax_cache/`). Round-4 lesson: the tunnel relay FLAPS — live
+    windows can be minutes long, and a first Pallas compile through the
+    tunnel costs 20-40 s; with the cache, a compile paid in one window
+    is free in the next. Best-effort by contract: a backend that cannot
+    serialize executables just skips caching (JAX logs it), and any
+    config failure degrades to the uncached behavior we have always
+    had. Returns the armed directory, or None when disabled/failed."""
+    global _active_dir
+    if disabled():
+        return None
+    if path is None:
+        path = default_dir()
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache EVERYTHING: the defaults skip sub-second compiles and
+        # tiny entries, which would leave every off-chip rehearsal
+        # executable uncached and the cold/warm verdict meaningless
+        for knob, val in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(knob, val)
+            except Exception:
+                pass   # older jax: threshold knobs absent — still cached
+        # jax memoizes its cache handle at first use: a dir switch
+        # inside one process (tests; a rehearsal pointing at a sandbox)
+        # needs the handle dropped or the new dir is silently ignored.
+        # Best-effort private API by necessity; on-disk entries are
+        # untouched and the handle re-initializes lazily from config.
+        try:
+            from jax._src import compilation_cache
+            compilation_cache.reset_cache()
+        except Exception:
+            pass
+        _active_dir = path
+        return path
+    except Exception as e:   # never let cache plumbing fail a run
+        print(f"# compile cache unavailable (non-fatal): {e}",
+              file=sys.stderr)
+        return None
+
+
+def fingerprint() -> FrozenSet[str]:
+    """The cache entries on disk right now (empty set when the cache is
+    disabled, unarmed-and-absent, or unreadable). Entry names are jax's
+    content-addressed keys, so set difference across a compile is an
+    exact 'did this compile populate the cache' probe."""
+    d = active_dir()
+    if d is None:
+        return frozenset()
+    try:
+        return frozenset(name for name in os.listdir(d)
+                         if not name.endswith("-atime"))
+    except OSError:
+        return frozenset()
+
+
+def verdict(before: FrozenSet[str], after: FrozenSet[str]) -> str:
+    """The cache verdict for a compile bracketed by two fingerprints:
+    `cold` (new entries appeared — the compile had to populate the
+    cache), `warm` (a populated cache gained nothing — served from the
+    persistent or in-process executable cache), or `untracked` (no
+    cache to consult: disabled or empty both before and after)."""
+    if after - before:
+        return "cold"
+    if after:
+        return "warm"
+    return "untracked"
