@@ -442,6 +442,15 @@ fallback_static_session() {
             --n=16777216 --iterations=256 --chainreps=7 --grid=fine \
             --out=tune_fine.json
 
+    # off-chip by design (--platform=cpu): the accuracy-vs-bandwidth
+    # curve needs no live chip, so it is honest flap-time filler here
+    # exactly as it is in the scheduler's plan (docs/COLLECTIVES.md)
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py quant_curve
+    step "accuracy-vs-bandwidth curve" 300 \
+            examples/rank_scaling/quant_curve.json -- \
+        python -m tpu_reductions.bench.quant_curve --platform=cpu \
+            --out=examples/rank_scaling/quant_curve.json
+
     # 3 h: the long tail (hazard cells last), and the watcher re-arms
     # on abort — a flagship that wedges slow-but-alive must not pin the
     # watcher past the round

@@ -114,3 +114,9 @@ for (dt, op, k), g in sorted(avgs.items()):
 print("figures:", ", ".join(str(f) for f in figures))
 print("wrote", out / "scaling_shape.json")
 PY
+
+# refresh the quantized suite's accuracy-vs-bandwidth curve next to the
+# rank-scaling evidence (same rank ladder, same off-chip virtual mesh;
+# bench/regen folds it into report.md from here — docs/COLLECTIVES.md)
+python -m tpu_reductions.bench.quant_curve --platform=cpu \
+    --out="$OUT/quant_curve.json"

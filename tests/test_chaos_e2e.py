@@ -210,6 +210,63 @@ def test_chaos_stall_relay_heartbeat_exit4_then_resume(tmp_path):
     assert alive["reused_rows"] >= 1 and alive["persists"] >= 1
 
 
+SWEEP_ARGS = ["--platform=cpu", "--ranks=2,4", "--methods=SUM",
+              "--types=int", "--n=65536", "--retries=1"]
+
+
+def _sweep(out_dir: Path, env):
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpu_reductions.bench.sweep",
+         *SWEEP_ARGS, f"--out-dir={out_dir}"],
+        env=env, cwd=str(REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def test_chaos_sweep_relay_death_midladder_resumes_rank_rows(tmp_path):
+    """ISSUE 10 satellite: the rank-scaling sweep under a relay death
+    BETWEEN ladder rungs. The `collective.hop` fault point wedges the
+    second rung's launch (rank 4) while the test flips the relay dead —
+    the watchdog exits 3 with the completed rank-2 rows persisted in
+    `collective_sweep.json`, and the re-invoked sweep resumes those
+    per-rank-count rows byte-identically (zero re-measures of rung 2)
+    instead of restarting at the bottom of the ladder
+    (docs/COLLECTIVES.md; docs/RESILIENCE.md fault-point table)."""
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    out = tmp_path / "collective_sweep.json"
+    with FakeRelay() as relay:
+        # hop 1 (rank 2) measures clean; hop 2 (rank 4) wedges in its
+        # launch — the relay-death-between-rungs shape
+        env = _chaos_env(relay, marker, faults={
+            "collective.hop": {"after": 1, "action": "stall",
+                               "seconds": 120}})
+        proc = _sweep(tmp_path, env)
+        _wait_for_rows(out, 1)          # rank-2 row verified + persisted
+        relay.force("refuse")
+        rc = proc.wait(timeout=90)
+        stderr = proc.stderr.read()
+        assert rc == 3, f"expected watchdog exit 3, got {rc}: {stderr}"
+        interrupted = json.loads(out.read_text())
+        assert interrupted["complete"] is False
+        assert {r["ranks"] for r in interrupted["rows"]} == {2}
+
+        # window 2: relay back, no faults — the ladder resumes at rank 4
+        relay.force("accept")
+        time.sleep(0.15)
+        proc2 = _sweep(tmp_path, _chaos_env(relay, marker))
+        rc2 = proc2.wait(timeout=90)
+        stderr2 = proc2.stderr.read()
+        assert rc2 == 0, stderr2
+        assert "resumed from prior artifact" in stderr2
+        resumed = json.loads(out.read_text())
+    assert resumed["complete"] is True
+    # the banked rung is reused byte-identically, then the ladder climbs
+    n2 = len(interrupted["rows"])
+    assert resumed["rows"][:n2] == interrupted["rows"]
+    assert [r["ranks"] for r in resumed["rows"][n2:]] == [4]
+    assert all(r["status"] in ("PASSED", "WAIVED") for r in resumed["rows"])
+
+
 def test_await_window_defers_on_non_live_preflight(tmp_path):
     """The wedge-aware polling loop: relay ports answer, but a
     preflight verdict of 4 (stall/wedge) must stop await_window from

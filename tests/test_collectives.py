@@ -582,3 +582,77 @@ def test_collective_at_reference_scale_16_ranks():
     assert r.returncode == 0, r.stdout + r.stderr
     assert "INT SUM 16 " in r.stdout
     assert "&&&& tpu_reductions.collective PASSED" in r.stdout
+
+
+def test_collective_events_land_in_ledger_and_timeline_summary(
+        tmp_path, monkeypatch):
+    """ISSUE 10 satellite: a launch routed through the selector leaves a
+    typed collective.select/launch/done trail in the flight recorder,
+    every emitted name is registered grammar, and the timeline CLI
+    attributes collective-phase wall clock per algorithm
+    (docs/COLLECTIVES.md; docs/OBSERVABILITY.md)."""
+    from tpu_reductions.bench.collective_driver import main
+    from tpu_reductions.obs import ledger as ledger_mod
+    from tpu_reductions.obs.timeline import (read_ledger, summarize,
+                                             summary_markdown)
+
+    led = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER", str(led))
+    try:
+        rc = main(["--method=SUM", "--type=float", "--quantized",
+                   "--quant-bits=8", "--devices=4", f"--n={K * L}",
+                   "--retries=1"])
+    finally:
+        ledger_mod.disarm()
+    assert rc == 0
+    events, torn = read_ledger(led)
+    assert torn == 0
+    names = [e["ev"] for e in events]
+    for ev in ("collective.select", "collective.launch",
+               "collective.done"):
+        assert ev in names, ev
+    # every emitted collective.* name is registered grammar
+    from tpu_reductions.lint.grammar import COLLECTIVE_EVENTS
+    assert set(n for n in names if n.startswith("collective.")) \
+        <= set(COLLECTIVE_EVENTS)
+    sel = next(e for e in events if e["ev"] == "collective.select")
+    assert sel["algorithm"] == "q8_ring_rs_ag"
+    assert 0.0 < sel["wire_factor"] < 1.0
+    summary = summarize(led, events, torn)
+    coll = summary["collective"]
+    assert coll["selects"] >= 1 and coll["launches"] >= 1
+    assert coll["algorithms"][0]["algorithm"] == "q8_ring_rs_ag"
+    assert coll["collective_s"] > 0
+    md = summary_markdown(summary)
+    assert "per-algorithm attribution" in md and "q8_ring_rs_ag" in md
+
+
+@pytest.mark.parametrize("method", ["SUM", "MIN", "MAX"])
+@pytest.mark.parametrize("topology", ["ring", "bidir", "torus2d", "naive"])
+def test_topology_all_reduce_matches_oracle(topology, method):
+    """The explicit-topology ring family as RUNNING code (ISSUE 10
+    tentpole): every registry topology executes on the 8-device mesh
+    and reproduces the elementwise oracle bit-exactly — the selector's
+    label (tests/test_algorithms.py) names a pattern that provably
+    computes the same reduction."""
+    from tpu_reductions.collectives import (make_topology_all_reduce,
+                                            select_algorithm)
+
+    mesh = build_mesh()
+    per = 1024 if topology != "naive" else 17   # naive: the indivisible
+    x = _payload("float32", per=per)            # length nothing else fits
+    fn = make_topology_all_reduce(method, mesh, "ranks",
+                                  topology=topology)
+    got = np.asarray(fn(shard_payload(x, mesh, "ranks")))
+    oracle = getattr(np, {"SUM": "sum", "MIN": "min", "MAX": "max"}
+                     [method])(x.reshape(K, per), axis=0)
+    if method == "SUM" and topology != "naive":
+        # RS+AG reassociates the sum; naive and MIN/MAX are order-free
+        np.testing.assert_allclose(got, oracle, rtol=1e-5)
+    else:
+        np.testing.assert_array_equal(got, oracle)
+    # and the selector names the pattern that just ran
+    assert select_algorithm(method, "float32", K, per,
+                            topology=topology).algorithm \
+        == {"ring": "ring_rs_ag", "bidir": "bidir_ring_rs_ag",
+            "torus2d": "torus2d_rs_ag", "naive": "naive_accumulate"}[topology]

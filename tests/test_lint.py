@@ -527,6 +527,44 @@ def test_red015_whitelists_staging_and_stream_and_honors_waiver(tmp_path):
                                             name="ops/fixture.py"))
 
 
+# ---------------------------------------------------------------- RED016
+
+
+def test_red016_flags_adhoc_ppermute_outside_collectives(tmp_path):
+    src = (
+        "import jax\n"
+        "from jax import lax\n"
+        "def hop(x, perm):\n"
+        "    y = jax.lax.ppermute(x, 'ranks', perm)\n"
+        "    return lax.ppermute(y, 'ranks', perm)\n"
+    )
+    findings = _lint_src(tmp_path, src, name="ops/fixture.py")
+    assert _rules(findings).count("RED016") == 2
+    hit = next(f for f in findings if f.rule == "RED016")
+    assert "collectives" in hit.message
+    # the import spelling is flagged too: a bound alias hides the chain
+    imported = ("from jax.lax import ppermute\n"
+                "def hop(x, perm):\n"
+                "    return ppermute(x, 'ranks', perm)\n")
+    findings = _lint_src(tmp_path, imported, name="bench/fixture.py")
+    assert _rules(findings).count("RED016") == 2  # import + call
+
+
+def test_red016_exempts_collectives_and_honors_waiver(tmp_path):
+    src = ("import jax\n"
+           "def hop(x, perm):\n"
+           "    return jax.lax.ppermute(x, 'ranks', perm)\n")
+    # the sanctioned home: the collective suite itself
+    assert "RED016" not in _rules(_lint_src(
+        tmp_path, src, name="tpu_reductions/collectives/fixture.py"))
+    waived = ("import jax\n"
+              "def hop(x, perm):\n"
+              "    # redlint: disable=RED016 -- registry cannot express this one-off probe\n"
+              "    return jax.lax.ppermute(x, 'ranks', perm)\n")
+    assert "RED016" not in _rules(_lint_src(tmp_path, waived,
+                                            name="ops/fixture.py"))
+
+
 # ---------------------------------------------------------------- RED008
 
 
@@ -657,6 +695,10 @@ def test_cli_positive_fixture_per_rule_exits_nonzero(tmp_path):
         "RED015": ("ops/r15.py", "import jax.numpy as jnp\n"
                                  "def f(x_np):\n"
                                  "    return jnp.asarray(x_np)\n"),
+        "RED016": ("ops/r16.py", "import jax\n"
+                                 "def f(x, perm):\n"
+                                 "    return jax.lax.ppermute("
+                                 "x, 'r', perm)\n"),
     }
     for rule, (name, src) in fixtures.items():
         f = tmp_path / name

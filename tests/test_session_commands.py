@@ -153,6 +153,12 @@ STEPS = [
      ["--method=SUM", "--type=int", "--n=65536", "--iterations=4",
       "--chainreps=2", "--grid=fine", "--out=tune_fine.json"],
      "tune_fine.json"),
+    ("python -m tpu_reductions.bench.quant_curve --platform=cpu "
+     "--out=examples/rank_scaling/quant_curve.json",
+     "tpu_reductions.bench.quant_curve",
+     ["--platform=cpu", "--ranks=2", "--bits=8", "--n=4096",
+      "--out=quant_curve.json"],
+     "quant_curve.json"),
     # the window scheduler's shell interface (run_scheduled_session):
     # one pick + one outcome record per loop iteration
     # (docs/SCHEDULER.md); rehearsed against the real registry's cpu

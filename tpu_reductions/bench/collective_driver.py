@@ -104,7 +104,7 @@ def collective_meta(cfg: CollectiveConfig) -> dict:
             "rooted": cfg.rooted, "mode": cfg.mode,
             "mapping": cfg.mapping, "timing": cfg.timing,
             "chain_span": cfg.chain_span, "quantized": cfg.quantized,
-            "seed": cfg.seed}
+            "quant_bits": cfg.quant_bits, "seed": cfg.seed}
 
 
 def _result_from_collective_row(row: dict) -> CollectiveResult:
@@ -177,7 +177,7 @@ def run_collective_benchmark(cfg: CollectiveConfig,
     # this function (results are host numpy), so the restore cannot
     # strand an in-flight f64 computation.
     with preserve_x64():
-        if cfg.dtype == "float64" and not _use_dd_planes(cfg.dtype):
+        if cfg.dtype == "float64" and not _dd_planes_for(cfg):
             # off-TPU native-f64 path needs x64; the dd pair path must
             # NOT get it — its whole point (and the FORCE_DD rehearsal
             # hook's) is running the 32-bit TPU numerics regime, where
@@ -201,6 +201,17 @@ def _use_dd_planes(dtype: str) -> bool:
         or os.environ.get("TPU_REDUCTIONS_FORCE_DD") == "1")
 
 
+def _dd_planes_for(cfg: CollectiveConfig) -> bool:
+    """Whether THIS run's f64 travels as 32-bit plane pairs: the
+    platform rule (_use_dd_planes), plus always under --quantized —
+    the quantized f64 wire (collectives/quant.py) is defined over the
+    host-split dd planes on every backend, so the CPU rehearsal
+    measures the same encoding the TPU would run (and never needs
+    x64)."""
+    return _use_dd_planes(cfg.dtype) or (cfg.quantized
+                                         and cfg.dtype == "float64")
+
+
 def _run_collective_benchmark(cfg: CollectiveConfig,
                               logger: BenchLogger,
                               checkpoint=None, row_key=None
@@ -217,10 +228,12 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
             checkpoint.add(res.to_dict())
         return res
 
-    from tpu_reductions.parallel.collectives import (
-        bandwidth_report, collective_algorithm, dd_ring_algorithm,
-        host_collective_oracle, local_view, local_view_and_selection,
-        make_collective_reduce, mesh_spans_processes, shard_payload)
+    from tpu_reductions.collectives import (
+        bandwidth_report, host_collective_oracle, local_view,
+        local_view_and_selection, make_collective_reduce,
+        mesh_spans_processes, select_algorithm, shard_payload)
+    from tpu_reductions.faults.inject import fault_point
+    from tpu_reductions.obs import ledger
     from tpu_reductions.parallel.mesh import build_mesh
 
     mesh = build_mesh(num_devices=cfg.num_devices,
@@ -237,16 +250,30 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
     # planes for MIN/MAX (see parallel.collectives docstrings); the
     # shared predicate also gates the x64 enable above so the forced
     # rehearsal keeps pure 32-bit TPU numerics (_use_dd_planes).
-    dd_planes = _use_dd_planes(dtype)
+    dd_planes = _dd_planes_for(cfg)
     x_np = _build_payload(cfg, k)
     rooted = cfg.rooted
     per_rank = cfg.n // k
     dd_scale = 0    # power-of-two pre-scale exponent of the dd SUM planes
+    # THE selector (collectives/algorithms.select_algorithm): one
+    # registry-driven decision names the wire pattern every branch below
+    # builds, so the algorithm column, busbw factor and resume artifact
+    # all describe the code that runs
+    sel = select_algorithm(method, dtype, k, per_rank, rooted=rooted,
+                           quantized=cfg.quantized, bits=cfg.quant_bits,
+                           dd_planes=dd_planes)
+    algorithm = sel.algorithm
+    ledger.emit("collective.select", algorithm=algorithm,
+                method=method, dtype=dtype, ranks=k,
+                wire_factor=round(sel.wire_factor, 6),
+                quantized=bool(cfg.quantized),
+                bits=(cfg.quant_bits if cfg.quantized else None))
     if dd_planes:
+        from tpu_reductions.collectives import (
+            make_dd_sum_all_reduce, make_key_minmax_all_reduce,
+            make_quant_key_minmax_all_reduce, make_quant_sum_all_reduce)
         from tpu_reductions.ops.dd_reduce import (host_key_encode,
                                                   host_split_scaled)
-        from tpu_reductions.parallel.collectives import (
-            make_dd_sum_all_reduce, make_key_minmax_all_reduce)
         if rooted == "scatter":
             # the pair collectives are all-reduce shaped; the result rows
             # keep rooted='scatter' (the REQUESTED mode) while the
@@ -268,35 +295,50 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
             # payload contract; a production variant would agree on the
             # max exponent with one tiny pmax first)
             hi, lo, dd_scale = host_split_scaled(x_np)
-            pair_fn = make_dd_sum_all_reduce(mesh, axis)
-            algorithm = dd_ring_algorithm(k, per_rank)
+            if cfg.quantized:
+                pair_fn = make_quant_sum_all_reduce(
+                    mesh, axis, bits=cfg.quant_bits, dtype="float64")
+                if algorithm == "all_reduce":
+                    logger.log("note: per-rank length does not divide "
+                               "by k*Q8_BLOCK; quantized ring fell back "
+                               "to the exact f32 psum (full wire)")
+            else:
+                pair_fn = make_dd_sum_all_reduce(mesh, axis)
         else:
             hi, lo = host_key_encode(x_np)
-            pair_fn = make_key_minmax_all_reduce(method, mesh, axis)
-            algorithm = "key_two_phase_all_reduce"
+            if cfg.quantized:
+                pair_fn = make_quant_key_minmax_all_reduce(
+                    method, mesh, axis, bits=cfg.quant_bits,
+                    dtype="float64")
+            else:
+                pair_fn = make_key_minmax_all_reduce(method, mesh, axis)
         x_dev = (shard_payload(hi, mesh, axis), shard_payload(lo, mesh, axis))
 
         def run(x):
             return pair_fn(*x)
     elif cfg.quantized:
-        from tpu_reductions.parallel.collectives import (
-            make_q8_sum_all_reduce, q8_ring_algorithm)
+        from tpu_reductions.collectives import (
+            make_quant_key_minmax_all_reduce, make_quant_sum_all_reduce)
         if rooted != "none":
             # the quantized ring replicates its output; root already
             # holds the full array — same note discipline as the dd pair
             logger.log("note: --rooted with --quantized runs the ring "
                        "all-reduce (replicated output)")
         x_dev = shard_payload(x_np, mesh, axis)
-        run = make_q8_sum_all_reduce(mesh, axis)
-        algorithm = q8_ring_algorithm(k, per_rank)
-        if algorithm == "all_reduce":
-            logger.log("note: per-rank length does not divide by "
-                       "k*Q8_BLOCK; quantized ring fell back to the "
-                       "exact f32 psum (full wire)")
+        if method == "SUM":
+            run = make_quant_sum_all_reduce(mesh, axis,
+                                            bits=cfg.quant_bits,
+                                            dtype=dtype)
+            if algorithm == "all_reduce":
+                logger.log("note: per-rank length does not divide by "
+                           "k*Q8_BLOCK; quantized ring fell back to the "
+                           "exact f32 psum (full wire)")
+        else:
+            run = make_quant_key_minmax_all_reduce(
+                method, mesh, axis, bits=cfg.quant_bits, dtype=dtype)
     else:
         x_dev = shard_payload(x_np, mesh, axis)
         run = make_collective_reduce(method, mesh, axis, rooted=rooted)
-        algorithm = collective_algorithm(method, k, per_rank, rooted)
 
     # bytes actually staged: k * (n // k) elements — when n % k != 0 the
     # remainder is dropped, as the reference's N/commSize split also does;
@@ -307,6 +349,21 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
     results: List[CollectiveResult] = []
     logger.log(COLLECTIVE_HEADER)
 
+    # the interruptible device unit of the rank-scaling sweep — a
+    # scripted stall/raise here is how a relay flap mid-sweep is
+    # rehearsed (tests/test_chaos_e2e.py's sweep-resume pipeline)
+    fault_point("collective.hop")
+    ledger.emit("collective.launch", algorithm=algorithm,
+                method=method, dtype=dtype, ranks=k, n=int(cfg.n))
+    _t_launch = Stopwatch()
+    _t_launch.start()
+
+    def _done() -> None:
+        ledger.emit("collective.done", algorithm=algorithm,
+                    method=method, dtype=dtype, ranks=k,
+                    wall_s=round(_t_launch.stop(), 6),
+                    rows=len(results))
+
     # warm-up collective (reduce.c:61-64)
     for _ in range(max(cfg.warmup, 1)):
         out = jax.block_until_ready(run(x_dev))
@@ -315,13 +372,18 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
     expect = None
     if cfg.verify:
         expect = host_collective_oracle(x_np, k, method)
-    # quantized acceptance: |err| <= k * (k * max|x| / 127) per element
-    # (one int8 rounding of a <= k*M partial per scatter hop + the one
-    # gather encode — make_q8_sum_all_reduce docstring). Zero when the
-    # geometry fell back to the exact psum.
-    quant_atol = (float(k * (k * np.abs(x_np).max() / 127.0))
-                  if cfg.quantized and algorithm == "q8_ring_rs_ag"
-                  else 0.0)
+    # quantized SUM acceptance: the declared per-element bound from the
+    # error model (collectives/quant.quant_error_bound — hop roundings
+    # of <= k*M partials, the error-feedback margin, and the bf16 cast /
+    # dd-collapse terms). Applied whenever --quantized SUM ran: the f64
+    # path's f32 hi+lo collapse is inside the bound even when the ring
+    # geometry fell back to the exact psum. Quantized MIN/MAX stays 0 —
+    # the coarse-key phases are exact and checked exactly.
+    quant_atol = 0.0
+    if cfg.quantized and method == "SUM":
+        from tpu_reductions.collectives import quant_error_bound
+        quant_atol = quant_error_bound(method, dtype, cfg.quant_bits, k,
+                                       float(np.abs(x_np).max()))
 
     timing = cfg.timing
     if timing == "chained":
@@ -331,7 +393,7 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
         # "retry" row here is one slope sample over chain_span
         # data-dependent in-program collectives. Chains the SAME closure
         # that was warmed up and verified above.
-        from tpu_reductions.parallel.collectives import (
+        from tpu_reductions.collectives import (
             make_chained_collective, make_chained_pair_collective)
         from tpu_reductions.utils.timing import time_chained
         if dd_planes:
@@ -379,6 +441,7 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
                 method, dtype, cfg.n, k, rep, rooted, dt,
                 bw["reference_gbps"], bw["busbw_gbps"], status,
                 algorithm))
+        _done()
         return results
 
     for rep in range(cfg.retries):
@@ -401,6 +464,7 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
         book(CollectiveResult(
             method, dtype, cfg.n, k, rep, rooted, dt,
             bw["reference_gbps"], bw["busbw_gbps"], status, algorithm))
+    _done()
     return results
 
 
@@ -413,7 +477,7 @@ def _gather_result(out, method: str, cfg: CollectiveConfig, k: int,
     interleaved mapping (parallel.collectives.local_view_and_selection).
     scale_exp undoes the dd SUM planes' exact power-of-two pre-scale
     (host_split_scaled)."""
-    from tpu_reductions.parallel.collectives import local_view_and_selection
+    from tpu_reductions.collectives import local_view_and_selection
     if dd_planes:
         if method == "SUM":
             hi_v, sel = local_view_and_selection(out[0])

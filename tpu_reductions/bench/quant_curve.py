@@ -1,0 +1,324 @@
+"""L5: the accuracy-vs-bandwidth curve — quantized collectives, measured.
+
+The reference publishes one number per (op, dtype, rank-count) cell and
+ships every payload byte at full width (reduce.c:81,95; its 2 GiB
+payload, mpi/constants.h:1-2). The quantized suite
+(collectives/quant.py, EQuARX-style — PAPERS.md 2506.17615) trades
+wire bytes for a bounded accumulation error; this instrument measures
+BOTH sides of that trade on the same grid and commits them as one
+artifact:
+
+  * wire reduction: declared bytes-on-the-wire of the selected
+    quantized algorithm vs the unquantized selection for the same
+    geometry — both read from the algorithm registry
+    (collectives/algorithms.py), never re-derived here, so the curve
+    and the running code cannot disagree;
+  * accuracy: max |quantized - float64 host oracle| per cell, printed
+    next to the DECLARED bound (collectives/quant.quant_error_bound) —
+    a cell whose measured error exceeds its declared bound FAILS, so
+    the committed curve is itself a bound-verification run. MIN/MAX
+    travel as order-preserving keys and must be bit-exact (bound 0).
+
+Grid: SUM x {float32, bfloat16, float64} x bits {4, 8, 16} and
+MIN/MAX x {float32, float64} x bits {8, 16}, each across the
+rank-count ladder (2..64 virtual ranks by default — in-process tests
+stop at 8, the conftest device count; the committed artifact at
+examples/rank_scaling/quant_curve.json climbs the full ladder).
+float64 rides the dd pair planes (ops/dd_reduce.py) — never x64.
+
+Every cell persists the moment it lands and resumes under the shared
+contract (bench/resume.Checkpoint, keyed (op, dtype, bits, ranks));
+rows print in the pinned `DATATYPE OP BITS NODES WIREX MAXERR BOUND`
+schema (lint/grammar.py).
+
+CLI:
+    python -m tpu_reductions.bench.quant_curve [--platform=cpu] \
+        [--n=1048576 --ranks=2,4,8,16,32,64 --seed=0] \
+        --out=quant_curve.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from tpu_reductions.lint.grammar import QUANT_CURVE_HEADER
+from tpu_reductions.obs import ledger
+from tpu_reductions.utils.logging import BenchLogger, quant_curve_row
+
+# the committed grid: every (op, dtype) the quantized suite supports,
+# at every registered bit width (collectives/quant.QUANT_BITS/KEY_BITS)
+SUM_DTYPES = ("float32", "bfloat16", "float64")
+SUM_BITS = (4, 8, 16)
+MINMAX_DTYPES = ("float32", "float64")
+MINMAX_BITS = (8, 16)
+DEFAULT_RANKS = (2, 4, 8, 16, 32, 64)
+
+
+def curve_cells(ranks=DEFAULT_RANKS, bits: Optional[tuple] = None
+                ) -> List[tuple]:
+    """The (method, dtype, bits, ranks) grid in artifact order — ops
+    grouped like the reference loop (MAX, MIN, SUM — reduce.c:73 runs
+    ops innermost; here SUM leads because its rows carry the bound
+    story), rank ladder innermost like submit_all.sh's node fan-out
+    (mpi/submit_all.sh:3-4)."""
+    cells = []
+    for dtype in SUM_DTYPES:
+        for b in (bits or SUM_BITS):
+            if b not in SUM_BITS:
+                continue
+            for k in ranks:
+                cells.append(("SUM", dtype, b, k))
+    for method in ("MIN", "MAX"):
+        for dtype in MINMAX_DTYPES:
+            for b in (bits or MINMAX_BITS):
+                if b not in MINMAX_BITS:
+                    continue
+                for k in ranks:
+                    cells.append((method, dtype, b, k))
+    return cells
+
+
+def measure_cell(method: str, dtype: str, bits: int, k: int, n: int,
+                 seed: int) -> dict:
+    """One curve cell: run the selected quantized collective on a
+    k-rank mesh, compare to the float64 host oracle, and report the
+    measured error next to the declared bound and the registry's wire
+    accounting. The elementwise-oracle discipline of the single-chip
+    bench (reduction.cpp:232-239) with the quantization bound as the
+    acceptance tolerance — MIN/MAX must be exact (order-preserving
+    keys), so their bound is 0 and the check is array_equal."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_reductions.collectives import (make_quant_key_minmax_all_reduce,
+                                            make_quant_sum_all_reduce,
+                                            quant_error_bound,
+                                            select_algorithm, shard_payload)
+
+    if n % k:
+        raise ValueError(f"--n={n} must divide by every rank count "
+                         f"(got k={k})")
+    per_rank = n // k
+    dd = dtype == "float64"
+    sel_q = select_algorithm(method, dtype, k, per_rank,
+                             quantized=True, bits=bits, dd_planes=dd)
+    sel_b = select_algorithm(method, dtype, k, per_rank, dd_planes=dd)
+    ledger.emit("collective.select", algorithm=sel_q.algorithm,
+                method=method, dtype=dtype, ranks=k, bits=bits,
+                wire_factor=round(sel_q.wire_factor, 6),
+                baseline=sel_b.algorithm,
+                baseline_wire_factor=round(sel_b.wire_factor, 6),
+                quantized=True)
+    mesh = Mesh(np.array(jax.devices()[:k]), ("ranks",))
+    # same draw for every (bits, op) at one (dtype, k): curves compare
+    # bit widths on identical data
+    rng = np.random.default_rng([seed, k])
+    ledger.emit("collective.launch", algorithm=sel_q.algorithm,
+                method=method, dtype=dtype, ranks=k, n=int(n))
+    from tpu_reductions.utils.timing import Stopwatch
+    watch = Stopwatch()
+    watch.start()
+    if dd:
+        x64 = rng.standard_normal(n)
+        m_abs = float(np.abs(x64).max())
+        if method == "SUM":
+            from tpu_reductions.ops.dd_reduce import host_split
+            hi, lo = host_split(x64)
+            fn = make_quant_sum_all_reduce(mesh, bits=bits, dtype=dtype)
+            o_hi, o_lo = fn(shard_payload(hi, mesh, "ranks"),
+                            shard_payload(lo, mesh, "ranks"))
+            got = (np.asarray(jax.device_get(o_hi)).astype(np.float64)
+                   + np.asarray(jax.device_get(o_lo)))
+            want = x64.reshape(k, -1).sum(axis=0)
+        else:
+            from tpu_reductions.ops.dd_reduce import (host_key_decode,
+                                                      host_key_encode)
+            k_hi, k_lo = host_key_encode(x64)
+            fn = make_quant_key_minmax_all_reduce(method, mesh, bits=bits,
+                                                  dtype=dtype)
+            m_hi, m_lo = fn(shard_payload(k_hi, mesh, "ranks"),
+                            shard_payload(k_lo, mesh, "ranks"))
+            got = host_key_decode(np.asarray(jax.device_get(m_hi)),
+                                  np.asarray(jax.device_get(m_lo)))
+            reduce = np.minimum if method == "MIN" else np.maximum
+            want = reduce.reduce(x64.reshape(k, -1), axis=0)
+    else:
+        import jax.numpy as jnp
+        x = rng.standard_normal(n).astype(np.float32)
+        if dtype == "bfloat16":
+            # redlint: disable=RED015 -- <= 4 MiB host-side dtype round-trip (n <= 2^20 f32), far under the 512 MiB staging bound
+            x = np.asarray(jnp.asarray(x, dtype=jnp.bfloat16))
+        m_abs = float(np.abs(x.astype(np.float32)).max())
+        xs = shard_payload(x, mesh, "ranks")
+        x64 = x.astype(np.float32).astype(np.float64)
+        if method == "SUM":
+            fn = make_quant_sum_all_reduce(mesh, bits=bits, dtype=dtype)
+            got = np.asarray(jax.device_get(fn(xs)).astype(jnp.float32)
+                             ).astype(np.float64)
+            want = x64.reshape(k, -1).sum(axis=0)
+        else:
+            fn = make_quant_key_minmax_all_reduce(method, mesh, bits=bits,
+                                                  dtype=dtype)
+            got = np.asarray(jax.device_get(fn(xs)).astype(jnp.float32)
+                             ).astype(np.float64)
+            reduce = np.minimum if method == "MIN" else np.maximum
+            want = reduce.reduce(x64.reshape(k, -1), axis=0)
+    wall_s = watch.stop()
+    bound = quant_error_bound(method, dtype, bits, k, m_abs)
+    max_err = float(np.abs(got - want).max())
+    exact = bool(np.array_equal(got, want))
+    ok = exact if bound == 0.0 else max_err <= bound
+    row = {"method": method, "dtype": dtype, "bits": bits, "ranks": k,
+           "n": int(n),
+           "algorithm": sel_q.algorithm,
+           "baseline_algorithm": sel_b.algorithm,
+           "wire_factor": sel_q.wire_factor,
+           "baseline_wire_factor": sel_b.wire_factor,
+           "wire_reduction": sel_b.wire_factor / sel_q.wire_factor,
+           "max_err": max_err, "bound": bound, "exact": exact,
+           "status": "PASSED" if ok else "FAILED"}
+    ledger.emit("collective.done", algorithm=sel_q.algorithm,
+                method=method, dtype=dtype, ranks=k,
+                wall_s=round(wall_s, 6), rows=1)
+    return row
+
+
+def run_curve(*, n: int, seed: int, ranks=DEFAULT_RANKS,
+              bits: Optional[tuple] = None, out: Optional[str] = None,
+              logger: Optional[BenchLogger] = None) -> List[dict]:
+    """The full grid with per-cell persist/resume — every row is on
+    disk the moment it lands (the live-window discipline every other
+    --out-writing instrument follows; bench/resume.Checkpoint). The
+    grid loop is the reference's op fan-out (reduce.c:73) crossed with
+    the node fan-out (mpi/submit_all.sh:3-4), plus the bits axis the
+    reference never had."""
+    from tpu_reductions.bench.resume import Checkpoint
+    logger = logger or BenchLogger(None, None)
+    ck = Checkpoint(out, {"n": n, "seed": seed},
+                    key_fn=lambda r: (r.get("method"), r.get("dtype"),
+                                      r.get("bits"), r.get("ranks")))
+    logger.log(QUANT_CURVE_HEADER)
+    rows = []
+    for method, dtype, b, k in curve_cells(ranks, bits):
+        key = (method, dtype, b, k)
+        row = ck.resume(key)
+        if row is None:
+            row = measure_cell(method, dtype, b, k, n, seed)
+            ck.add(row)
+        else:
+            ck.add(row)
+        logger.log(quant_curve_row(dtype, method, b, k,
+                                   row["wire_reduction"], row["max_err"],
+                                   row["bound"]))
+        rows.append(row)
+    ck.finalize()
+    return rows
+
+
+def quant_curve_markdown(data: dict) -> str:
+    """The report fold (bench/regen.py): the committed curve collapsed
+    to one row per (op, dtype, bits) — the wire factors are geometry-
+    normalized registry constants (both sides scale (k-1)/k), so the
+    rank axis only moves the error column and the table reports its
+    worst rung. Mirrors the reference's results tables
+    (mpi/results/INT_SUM.txt:2-4) with the wire/accuracy trade the
+    reference never measured."""
+    rows = [r for r in data.get("rows", []) if isinstance(r, dict)]
+    if not rows:
+        return ""
+    cells = {}
+    for r in rows:
+        key = (r["method"], r["dtype"], r["bits"])
+        prev = cells.get(key)
+        if prev is None or r["max_err"] > prev["max_err"]:
+            cells[key] = r
+    ranks = sorted({r["ranks"] for r in rows})
+    n_fail = sum(1 for r in rows if r.get("status") != "PASSED")
+    lines = [
+        "### Accuracy vs bandwidth (quantized collectives)",
+        "",
+        f"{len(rows)} cells across ranks {ranks} at n={rows[0]['n']}"
+        + (f" — **{n_fail} exceeded their declared bound**" if n_fail
+           else "; every measured error within its declared bound"),
+        "",
+        "| op | dtype | bits | algorithm | wire reduction | "
+        "worst max err | declared bound | exact |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for (method, dtype, bits), r in sorted(cells.items()):
+        lines.append(
+            f"| {method} | {dtype} | {bits} | {r['algorithm']} "
+            f"| {r['wire_reduction']:.3f}x | {r['max_err']:.3e} "
+            f"| {r['bound']:.3e} "
+            f"| {'yes' if r['exact'] else 'no'} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    """CLI: sweep bits x rank-count x op, one committed JSON artifact —
+    the submit_all.sh fan-out (mpi/submit_all.sh:3-4) turned into the
+    quantized suite's accuracy-vs-bandwidth instrument."""
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.bench.quant_curve",
+        description="Accuracy-vs-bandwidth curve of the quantized "
+                    "collective suite: wire reduction + measured error "
+                    "vs declared bound, per (op, dtype, bits, ranks)",
+    )
+    p.add_argument("--n", type=int, default=1 << 20,
+                   help="Global element count; must divide by every rank "
+                        "count AND keep per-rank a multiple of "
+                        "ranks*256 so the quantized ring engages "
+                        "(collectives/quant.quant_ring_applies)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ranks", type=str, default=None,
+                   help="Comma-separated rank ladder "
+                        f"(default {','.join(map(str, DEFAULT_RANKS))})")
+    p.add_argument("--bits", type=str, default=None,
+                   help="Comma-separated bit widths to restrict the grid")
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"))
+    p.add_argument("--out", type=str, default=None)
+    ns = p.parse_args(argv)
+    try:
+        ranks = (tuple(int(r) for r in ns.ranks.split(",") if r.strip())
+                 if ns.ranks else DEFAULT_RANKS)
+        bits = (tuple(int(b) for b in ns.bits.split(",") if b.strip())
+                if ns.bits else None)
+    except ValueError:
+        p.error(f"--ranks/--bits must be comma-separated ints")
+    if not ranks or any(k < 2 for k in ranks):
+        p.error(f"--ranks must all be >= 2, got {ns.ranks!r}")
+    if any(ns.n % k for k in ranks):
+        p.error(f"--n={ns.n} must divide by every rank count {ranks}")
+    from tpu_reductions.config import _apply_platform
+    # provision enough virtual CPU devices for the tallest rung
+    # (_apply_platform reads ns.num_devices, exactly like the sweep CLI)
+    ns.num_devices = max(ranks)
+    ns.mode = "vn"
+    _apply_platform(ns)
+    # flight recorder + watchdog BEFORE the first device touch
+    # (docs/OBSERVABILITY.md; RED011)
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("bench.quant_curve",
+                argv=list(argv) if argv else sys.argv[1:])
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()
+    logger = BenchLogger(None, None, console=sys.stdout)
+    rows = run_curve(n=ns.n, seed=ns.seed, ranks=ranks, bits=bits,
+                     out=ns.out, logger=logger)
+    if ns.out:
+        print(f"wrote {ns.out}")
+    bad = [r for r in rows if r["status"] != "PASSED"]
+    if bad:
+        for r in bad:
+            print(f"FAILED: {r['method']} {r['dtype']} {r['bits']}b "
+                  f"k={r['ranks']}: err {r['max_err']:.3e} > bound "
+                  f"{r['bound']:.3e}", file=sys.stderr)
+    return 1 if bad or not rows else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

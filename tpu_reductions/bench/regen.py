@@ -217,6 +217,25 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                 f"({', '.join(sorted(probes))})")
         except (OSError, ValueError, KeyError, TypeError) as e:
             log(f"regen: stream probes unusable ({e}); skipped")
+    # the quantized suite's accuracy-vs-bandwidth curve (ISSUE 10):
+    # the committed instrument lives with the rank-scaling evidence
+    # (examples/rank_scaling/quant_curve.json — the sibling experiment
+    # dir, same rank ladder); an out_dir-local copy wins if present
+    qc_file = out / "quant_curve.json"
+    if not qc_file.exists():
+        qc_file = out.parent / "rank_scaling" / "quant_curve.json"
+    if qc_file.exists():
+        try:
+            from tpu_reductions.bench.quant_curve import quant_curve_markdown
+            qc = json.loads(qc_file.read_text())
+            md = quant_curve_markdown(qc)
+            if md:
+                with open(paths["md"], "a") as f:
+                    f.write("\n" + md + "\n")
+                log(f"regen: appended accuracy-vs-bandwidth table "
+                    f"({qc_file})")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: quant_curve.json unusable ({e}); skipped")
     # the compile observatory's per-surface cold/warm table (ISSUE 8):
     # chip_session's exit trap copies compile_ledger.json next to the
     # evidence; the compile axis ships with the numbers it explains

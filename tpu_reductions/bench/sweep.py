@@ -15,7 +15,9 @@ raw -> collected -> averaged pipeline (aggregate.py).
 
 from __future__ import annotations
 
+import argparse
 import dataclasses
+import sys
 from pathlib import Path
 from typing import List, Optional
 
@@ -378,3 +380,77 @@ def sweep_all(*, methods=("SUM", "MIN", "MAX"),
         run_benchmark_batch(queued_cfgs, logger=logger,
                             on_result=on_result)
     return rows
+
+
+def main(argv=None) -> int:
+    """CLI over sweep_collective — the submit_all.sh analog as one
+    resumable subprocess (mpi/submit_all.sh:3-4 rank fan-out). Exists so
+    the chaos suite (tests/test_chaos_e2e.py) can kill a rank-scaling
+    sweep mid-ladder and assert the re-invocation resumes the persisted
+    per-rank-count rows instead of restarting at 2 ranks; the shell
+    pipeline (scripts/run_rank_scaling.sh) keeps its richer in-process
+    driver for the amortization probe."""
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.bench.sweep",
+        description="Resumable rank-count sweep of the collective "
+                    "benchmark (collective_sweep.json checkpoint)",
+    )
+    p.add_argument("--out-dir", dest="out_dir", type=str, required=True)
+    p.add_argument("--ranks", type=str, default="2,4,8",
+                   help="Comma-separated virtual rank counts")
+    p.add_argument("--methods", type=str, default="MAX,MIN,SUM",
+                   help="Reference op order (reduce.c:73)")
+    p.add_argument("--types", dest="dtypes", type=str,
+                   default="int32,float64")
+    p.add_argument("--n", type=int, default=1 << 20)
+    p.add_argument("--retries", type=int, default=1)
+    p.add_argument("--timing", type=str, default="periter",
+                   choices=("periter", "chained"))
+    p.add_argument("--chainspan", dest="chain_span", type=int, default=16)
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"))
+    ns = p.parse_args(argv)
+    from tpu_reductions.config import (DTYPE_ALIASES, METHODS,
+                                       _apply_platform)
+    methods = tuple(m.strip().upper() for m in ns.methods.split(",")
+                    if m.strip())
+    if not methods or any(m not in METHODS for m in methods):
+        p.error(f"--methods must name only {METHODS}, got {ns.methods!r}")
+    dtypes = tuple(DTYPE_ALIASES[d.strip()] for d in ns.dtypes.split(",")
+                   if d.strip() in DTYPE_ALIASES)
+    if not dtypes or len(dtypes) != len(
+            [d for d in ns.dtypes.split(",") if d.strip()]):
+        p.error(f"--types must name only {sorted(DTYPE_ALIASES)}, "
+                f"got {ns.dtypes!r}")
+    try:
+        rank_counts = tuple(int(r) for r in ns.ranks.split(",") if r.strip())
+    except ValueError:
+        p.error(f"--ranks must be comma-separated ints, got {ns.ranks!r}")
+    if not rank_counts or any(k < 2 for k in rank_counts):
+        p.error(f"--ranks must all be >= 2, got {ns.ranks!r}")
+    # provision enough virtual CPU devices for the tallest rung
+    # (_apply_platform reads ns.num_devices; mode is always vn here)
+    ns.num_devices = max(rank_counts)
+    ns.mode = "vn"
+    _apply_platform(ns)
+    # flight recorder + watchdog, armed together BEFORE the first device
+    # touch (docs/OBSERVABILITY.md; RED011) — a sweep hung on a dead
+    # relay must exit 3 with its completed rank rows persisted
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("bench.sweep", argv=list(argv) if argv else sys.argv[1:])
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()
+    logger = BenchLogger(None, None, console=sys.stderr)
+    rows = sweep_collective(rank_counts=rank_counts, methods=methods,
+                            dtypes=dtypes, n=ns.n, retries=ns.retries,
+                            timing=ns.timing, chain_span=ns.chain_span,
+                            out_dir=ns.out_dir, logger=logger)
+    bad = [r for r in rows if r.get("status") not in ("PASSED", "WAIVED")]
+    print(f"swept {len(rows)} rows across ranks={list(rank_counts)} "
+          f"-> {ns.out_dir}/collective_sweep.json"
+          + (f" ({len(bad)} FAILED)" if bad else ""))
+    return 1 if bad or not rows else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""Topology-aware, quantization-aware collective suite (ROADMAP item 4).
+
+The MPI_Reduce analog (reduce.c:76,90) as a subsystem:
+
+  rings.py       ONE copy of the ring RS+AG index arithmetic, generalized
+                 over payload/state/direction/sub-ring, + the explicit
+                 topology builders (ring / bidir / torus2d / naive)
+  quant.py       EQuARX-style block-scaled quantized wire forms
+                 (arXiv:2506.17615): 4/8/16-bit SUM rings with
+                 error-feedback residuals over f32/bf16/dd, and EXACT
+                 coarse-key MIN/MAX
+  algorithms.py  the registry of wire patterns with declared cost
+                 factors, and select_algorithm — the ONE place a label
+                 and its wire cost come from
+  core.py        the builders and host plumbing (sharding, oracles,
+                 chained-timing wrappers)
+
+parallel/collectives.py re-exports this namespace for the pre-package
+import paths; redlint RED016 fences ppermute ring construction in here.
+"""
+
+from tpu_reductions.collectives.algorithms import (
+    REGISTRY, ROOTED_MODES, WIRE_FACTORS, Algorithm, Selection,
+    algorithm_cost, bandwidth_report, choose_topology,
+    collective_algorithm, dd_ring_algorithm, normalize_rooted,
+    q8_ring_algorithm, quant_ring_algorithm, select_algorithm,
+    topology_supported)
+from tpu_reductions.collectives.core import (
+    host_collective_oracle, local_view, local_view_and_selection,
+    make_chained_collective, make_chained_pair_collective,
+    make_collective_reduce, make_dd_sum_all_reduce,
+    make_key_minmax_all_reduce, mesh_spans_processes, shard_payload)
+from tpu_reductions.collectives.quant import (
+    KEY_BITS, MINMAX_DTYPES, Q8_BLOCK, QUANT_BITS, QUANT_BLOCK,
+    SUM_DTYPES, block_decode, block_encode, levels,
+    make_q8_sum_all_reduce, make_quant_key_minmax_all_reduce,
+    make_quant_sum_all_reduce, quant_error_bound, quant_ring_applies,
+    quant_support_error, quant_supported)
+from tpu_reductions.collectives.rings import (
+    grid_factors, make_topology_all_reduce, naive_accumulate,
+    ring_perm, ring_rs_ag, ring_rs_ag_stateful, shard_map)
+
+__all__ = [
+    "REGISTRY", "ROOTED_MODES", "WIRE_FACTORS", "Algorithm", "Selection",
+    "algorithm_cost", "bandwidth_report", "choose_topology",
+    "collective_algorithm", "dd_ring_algorithm", "normalize_rooted",
+    "q8_ring_algorithm", "quant_ring_algorithm", "select_algorithm",
+    "topology_supported",
+    "host_collective_oracle", "local_view", "local_view_and_selection",
+    "make_chained_collective", "make_chained_pair_collective",
+    "make_collective_reduce", "make_dd_sum_all_reduce",
+    "make_key_minmax_all_reduce", "mesh_spans_processes", "shard_payload",
+    "KEY_BITS", "MINMAX_DTYPES", "Q8_BLOCK", "QUANT_BITS", "QUANT_BLOCK",
+    "SUM_DTYPES", "block_decode", "block_encode", "levels",
+    "make_q8_sum_all_reduce", "make_quant_key_minmax_all_reduce",
+    "make_quant_sum_all_reduce", "quant_error_bound",
+    "quant_ring_applies", "quant_support_error", "quant_supported",
+    "grid_factors", "make_topology_all_reduce", "naive_accumulate",
+    "ring_perm", "ring_rs_ag", "ring_rs_ag_stateful", "shard_map",
+]

@@ -1,0 +1,399 @@
+"""Cross-chip collective reductions — the MPI_Reduce analog over ICI.
+
+The reference times blocking rooted `MPI_Reduce(sendbuf, recvbuf, count,
+dtype, op, 0, MPI_COMM_WORLD)` (reduce.c:76,90): every rank holds
+N/commSize elements and the root receives the ELEMENTWISE op across ranks.
+The TPU-native equivalent (SURVEY.md §2.6):
+
+  MPI_Reduce(op)            ->  shard_map(lambda s: lax.psum/pmin/pmax(s, axis))
+                                over a Mesh — an all-reduce; "rooted"
+                                semantics via lax.psum_scatter (each rank
+                                keeps 1/k of the reduced array — the same
+                                bytes-on-wire as a rooted reduce tree)
+  per-rank sendbuf          ->  a global array sharded over the mesh axis
+  rank-0 recvbuf            ->  out_specs P(None) replication (all_reduce)
+                                or the scattered shard (reduce_scatter)
+
+Bandwidth accounting: the reference reports total-bytes / rank-0-time
+(reduce.c:78-79,92-93). We report that same "reference GB/s" for
+comparability, plus the standard collective metrics (NCCL-convention
+algorithm and bus bandwidth) so numbers are meaningful per-link:
+  algbw = payload_bytes / t
+  busbw = algbw * wire_factor(algorithm, k)   (collectives/algorithms.py)
+
+Package layout: explicit ring machinery lives in collectives/rings.py,
+quantized wire forms in collectives/quant.py, the algorithm registry +
+the ONE selector in collectives/algorithms.py; this module holds the
+builders and host-side plumbing. parallel/collectives.py remains as a
+re-export shim for the pre-package import paths.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_reductions.collectives.algorithms import (ROOTED_MODES,
+                                                   _halving_applies,
+                                                   normalize_rooted)
+from tpu_reductions.collectives.rings import (naive_accumulate,
+                                              ring_rs_ag, shard_map)
+from tpu_reductions.ops.registry import get_op
+
+_COLLECTIVES = {
+    "SUM": jax.lax.psum,
+    "MIN": jax.lax.pmin,
+    "MAX": jax.lax.pmax,
+}
+
+
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh includes devices owned by other processes —
+    the multi-host regime (N MPI ranks across nodes, reduce.c:32-34 ≙ N
+    jax processes over DCN), where only this process's shards are
+    addressable."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.ravel())
+
+
+def shard_payload(x_global: np.ndarray, mesh: Mesh, axis: str) -> jax.Array:
+    """Place a global (k*L,) payload sharded over the mesh axis — each
+    device ends up with its rank's contiguous L-element block, the analog
+    of each MPI rank generating/holding its own sendbuf (reduce.c:43-57).
+
+    Multi-host meshes take the callback path: every process stages the
+    same deterministic global payload (the rank-offset MT19937 contract,
+    reduce.c:38-41 — seeds derive from GLOBAL rank, so all hosts agree)
+    and contributes only its addressable shards."""
+    sharding = NamedSharding(mesh, P(axis))
+    if mesh_spans_processes(mesh):
+        return jax.make_array_from_callback(
+            x_global.shape, sharding, lambda idx: x_global[idx])
+    # Sharded placement: utils.staging's chunked path cannot express a
+    # NamedSharding, and each device receives only its n/k shard — the
+    # >512 MiB single-message relay hazard is the single-DEVICE staging
+    # path, which does go through utils/staging.py.
+    # redlint: disable=RED003 -- sharded n/k-per-device placement, not single-device bulk staging
+    return jax.device_put(x_global, sharding)
+
+
+def local_view(arr: jax.Array) -> np.ndarray:
+    """local_view_and_selection without the selector — this process's
+    recvbuf contents alone (e.g. as a chained-timing materializer,
+    utils/timing.time_chained)."""
+    return local_view_and_selection(arr)[0]
+
+
+def local_view_and_selection(arr: jax.Array):
+    """Materialize this process's view of a (possibly multi-host) array —
+    the analog of an MPI rank examining its recvbuf after MPI_Reduce
+    (reduce.c:76,90; only rank 0's was meaningful there, every process's
+    is here).
+
+    Returns (view, selector):
+      view      the full array when fully addressable (single host) or
+                when the output is replicated; else this process's shards
+                concatenated in global-index order.
+      selector  indexes the global result to what `view` holds:
+                slice(None) for a full/replicated view, else an integer
+                index array — which need NOT be contiguous (an
+                'interleaved' device mapping scatters one process's
+                shards across the global order), so a verifier must
+                apply it, not assume an offset.
+    """
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(arr)), slice(None)
+    shards = list(arr.addressable_shards)
+    if not shards:
+        raise RuntimeError(
+            "mesh excludes this process: no addressable shards (the "
+            "requested --devices count cut this process's devices out "
+            "of the mesh; every participating process must own at "
+            "least one mesh device)")
+    idx0 = shards[0].index[0] if shards[0].index else slice(None)
+    if idx0 == slice(None, None, None):     # replicated: any shard is whole
+        return np.asarray(shards[0].data), slice(None)
+    shards.sort(key=lambda s: s.index[0].start or 0)
+    view = np.concatenate([np.asarray(s.data) for s in shards])
+    sel = np.concatenate([
+        np.arange((s.index[0].start or 0),
+                  (s.index[0].start or 0) + int(np.asarray(s.data).shape[0]))
+        for s in shards])
+    return view, sel
+
+
+def make_collective_reduce(method: str, mesh: Mesh, axis: str = "ranks",
+                           rooted=False) -> Callable:
+    """Build the jitted collective: sharded (k*L,) -> reduced array.
+
+    rooted (see ROOTED_MODES; bools accepted for compatibility):
+      'none'    all-reduce; every rank holds the full elementwise-reduced
+                (L,) result (out replicated). The semantic superset of
+                MPI_Reduce — the reference materializes only on rank 0.
+      'scatter' reduce-scatter — each rank keeps L/k of the reduced
+                result, the rooted-reduce wire cost. SUM uses
+                lax.psum_scatter; MIN/MAX (no native scatter variant) use
+                a ppermute recursive-halving butterfly at the same
+                (k-1)/k wire cost when `_halving_applies`, else fall back
+                to reduce-fully-then-slice (all-reduce wire cost —
+                reported as such, `collective_algorithm`).
+      'root'    true reduce-to-root (MPI_Reduce recvbuf semantics,
+                reduce.c:76,90): reduce-scatter, then all-gather the
+                reduced pieces, so rank 0 — and, as a side effect of the
+                ring, every rank — holds the FULL reduced (L,) array.
+                Wire cost = RS + AG = the ring all-reduce's 2(k-1)/k.
+                When the scatter phase can't apply (indivisible lengths /
+                non-pow2 ranks for min/max) this degrades to the plain
+                all-reduce, which also satisfies root semantics.
+
+    `collective_algorithm(method, k, L, rooted)` names the path that will
+    run for a given per-rank length — the accounting must use it.
+    """
+    method = method.upper()
+    mode = normalize_rooted(rooted)
+    prim = _COLLECTIVES[method]
+    k = mesh.shape[axis]
+
+    if mode == "none" or k == 1:
+        def local(shard):
+            return prim(shard, axis)
+
+        fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P())
+        return jax.jit(fn)
+
+    def local_slice_fallback(shard):
+        # no scatter variant applies: reduce fully, keep this rank's
+        # slice (XLA still schedules the slice-discard efficiently; wire
+        # cost is the all-reduce's — `collective_algorithm` reports this
+        # path as 'all_reduce_slice' so the busbw column stays truthful).
+        full = prim(shard, axis)
+        r = jax.lax.axis_index(axis)
+        piece = full.shape[0] // k
+        return jax.lax.dynamic_slice_in_dim(full, r * piece, piece)
+
+    def local_minmax_halving(shard):
+        # Recursive-halving reduce-scatter on ppermute — the min/max
+        # twin of psum_scatter at the same (k-1)/k wire cost: log2(k)
+        # butterfly rounds, each exchanging the half of the working
+        # buffer the partner is responsible for and combining the rest.
+        # Round-by-round the kept offset follows this rank's bit at the
+        # current distance, which lands rank r on exactly slice r of the
+        # reduced vector (rank-major, psum_scatter tiled layout).
+        op = get_op(method)
+        r = jax.lax.axis_index(axis)
+        buf = shard
+        size = shard.shape[0]
+        d = k // 2
+        while d >= 1:
+            size //= 2
+            bit = (r // d) % 2
+            keep = jax.lax.dynamic_slice_in_dim(buf, bit * size, size)
+            send = jax.lax.dynamic_slice_in_dim(buf, (1 - bit) * size,
+                                                size)
+            recv = jax.lax.ppermute(send, axis,
+                                    [(i, i ^ d) for i in range(k)])
+            buf = op.jnp_combine(keep, recv)
+            d //= 2
+        return buf
+
+    def scatter_piece(shard):
+        # this rank's L/k slice of the reduced array at (k-1)/k wire
+        # cost, or None when no scatter algorithm applies to the geometry
+        # (the predicates mirror collective_algorithm exactly)
+        if method == "SUM":
+            if shard.shape[0] % k == 0:
+                return jax.lax.psum_scatter(shard, axis, tiled=True)
+            return None
+        if _halving_applies(k, shard.shape[0]):
+            return local_minmax_halving(shard)
+        return None
+
+    if mode == "scatter":
+        def dispatch(shard):
+            piece = scatter_piece(shard)
+            return piece if piece is not None else local_slice_fallback(shard)
+
+        fn = shard_map(dispatch, mesh=mesh, in_specs=P(axis),
+                       out_specs=P(axis))
+        return jax.jit(fn)
+
+    # mode == "root": RS + AG (ring all-reduce wire pattern made explicit)
+    def dispatch_root(shard):
+        piece = scatter_piece(shard)
+        if piece is None:
+            return prim(shard, axis)   # all-reduce: root holds full array
+        return jax.lax.all_gather(piece, axis, tiled=True)
+
+    # check_vma=False: the all-gather output IS replicated (every rank
+    # assembles the same reduced pieces) but the static replication
+    # checker cannot infer that through ppermute/all_gather — same
+    # waiver the dd ring needs.
+    fn = shard_map(dispatch_root, mesh=mesh, in_specs=P(axis),
+                   out_specs=P(), check_vma=False)
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# float64 collectives with no device f64 (TPU path)
+# ---------------------------------------------------------------------------
+
+
+def make_chained_collective(method: str, mesh: Mesh = None,
+                            axis: str = "ranks", rooted: bool = False,
+                            coll: Callable = None) -> Callable:
+    """`chained(x_sharded, k) -> scalar`: k data-dependent collective
+    reductions inside one compiled program, for honest slope timing
+    (ops/chain.py rationale — on the tunneled platform a blocked launch
+    returns on dispatch ack, so reduce.c's rdtsc-around-MPI_Reduce timing
+    structure (reduce.c:73-77) cannot be transplanted as-is; this is
+    that structure rebuilt with the sync INSIDE the compiled program).
+
+    `x` may be a single sharded plane or a tuple of planes (the dd SUM /
+    key MIN/MAX pair paths): each fori_loop step runs the collective,
+    then folds element [0] of the reduced output's first plane back into
+    shard 0 of the carried first plane with the op's own combine — the
+    next step's collective is data-dependent on this step's, so XLA can
+    neither hoist the loop-invariant collective nor elide any iteration.
+    (For MIN/MAX the carried value reaches a fixpoint after one step;
+    the dependency chain, and therefore per-iteration execution,
+    remains.) Fetching the returned scalar bounds the completion of all
+    k collectives; the chained scalar is for timing only — correctness
+    is verified on the unchained call (collective_driver).
+
+    Pass `coll` to chain an already-built closure (so the timed
+    collective is provably the one the caller verified): single-plane
+    closures take one array, pair closures take the planes as separate
+    arguments; otherwise one is built from (method, mesh, axis,
+    rooted)."""
+    op = get_op(method)
+    if coll is None:
+        coll = make_collective_reduce(method, mesh, axis, rooted=rooted)
+
+    def call(x):
+        return coll(*x) if isinstance(x, tuple) else coll(x)
+
+    def first_plane(y):
+        return y[0] if isinstance(y, tuple) else y
+
+    def chained(x, k):
+        out_sds = jax.eval_shape(call, x)
+        init = jnp.zeros((), first_plane(out_sds).dtype)  # scalar carry:
+        # the loop state stays identically sharded however coll's output
+        # is laid out (replicated all-reduce vs scattered rooted reduce)
+
+        def body(_, carry):
+            x, _last = carry
+            s = first_plane(call(x))[0]
+            if isinstance(x, tuple):
+                x0 = x[0].at[0].set(
+                    op.jnp_combine(x[0][0], s.astype(x[0].dtype)))
+                x = (x0,) + x[1:]
+            else:
+                x = x.at[0].set(op.jnp_combine(x[0], s.astype(x.dtype)))
+            return x, s
+
+        _, last = jax.lax.fori_loop(0, k, body, (x, init))
+        return last
+
+    return jax.jit(chained)
+
+
+def make_chained_pair_collective(method: str, coll: Callable) -> Callable:
+    """The pair-path spelling of make_chained_collective (same rebuilt
+    reduce.c:73-77 timing structure): `chained((hi, lo), k) -> scalar`
+    for the two-plane collectives (dd SUM, key MIN/MAX), whose closures
+    take the planes as separate arguments."""
+    return make_chained_collective(method, coll=coll)
+
+
+def make_dd_sum_all_reduce(mesh: Mesh, axis: str = "ranks") -> Callable:
+    """Elementwise f64-fidelity SUM across ranks carried as (hi, lo) f32
+    pairs — a RING all-reduce built from jax.lax.ppermute hops with
+    compensated (double-double) accumulation at every hop.
+
+    A plain psum of the hi/lo planes would round at f32 (~1e-7 relative),
+    missing the reference's f64 acceptance threshold of 1e-12
+    (reduction.cpp:764). The pair arithmetic stays error-free to ~2^-48:
+    every combine is a dd_add (dd_reduce._dd_add).
+
+    Wire pattern: when the per-rank length divides by k, the classic
+    bandwidth-optimal ring (collectives/rings.ring_rs_ag) — a
+    reduce-scatter phase (k-1 hops of L/k chunks, each arriving chunk
+    dd-added into the matching local chunk; after the last hop rank r
+    owns the fully reduced chunk (r+1) mod k) followed by an all-gather
+    phase (k-1 hops circulating the reduced chunks) — 2L(k-1)/k per rank
+    per plane, the pattern the ICI torus is built for. Each chunk is
+    reduced exactly once then broadcast, so replicas are bit-identical.
+    Indivisible lengths fall back to the naive accumulate-around-the-ring
+    (k-1 full-L hops; replicas there can differ by O(2^-48)
+    rotation-order error — far inside the 1e-12 acceptance band).
+    """
+    from tpu_reductions.ops.dd_reduce import _dd_add
+
+    k = mesh.shape[axis]
+
+    def local(hi, lo):
+        if k > 1 and hi.shape[0] % k == 0:   # static at trace time
+            # shared ring scaffold; the dd wire form is the pair itself
+            # (lossless), so from_wire(to_wire(.)) is the identity
+            return ring_rs_ag(
+                axis, k, (hi, lo),
+                to_wire=lambda ch: ch,
+                absorb=lambda tgt, rx: _dd_add(tgt[0], tgt[1],
+                                               rx[0], rx[1]),
+                from_wire=lambda w: w)
+        return naive_accumulate(
+            axis, k, (hi, lo),
+            combine=lambda acc, rx: _dd_add(acc[0], acc[1],
+                                            rx[0], rx[1]))
+
+    fn = shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
+                   out_specs=(P(), P()), check_vma=False)
+    return jax.jit(fn)
+
+
+def make_key_minmax_all_reduce(method: str, mesh: Mesh,
+                               axis: str = "ranks") -> Callable:
+    """EXACT f64 MIN/MAX across ranks on order-preserving int32 key pairs
+    (dd_reduce.host_key_encode) using two collective phases:
+
+      phase 1: m_hi = pmin/pmax(k_hi)            -- winning high word
+      phase 2: m_lo = pmin/pmax(where(k_hi == m_hi, k_lo, sentinel))
+               -- among ranks tied on the high word, select the low word
+
+    (m_hi, m_lo) is then the exact lexicographic winner: ranks not tied at
+    the high word are masked to the sentinel (the identity for the op), so
+    they cannot win phase 2. Decode on host is bit-exact
+    (dd_reduce.host_key_decode).
+    """
+    method = method.upper()
+    assert method in ("MIN", "MAX")
+    prim = _COLLECTIVES[method]
+    sentinel = jnp.int32(2**31 - 1) if method == "MIN" else jnp.int32(-2**31)
+
+    def local(k_hi, k_lo):
+        m_hi = prim(k_hi, axis)
+        cand = jnp.where(k_hi == m_hi, k_lo, sentinel)
+        m_lo = prim(cand, axis)
+        return m_hi, m_lo
+
+    fn = shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
+                   out_specs=(P(), P()))
+    return jax.jit(fn)
+
+
+def host_collective_oracle(x_global: np.ndarray, k: int, method: str
+                           ) -> np.ndarray:
+    """Elementwise host oracle: reshape (k, L) and combine across ranks.
+    The reference MPI program verified nothing (SURVEY.md §4 — 'the MPI
+    program has no correctness oracle at all'); we add the missing check."""
+    op = get_op(method)
+    blocks = np.asarray(x_global).reshape(k, -1)
+    if method.upper() == "SUM" and blocks.dtype == np.int32:
+        # match the device's wrapping int32 accumulator
+        return blocks.astype(np.int64).sum(axis=0).astype(np.int32)
+    return op.np_reduce(blocks, axis=0)

@@ -226,9 +226,12 @@ class CollectiveConfig:
     mapping: str = "default"
     mode: str = "vn"
     rooted: str = "none"             # none|scatter|root (bools accepted)
-    quantized: bool = False          # int8 block-quantized ring SUM
-                                     # (EQuARX-style wire compression;
-                                     # SUM float32 only)
+    quantized: bool = False          # block-quantized wire (EQuARX-style
+                                     # compression; SUM f32/bf16/f64-dd,
+                                     # exact coarse-key MIN/MAX f32/f64 —
+                                     # collectives/quant.quant_supported)
+    quant_bits: int = 8              # wire width for --quantized
+                                     # (SUM: 4|8|16; MIN/MAX keys: 8|16)
     backend: str = "xla"
     seed: int = 0
     verify: bool = True
@@ -260,11 +263,15 @@ class CollectiveConfig:
                              f"got {self.timing!r}")
         if self.chain_span <= 0:
             raise ValueError("chain_span must be positive")
-        if self.quantized and (self.method != "SUM"
-                               or self.dtype != "float32"):
-            raise ValueError("--quantized is SUM over float32 only "
-                             "(int8 quantization of other ops/dtypes "
-                             "has no exactness story)")
+        if self.quantized:
+            from tpu_reductions.collectives.quant import (
+                quant_support_error, quant_supported)
+            if not quant_supported(self.method, self.dtype,
+                                   self.quant_bits):
+                # actionable fail-fast: the error names the supported
+                # (op, dtype, bits) space instead of silently narrowing
+                raise ValueError(quant_support_error(
+                    self.method, self.dtype, self.quant_bits))
 
 
 def _add_common_flags(p: argparse.ArgumentParser) -> None:
@@ -481,10 +488,19 @@ def build_collective_parser() -> argparse.ArgumentParser:
     p.add_argument("--mode", type=str, default="vn", choices=("vn", "co"),
                    help="vn=all devices, co=one per chip (BG/L VN/CO analog)")
     p.add_argument("--quantized", action="store_true",
-                   help="int8 block-quantized ring SUM (EQuARX-style "
-                        "wire compression, ~25%% of f32 wire bytes; "
-                        "approximate — verified within the documented "
-                        "k^2*max/127 bound). SUM over float only")
+                   help="block-quantized wire (EQuARX-style compression, "
+                        "collectives/quant.py): SUM over float32/"
+                        "bfloat16/float64 rides a --quant-bits ring with "
+                        "error-feedback residuals (approximate — "
+                        "verified within the declared quant_error_bound);"
+                        " MIN/MAX over float32/float64 use coarse "
+                        "order-preserving keys and stay EXACT. "
+                        "Unsupported combos fail fast with the "
+                        "supported table (docs/COLLECTIVES.md)")
+    p.add_argument("--quant-bits", dest="quant_bits", type=int, default=8,
+                   help="wire width for --quantized: 4|8|16 for SUM "
+                        "block scaling, 8|16 for MIN/MAX coarse keys "
+                        "(default 8)")
     p.add_argument("--rooted", nargs="?", const="scatter", default="none",
                    choices=("none", "scatter", "root"),
                    help="Rooted reduce semantics: bare --rooted = "
@@ -530,7 +546,7 @@ def parse_collective(argv=None) -> CollectiveConfig:
         warmup=ns.warmup, num_devices=ns.num_devices, mapping=ns.mapping,
         mode=ns.mode, rooted=ns.rooted, seed=ns.seed, verify=ns.verify,
         qatest=ns.qatest, timing=ns.timing, chain_span=ns.chain_span,
-        quantized=ns.quantized,
+        quantized=ns.quantized, quant_bits=ns.quant_bits,
         coordinator=ns.coordinator, num_processes=ns.num_processes,
         process_id=ns.process_id, out=ns.out,
     )

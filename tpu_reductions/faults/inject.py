@@ -40,11 +40,15 @@ deterministic "executor died mid-plan" the plan-resume contract is
 tested against), `serve.batch` (one coalesced serving launch,
 serve/executor.py — a scripted `raise` proves the engine contains a
 batch crash to explicit error responses, tests/test_serve_chaos.py),
-and `stream.chunk` (one chunk of the streaming pipeline,
+`stream.chunk` (one chunk of the streaming pipeline,
 ops/stream.run_stream — a scripted `stall` mid-stream rehearses the
 round-2 relay-death-mid-payload shape against the partial-accumulator
-checkpoint, tests/test_stream_chaos.py). docs/RESILIENCE.md keeps the
-list.
+checkpoint, tests/test_stream_chaos.py), and `collective.hop` (fired
+once per collective benchmark launch just before the warmup dispatch,
+bench/collective_driver.py — a scripted `stall` mid rank-scaling sweep
+rehearses a relay death between ladder rungs, and the re-invoked sweep
+must resume its persisted per-rank-count rows byte-identically,
+tests/test_chaos_e2e.py). docs/RESILIENCE.md keeps the list.
 
 Counters are process-global and monotonic; `reset()` re-arms them for
 in-process tests (subprocesses start fresh by construction).

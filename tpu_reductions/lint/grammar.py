@@ -58,6 +58,24 @@ COLLECTIVE_ROW_TEMPLATE = "{dtype} {op} {ranks} {gbps:.3f}"
 COLLECTIVE_ROW_RE = re.compile(r"^[A-Z][A-Z0-9]* [A-Z]+ \d+ [0-9.]+$")
 
 # --------------------------------------------------------------------------
+# Quant-curve row schema (bench/quant_curve.py; ISSUE 10) — the
+# accuracy-vs-bandwidth instrument's stdout rows, one per (op, dtype,
+# bits, rank-count) cell: wire reduction vs the unquantized ring and
+# the measured |err| against its declared bound. Registered HERE like
+# the collective rows so the producer and any grep pipeline share one
+# byte-exact schema.
+# --------------------------------------------------------------------------
+
+QUANT_CURVE_COLUMNS = ("DATATYPE", "OP", "BITS", "NODES", "WIREX",
+                       "MAXERR", "BOUND")
+QUANT_CURVE_HEADER = " ".join(QUANT_CURVE_COLUMNS)
+
+QUANT_CURVE_ROW_TEMPLATE = ("{dtype} {op} {bits} {ranks} {wirex:.3f} "
+                            "{max_err:.3e} {bound:.3e}")
+QUANT_CURVE_ROW_RE = re.compile(
+    r"^[A-Z][A-Z0-9]* [A-Z]+ \d+ \d+ [0-9.]+ [0-9.e+-]+ [0-9.e+-]+$")
+
+# --------------------------------------------------------------------------
 # Flight-recorder event rows (obs/ledger.py; docs/OBSERVABILITY.md).
 # One JSON object per line, leading keys fixed as {"t": ..., "ev": ...,
 # "pid": ...} so awk/grep postmortems can key on byte offsets the same
@@ -104,6 +122,15 @@ SERVE_EVENTS = ("serve.start", "serve.enqueue", "serve.coalesce",
 STREAM_EVENTS = ("stream.start", "stream.chunk", "stream.sync",
                  "stream.serial", "stream.overlap", "stream.end")
 
+# the collective suite's typed events (tpu_reductions/collectives/ +
+# bench/collective_driver.py + bench/quant_curve.py; ISSUE 10 —
+# docs/COLLECTIVES.md): collective.select records the registry
+# selection (algorithm label + declared wire factor) for the geometry,
+# collective.launch/done bracket the device phase so obs/timeline's
+# collective_summary can attribute collective wall-clock per algorithm
+COLLECTIVE_EVENTS = ("collective.select", "collective.launch",
+                     "collective.done")
+
 # the compile observatory's typed events (obs/compile.py; ISSUE 8 —
 # docs/OBSERVABILITY.md "reading the compile table"): every XLA/Pallas
 # compile bracketed with its surface id, lower/compile split where the
@@ -148,7 +175,7 @@ SHELL_EVENTS = (
 
 REGISTERED_EVENTS = frozenset(CORE_EVENTS + SHELL_EVENTS + SCHED_EVENTS
                               + SERVE_EVENTS + STREAM_EVENTS
-                              + COMPILE_EVENTS)
+                              + COMPILE_EVENTS + COLLECTIVE_EVENTS)
 
 
 def event_registered(name: str) -> bool:
@@ -250,9 +277,13 @@ def _check_line(line: str) -> str | None:
                 return (f"throughput literal {line!r} deviates from the "
                         f"reduction.cpp:744-745 template "
                         f"'{THROUGHPUT_TEMPLATE}'")
-    if "DATATYPE" in s and s != COLLECTIVE_HEADER:
-        # a literal mentioning the header's lead token must BE the header
+    if ("DATATYPE" in s and s != COLLECTIVE_HEADER
+            and s != QUANT_CURVE_HEADER):
+        # a literal mentioning the header's lead token must BE one of
+        # the registered headers (the collective row schema or the
+        # quant-curve extension of it)
         if s.startswith("DATATYPE "):
             return (f"collective header literal {line!r} != golden "
-                    f"'{COLLECTIVE_HEADER}' (reduce.c:67-69)")
+                    f"'{COLLECTIVE_HEADER}' (reduce.c:67-69) or "
+                    f"'{QUANT_CURVE_HEADER}' (bench/quant_curve.py)")
     return None

@@ -58,6 +58,11 @@ SERVE_EXECUTOR_WHITELIST = ("serve/executor.py",)
 # double-buffered pipeline); ISSUE 7, docs/STREAMING.md
 STAGE_INGEST_WHITELIST = ("utils/staging.py", "ops/stream.py")
 STAGE_INGEST_SCOPE_DIRS = ("ops", "bench", "serve", "utils", "parallel")
+# RED016: cross-device wire patterns (jax.lax.ppermute rings) live in
+# the collective suite and nowhere else — an ad-hoc ring has no
+# registry entry, so its wire cost is invisible to the selector, the
+# curve and the busbw accounting (ISSUE 10; docs/COLLECTIVES.md)
+COLLECTIVES_SCOPE_DIR = "collectives"
 
 # RED006 applies to the measured packages only: every public surface in
 # ops/ and bench/ must carry its reference citation (PARITY.md).
@@ -172,6 +177,7 @@ def check_python(rel_posix: str, source: str) -> List[RawFinding]:
     out += _red013(rel_posix, ctx)
     out += _red014(rel_posix, ctx)
     out += _red015(rel_posix, ctx)
+    out += _red016(rel_posix, ctx)
     # nested timing scopes can double-report the same call site
     return sorted(set(out), key=lambda f: (f.line, f.rule, f.message))
 
@@ -671,6 +677,46 @@ def _red015(rel: str, ctx: _FileContext) -> List[RawFinding]:
                 "utils.staging (bounded chunks) or ops/stream.py (the "
                 "double-buffered pipeline), or waive with the payload's "
                 "size bound as the reason"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED016 — ad-hoc cross-device ring construction outside the collective
+# suite. `jax.lax.ppermute` IS the ring primitive: every hop pattern
+# built on it must live in tpu_reductions/collectives/ where the
+# algorithm registry (collectives/algorithms.py) declares its wire
+# factor and step count — a ring spelled anywhere else is invisible to
+# the selector, the accuracy-vs-bandwidth curve and the busbw
+# accounting, so its cost model silently drifts from the code
+# (ISSUE 10; docs/COLLECTIVES.md).
+# --------------------------------------------------------------------------
+
+
+def _red016(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    parts = rel.split("/")
+    if COLLECTIVES_SCOPE_DIR in parts[:-1]:
+        return []
+    msg = ("outside tpu_reductions/collectives/ — ring wire patterns "
+           "belong to the collective suite, where the algorithm "
+           "registry (collectives/algorithms.py) declares their wire "
+           "cost; build on make_topology_all_reduce / ring_rs_ag, or "
+           "waive with the reason the registry cannot express this "
+           "pattern")
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod in ("jax.lax", "jax._src.lax.parallel"):
+                for n in node.names:
+                    if n.name == "ppermute":
+                        out.append(RawFinding(
+                            "RED016", node.lineno,
+                            f"import of ppermute {msg}"))
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain.endswith(".ppermute") or chain == "ppermute":
+                out.append(RawFinding(
+                    "RED016", node.lineno, f"{chain}() {msg}"))
     return out
 
 

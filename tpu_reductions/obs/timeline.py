@@ -297,6 +297,42 @@ def stream_summary(events: List[dict]) -> Optional[dict]:
     return out
 
 
+def collective_summary(events: List[dict]) -> Optional[dict]:
+    """Collective-phase attribution from the collective.* typed events
+    (lint/grammar.py COLLECTIVE_EVENTS; bench/collective_driver.py +
+    bench/quant_curve.py). The ISSUE-10 answer to "where did the
+    collective minutes go": per selected algorithm, how many launches
+    ran and how much wall-clock their device phases took (the
+    launch/done brackets), plus how often the selector fell back off
+    its first choice (a select whose note marks a degrade). None when
+    no collective ran."""
+    selects = [e for e in events if e["ev"] == "collective.select"]
+    dones = [e for e in events if e["ev"] == "collective.done"]
+    launches = sum(1 for e in events if e["ev"] == "collective.launch")
+    if not selects and not dones and not launches:
+        return None
+    algos: dict = {}
+    order: List[str] = []
+    total_s = 0.0
+    for e in dones:
+        a = e.get("algorithm")
+        if not isinstance(a, str):
+            continue
+        if a not in algos:
+            algos[a] = {"algorithm": a, "launches": 0, "wall_s": 0.0}
+            order.append(a)
+        algos[a]["launches"] += 1
+        d = e.get("wall_s")
+        if isinstance(d, (int, float)):
+            algos[a]["wall_s"] += float(d)
+            total_s += float(d)
+    for rec in algos.values():
+        rec["wall_s"] = round(rec["wall_s"], 6)
+    return {"selects": len(selects), "launches": launches,
+            "collective_s": round(total_s, 6),
+            "algorithms": [algos[a] for a in order]}
+
+
 def compile_summary(events: List[dict]) -> Optional[dict]:
     """Per-surface compile attribution from the compile observatory's
     typed events (compile.start/end, warm.* — lint/grammar.py
@@ -355,6 +391,9 @@ def summarize(path, events: List[dict], torn: int) -> dict:
     stream = stream_summary(events)
     if stream is not None:
         out["stream"] = stream
+    coll = collective_summary(events)
+    if coll is not None:
+        out["collective"] = coll
     comp = compile_summary(events)
     if comp is not None:
         out["compile"] = comp
@@ -512,6 +551,25 @@ def summary_markdown(summary: dict) -> str:
                 f"overlap efficiency x{stream['overlap_efficiency']} "
                 f"(serial {stream.get('serial_wall_s', '?')} s vs "
                 f"streamed {stream.get('stream_wall_s', '?')} s)")
+    coll = summary.get("collective")
+    if coll:
+        # the collective suite's record (ISSUE 10): per selected
+        # algorithm, launches and device-phase wall-clock — the
+        # collective share of the window, attributed by the registry
+        # label the ONE selector picked
+        lines.append("")
+        lines.append("### collective (per-algorithm attribution)")
+        lines.append("")
+        lines.append("| algorithm | launches | wall s |")
+        lines.append("|---|---|---|")
+        for rec in coll["algorithms"]:
+            lines.append(f"| {rec['algorithm']} | {rec['launches']} "
+                         f"| {rec['wall_s']:.3f} |")
+        lines.append("")
+        lines.append(f"{coll['selects']} selection(s), "
+                     f"{coll['launches']} launch(es), "
+                     f"{coll['collective_s']:.2f} s in collective "
+                     "device phases")
     comp = summary.get("compile")
     if comp:
         # the compile observatory's record (ISSUE 8): per-surface

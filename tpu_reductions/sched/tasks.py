@@ -229,6 +229,21 @@ SESSION_TASKS: Tuple[Task, ...] = (
                             "--grid=fine --out=tune_fine.json"),
          artifacts=("tune_fine.json",), done_artifact="tune_fine.json",
          requires=("smoke",), surfaces=("k6", "k7", "k8")),
+    Task("quant_curve", "accuracy-vs-bandwidth curve", value=140.0,
+         budget_s=300,
+         # off-chip by design (virtual CPU mesh up to 64 ranks —
+         # bench/quant_curve.py): safe with the relay dead, so it is
+         # ideal flap-time filler; the committed artifact lives with
+         # the rank-scaling evidence and bench/regen folds it into
+         # report.md from there
+         command=("python -m tpu_reductions.bench.quant_curve "
+                  "--platform=cpu "
+                  "--out=examples/rank_scaling/quant_curve.json"),
+         rehearsal_command=("python -m tpu_reductions.bench.quant_curve "
+                            "--platform=cpu --ranks=2,4,8 --n=262144 "
+                            "--out=quant_curve.json"),
+         artifacts=("examples/rank_scaling/quant_curve.json",),
+         done_artifact="examples/rank_scaling/quant_curve.json"),
     Task("flagship", "flagship experiment", value=300.0, budget_s=10800,
          command="bash scripts/run_tpu_experiment.sh examples/tpu_run",
          artifacts=("examples/tpu_run",),

@@ -19,6 +19,7 @@ from typing import Optional, TextIO
 
 from tpu_reductions.lint.grammar import (COLLECTIVE_HEADER,
                                          COLLECTIVE_ROW_TEMPLATE,
+                                         QUANT_CURVE_ROW_TEMPLATE,
                                          THROUGHPUT_TEMPLATE)
 
 
@@ -41,6 +42,19 @@ def collective_row(dtype: str, op: str, ranks: int, gbps: float) -> str:
     return COLLECTIVE_ROW_TEMPLATE.format(
         dtype=names.get(dtype, dtype.upper()), op=op.upper(), ranks=ranks,
         gbps=gbps)
+
+
+def quant_curve_row(dtype: str, op: str, bits: int, ranks: int,
+                    wirex: float, max_err: float, bound: float) -> str:
+    """One accuracy-vs-bandwidth curve row (bench/quant_curve.py):
+    `DATATYPE OP BITS NODES WIREX MAXERR BOUND` — the quantized-suite
+    extension of the MPI rank-0 schema (reduce.c:81,95), same upper-cased
+    dtype spelling, template pinned in lint/grammar.py."""
+    names = {"int32": "INT", "float64": "DOUBLE", "float32": "FLOAT",
+             "bfloat16": "BF16"}
+    return QUANT_CURVE_ROW_TEMPLATE.format(
+        dtype=names.get(dtype, dtype.upper()), op=op.upper(), bits=bits,
+        ranks=ranks, wirex=wirex, max_err=max_err, bound=bound)
 
 
 # COLLECTIVE_HEADER (reduce.c:67-69) is imported from lint/grammar.py
