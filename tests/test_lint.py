@@ -766,3 +766,110 @@ def test_lint_paths_walks_directories(tmp_path):
 def test_lint_paths_missing_path_raises():
     with pytest.raises(FileNotFoundError):
         lint_paths(["/nonexistent/definitely/missing"])
+
+
+# --------------------------------------------- degraded comment scan
+
+
+def test_fallback_scan_ignores_hash_inside_strings(tmp_path):
+    # tokenize dies on the unclosed paren (TokenError), so the engine
+    # degrades to the line scan — which must NOT read the waiver-shaped
+    # string literal on line 1 as a live waiver (and then flag it
+    # RED009-stale)
+    src = ('x = "a # redlint: disable=RED001 -- nope"\n'
+           "y = (1,\n")
+    findings = _lint_src(tmp_path, src, name="broken.py")
+    assert _rules(findings) == ["RED???"]  # just the syntax finding
+
+
+def test_fallback_scan_still_parses_real_trailing_waivers(tmp_path):
+    # same degraded path, but a genuine comment after code survives the
+    # quote walk (and, being unmatched, goes RED009) — and is reported
+    # exactly once despite tokenize banking it before the error
+    src = ("x = 1  # redlint: disable=RED001 -- kept\n"
+           "y = (1,\n")
+    findings = _lint_src(tmp_path, src, name="broken2.py")
+    assert sorted(_rules(findings)) == ["RED009", "RED???"]
+    assert _rules(findings).count("RED009") == 1
+
+
+# ------------------------------------------------- fix_stale_waivers
+
+
+def test_fix_stale_waivers_round_trip(tmp_path):
+    from tpu_reductions.lint.fixers import fix_stale_waivers
+    f = tmp_path / "w.py"
+    f.write_text(
+        "# redlint: disable=RED003 -- standalone, nothing below\n"
+        "x = 1\n"
+        "y = 2  # redlint: disable=RED001 -- trailing, nothing here\n"
+        "import jax\n"
+        "z = jax.device_put(1)  # redlint: disable=RED003 -- used: fixture\n")
+    changed = fix_stale_waivers([f], flow=False)
+    assert [(Path(p).name, ln) for p, ln, _ in changed] == \
+        [("w.py", 3), ("w.py", 1)]          # bottom-up
+    assert f.read_text() == (
+        "x = 1\n"
+        "y = 2\n"
+        "import jax\n"
+        "z = jax.device_put(1)  # redlint: disable=RED003 -- used: fixture\n")
+    assert _rules(lint_file(f)) == []       # clean after the fix
+    assert fix_stale_waivers([f], flow=False) == []   # idempotent
+
+
+def test_fix_stale_waivers_cli(tmp_path):
+    f = tmp_path / "w.py"
+    f.write_text("x = 1  # redlint: disable=RED004 -- dead\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_reductions.lint", str(f),
+         "--fix-stale-waivers", "--flow-cache="],
+        capture_output=True, text=True, cwd=str(Path(__file__).parents[1]))
+    assert proc.returncode == 0
+    assert f.read_text() == "x = 1\n"
+
+
+# --------------------------------------- waivers over decorated defs
+
+
+def test_standalone_waiver_reaches_through_decorators(tmp_path):
+    # RED006 anchors at the def line; a standalone waiver written above
+    # the decorator (where humans put it) must still apply
+    src = ("# redlint: disable=RED006 -- fixture: private-ish helper\n"
+           "@staticmethod\n"
+           "@property\n"
+           "def f():\n"
+           "    pass\n")
+    assert _rules(_lint_src(tmp_path, src, name="ops/deco.py")) == []
+    # and it is USED, not RED009-stale
+    src_no_def = ("# redlint: disable=RED006 -- fixture\n"
+                  "@staticmethod\n"
+                  "x = 1\n")
+    findings = _lint_src(tmp_path, src_no_def, name="ops/deco2.py")
+    assert "RED009" in _rules(findings)
+
+
+# ----------------------------------------------- JSON schema pinning
+
+
+def test_cli_json_schema_and_ordering(tmp_path):
+    # schema pin: exactly {rule, path, line, message}, rows sorted by
+    # (path, line, rule) — downstream tooling depends on both
+    (tmp_path / "b.py").write_text("import jax\n"
+                                   "x = jax.device_put(1)\n"
+                                   "y = jax.device_put(2)\n")
+    (tmp_path / "a.py").write_text(
+        "import os\n"
+        'os.environ["JAX_PLATFORMS"] = "x"\n'
+        "import jax\n"
+        "z = jax.device_put(3)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_reductions.lint", str(tmp_path),
+         "--format=json", "--flow-cache="],
+        capture_output=True, text=True, cwd=str(Path(__file__).parents[1]))
+    assert proc.returncode == 1
+    rows = json.loads(proc.stdout)
+    assert all(set(r) == {"rule", "path", "line", "message"} for r in rows)
+    keys = [(r["path"], r["line"], r["rule"]) for r in rows]
+    assert keys == sorted(keys)
+    assert [r["rule"] for r in rows] == ["RED004", "RED003",
+                                        "RED003", "RED003"]

@@ -2,20 +2,45 @@
 
 Every hard-won environment rule (CLAUDE.md) the linter encodes is only
 worth anything if the repo enforces it on itself: this test runs the
-full pass over the package, the session scripts and the repo-root entry
-points and asserts zero findings — pre-existing violations were either
-fixed or carry a reasoned inline waiver (docs/LINT.md).
+full pass — per-file rules AND the whole-program flow layer
+(RED017-RED020, docs/LINT.md) — over the package, the session scripts
+and the repo-root entry points and asserts zero findings; pre-existing
+violations were either fixed or carry a reasoned inline waiver.
 """
 
+import time
 from pathlib import Path
 
 from tpu_reductions.lint.engine import lint_paths
 
 REPO = Path(__file__).resolve().parents[1]
+TARGETS = [REPO / "tpu_reductions", REPO / "scripts",
+           REPO / "bench.py", REPO / "__graft_entry__.py"]
 
 
 def test_repo_is_redlint_clean():
-    targets = [REPO / "tpu_reductions", REPO / "scripts",
-               REPO / "bench.py", REPO / "__graft_entry__.py"]
-    findings = lint_paths(targets)
+    findings = lint_paths(TARGETS)
     assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_repo_clean_without_flow_too():
+    # the per-file rules must not depend on the flow pass masking them
+    findings = lint_paths(TARGETS, flow=False)
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_warm_cached_flow_pass_is_fast(tmp_path):
+    """The fact cache earns its keep: a warm whole-program pass over
+    the full repo must stay well under the per-file pass's own order of
+    magnitude (budget generous vs the ~1 s cold pass so CI jitter
+    cannot flake it, but tight enough that an accidental
+    cache-invalidation bug — e.g. a schema key that never matches —
+    shows up as a timing regression here)."""
+    cache = tmp_path / "lint_cache.json"
+    lint_paths(TARGETS, flow_cache=str(cache))      # cold: fills cache
+    assert cache.exists()
+    t0 = time.perf_counter()
+    findings = lint_paths(TARGETS, flow_cache=str(cache))
+    warm_s = time.perf_counter() - t0
+    assert findings == []
+    assert warm_s < 5.0, f"warm cached lint took {warm_s:.2f}s"

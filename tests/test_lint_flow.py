@@ -1,0 +1,476 @@
+"""Whole-program flow-layer fixtures: RED017-RED020 (violating +
+clean pairs), the call-graph/cache machinery, waivers on flow rules,
+and the interprocedural acceptance probe (a bench entry with its gate
+deleted must fire RED017 through an intermediate helper frame).
+
+Fixture trees live under a `proj/` package subdir so absolute imports
+(`from proj.work import helper`) resolve against the scan root — the
+same layout contract the real scan has (`tpu_reductions/` scanned from
+the repo root).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from tpu_reductions.lint.engine import FLOW_RULES, lint_file, lint_paths
+from tpu_reductions.lint.flow.callgraph import module_name_for
+from tpu_reductions.lint.flow.dataflow import (analyze_flow,
+                                               build_cached_project,
+                                               export_graph)
+
+REPO = Path(__file__).parents[1]
+
+
+def _tree(tmp_path, files):
+    root = tmp_path / "proj"
+    for rel, src in files.items():
+        f = root / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(src)
+    return root
+
+
+def _flow(root, cache=None):
+    files = sorted(root.rglob("*.py"))
+    return analyze_flow(files, [root], rels={f: str(f) for f in files},
+                        cache_path=cache)
+
+
+def _flat(raws):
+    return sorted((rel, f.rule, f.line)
+                  for rel, lst in raws.items() for f in lst)
+
+
+def _rules(raws):
+    return sorted(f.rule for lst in raws.values() for f in lst)
+
+
+# ---------------------------------------------------------------- RED017
+
+
+UNGATED_CLI = (
+    "from proj.work import helper\n"
+    "\n"
+    "def main():\n"
+    "    helper()\n"
+    "\n"
+    "if __name__ == \"__main__\":\n"
+    "    main()\n")
+
+DEVICE_WORK = (
+    "import jax\n"
+    "\n"
+    "def helper():\n"
+    "    return deeper()\n"
+    "\n"
+    "def deeper():\n"
+    "    return jax.devices()\n")
+
+
+def test_red017_fires_through_helper_frames(tmp_path):
+    root = _tree(tmp_path, {"cli.py": UNGATED_CLI,
+                            "work.py": DEVICE_WORK})
+    raws = _flow(root)
+    flat = _flat(raws)
+    assert len(flat) == 1
+    rel, rule, line = flat[0]
+    assert rule == "RED017" and rel.endswith("cli.py") and line == 7
+    msg = next(iter(raws.values()))[0].message
+    # the witness chain names the intermediate frames
+    assert "proj.work.helper" in msg and "proj.work.deeper" in msg
+
+
+def test_red017_clean_when_gated(tmp_path):
+    gated = UNGATED_CLI.replace(
+        "def main():\n",
+        "def main():\n"
+        "    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu\n"
+        "    maybe_arm_for_tpu()\n")
+    root = _tree(tmp_path, {"cli.py": gated, "work.py": DEVICE_WORK})
+    assert _flow(root) == {}
+
+
+def test_red017_gate_inside_callee_counts(tmp_path):
+    # a helper that arms the gate internally gates everything after it
+    src = (
+        "from proj.work import helper\n"
+        "\n"
+        "def boot():\n"
+        "    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu\n"
+        "    maybe_arm_for_tpu()\n"
+        "\n"
+        "def main():\n"
+        "    boot()\n"
+        "    helper()\n"
+        "\n"
+        "if __name__ == \"__main__\":\n"
+        "    main()\n")
+    root = _tree(tmp_path, {"cli.py": src, "work.py": DEVICE_WORK})
+    assert _flow(root) == {}
+
+
+def test_module_level_touch_is_not_an_entry(tmp_path):
+    # no __main__ guard -> no entry -> RED017/RED019 stay quiet (the
+    # per-file rules own module-level touches)
+    root = _tree(tmp_path, {"mod.py": "import jax\nx = jax.devices()\n"})
+    assert _flow(root) == {}
+
+
+# ---------------------------------------------------------------- RED019
+
+
+GATED_DISPATCH_CLI = (
+    "from proj.work import push\n"
+    "\n"
+    "def main():\n"
+    "    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu\n"
+    "    maybe_arm_for_tpu()\n"
+    "    push()\n"
+    "\n"
+    "if __name__ == \"__main__\":\n"
+    "    main()\n")
+
+RAW_DISPATCH = (
+    "import jax\n"
+    "\n"
+    "def push():\n"
+    "    return jax.device_put(1)\n")
+
+
+def test_red019_fires_on_unguarded_dispatch(tmp_path):
+    root = _tree(tmp_path, {"cli.py": GATED_DISPATCH_CLI,
+                            "work.py": RAW_DISPATCH})
+    raws = _flow(root)
+    assert _rules(raws) == ["RED019"]
+    [(rel, _, line)] = _flat(raws)
+    assert rel.endswith("cli.py") and line == 9
+
+
+def test_red019_clean_under_retry(tmp_path):
+    retried = (
+        "import jax\n"
+        "from tpu_reductions.utils.retry import retry_device_call\n"
+        "\n"
+        "def push():\n"
+        "    return retry_device_call(lambda: jax.device_put(1))\n")
+    root = _tree(tmp_path, {"cli.py": GATED_DISPATCH_CLI,
+                            "work.py": retried})
+    assert _flow(root) == {}
+
+
+def test_red019_clean_under_heartbeat_guard(tmp_path):
+    guarded = (
+        "import jax\n"
+        "from tpu_reductions.utils import heartbeat\n"
+        "\n"
+        "def push():\n"
+        "    with heartbeat.guard(\"push\"):\n"
+        "        return jax.device_put(1)\n")
+    root = _tree(tmp_path, {"cli.py": GATED_DISPATCH_CLI,
+                            "work.py": guarded})
+    assert _flow(root) == {}
+
+
+def test_bare_jit_closure_creation_is_not_dispatch(tmp_path):
+    # jax.jit(f) builds a lazy closure; only the immediately-invoked
+    # jax.jit(f)(x) form dispatches (callgraph '()' marker)
+    lazy = ("import jax\n\n"
+            "def push():\n"
+            "    return jax.jit(abs)\n")
+    root = _tree(tmp_path, {"cli.py": GATED_DISPATCH_CLI,
+                            "work.py": lazy})
+    assert _flow(root) == {}
+    invoked = ("import jax\n\n"
+               "def push():\n"
+               "    return jax.jit(abs)(-1)\n")
+    root2 = _tree(tmp_path / "b", {"cli.py": GATED_DISPATCH_CLI,
+                                   "work.py": invoked})
+    assert _rules(_flow(root2)) == ["RED019"]
+
+
+# ---------------------------------------------------------------- RED018
+
+
+def test_red018_fires_on_sync_reaching_call_in_window(tmp_path):
+    bench = (
+        "import time\n"
+        "from proj.work import settle\n"
+        "\n"
+        "def measure():\n"
+        "    t0 = time.perf_counter()\n"
+        "    settle()\n"
+        "    return time.perf_counter() - t0\n")
+    work = ("import jax\n\n"
+            "def settle():\n"
+            "    return jax.block_until_ready(1)\n")
+    root = _tree(tmp_path, {"bench.py": bench, "work.py": work})
+    raws = _flow(root)
+    assert _rules(raws) == ["RED018"]
+    [(rel, _, line)] = _flat(raws)
+    assert rel.endswith("bench.py") and line == 6
+
+
+def test_red018_clean_without_sync_in_callee(tmp_path):
+    bench = (
+        "import time\n"
+        "from proj.work import settle\n"
+        "\n"
+        "def measure():\n"
+        "    t0 = time.perf_counter()\n"
+        "    settle()\n"
+        "    return time.perf_counter() - t0\n")
+    work = "def settle():\n    return 41 + 1\n"
+    root = _tree(tmp_path, {"bench.py": bench, "work.py": work})
+    assert _flow(root) == {}
+
+
+def test_red018_own_sync_stays_red002_territory(tmp_path):
+    # an in-function sync inside a window is the per-file RED002's
+    # finding; the flow rule must not double-report it
+    bench = (
+        "import time\n"
+        "import jax\n"
+        "\n"
+        "def measure(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    jax.block_until_ready(x)\n"
+        "    return time.perf_counter() - t0\n")
+    root = _tree(tmp_path, {"bench.py": bench})
+    assert _flow(root) == {}
+
+
+# ---------------------------------------------------------------- RED020
+
+
+def test_red020_fires_on_aliased_unstaged_ingest(tmp_path):
+    # `from jax.numpy import asarray` is invisible to the literal
+    # per-file RED015 spelling match — the flow rule sees the binding
+    cli = (
+        "from jax.numpy import asarray\n"
+        "\n"
+        "def load(x):\n"
+        "    return asarray(x)\n"
+        "\n"
+        "def main():\n"
+        "    load([1, 2])\n"
+        "\n"
+        "if __name__ == \"__main__\":\n"
+        "    main()\n")
+    root = _tree(tmp_path, {"cli.py": cli})
+    raws = _flow(root)
+    assert _rules(raws) == ["RED020"]
+    [(rel, _, line)] = _flat(raws)
+    assert rel.endswith("cli.py") and line == 4
+
+
+def test_red020_clean_behind_staging_node(tmp_path):
+    cli = (
+        "from jax.numpy import asarray\n"
+        "from tpu_reductions.utils.staging import maybe_chunked_stage\n"
+        "\n"
+        "def load(x):\n"
+        "    return asarray(x)\n"
+        "\n"
+        "def stage_entry(x):\n"
+        "    maybe_chunked_stage(x)\n"
+        "    return load(x)\n"
+        "\n"
+        "def main():\n"
+        "    stage_entry([1, 2])\n"
+        "\n"
+        "if __name__ == \"__main__\":\n"
+        "    main()\n")
+    root = _tree(tmp_path, {"cli.py": cli})
+    assert _flow(root) == {}
+
+
+def test_red020_defers_to_red015_in_scope_dirs(tmp_path):
+    # literal jnp.asarray in a RED015 scope dir keeps its RED015
+    # finding/waiver; RED020 must not double-report the same site
+    cli = (
+        "import jax.numpy as jnp\n"
+        "\n"
+        "def main():\n"
+        "    jnp.asarray([1])\n"
+        "\n"
+        "if __name__ == \"__main__\":\n"
+        "    main()\n")
+    root = _tree(tmp_path, {"ops/cli.py": cli})
+    assert "RED020" not in _rules(_flow(root))
+
+
+# ------------------------------------------------------- waivers on flow
+
+
+def test_flow_findings_respect_inline_waivers(tmp_path):
+    root = _tree(tmp_path, {
+        "cli.py": UNGATED_CLI.replace(
+            "    main()\n",
+            "    main()  # redlint: disable=RED017 -- fixture: probe "
+            "entry, gate armed by the harness\n"),
+        "work.py": DEVICE_WORK})
+    findings = lint_paths([root])
+    assert [f.rule for f in findings] == []
+
+
+def test_multi_rule_waiver_suppresses_both_flow_rules(tmp_path):
+    # one entry line carrying both RED017 and RED019, one waiver comment
+    cli = (
+        "from proj.work import push\n"
+        "\n"
+        "def main():\n"
+        "    push()\n"
+        "\n"
+        "if __name__ == \"__main__\":\n"
+        "    main()  # redlint: disable=RED017,RED019 -- fixture: both "
+        "flow rules on one entry\n")
+    # invoked-jit dispatch: invisible to the per-file rules, so
+    # lint_paths' residue is exactly the flow findings
+    work = ("import jax\n\n"
+            "def push():\n"
+            "    return jax.jit(abs)(-1)\n")
+    root = _tree(tmp_path, {"cli.py": cli, "work.py": work})
+    assert _rules(_flow(root)) == ["RED017", "RED019"]  # raw pass sees 2
+    assert [f.rule for f in lint_paths([root])] == []   # waiver eats both
+
+
+def test_flow_waiver_not_stale_without_flow_context(tmp_path):
+    # single-file lint (no whole-program pass) cannot judge a
+    # RED017-RED020 waiver stale ...
+    f = tmp_path / "cli.py"
+    f.write_text(UNGATED_CLI.replace(
+        "    main()\n",
+        "    main()  # redlint: disable=RED017 -- fixture reason\n"))
+    assert [x.rule for x in lint_file(f)] == []
+    # ... but with flow active a genuinely dead flow waiver IS stale
+    g = tmp_path / "proj" / "other.py"
+    g.parent.mkdir()
+    g.write_text("x = 1  # redlint: disable=RED019 -- nothing here\n")
+    findings = lint_paths([g.parent])
+    assert [x.rule for x in findings] == ["RED009"]
+
+
+# -------------------------------------------------------- cache + graph
+
+
+def test_fact_cache_roundtrip_and_invalidation(tmp_path):
+    root = _tree(tmp_path, {"cli.py": UNGATED_CLI,
+                            "work.py": DEVICE_WORK})
+    cache = tmp_path / "cache.json"
+    cold = _flat(_flow(root, cache=cache))
+    assert cache.exists()
+    payload = json.loads(cache.read_text())
+    assert "version" in payload and len(payload["files"]) == 2
+    warm = _flat(_flow(root, cache=cache))
+    assert warm == cold and cold and cold[0][1] == "RED017"
+    # content change invalidates just that file: gate the entry, the
+    # finding disappears on the next cached run
+    (root / "cli.py").write_text(UNGATED_CLI.replace(
+        "def main():\n",
+        "def main():\n"
+        "    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu\n"
+        "    maybe_arm_for_tpu()\n"))
+    assert _flow(root, cache=cache) == {}
+
+
+def test_corrupt_cache_is_ignored(tmp_path):
+    root = _tree(tmp_path, {"cli.py": UNGATED_CLI,
+                            "work.py": DEVICE_WORK})
+    cache = tmp_path / "cache.json"
+    cache.write_text("{not json")
+    assert _rules(_flow(root, cache=cache)) == ["RED017"]
+
+
+def test_graph_export_json_and_dot(tmp_path):
+    root = _tree(tmp_path, {"cli.py": UNGATED_CLI,
+                            "work.py": DEVICE_WORK})
+    files = sorted(root.rglob("*.py"))
+    project = build_cached_project(files, [root],
+                                   rels={f: str(f) for f in files})
+    g = json.loads(export_graph(project, "json"))
+    ids = {n["id"] for n in g["functions"]}
+    assert "proj.work::deeper" in ids and "proj.cli::<main>" in ids
+    deeper = next(n for n in g["functions"]
+                  if n["id"] == "proj.work::deeper")
+    assert "TOUCHES_DEVICE" in deeper["facts"]
+    edges = {(e["from"], e["to"]) for e in g["edges"]}
+    assert ("proj.cli::main", "proj.work::helper") in edges
+    dot = export_graph(project, "dot")
+    assert dot.startswith("digraph") and '"proj.work::deeper"' in dot
+
+
+def test_unresolved_dynamic_calls_are_recorded(tmp_path):
+    src = ("def run(fns):\n"
+           "    fns[0]()\n")
+    root = _tree(tmp_path, {"mod.py": src})
+    project = build_cached_project(sorted(root.rglob("*.py")), [root])
+    (_, fi) = project.nodes["proj.mod::run"]
+    assert [c.resolved for c in fi.calls] == [False]
+
+
+def test_module_name_for_layout():
+    assert module_name_for(
+        REPO / "tpu_reductions" / "bench" / "spot.py",
+        [REPO / "tpu_reductions"]) == "tpu_reductions.bench.spot"
+    assert module_name_for(
+        REPO / "tpu_reductions" / "lint" / "__init__.py",
+        [REPO / "tpu_reductions"]) == "tpu_reductions.lint"
+
+
+# ------------------------------------------- acceptance: real bench entry
+
+
+def test_deleting_gate_from_real_bench_entry_fires_red017(tmp_path):
+    """ISSUE 11 acceptance: drop maybe_arm_for_tpu() from a real bench
+    entry point and RED017 must fire through at least one intermediate
+    helper frame (main -> run_spots -> run_benchmark), proving the
+    analysis is interprocedural rather than pattern-matched."""
+    root = tmp_path / "tpu_reductions"
+    for rel in ("bench/spot.py", "bench/driver.py"):
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text((REPO / "tpu_reductions" / rel).read_text())
+    # control: the committed sources are gated and guarded -> clean
+    assert _flow(root) == {}
+    spot = root / "bench" / "spot.py"
+    src = spot.read_text()
+    assert "maybe_arm_for_tpu()" in src
+    spot.write_text(src.replace("maybe_arm_for_tpu()",
+                                "disabled_gate_probe()"))
+    raws = _flow(root)
+    flat = _flat(raws)
+    assert any(rule == "RED017" and rel.endswith("bench/spot.py")
+               for rel, rule, _ in flat), flat
+    msg = next(f.message for lst in raws.values() for f in lst
+               if f.rule == "RED017")
+    assert "run_spots" in msg     # the intermediate helper frame
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_cli_no_flow_and_graph(tmp_path):
+    root = _tree(tmp_path, {"cli.py": UNGATED_CLI,
+                            "work.py": DEVICE_WORK})
+    base = [sys.executable, "-m", "tpu_reductions.lint", str(root),
+            "--flow-cache="]
+    cwd = str(REPO)
+    hot = subprocess.run(base, capture_output=True, text=True, cwd=cwd)
+    assert hot.returncode == 1 and "RED017" in hot.stdout
+    off = subprocess.run(base + ["--no-flow"], capture_output=True,
+                         text=True, cwd=cwd)
+    assert off.returncode == 0 and "clean" in off.stdout
+    graph = subprocess.run(base + ["--graph=json"], capture_output=True,
+                           text=True, cwd=cwd)
+    assert graph.returncode == 0
+    payload = json.loads(graph.stdout)
+    assert payload["modules"] == 2
+
+
+def test_flow_rules_constant_matches_docs():
+    assert FLOW_RULES == ("RED017", "RED018", "RED019", "RED020")
+    docs = (REPO / "docs" / "LINT.md").read_text()
+    for rule in FLOW_RULES:
+        assert rule in docs

@@ -364,9 +364,16 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
                     wall_s=round(_t_launch.stop(), 6),
                     rows=len(results))
 
-    # warm-up collective (reduce.c:61-64)
-    for _ in range(max(cfg.warmup, 1)):
-        out = jax.block_until_ready(run(x_dev))
+    # warm-up collective (reduce.c:61-64). Guarded: this is the first
+    # blocking dispatch of the run — the timed path below guards itself
+    # inside time_chained, but a relay that stalls DURING warm-up would
+    # otherwise hang with live ports, invisible to the port-probe
+    # watchdog (redlint RED019).
+    from tpu_reductions.utils import heartbeat
+    with heartbeat.guard("collective.warmup"):
+        for _ in range(max(cfg.warmup, 1)):
+            out = jax.block_until_ready(run(x_dev))
+            heartbeat.tick()
 
     # host oracle (the check reduce.c never had)
     expect = None
@@ -594,6 +601,19 @@ def main(argv=None) -> int:
     except Exception as e:   # config validation (bad --method value, ...)
         print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
         return qa_finish(name, QAStatus.FAILED, out=qa_out)
+    # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md;
+    # every process emits — events carry pid, so a multi-process ledger
+    # still splits into per-process sessions in the timeline CLI).
+    # Armed BEFORE the multi-host bring-up: jax.process_index() below is
+    # a backend touch, and a backend touch under a dead relay hangs
+    # forever unless the watchdog is already probing (redlint RED017
+    # found this gap — the gate used to arm after bring-up).
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("bench.collective_driver", argv=args)
+    # a collective hung on a mid-run relay death reports nothing; exit
+    # promptly instead (utils/watchdog.py; no-op off-TPU)
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()
     try:
         if cfg.num_processes and cfg.num_processes > 1:
             # multi-host bring-up BEFORE any device touch (the mpirun
@@ -631,15 +651,6 @@ def main(argv=None) -> int:
     logger = BenchLogger(None, None,
                          console=open(os.devnull, "w")
                          if (cfg.qatest or not reporting) else None)
-    # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md;
-    # every process emits — events carry pid, so a multi-process ledger
-    # still splits into per-process sessions in the timeline CLI)
-    from tpu_reductions.obs.ledger import arm_session
-    arm_session("bench.collective_driver", argv=args)
-    # a collective hung on a mid-run relay death reports nothing; exit
-    # promptly instead (utils/watchdog.py; no-op off-TPU)
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
-    maybe_arm_for_tpu()
     # --out: the Checkpoint resume discipline every other --out-writing
     # entry point already has (bench/resume.py) — rows persisted the
     # moment they land, an interrupted run's rows reused on

@@ -121,51 +121,57 @@ def measure_cell(method: str, dtype: str, bits: int, k: int, n: int,
     from tpu_reductions.utils.timing import Stopwatch
     watch = Stopwatch()
     watch.start()
-    if dd:
-        x64 = rng.standard_normal(n)
-        m_abs = float(np.abs(x64).max())
-        if method == "SUM":
-            from tpu_reductions.ops.dd_reduce import host_split
-            hi, lo = host_split(x64)
-            fn = make_quant_sum_all_reduce(mesh, bits=bits, dtype=dtype)
-            o_hi, o_lo = fn(shard_payload(hi, mesh, "ranks"),
-                            shard_payload(lo, mesh, "ranks"))
-            got = (np.asarray(jax.device_get(o_hi)).astype(np.float64)
-                   + np.asarray(jax.device_get(o_lo)))
-            want = x64.reshape(k, -1).sum(axis=0)
+    # the cell's one blocking device region: quantized collective
+    # dispatch + result materialization. Guarded so a relay that
+    # stalls mid-cell trips the heartbeat (exit 4) instead of
+    # hanging with live ports (redlint RED019).
+    from tpu_reductions.utils import heartbeat
+    with heartbeat.guard("quant.cell"):
+        if dd:
+            x64 = rng.standard_normal(n)
+            m_abs = float(np.abs(x64).max())
+            if method == "SUM":
+                from tpu_reductions.ops.dd_reduce import host_split
+                hi, lo = host_split(x64)
+                fn = make_quant_sum_all_reduce(mesh, bits=bits, dtype=dtype)
+                o_hi, o_lo = fn(shard_payload(hi, mesh, "ranks"),
+                                shard_payload(lo, mesh, "ranks"))
+                got = (np.asarray(jax.device_get(o_hi)).astype(np.float64)
+                       + np.asarray(jax.device_get(o_lo)))
+                want = x64.reshape(k, -1).sum(axis=0)
+            else:
+                from tpu_reductions.ops.dd_reduce import (host_key_decode,
+                                                          host_key_encode)
+                k_hi, k_lo = host_key_encode(x64)
+                fn = make_quant_key_minmax_all_reduce(method, mesh, bits=bits,
+                                                      dtype=dtype)
+                m_hi, m_lo = fn(shard_payload(k_hi, mesh, "ranks"),
+                                shard_payload(k_lo, mesh, "ranks"))
+                got = host_key_decode(np.asarray(jax.device_get(m_hi)),
+                                      np.asarray(jax.device_get(m_lo)))
+                reduce = np.minimum if method == "MIN" else np.maximum
+                want = reduce.reduce(x64.reshape(k, -1), axis=0)
         else:
-            from tpu_reductions.ops.dd_reduce import (host_key_decode,
-                                                      host_key_encode)
-            k_hi, k_lo = host_key_encode(x64)
-            fn = make_quant_key_minmax_all_reduce(method, mesh, bits=bits,
-                                                  dtype=dtype)
-            m_hi, m_lo = fn(shard_payload(k_hi, mesh, "ranks"),
-                            shard_payload(k_lo, mesh, "ranks"))
-            got = host_key_decode(np.asarray(jax.device_get(m_hi)),
-                                  np.asarray(jax.device_get(m_lo)))
-            reduce = np.minimum if method == "MIN" else np.maximum
-            want = reduce.reduce(x64.reshape(k, -1), axis=0)
-    else:
-        import jax.numpy as jnp
-        x = rng.standard_normal(n).astype(np.float32)
-        if dtype == "bfloat16":
-            # redlint: disable=RED015 -- <= 4 MiB host-side dtype round-trip (n <= 2^20 f32), far under the 512 MiB staging bound
-            x = np.asarray(jnp.asarray(x, dtype=jnp.bfloat16))
-        m_abs = float(np.abs(x.astype(np.float32)).max())
-        xs = shard_payload(x, mesh, "ranks")
-        x64 = x.astype(np.float32).astype(np.float64)
-        if method == "SUM":
-            fn = make_quant_sum_all_reduce(mesh, bits=bits, dtype=dtype)
-            got = np.asarray(jax.device_get(fn(xs)).astype(jnp.float32)
-                             ).astype(np.float64)
-            want = x64.reshape(k, -1).sum(axis=0)
-        else:
-            fn = make_quant_key_minmax_all_reduce(method, mesh, bits=bits,
-                                                  dtype=dtype)
-            got = np.asarray(jax.device_get(fn(xs)).astype(jnp.float32)
-                             ).astype(np.float64)
-            reduce = np.minimum if method == "MIN" else np.maximum
-            want = reduce.reduce(x64.reshape(k, -1), axis=0)
+            import jax.numpy as jnp
+            x = rng.standard_normal(n).astype(np.float32)
+            if dtype == "bfloat16":
+                # redlint: disable=RED015 -- <= 4 MiB host-side dtype round-trip (n <= 2^20 f32), far under the 512 MiB staging bound
+                x = np.asarray(jnp.asarray(x, dtype=jnp.bfloat16))
+            m_abs = float(np.abs(x.astype(np.float32)).max())
+            xs = shard_payload(x, mesh, "ranks")
+            x64 = x.astype(np.float32).astype(np.float64)
+            if method == "SUM":
+                fn = make_quant_sum_all_reduce(mesh, bits=bits, dtype=dtype)
+                got = np.asarray(jax.device_get(fn(xs)).astype(jnp.float32)
+                                 ).astype(np.float64)
+                want = x64.reshape(k, -1).sum(axis=0)
+            else:
+                fn = make_quant_key_minmax_all_reduce(method, mesh, bits=bits,
+                                                      dtype=dtype)
+                got = np.asarray(jax.device_get(fn(xs)).astype(jnp.float32)
+                                 ).astype(np.float64)
+                reduce = np.minimum if method == "MIN" else np.maximum
+                want = reduce.reduce(x64.reshape(k, -1), axis=0)
     wall_s = watch.stop()
     bound = quant_error_bound(method, dtype, bits, k, m_abs)
     max_err = float(np.abs(got - want).max())

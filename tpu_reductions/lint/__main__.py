@@ -1,11 +1,17 @@
 """redlint CLI.
 
     python -m tpu_reductions.lint [paths...] [--format=text|json]
-                                  [--fix-docstrings]
+                                  [--no-flow] [--flow-cache=FILE]
+                                  [--graph=dot|json]
+                                  [--fix-docstrings] [--fix-stale-waivers]
 
 Exit codes: 0 clean, 1 findings, 2 usage error (argparse). JSON output
-is a list of {rule, path, line, message} objects — one per violation —
-for machine consumption (CI annotations, the test gate).
+is a list of {rule, path, line, message} objects — one per violation,
+sorted by (path, line, rule) — for machine consumption (CI annotations,
+the test gate). The whole-program device-flow pass (RED017-RED020,
+lint/flow/) runs by default with a content-hash fact cache at
+.lint_cache.json; --graph prints the resolved call graph + facts
+instead of linting (the ROADMAP-4 seam inventory).
 """
 
 from __future__ import annotations
@@ -15,33 +21,73 @@ import json
 import sys
 
 from tpu_reductions.lint.engine import lint_paths, summarize
-from tpu_reductions.lint.fixers import fix_docstrings
+from tpu_reductions.lint.fixers import fix_docstrings, fix_stale_waivers
+
+
+def _print_graph(paths, fmt: str, cache: str | None) -> int:
+    from pathlib import Path
+
+    from tpu_reductions.lint.engine import iter_lintable
+    from tpu_reductions.lint.flow.dataflow import (build_cached_project,
+                                                   export_graph)
+    py = [f for f in iter_lintable(paths) if f.suffix == ".py"]
+    project = build_cached_project(
+        py, [Path(p) for p in paths],
+        rels={f: str(f).replace("\\", "/") for f in py},
+        cache_path=Path(cache) if cache else None)
+    print(export_graph(project, fmt))
+    return 0
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tpu_reductions.lint",
         description="redlint: static checks for the repo's TPU safety & "
-                    "timing doctrine (rules RED001-RED008; docs/LINT.md)")
+                    "timing doctrine (rules RED001-RED020; docs/LINT.md)")
     p.add_argument("paths", nargs="*", default=None,
                    help="files or directories to lint (default: the "
                         "tpu_reductions package + scripts/)")
     p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--no-flow", action="store_true",
+                   help="skip the whole-program device-flow pass "
+                        "(RED017-RED020; lint/flow/)")
+    p.add_argument("--flow-cache", default=".lint_cache.json",
+                   metavar="FILE",
+                   help="content-hash per-file fact cache for the flow "
+                        "pass (default: %(default)s; empty string "
+                        "disables caching)")
+    p.add_argument("--graph", choices=("dot", "json"),
+                   help="print the resolved call graph with per-function "
+                        "facts instead of linting")
     p.add_argument("--fix-docstrings", action="store_true",
                    help="append an explicit 'No reference analog "
                         "(TPU-native).' marker to public ops/bench "
                         "docstrings that lack a citation (RED006), then "
                         "re-lint")
+    p.add_argument("--fix-stale-waivers", action="store_true",
+                   help="delete waiver comments RED009 reports as stale "
+                        "(standalone waiver lines removed whole; trailing "
+                        "waivers stripped to the code), then re-lint")
     ns = p.parse_args(argv)
 
     paths = ns.paths or ["tpu_reductions", "scripts"]
+    flow = not ns.no_flow
+    cache = ns.flow_cache or None
     try:
+        if ns.graph:
+            return _print_graph(paths, ns.graph, cache)
         if ns.fix_docstrings:
             fixed = fix_docstrings(paths)
             for path, line, name in fixed:
                 print(f"fixed: {path}:{line}: marked '{name}' as "
                       "no-reference-analog", file=sys.stderr)
-        findings = lint_paths(paths)
+        if ns.fix_stale_waivers:
+            removed = fix_stale_waivers(paths, flow=flow,
+                                        flow_cache=cache)
+            for path, line, rules in removed:
+                print(f"fixed: {path}:{line}: removed stale waiver "
+                      f"({rules})", file=sys.stderr)
+        findings = lint_paths(paths, flow=flow, flow_cache=cache)
     except FileNotFoundError as e:
         p.error(str(e))
 

@@ -31,6 +31,12 @@ WAIVER_RE = re.compile(
 RULE_MALFORMED_WAIVER = "RED000"
 RULE_STALE_WAIVER = "RED009"
 
+# the interprocedural rules computed by lint/flow/ (docs/LINT.md).
+# Owned here (not in flow/) so the waiver machinery can reason about
+# them without importing the flow package: a waiver naming one of these
+# is only judged stale when the flow analysis actually ran.
+FLOW_RULES = ("RED017", "RED018", "RED019", "RED020")
+
 _SKIP_DIRS = {".git", "__pycache__", ".jax_cache", "node_modules", ".venv"}
 
 
@@ -75,16 +81,43 @@ def _comment_lines(source: str, is_python: bool) -> List[Tuple[int, str,
                     out.append((tok.start[0], tok.string, standalone))
             return out
         except (tokenize.TokenError, IndentationError, SyntaxError):
-            pass  # unparseable: degrade to the shell-style line scan
+            # unparseable: degrade to the shell-style line scan —
+            # dropping any tokens banked before the error so the two
+            # passes never double-report one comment
+            out = []
     for i, raw in enumerate(source.splitlines(), start=1):
-        if "#" in raw:
-            out.append((i, raw[raw.index("#"):],
-                        raw.strip().startswith("#")))
+        idx = _hash_outside_quotes(raw)
+        if idx != -1:
+            out.append((i, raw[idx:], raw.strip().startswith("#")))
     return out
+
+
+def _hash_outside_quotes(raw: str) -> int:
+    """Index of the first ``#`` not inside a quoted string, -1 if none.
+    The degraded line scan must not read `url = "http://x#frag"` as a
+    comment and then treat waiver-shaped string contents as live
+    waivers (single-line quoting only — good enough for a fallback)."""
+    quote = None
+    i = 0
+    while i < len(raw):
+        c = raw[i]
+        if quote is not None:
+            if c == "\\":
+                i += 2
+                continue
+            if c == quote:
+                quote = None
+        elif c in "\"'":
+            quote = c
+        elif c == "#":
+            return i
+        i += 1
+    return -1
 
 
 def _parse_waivers(source: str, is_python: bool) -> List[_Waiver]:
     out = []
+    lines = source.splitlines()
     for i, comment, standalone in _comment_lines(source, is_python):
         m = WAIVER_RE.search(comment)
         if not m:
@@ -92,14 +125,25 @@ def _parse_waivers(source: str, is_python: bool) -> List[_Waiver]:
         rules = tuple(r.strip() for r in m.group("rules").split(",")
                       if r.strip())
         # a standalone waiver comment guards the NEXT line; an inline
-        # one guards its own line
-        applies = (i, i + 1) if standalone else (i,)
+        # one guards its own line. A standalone waiver above a decorated
+        # `def` reaches past the decorator lines to the `def` itself —
+        # AST rules anchor findings at the def line, not the decorator.
+        if standalone:
+            applies = [i, i + 1]
+            j = i + 1
+            while is_python and j <= len(lines) and \
+                    lines[j - 1].lstrip().startswith("@"):
+                j += 1
+                applies.append(j)
+            applies = tuple(applies)
+        else:
+            applies = (i,)
         out.append(_Waiver(i, rules, m.group("reason"), applies))
     return out
 
 
 def _apply_waivers(raw: Iterable[RawFinding], waivers: List[_Waiver],
-                   path: str) -> List[Finding]:
+                   path: str, flow_active: bool = False) -> List[Finding]:
     findings: List[Finding] = []
     for f in raw:
         suppressed = False
@@ -110,6 +154,7 @@ def _apply_waivers(raw: Iterable[RawFinding], waivers: List[_Waiver],
                 break
         if not suppressed:
             findings.append(Finding(f.rule, path, f.line, f.message))
+    flow_set = set(FLOW_RULES)
     for w in waivers:
         if not w.reason:
             findings.append(Finding(
@@ -117,6 +162,10 @@ def _apply_waivers(raw: Iterable[RawFinding], waivers: List[_Waiver],
                 "waiver without a reason — write "
                 "'# redlint: disable=RED00X -- why this is safe'"))
         elif not w.used:
+            if not flow_active and set(w.rules) & flow_set:
+                # RED017-RED020 need the whole-program pass; a
+                # single-file lint can't judge their waivers stale
+                continue
             findings.append(Finding(
                 RULE_STALE_WAIVER, path, w.line,
                 f"stale waiver ({','.join(w.rules)}): no matching finding "
@@ -124,10 +173,15 @@ def _apply_waivers(raw: Iterable[RawFinding], waivers: List[_Waiver],
     return findings
 
 
-def lint_file(path: Path, rel: str | None = None) -> List[Finding]:
+def lint_file(path: Path, rel: str | None = None, *,
+              extra_raw: Sequence[RawFinding] = (),
+              flow_active: bool = False) -> List[Finding]:
     """Lint one file (.py via the AST rules, .sh via the shell pass).
     `rel` overrides the path string used for whitelist suffix matching
-    and reporting (defaults to the path as given)."""
+    and reporting (defaults to the path as given). `extra_raw` carries
+    this file's findings from the whole-program flow pass (lint_paths)
+    so they share the per-file waiver machinery; `flow_active` tells the
+    staleness check whether RED017-RED020 waivers can be judged."""
     rel = rel if rel is not None else str(path)
     rel_posix = rel.replace("\\", "/")
     try:
@@ -135,13 +189,14 @@ def lint_file(path: Path, rel: str | None = None) -> List[Finding]:
     except (OSError, UnicodeDecodeError) as e:
         return [Finding("RED???", rel, 1, f"unreadable: {e}")]
     if path.suffix == ".py":
-        raw = check_python(rel_posix, source)
+        raw = list(check_python(rel_posix, source)) + list(extra_raw)
     elif path.suffix == ".sh":
-        raw = check_shell(rel_posix, source)
+        raw = list(check_shell(rel_posix, source)) + list(extra_raw)
     else:
         return []
     waivers = _parse_waivers(source, is_python=path.suffix == ".py")
-    return sorted(_apply_waivers(raw, waivers, rel),
+    return sorted(_apply_waivers(raw, waivers, rel,
+                                 flow_active=flow_active),
                   key=lambda f: (f.line, f.rule))
 
 
@@ -162,12 +217,31 @@ def iter_lintable(paths: Sequence[str | Path]) -> List[Path]:
     return out
 
 
-def lint_paths(paths: Sequence[str | Path]) -> List[Finding]:
+def lint_paths(paths: Sequence[str | Path], *, flow: bool = True,
+               flow_cache: str | Path | None = None) -> List[Finding]:
     """Lint every .py/.sh file under `paths`; the package's public
-    entry point (CLI: python -m tpu_reductions.lint)."""
+    entry point (CLI: python -m tpu_reductions.lint). With `flow` on
+    (the default), the whole-program device-flow pass (lint/flow/)
+    runs over all the .py files together and its RED017-RED020
+    findings merge into the per-file waiver application; `flow_cache`
+    names the content-hash fact cache (.lint_cache.json)."""
+    files = iter_lintable(paths)
+    flow_raw: Dict[str, List[RawFinding]] = {}
+    if flow:
+        py = [f for f in files if f.suffix == ".py"]
+        if py:
+            # deferred: flow imports lint.rules, which would re-enter
+            # this package's __init__ during a top-level import here
+            from tpu_reductions.lint.flow.dataflow import analyze_flow
+            roots = [Path(p) for p in paths]
+            rels = {f: str(f).replace("\\", "/") for f in py}
+            flow_raw = analyze_flow(
+                py, roots, rels=rels,
+                cache_path=Path(flow_cache) if flow_cache else None)
     findings: List[Finding] = []
-    for f in iter_lintable(paths):
-        findings += lint_file(f)
+    for f in files:
+        extra = flow_raw.get(str(f).replace("\\", "/"), [])
+        findings += lint_file(f, extra_raw=extra, flow_active=flow)
     return sorted(findings, key=lambda x: (x.path, x.line, x.rule))
 
 

@@ -1,4 +1,4 @@
-"""redlint --fix-docstrings: the one mechanical fix the linter offers.
+"""redlint mechanical fixers (--fix-docstrings, --fix-stale-waivers).
 
 RED006 demands every public ops/bench docstring either cite the
 reference file:line it re-creates (PARITY.md) or explicitly declare
@@ -7,15 +7,22 @@ the declaration can be applied mechanically — it converts an *implicit*
 omission into an *explicit, greppable* claim a reviewer can challenge.
 Only existing docstrings are amended; a missing docstring stays a
 finding (writing one is authorship, not formatting).
+
+RED009's fix IS mechanical: a stale waiver suppresses nothing, so
+deleting it cannot change what the linter reports except to drop the
+RED009 row itself. `fix_stale_waivers` removes standalone waiver lines
+whole and strips trailing waivers back to the code, idempotently.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from pathlib import Path
 from typing import List, Sequence, Tuple
 
-from tpu_reductions.lint.engine import iter_lintable
+from tpu_reductions.lint.engine import (RULE_STALE_WAIVER, WAIVER_RE,
+                                        iter_lintable, lint_paths)
 from tpu_reductions.lint.rules import (_CITATION_RE, _NO_ANALOG_RE,
                                        _in_citation_dirs)
 
@@ -100,3 +107,38 @@ def fix_docstrings(paths: Sequence[str | Path]
         if targets:
             f.write_text("".join(lines))
     return fixed
+
+
+_TRAILING_WAIVER_RE = re.compile(r"\s*#\s*redlint:\s*disable=.*$")
+
+
+def fix_stale_waivers(paths: Sequence[str | Path], *, flow: bool = True,
+                      flow_cache: str | Path | None = None
+                      ) -> List[Tuple[str, int, str]]:
+    """Delete every waiver comment RED009 reports as stale under
+    `paths`: a waiver alone on its line is removed whole; a trailing
+    waiver is stripped back to the code it decorated. Idempotent — a
+    second run finds nothing stale. Returns [(path, line, rules)] for
+    the removed waivers."""
+    stale: dict = {}
+    for f in lint_paths(paths, flow=flow, flow_cache=flow_cache):
+        if f.rule == RULE_STALE_WAIVER:
+            stale.setdefault(f.path, []).append(f.line)
+    removed: List[Tuple[str, int, str]] = []
+    for path, line_nos in stale.items():
+        p = Path(path)
+        lines = p.read_text().splitlines(keepends=True)
+        # bottom-up so whole-line deletions don't shift pending targets
+        for ln in sorted(set(line_nos), reverse=True):
+            raw = lines[ln - 1]
+            m = WAIVER_RE.search(raw)
+            rules = m.group("rules").strip() if m else "?"
+            if raw.strip().startswith("#"):
+                del lines[ln - 1]
+            else:
+                nl = "\n" if raw.endswith("\n") else ""
+                lines[ln - 1] = _TRAILING_WAIVER_RE.sub(
+                    "", raw.rstrip("\n")).rstrip() + nl
+            removed.append((path, ln, rules))
+        p.write_text("".join(lines))
+    return removed

@@ -1,0 +1,26 @@
+"""redlint whole-program layer — call graph + device-flow dataflow.
+
+The per-file rules (lint/rules.py) fence *spellings*: RED011 sees a
+bare ``jax.devices()`` only inside a ``bench/`` entry-point ``main``,
+RED014 only inside ``serve/``, RED015/RED016 only match literal call
+chains. A helper that touches the backend two frames below an un-gated
+CLI passes those fences clean. This package closes that hole: it
+resolves a static call graph over every linted module (callgraph.py),
+seeds per-function *facts* — TOUCHES_DEVICE, GATES, GUARDS, STAGES,
+RETRIES, DRAINS, INGESTS, WALLCLOCK (facts.py) — and propagates them to
+a fixpoint (dataflow.py), so "device-reachable" and "gated on every
+path" are computed properties of a function, not of a file pattern.
+
+Rules RED017-RED020 (docs/LINT.md) are evaluated on the propagated
+graph; findings flow through the same engine/waiver machinery as the
+per-file rules. `analyze_flow` is the engine's entry; `build_project` /
+`export_graph` back the CLI's --graph seam-inventory output.
+"""
+
+from tpu_reductions.lint.flow.callgraph import (build_project,
+                                                module_name_for)
+from tpu_reductions.lint.flow.dataflow import (FLOW_RULES, analyze_flow,
+                                               export_graph)
+
+__all__ = ["analyze_flow", "build_project", "export_graph",
+           "module_name_for", "FLOW_RULES"]

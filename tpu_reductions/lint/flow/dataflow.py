@@ -1,0 +1,438 @@
+"""Fixpoint propagation + the interprocedural rules RED017-RED020.
+
+Summaries computed per function node, to a fixpoint over the call
+graph (monotone booleans + set-once witness chains, so recursion
+terminates):
+
+* ``device_reach``   — TOUCHES_DEVICE somewhere in the subtree;
+* ``sync_reach``     — a ``block_until_ready`` in the subtree;
+* ``gates_internally`` — a GATES call anywhere in the function or a
+  resolved callee (the "calling this function arms the gate" summary);
+* ``ungated_device`` — scanning the function's call sites in line
+  order, a device touch is reachable BEFORE any GATES node has run
+  (the interprocedural generalization of RED011's gate-precedes-touch
+  scan);
+* ``unguarded_dispatch`` — real device work (DISPATCH) reachable on a
+  chain carrying neither a GUARDS (heartbeat) nor a RETRIES node;
+* ``staged``         — the function stages through the bounded-transfer
+  surfaces (utils/staging.py / ops/stream.py).
+
+Rules (docs/LINT.md):
+
+* RED017 — an entry point (any ``if __name__ == "__main__"`` guard)
+  whose transitive execution can touch the device before the pre-JAX
+  gates run;
+* RED018 — a call inside a perf_counter/monotonic timing window whose
+  callee transitively syncs (``block_until_ready``) — the helper-syncs-
+  inside-someone-else's-window bug RED002 cannot see;
+* RED019 — an entry point reaching DISPATCH work on a path with no
+  heartbeat guard and no bounded retry anywhere on the chain (the
+  hangs-forever-on-a-relay-flap class);
+* RED020 — a host-array ingestion (np->jnp) reachable from an entry
+  point with no STAGES node on the path, where the per-file RED015
+  fence does not already apply (aliased spellings; files outside
+  RED015's scope dirs).
+
+A content-hash per-file fact cache (.lint_cache.json, written through
+utils/jsonio.atomic_json_dump) makes warm runs re-extract only changed
+files; the propagation itself always runs (it is cross-file and
+cheap).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_reductions.lint import rules as _rules
+from tpu_reductions.lint.flow import facts as F
+from tpu_reductions.lint.flow.callgraph import (MAIN_GUARD, ModuleInfo,
+                                                Project, extract_module,
+                                                module_name_for)
+from tpu_reductions.lint.engine import FLOW_RULES  # noqa: F401 (re-export)
+from tpu_reductions.lint.rules import RawFinding, _suffix_match
+
+# cache schema: bumped together with FACTS_SCHEMA_VERSION it keys on
+CACHE_SCHEMA = 1
+
+
+@dataclass
+class Summary:
+    """Per-function propagated state (all transitions monotone)."""
+    device_reach: bool = False
+    sync_reach: bool = False
+    gates_internally: bool = False
+    staged: bool = False
+    protected: bool = False
+    ungated_device: Optional[Tuple[int, Tuple[str, ...]]] = None
+    unguarded_dispatch: Optional[Tuple[int, Tuple[str, ...]]] = None
+    site_facts: Dict[int, frozenset] = field(default_factory=dict)
+
+
+def _node_label(project: Project, fqn: str) -> str:
+    mi, fi = project.nodes[fqn]
+    return f"{mi.module}.{fi.qualname}"
+
+
+def compute_summaries(project: Project) -> Dict[str, Summary]:
+    """Iterate the whole graph to a fixpoint. Unresolvable call sites
+    contribute their own seeded facts but are never propagated over —
+    recorded, not dropped (callgraph.py docstring contract)."""
+    summaries: Dict[str, Summary] = {}
+    resolved_callee: Dict[str, List[Tuple[int, Optional[str], frozenset]]] \
+        = {}
+    for fqn, (mi, fi) in project.nodes.items():
+        s = Summary()
+        sites = []
+        for cs in fi.calls:
+            cf = frozenset(F.classify_call(cs))
+            callee = project.resolve_target(cs.target) if cs.target \
+                else None
+            if callee == fqn:
+                callee = None            # direct recursion: no new info
+            sites.append((cs.line, callee, cf))
+            s.site_facts[cs.line] = s.site_facts.get(
+                cs.line, frozenset()) | cf
+        resolved_callee[fqn] = sites
+        s.protected = bool({F.GUARDS, F.RETRIES}
+                           & set(fi.facts.keys()))
+        s.staged = F.STAGES in fi.facts
+        summaries[fqn] = s
+
+    changed = True
+    passes = 0
+    while changed and passes < 100:
+        changed = False
+        passes += 1
+        for fqn in project.nodes:
+            s = summaries[fqn]
+            gated = False
+            for line, callee, cf in resolved_callee[fqn]:
+                cal = summaries.get(callee) if callee else None
+                if F.TOUCHES_DEVICE in cf and not s.device_reach:
+                    s.device_reach = changed = True
+                if F.SYNC in cf and not s.sync_reach:
+                    s.sync_reach = changed = True
+                if F.GATES in cf and not s.gates_internally:
+                    s.gates_internally = changed = True
+                if cal is not None:
+                    if cal.device_reach and not s.device_reach:
+                        s.device_reach = changed = True
+                    if cal.sync_reach and not s.sync_reach:
+                        s.sync_reach = changed = True
+                    if cal.gates_internally and not s.gates_internally:
+                        s.gates_internally = changed = True
+                # --- ordered gate scan (RED017) ---
+                if F.GATES in cf:
+                    gated = True
+                if not gated and s.ungated_device is None:
+                    if F.TOUCHES_DEVICE in cf:
+                        s.ungated_device = (line, ())
+                        changed = True
+                    elif cal is not None and cal.ungated_device \
+                            is not None:
+                        s.ungated_device = (
+                            line, (_node_label(project, callee),)
+                            + cal.ungated_device[1])
+                        changed = True
+                if cal is not None and cal.gates_internally:
+                    gated = True
+                # --- unguarded dispatch (RED019) ---
+                if not s.protected and s.unguarded_dispatch is None:
+                    if F.DISPATCH in cf:
+                        s.unguarded_dispatch = (line, ())
+                        changed = True
+                    elif cal is not None and cal.unguarded_dispatch \
+                            is not None:
+                        s.unguarded_dispatch = (
+                            line, (_node_label(project, callee),)
+                            + cal.unguarded_dispatch[1])
+                        changed = True
+    return summaries
+
+
+def _chain_text(frames: Tuple[str, ...]) -> str:
+    return " -> ".join(frames) if frames else "a direct call here"
+
+
+def _red017(project: Project, summaries: Dict[str, Summary]
+            ) -> Dict[str, List[RawFinding]]:
+    out: Dict[str, List[RawFinding]] = {}
+    for fqn in project.entries():
+        mi, _ = project.nodes[fqn]
+        s = summaries[fqn]
+        if s.ungated_device is None:
+            continue
+        line, frames = s.ungated_device
+        out.setdefault(mi.rel, []).append(RawFinding(
+            "RED017", line,
+            "entry point reaches a JAX backend touch with no liveness "
+            "gate on the path (via "
+            f"{_chain_text(frames)}) — on the tunneled box the first "
+            "backend touch can hang forever under a dead/stalled "
+            "relay; call utils.watchdog.maybe_arm_for_tpu (or the "
+            "utils.preflight gate) before any device-reaching call "
+            "(docs/LINT.md RED017)"))
+    return out
+
+
+def _red019(project: Project, summaries: Dict[str, Summary]
+            ) -> Dict[str, List[RawFinding]]:
+    out: Dict[str, List[RawFinding]] = {}
+    for fqn in project.entries():
+        mi, _ = project.nodes[fqn]
+        s = summaries[fqn]
+        if s.unguarded_dispatch is None:
+            continue
+        line, frames = s.unguarded_dispatch
+        out.setdefault(mi.rel, []).append(RawFinding(
+            "RED019", line,
+            "entry point reaches device dispatch with neither a "
+            "heartbeat guard nor a bounded retry on the path (via "
+            f"{_chain_text(frames)}) — a relay flap mid-dispatch hangs "
+            "this path forever (exit-4 territory the watchdog cannot "
+            "attribute); wrap the device work in utils.heartbeat."
+            "guard/tick or utils.retry.retry_device_call "
+            "(docs/LINT.md RED019)"))
+    return out
+
+
+def _red018(project: Project, summaries: Dict[str, Summary]
+            ) -> Dict[str, List[RawFinding]]:
+    out: Dict[str, List[RawFinding]] = {}
+    for fqn, (mi, fi) in project.nodes.items():
+        if _suffix_match(mi.rel, _rules.TIMING_WHITELIST):
+            continue
+        wall = fi.facts.get(F.WALLCLOCK, [])
+        if len(wall) < 2:
+            continue                      # no window, just a clock read
+        if F.SYNC in fi.facts:
+            continue                      # in-function sync: RED002's
+        lo, hi = min(wall), max(wall)
+        s = summaries[fqn]
+        for cs in fi.calls:
+            if not (lo <= cs.line <= hi) or not cs.target:
+                continue
+            callee = project.resolve_target(cs.target)
+            if callee is None:
+                continue
+            cal = summaries[callee]
+            if cal.sync_reach:
+                out.setdefault(mi.rel, []).append(RawFinding(
+                    "RED018", cs.line,
+                    f"call to {_node_label(project, callee)} inside a "
+                    "perf_counter/monotonic timing window reaches "
+                    "jax.block_until_ready — on the tunneled TPU the "
+                    "sync returns on dispatch ack, so the window "
+                    "measures nothing; use the chained-slope "
+                    "discipline (ops/chain.py) or hoist the helper "
+                    "out of the window (docs/LINT.md RED018)"))
+                break                     # one finding per window
+    return out
+
+
+def _red015_covered(rel: str, site_raw: str) -> bool:
+    """True when the per-file RED015 fence already judges this ingest
+    spelling (so RED020 defers to it and its reason-waivers)."""
+    if site_raw not in _rules._INGEST_CALLS:
+        return False
+    parts = rel.split("/")
+    return bool(set(_rules.STAGE_INGEST_SCOPE_DIRS) & set(parts[:-1]))
+
+
+def _red020(project: Project, summaries: Dict[str, Summary]
+            ) -> Dict[str, List[RawFinding]]:
+    # forward pass: nodes reachable from an entry along a chain with no
+    # STAGES node (the chain INCLUDES both endpoints)
+    reach: Dict[str, Tuple[str, ...]] = {}
+    work = []
+    for fqn in project.entries():
+        if not summaries[fqn].staged:
+            reach[fqn] = (_node_label(project, fqn),)
+            work.append(fqn)
+    while work:
+        fqn = work.pop()
+        for cs in project.nodes[fqn][1].calls:
+            callee = project.resolve_target(cs.target) if cs.target \
+                else None
+            if callee is None or callee in reach:
+                continue
+            if summaries[callee].staged:
+                continue
+            reach[callee] = reach[fqn] + (_node_label(project, callee),)
+            work.append(callee)
+
+    out: Dict[str, List[RawFinding]] = {}
+    for fqn, frames in reach.items():
+        mi, fi = project.nodes[fqn]
+        if _suffix_match(mi.rel, _rules.STAGE_INGEST_WHITELIST):
+            continue                      # the sanctioned bounded homes
+        for cs in fi.calls:
+            if F.INGESTS not in F.classify_call(cs):
+                continue
+            if _red015_covered(mi.rel, cs.raw):
+                continue
+            out.setdefault(mi.rel, []).append(RawFinding(
+                "RED020", cs.line,
+                "host->device ingestion reachable from an entry point "
+                f"({' -> '.join(frames)}) with no staging node on the "
+                "path — an unbounded single-message transfer is the "
+                "4 GiB relay killer; route the payload through "
+                "utils.staging / ops/stream.py, or waive with the "
+                "payload's size bound as the reason (docs/LINT.md "
+                "RED020)"))
+    return out
+
+
+def run_flow_rules(project: Project) -> Dict[str, List[RawFinding]]:
+    """All four interprocedural rules over a seeded, linked project;
+    findings keyed by reporting path."""
+    summaries = compute_summaries(project)
+    merged: Dict[str, List[RawFinding]] = {}
+    for part in (_red017(project, summaries), _red018(project, summaries),
+                 _red019(project, summaries), _red020(project, summaries)):
+        for rel, lst in part.items():
+            merged.setdefault(rel, []).extend(lst)
+    return merged
+
+
+# ---------------------------------------------------------------- cache
+
+
+def _source_hash(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8", "replace")).hexdigest()
+
+
+def _load_cache(cache_path: Optional[Path]) -> dict:
+    if cache_path is None:
+        return {}
+    try:
+        data = json.loads(Path(cache_path).read_text())
+    except (OSError, ValueError):
+        return {}
+    if data.get("version") != [CACHE_SCHEMA, F.FACTS_SCHEMA_VERSION]:
+        return {}
+    files = data.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def _store_cache(cache_path: Optional[Path], entries: dict) -> None:
+    if cache_path is None:
+        return
+    from tpu_reductions.utils.jsonio import atomic_json_dump
+    try:
+        atomic_json_dump(cache_path, {
+            "version": [CACHE_SCHEMA, F.FACTS_SCHEMA_VERSION],
+            "files": entries}, indent=None)
+    except OSError:
+        pass                              # read-only tree: cache is best-effort
+
+
+def build_cached_project(files: Sequence[Path], roots: Sequence[Path],
+                         rels: Optional[Dict[Path, str]] = None,
+                         cache_path: Optional[Path] = None) -> Project:
+    """Extract every .py file into a linked Project, reusing cached
+    per-file extractions whose content hash matches (the warm-run path
+    the tier-1 gate budget depends on)."""
+    cached = _load_cache(cache_path)
+    entries: dict = {}
+    modules: Dict[str, ModuleInfo] = {}
+    for f in files:
+        if f.suffix != ".py":
+            continue
+        key = str(f.resolve())
+        rel = (rels or {}).get(f, str(f)).replace("\\", "/")
+        try:
+            src = f.read_text()
+        except (OSError, UnicodeDecodeError):
+            continue
+        sha = _source_hash(src)
+        mod = module_name_for(f, roots)
+        hit = cached.get(key)
+        if hit and hit.get("sha") == sha and hit.get("module") == mod \
+                and hit.get("rel") == rel:
+            mi = ModuleInfo.from_dict(hit["info"])
+        else:
+            mi = extract_module(src, mod, rel,
+                                is_pkg=f.name == "__init__.py")
+            F.seed_module(mi)
+        entries[key] = {"sha": sha, "module": mod, "rel": rel,
+                        "info": mi.to_dict()}
+        modules[mod] = mi
+    _store_cache(cache_path, entries)
+    return Project(modules)
+
+
+def analyze_flow(files: Sequence[Path], roots: Sequence[Path],
+                 rels: Optional[Dict[Path, str]] = None,
+                 cache_path: Optional[Path] = None
+                 ) -> Dict[str, List[RawFinding]]:
+    """The engine's flow entry: extract (cached), link, propagate, and
+    return RED017-RED020 raw findings keyed by reporting path."""
+    project = build_cached_project(files, roots, rels=rels,
+                                   cache_path=cache_path)
+    return run_flow_rules(project)
+
+
+# ---------------------------------------------------------------- graph export
+
+
+def export_graph(project: Project, fmt: str = "json") -> str:
+    """The seam inventory the ROADMAP-4 'one execution core' refactor
+    consumes: every function node with its facts and resolved edges
+    (unresolved call sites included, marked as such)."""
+    summaries = compute_summaries(project)
+    if fmt == "json":
+        nodes = []
+        for fqn in sorted(project.nodes):
+            mi, fi = project.nodes[fqn]
+            s = summaries[fqn]
+            nodes.append({
+                "id": fqn, "module": mi.module, "qualname": fi.qualname,
+                "path": mi.rel, "line": fi.line,
+                "facts": {k: v for k, v in sorted(fi.facts.items())},
+                "device_reach": s.device_reach,
+                "gated": s.ungated_device is None,
+                "guarded": s.unguarded_dispatch is None,
+                "calls": [c.to_dict() for c in fi.calls],
+            })
+        edges = []
+        unresolved = 0
+        for fqn in sorted(project.nodes):
+            for cs in project.nodes[fqn][1].calls:
+                callee = project.resolve_target(cs.target) \
+                    if cs.target else None
+                if callee:
+                    edges.append({"from": fqn, "to": callee,
+                                  "line": cs.line})
+                elif not cs.raw:
+                    unresolved += 1
+        return json.dumps({"modules": len(project.modules),
+                           "functions": nodes, "edges": edges,
+                           "dynamic_unresolved_calls": unresolved},
+                          indent=1)
+    if fmt == "dot":
+        lines = ["digraph redlint_flow {", "  rankdir=LR;",
+                 "  node [shape=box, fontsize=9];"]
+        for fqn in sorted(project.nodes):
+            mi, fi = project.nodes[fqn]
+            facts = ",".join(sorted(fi.facts)) or "-"
+            color = "red" if F.TOUCHES_DEVICE in fi.facts else (
+                "green" if F.GATES in fi.facts else "black")
+            lines.append(
+                f'  "{fqn}" [label="{mi.module}.{fi.qualname}\\n'
+                f'[{facts}]", color={color}];')
+        seen = set()
+        for fqn in sorted(project.nodes):
+            for cs in project.nodes[fqn][1].calls:
+                callee = project.resolve_target(cs.target) \
+                    if cs.target else None
+                if callee and (fqn, callee) not in seen:
+                    seen.add((fqn, callee))
+                    lines.append(f'  "{fqn}" -> "{callee}";')
+        lines.append("}")
+        return "\n".join(lines)
+    raise ValueError(f"unknown graph format: {fmt!r}")

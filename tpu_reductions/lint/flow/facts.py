@@ -1,0 +1,125 @@
+"""Per-function fact seeding for the redlint flow layer.
+
+Each fact names one side of the repo's device-safety doctrine
+(CLAUDE.md "Hard-won environment facts"; docs/LINT.md):
+
+* TOUCHES_DEVICE — jax backend/dispatch primitives: ``jax.devices`` /
+  ``default_backend`` (backend discovery, the hang-forever class),
+  ``device_put*``/``device_get``, ``block_until_ready``, ``jax.jit`` /
+  ``jax.pmap`` call sites, ``ppermute``;
+* DISPATCH — the subset that queues real device work (everything above
+  minus the pure backend queries) — RED019's object;
+* SYNC — ``block_until_ready`` alone — RED018's object;
+* GATES — the pre-JAX liveness gates: ``maybe_arm_for_tpu``,
+  ``run_preflight``, ``gate_verdict`` (utils/watchdog.py,
+  utils/preflight.py);
+* GUARDS — heartbeat liveness (``heartbeat.tick``/``heartbeat.guard``,
+  utils/heartbeat.py);
+* RETRIES — bounded-backoff flap retries (``retry_device_call``,
+  utils/retry.py);
+* STAGES — bounded host->device transfer (utils/staging.py,
+  ops/stream.py surfaces);
+* DRAINS — ``device_get`` (the exit-drain marker RED007 keys on);
+* INGESTS — the np->jnp host-array boundary (``jnp.asarray`` /
+  ``jnp.array`` spellings, resolved aliases included);
+* WALLCLOCK — ``time.perf_counter``/``time.monotonic`` call sites.
+
+Recognition is last-component / chain based (like the per-file rules)
+so fixture trees without the real utils/ modules still seed correctly,
+and ALSO fires on resolved aliases (``from jax.numpy import asarray``)
+that the per-file literal rules cannot see.
+"""
+
+from __future__ import annotations
+
+from typing import Set
+
+from tpu_reductions.lint.flow.callgraph import (CallSite, ModuleInfo,
+                                                Project)
+
+TOUCHES_DEVICE = "TOUCHES_DEVICE"
+DISPATCH = "DISPATCH"
+SYNC = "SYNC"
+GATES = "GATES"
+GUARDS = "GUARDS"
+RETRIES = "RETRIES"
+STAGES = "STAGES"
+DRAINS = "DRAINS"
+INGESTS = "INGESTS"
+WALLCLOCK = "WALLCLOCK"
+
+# bump to invalidate cached per-file facts when recognizers change
+FACTS_SCHEMA_VERSION = 1
+
+_BACKEND_QUERIES = {"jax.devices", "jax.local_devices",
+                    "jax.device_count", "jax.default_backend",
+                    "jax.process_index", "jax.process_count"}
+# bare jax.jit(f)/jax.pmap(f) builds a lazy closure: backend-adjacent
+# (gate before it — RED017's conservative posture) but queues no device
+# work. The immediately-invoked form jax.jit(f)(x) DOES dispatch; the
+# callgraph marks it with a '()' suffix (callgraph._collect_calls).
+_JIT_CALLS = {"jax.jit", "jax.pmap"}
+_JIT_INVOKED = {"jax.jit()", "jax.pmap()"}
+_DEVICE_PUT = {"device_put", "device_put_sharded", "device_put_replicated"}
+_GATE_NAMES = {"maybe_arm_for_tpu", "run_preflight", "gate_verdict"}
+_RETRY_NAMES = {"retry_device_call"}
+_STAGE_NAMES = {"device_put_chunked", "maybe_chunked_stage",
+                "put_chunk_async", "run_stream", "StreamReducer"}
+_STAGE_MODULES = ("utils.staging", "ops.stream")
+_INGEST_TARGETS = {"jnp.asarray", "jnp.array",
+                   "jax.numpy.asarray", "jax.numpy.array"}
+_WALLCLOCK_TARGETS = {"time.perf_counter", "time.monotonic"}
+
+
+def classify_call(site: CallSite) -> Set[str]:
+    """The fact set one call site seeds (on the function containing
+    it). Judged on the resolved target when a binding resolved it, on
+    the literal chain otherwise — both spellings of e.g.
+    ``jnp.asarray`` land in the same fact."""
+    facts: Set[str] = set()
+    for name in {site.target, site.raw} - {""}:
+        last = name.rsplit(".", 1)[-1]
+        if name in _BACKEND_QUERIES:
+            facts.add(TOUCHES_DEVICE)
+        if name in _JIT_CALLS:
+            facts.add(TOUCHES_DEVICE)
+        if name in _JIT_INVOKED:
+            facts |= {TOUCHES_DEVICE, DISPATCH}
+        if last in _DEVICE_PUT or last == "device_get":
+            facts |= {TOUCHES_DEVICE, DISPATCH}
+        if last == "device_get":
+            facts.add(DRAINS)
+        if last == "block_until_ready":
+            facts |= {TOUCHES_DEVICE, DISPATCH, SYNC}
+        if last == "ppermute":
+            facts |= {TOUCHES_DEVICE, DISPATCH}
+        if last in _GATE_NAMES:
+            facts.add(GATES)
+        if last in ("tick", "guard") and "heartbeat" in name:
+            facts.add(GUARDS)
+        if last in _RETRY_NAMES:
+            facts.add(RETRIES)
+        if last in _STAGE_NAMES or \
+                any(m in name for m in _STAGE_MODULES):
+            facts.add(STAGES)
+        if name in _INGEST_TARGETS:
+            facts.add(INGESTS)
+        if name in _WALLCLOCK_TARGETS:
+            facts.add(WALLCLOCK)
+    return facts
+
+
+def seed_module(mi: ModuleInfo) -> None:
+    """Annotate every function in `mi` with the facts its call sites
+    seed (idempotent: clears previous seeds first)."""
+    for fi in mi.functions.values():
+        fi.facts = {}
+        for site in fi.calls:
+            for fact in classify_call(site):
+                fi.add_fact(fact, site.line)
+
+
+def seed_project(project: Project) -> None:
+    """Seed facts across every module of a linked project."""
+    for mi in project.modules.values():
+        seed_module(mi)

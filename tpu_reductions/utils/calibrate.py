@@ -162,41 +162,49 @@ def calibrate(n: int = 1 << 24, dtype: str = "float32",
     from tpu_reductions.utils.rng import host_data
     from tpu_reductions.utils.timing import time_chained
 
-    op = get_op("SUM")
-    tm, p, t = choose_tiling(n, dtype=dtype)
-    x2d = jax.block_until_ready(
-        stage_padded(host_data(n, dtype, rank=0), tm, p, t, op))
-    f = jax.jit(op.jnp_reduce)
-    jax.block_until_ready(f(x2d))   # compile, still no materialization
+    # one guarded region around the whole probe ladder: the guard
+    # is entered once (zero per-iteration overhead inside the
+    # perf_counter windows, so the raw sync measurement is
+    # undistorted) but a relay that stalls mid-probe now trips the
+    # heartbeat (exit 4) instead of hanging with live ports
+    # (redlint RED019); time_chained below keeps its own guard.
+    from tpu_reductions.utils import heartbeat
+    with heartbeat.guard("calibrate"):
+        op = get_op("SUM")
+        tm, p, t = choose_tiling(n, dtype=dtype)
+        x2d = jax.block_until_ready(
+            stage_padded(host_data(n, dtype, rank=0), tm, p, t, op))
+        f = jax.jit(op.jnp_reduce)
+        jax.block_until_ready(f(x2d))   # compile, still no materialization
 
-    def blocked_single() -> float:
-        ts = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            jax.block_until_ready(f(x2d))
-            ts.append(time.perf_counter() - t0)
-        return statistics.median(ts)
+        def blocked_single() -> float:
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(f(x2d))
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
 
-    single = blocked_single()
+        single = blocked_single()
 
-    t0 = time.perf_counter()
-    r = None
-    for _ in range(iters):
-        r = f(x2d)
-    jax.block_until_ready(r)
-    amortized = (time.perf_counter() - t0) / iters
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(iters):
+            r = f(x2d)
+        jax.block_until_ready(r)
+        amortized = (time.perf_counter() - t0) / iters
 
-    # first true materialization — also drains everything queued above,
-    # so an early exit can never abandon in-flight work on the tunnel
-    t0 = time.perf_counter()
-    jax.device_get(r)
-    roundtrip = time.perf_counter() - t0
+        # first true materialization — also drains everything queued above,
+        # so an early exit can never abandon in-flight work on the tunnel
+        t0 = time.perf_counter()
+        jax.device_get(r)
+        roundtrip = time.perf_counter() - t0
 
-    chained = make_chained_reduce(op.jnp_reduce, op, surface="xla")
-    sw = time_chained(chained, x2d, k_lo=1, k_hi=1 + chain_span, reps=reps)
-    chained_s = sw.median_s
+        chained = make_chained_reduce(op.jnp_reduce, op, surface="xla")
+        sw = time_chained(chained, x2d, k_lo=1, k_hi=1 + chain_span, reps=reps)
+        chained_s = sw.median_s
 
-    post = blocked_single()
+        post = blocked_single()
 
     return TimingCalibration(
         platform=jax.default_backend(), n=n, dtype=dtype,
