@@ -482,6 +482,24 @@ export TPU_REDUCTIONS_LEDGER
 # next to the flagship evidence for the report fold (ISSUE 8).
 : "${TPU_REDUCTIONS_COMPILE_LEDGER:=compile_ledger.json}"
 export TPU_REDUCTIONS_COMPILE_LEDGER
+# Causal trace context (ISSUE 12, obs/trace.py): ONE trace per round —
+# a re-invocation after a watchdog exit 3/4 reuses the sidecar's
+# context (marking the seam with trace.cut) so the resumed session
+# continues the SAME trace; a fresh round mints new ids and persists
+# the sidecar for whoever dies next. Every step subprocess and
+# obs_event call inherits the exported TPU_REDUCTIONS_TRACE_CTX.
+trace_sidecar="${TPU_REDUCTIONS_LEDGER}.trace"
+if [ -z "${TPU_REDUCTIONS_TRACE_CTX:-}" ]; then
+    if [ -s "$trace_sidecar" ]; then
+        TPU_REDUCTIONS_TRACE_CTX=$(head -n1 "$trace_sidecar")
+        export TPU_REDUCTIONS_TRACE_CTX
+        obs_event trace.cut reason=session-reinvocation
+    else
+        TPU_REDUCTIONS_TRACE_CTX="$(od -An -N8 -tx1 /dev/urandom | tr -d ' \n'):$(od -An -N6 -tx1 /dev/urandom | tr -d ' \n')"
+        export TPU_REDUCTIONS_TRACE_CTX
+        printf '%s\n' "$TPU_REDUCTIONS_TRACE_CTX" > "$trace_sidecar" || true
+    fi
+fi
 obs_event session.start prog=chip_session
 
 if ! relay_ok; then
@@ -515,5 +533,9 @@ if [ "$sched_rc" -eq 20 ]; then
 fi
 
 obs_event session.end prog=chip_session
+# a cleanly-ended round retires its trace: the sidecar only exists to
+# let an exit-3/4 re-invocation continue a trace a death left open —
+# the NEXT round should mint a fresh one
+rm -f "$trace_sidecar" 2>/dev/null || true
 echo "=== chip_session: done ==="
 exit 0

@@ -25,6 +25,17 @@ obs_event() {
     [ "${TPU_REDUCTIONS_OBS_DISABLE:-0}" = 1 ] && return 0
     local ev=$1 fields="" kv k v
     shift
+    # causal identity (ISSUE 12): when TPU_REDUCTIONS_TRACE_CTX carries
+    # the session's `trace:span` wire form (obs/trace.py), shell events
+    # stamp it too — same trailing-field position as the python emitter,
+    # so EVENT_ROW_RE's leading keys stay untouched. The id grammar
+    # check mirrors obs/trace._ID_RE: a corrupt env var is dropped, it
+    # can never tear the JSON row.
+    if printf '%s' "${TPU_REDUCTIONS_TRACE_CTX:-}" \
+            | grep -Eq '^[A-Za-z0-9][A-Za-z0-9._-]*:[A-Za-z0-9][A-Za-z0-9._-]*$'; then
+        fields=", \"trace\": \"${TPU_REDUCTIONS_TRACE_CTX%%:*}\""
+        fields="$fields, \"span\": \"${TPU_REDUCTIONS_TRACE_CTX#*:}\""
+    fi
     for kv in "$@"; do
         k=${kv%%=*}
         v=${kv#*=}

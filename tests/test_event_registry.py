@@ -102,3 +102,62 @@ def test_registry_has_the_observatory_vocabulary():
     for name in ("compile.start", "compile.end", "warm.start",
                  "warm.surface", "warm.end"):
         assert event_registered(name), name
+
+
+def test_registry_has_the_trace_vocabulary():
+    assert event_registered("trace.cut")
+
+
+def test_every_registered_opener_has_a_registered_closer():
+    """Span reconstruction (obs/trace_export.build_spans) pairs
+    `X.start` with `X.end` plus the legacy opener/closer map — a
+    registered `.start` whose closer is missing from BOTH would open
+    spans the export can never close (every one an orphan)."""
+    from tpu_reductions.obs.trace_export import OPENER_CLOSERS
+    unclosed = sorted(
+        n for n in REGISTERED_EVENTS
+        if n.endswith(".start")
+        and n[:-len(".start")] + ".end" not in REGISTERED_EVENTS
+        and n not in OPENER_CLOSERS)
+    assert unclosed == [], (
+        f"registered span openers without a registered closer: "
+        f"{unclosed} — add the `.end` event or an OPENER_CLOSERS entry")
+    missing = sorted(c for c in OPENER_CLOSERS.values()
+                     if c not in REGISTERED_EVENTS)
+    assert missing == []
+
+
+def test_no_emit_site_outside_obs_mints_trace_fields():
+    """Causal-identity drift gate (ISSUE 12 satellite): the
+    trace/span/parent fields are stamped by obs/trace.py's ambient
+    context (or its per-request helpers) — an emit call passing them
+    as LITERAL kwargs anywhere outside tpu_reductions/obs/ forks the
+    span tree by hand (the runtime twin of redlint RED012's trace
+    extension). Splat-dict helpers (`**trace.request_fields(rid)`)
+    are invisible to this scan by design: they route through the
+    sanctioned producer."""
+    from tpu_reductions.lint.grammar import TRACE_FIELDS
+    offenders = []
+    files = []
+    for scope in PY_SCOPES:
+        files += sorted(scope.rglob("*.py")) if scope.is_dir() \
+            else [scope]
+    for f in files:
+        rel = f.relative_to(REPO)
+        if str(rel).replace("\\", "/").startswith(
+                "tpu_reductions/obs/"):
+            continue
+        tree = ast.parse(f.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _chain(node.func).rsplit(".", 1)[-1] != "emit":
+                continue
+            minted = sorted(kw.arg for kw in node.keywords
+                            if kw.arg in TRACE_FIELDS)
+            if minted:
+                offenders.append((str(rel), node.lineno, minted))
+    assert offenders == [], (
+        f"emit() sites minting trace-context kwargs outside obs/: "
+        f"{offenders} — use the ambient trace.child() context or "
+        "trace.request_fields()")

@@ -1,0 +1,464 @@
+"""Causal-tracing unit coverage (ISSUE 12): trace context adoption and
+nesting (obs/trace.py), event stamping through the ledger, span-tree
+reconstruction + orphan closing at trace.cut (obs/trace_export.py),
+Chrome-trace export, rotated-ledger stitching, the serve join-by-id
+latency attribution, and critical-path math (obs/critical_path.py).
+The cross-PROCESS propagation pipeline lives in
+tests/test_trace_chaos.py."""
+
+import json
+import threading
+from pathlib import Path
+
+import pytest
+
+from tpu_reductions.lint.grammar import TRACE_ENV, TRACE_FIELDS
+from tpu_reductions.obs import critical_path, ledger, trace
+from tpu_reductions.obs.spans import span
+from tpu_reductions.obs.timeline import read_ledger, serve_summary, \
+    summarize, summary_markdown
+from tpu_reductions.obs.trace_export import build_spans, chrome_trace, \
+    main as export_main
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    """Clean env + no armed ledger + no process trace root per test
+    (ledger.disarm resets the trace root too)."""
+    monkeypatch.delenv("TPU_REDUCTIONS_LEDGER", raising=False)
+    monkeypatch.delenv("TPU_REDUCTIONS_OBS_DISABLE", raising=False)
+    monkeypatch.delenv(TRACE_ENV, raising=False)
+    ledger.disarm()
+    yield
+    ledger.disarm()
+
+
+def _lines(path):
+    return [json.loads(line) for line in
+            Path(path).read_text().splitlines() if line.strip()]
+
+
+# ------------------------------------------------------------- context
+
+def test_encode_decode_roundtrip():
+    ctx = trace.TraceContext(trace_id="abc123", span_id="d4")
+    assert trace.decode(ctx.encode()) == ctx
+
+
+@pytest.mark.parametrize("wire", [
+    None, "", "nocolon", ":leading", "trailing:", "a:b:ok-extra:",
+    "bad id:x", "a:b c", "-lead:x", "a" * 65 + ":b"])
+def test_decode_rejects_malformed(wire):
+    assert trace.decode(wire) is None
+
+
+def test_decode_tolerates_extra_colon():
+    # partition: everything after the FIRST colon must be a valid id,
+    # so `a:b:c` is rejected (dots are legal, colons are the separator)
+    assert trace.decode("a:b.c") is not None
+    assert trace.decode("a:b:c") is None
+
+
+def test_ensure_root_fresh_mint():
+    root = trace.ensure_root()
+    assert root.parent_id is None
+    assert not trace.adopted()
+    assert trace.ensure_root() is root        # idempotent
+
+
+def test_ensure_root_adopts_env(monkeypatch):
+    monkeypatch.setenv(TRACE_ENV, "feedc0de:beef1234")
+    root = trace.ensure_root()
+    assert root.trace_id == "feedc0de"
+    assert root.parent_id == "beef1234"
+    assert root.span_id != "beef1234"         # own span, parented under
+    assert trace.adopted()
+
+
+def test_active_lazily_adopts_env(monkeypatch):
+    assert trace.active() is None
+    monkeypatch.setenv(TRACE_ENV, "feedc0de:beef1234")
+    ctx = trace.active()
+    assert ctx is not None and ctx.trace_id == "feedc0de"
+
+
+def test_corrupt_env_falls_back_to_fresh_trace(monkeypatch):
+    monkeypatch.setenv(TRACE_ENV, "not a context $(rm -rf /)")
+    root = trace.ensure_root()
+    assert root.parent_id is None and not trace.adopted()
+
+
+def test_child_is_noop_when_unarmed():
+    with trace.child() as ctx:
+        assert ctx is None
+    assert trace.active() is None             # no root minted either
+
+
+def test_child_nesting_and_restore(tmp_path):
+    ledger.arm(tmp_path / "l.jsonl")
+    root = trace.ensure_root()
+    with trace.child() as c1:
+        assert c1.trace_id == root.trace_id
+        assert c1.parent_id == root.span_id
+        with trace.child() as c2:
+            assert c2.parent_id == c1.span_id
+            assert trace.active() is c2
+        assert trace.active() is c1
+    assert trace.active() is root
+
+
+def test_child_thread_isolation(tmp_path):
+    ledger.arm(tmp_path / "l.jsonl")
+    root = trace.ensure_root()
+    seen = {}
+    with trace.child():
+        def worker():
+            # contextvars don't inherit across threads: the worker sees
+            # the process root, not the spawning thread's child span
+            seen["ctx"] = trace.active()
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["ctx"] is root
+
+
+def test_propagation_env_wire_form(tmp_path):
+    ledger.arm(tmp_path / "l.jsonl")
+    with trace.child() as c1:
+        env = trace.propagation_env()
+    assert env == {TRACE_ENV: f"{c1.trace_id}:{c1.span_id}"}
+    assert trace.decode(env[TRACE_ENV]) is not None
+
+
+def test_request_context_request_id_is_trace_id():
+    ctx = trace.request_context("r000007")
+    assert ctx.trace_id == ctx.span_id == "r000007"
+    assert trace.request_fields("r000007") == {"trace": "r000007",
+                                               "span": "r000007"}
+
+
+# ------------------------------------------------------------ stamping
+
+def test_emit_stamps_ambient_context(tmp_path, monkeypatch):
+    led = tmp_path / "l.jsonl"
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER", str(led))
+    assert ledger.arm_session("unit.trace") == str(led)
+    rows = _lines(led)
+    root = trace.ensure_root()
+    assert rows[-1]["trace"] == root.trace_id
+    assert rows[-1]["span"] == root.span_id
+    assert "parent" not in rows[-1]
+    with trace.child() as c1:
+        ledger.emit("artifact.persist", path="x", rows=1)
+    row = _lines(led)[-1]
+    assert row["trace"] == root.trace_id
+    assert row["span"] == c1.span_id
+    assert row["parent"] == root.span_id
+
+
+def test_explicit_trace_field_wins_over_ambient(tmp_path):
+    ledger.arm(tmp_path / "l.jsonl")
+    trace.ensure_root()
+    ledger.emit("serve.respond", req="r000001", status="ok",
+                **trace.request_fields("r000001"))
+    row = _lines(tmp_path / "l.jsonl")[-1]
+    assert row["trace"] == row["span"] == "r000001"
+    assert "parent" not in row
+
+
+def test_span_pair_shares_one_span_id(tmp_path):
+    led = tmp_path / "l.jsonl"
+    ledger.arm(led)
+    with span("step", task="x"):
+        pass
+    start, end = _lines(led)
+    assert start["ev"] == "step.start" and end["ev"] == "step.end"
+    assert start["span"] == end["span"]
+    assert start["parent"] == end["parent"]
+
+
+def test_trace_fields_are_trailing_keys(tmp_path):
+    """EVENT_ROW_RE's leading keys t/ev/pid must stay byte-stable —
+    the causal fields land after them."""
+    led = tmp_path / "l.jsonl"
+    ledger.arm(led)
+    trace.ensure_root()
+    ledger.emit("artifact.persist", path="x")
+    raw = led.read_text().splitlines()[-1]
+    keys = list(json.loads(raw).keys())
+    assert keys[:3] == ["t", "ev", "pid"]
+    assert [k for k in keys if k in TRACE_FIELDS]
+
+
+# ------------------------------------------------- span reconstruction
+
+def _ev(t, ev, pid=1, **fields):
+    return {"t": t, "ev": ev, "pid": pid, **fields}
+
+
+def test_build_spans_pairs_by_span_id():
+    events = [
+        _ev(0.0, "step.start", span="a", trace="T"),
+        _ev(1.0, "staging.start", span="b", parent="a", trace="T"),
+        _ev(2.0, "staging.end", span="b", parent="a", trace="T"),
+        _ev(3.0, "step.end", span="a", trace="T"),
+    ]
+    spans = build_spans(events)
+    byname = {s["name"]: s for s in spans}
+    assert byname["step"]["dur_s"] == 3.0
+    assert byname["staging"]["parent"] == "a"
+    assert not any(s["cut"] for s in spans)
+
+
+def test_build_spans_legacy_pairs_and_name_stack():
+    events = [
+        _ev(0.0, "collective.launch", algorithm="ring"),
+        _ev(2.5, "collective.done", wall_s=2.5),
+        _ev(3.0, "serve.start"),
+        _ev(4.0, "serve.stop"),
+    ]
+    spans = build_spans(events)
+    names = {s["name"]: s["dur_s"] for s in spans}
+    assert names["collective.launch"] == 2.5
+    assert names["serve.start"] == 1.0
+
+
+def test_orphaned_open_closes_at_trace_cut():
+    """The satellite-3 acceptance shape: a span the death tore open is
+    closed at the re-invocation's trace.cut, flagged, never left
+    dangling to end-of-ledger."""
+    events = [
+        _ev(0.0, "step.start", span="a", trace="T", pid=1),
+        _ev(5.0, "trace.cut", trace="T", pid=2, reason="resume"),
+        _ev(9.0, "sched.pick", trace="T", pid=2),
+    ]
+    spans = build_spans(events)
+    (s,) = [s for s in spans if s["name"] == "step"]
+    assert s["cut"] is True
+    assert s["t1"] == 5.0                     # the cut, not t=9.0
+
+
+def test_orphaned_open_without_cut_closes_at_pid_last():
+    events = [
+        _ev(0.0, "step.start", span="a", trace="T"),
+        _ev(4.0, "artifact.persist", trace="T", path="x"),
+    ]
+    (s,) = [s for s in build_spans(events) if s["name"] == "step"]
+    assert s["cut"] is True and s["t1"] == 4.0
+
+
+def test_point_events_with_duration_become_slices():
+    events = [_ev(10.0, "chain.trip", dur_s=2.0, trace="T", span="s")]
+    (s,) = build_spans(events)
+    assert (s["t0"], s["t1"], s["cut"]) == (8.0, 10.0, False)
+
+
+def test_request_span_synthesis_with_queue_split():
+    events = [
+        _ev(0.0, "serve.enqueue", req="r000001", trace="r000001",
+            span="r000001", method="SUM", n=1024),
+        _ev(3.0, "serve.respond", req="r000001", trace="r000001",
+            span="r000001", status="ok", latency_s=3.0, queue_s=1.0,
+            batch_size=2),
+    ]
+    spans = build_spans(events)
+    names = {s["name"]: s for s in spans}
+    req = names["request r000001"]
+    assert req["trace"] == "r000001" and req["dur_s"] == 3.0
+    assert names["queued"]["t1"] == 1.0
+    assert names["exec"]["t0"] == 1.0 and names["exec"]["t1"] == 3.0
+    assert names["queued"]["parent"] == "r000001"
+
+
+# --------------------------------------------------------- chrome trace
+
+def _session(pid, t0, prog, trace_id, span_id, parent=None):
+    start = _ev(t0, "session.start", pid=pid, prog=prog, trace=trace_id,
+                span=span_id)
+    if parent:
+        start["parent"] = parent
+    return start
+
+
+def test_chrome_trace_lanes_flows_and_metadata():
+    events = [
+        _session(1, 0.0, "chip_session", "T", "root"),
+        _session(2, 1.0, "bench.spot", "T", "sub", parent="root"),
+        _ev(1.5, "staging.start", pid=2, trace="T", span="st",
+            parent="sub"),
+        _ev(2.0, "staging.end", pid=2, trace="T", span="st",
+            parent="sub"),
+        _ev(3.0, "session.end", pid=2, trace="T", span="sub",
+            parent="root"),
+        _ev(4.0, "session.end", pid=1, trace="T", span="root"),
+    ]
+    doc = chrome_trace(events)
+    evs = doc["traceEvents"]
+    slices = [e for e in evs if e["ph"] == "X"]
+    assert {s["name"] for s in slices} == {"session", "staging"}
+    # cross-pid parentage (the propagated subprocess) draws a flow arrow
+    assert [e["ph"] for e in evs if e["ph"] in "sf"] == ["s", "f"]
+    meta = {(e["name"], e["pid"]): e["args"]["name"]
+            for e in evs if e["ph"] == "M"}
+    assert meta[("process_name", 1)].startswith("chip_session")
+    assert meta[("process_name", 2)].startswith("bench.spot")
+    assert any(v.startswith("trace ") for k, v in meta.items()
+               if k[0] == "thread_name")
+    # nesting: the staging slice sits inside its session slice
+    sess2 = [s for s in slices if s["pid"] == 2 and s["name"] == "session"][0]
+    stg = [s for s in slices if s["name"] == "staging"][0]
+    assert sess2["ts"] <= stg["ts"]
+    assert stg["ts"] + stg["dur"] <= sess2["ts"] + sess2["dur"]
+
+
+def test_request_lane_naming():
+    events = [
+        _ev(0.0, "serve.enqueue", req="r000009", trace="r000009",
+            span="r000009"),
+        _ev(1.0, "serve.respond", req="r000009", trace="r000009",
+            span="r000009", status="ok", latency_s=1.0),
+    ]
+    doc = chrome_trace(events)
+    names = [e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert "request r000009" in names
+
+
+def test_export_cli_writes_loadable_json(tmp_path, capsys, monkeypatch):
+    led = tmp_path / "l.jsonl"
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER", str(led))
+    ledger.arm_session("unit.export")
+    with span("step"):
+        ledger.emit("artifact.persist", path="x")
+    ledger.disarm()
+    out = tmp_path / "trace.json"
+    assert export_main([str(led), "--out", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["displayTimeUnit"] == "ms"
+    assert any(e["ph"] == "X" and e["name"] == "step"
+               for e in doc["traceEvents"])
+    assert "perfetto" in capsys.readouterr().err
+
+
+def test_export_cli_missing_ledger(tmp_path):
+    assert export_main([str(tmp_path / "nope.jsonl"),
+                        "--out", str(tmp_path / "t.json")]) == 1
+
+
+# ----------------------------------------------------- rotation stitch
+
+def test_rotated_ledger_stitches_whole_session(tmp_path):
+    """Satellite 1: a session whose ledger rolled to `<path>.1`
+    mid-run reads whole — the span opened before the roll closes from
+    the event after it."""
+    led = tmp_path / "l.jsonl"
+    rot = tmp_path / "l.jsonl.1"
+    rot.write_text(json.dumps(_ev(0.0, "session.start", prog="x",
+                                  trace="T", span="r")) + "\n" +
+                   json.dumps(_ev(1.0, "step.start", span="a",
+                                  trace="T", parent="r")) + "\n")
+    led.write_text(json.dumps(_ev(2.0, "step.end", span="a",
+                                  trace="T", parent="r")) + "\n" +
+                   json.dumps(_ev(3.0, "session.end", trace="T",
+                                  span="r")) + "\n")
+    events, torn = read_ledger(led)
+    assert torn == 0 and len(events) == 4
+    byname = {s["name"]: s for s in build_spans(events)}
+    assert byname["step"]["dur_s"] == 1.0 and not byname["step"]["cut"]
+    assert byname["session"]["dur_s"] == 3.0
+
+
+# ------------------------------------------------------ serve join-by-id
+
+def test_serve_summary_joins_by_request_id():
+    events = [
+        _ev(0.0, "serve.enqueue", req="r000001"),
+        _ev(0.1, "serve.enqueue", req="r000002"),
+        # completions land out of order; the id join keeps the split
+        _ev(2.0, "serve.respond", req="r000002", status="ok",
+            latency_s=1.9, queue_s=0.4),
+        _ev(3.0, "serve.respond", req="r000001", status="ok",
+            latency_s=3.0, queue_s=2.0),
+    ]
+    out = serve_summary(events)
+    assert out["requests"] == 2 and out["responses"] == 2
+    assert "orphans" not in out
+    assert out["latency_s"]["p50"] > 0
+
+
+def test_serve_summary_flags_orphans():
+    events = [
+        _ev(0.0, "serve.enqueue", req="r000001"),       # never responded
+        _ev(1.0, "serve.respond", req="r000009",        # never enqueued
+            status="ok", latency_s=1.0),
+        _ev(1.5, "serve.respond", req="r000010",        # shed pre-queue:
+            status="rejected"),                         # NOT an orphan
+    ]
+    out = serve_summary(events)
+    assert out["orphans"] == {"requests": 1, "responses": 1}
+
+
+# -------------------------------------------------------- critical path
+
+def test_critical_path_deepest_span_wins():
+    events = [
+        _ev(0.0, "session.start", trace="T", span="r", prog="x"),
+        _ev(0.0, "compile.start", trace="T", span="c", parent="r",
+            surface="k8"),
+        _ev(4.0, "compile.end", trace="T", span="c", parent="r"),
+        _ev(4.0, "staging.start", trace="T", span="s", parent="r"),
+        _ev(6.0, "staging.end", trace="T", span="s", parent="r"),
+        _ev(10.0, "session.end", trace="T", span="r"),
+    ]
+    cp = critical_path.compute(events)
+    assert cp["wall_s"] == 10.0
+    labels = [s["label"] for s in cp["segments"]]
+    assert labels == ["compile", "staging", "idle"]
+    shares = {s["label"]: s["share"] for s in cp["segments"]}
+    assert shares["compile"] == pytest.approx(0.4)
+    assert shares["staging"] == pytest.approx(0.2)
+    assert shares["idle"] == pytest.approx(0.4)
+    assert cp["chain"] == "compile 40% -> staging 20% -> idle 40%"
+
+
+def test_critical_path_merges_across_filtered_slivers():
+    """Dropping a sub-min_share sliver must not leave two same-label
+    neighbors split in the chain (`idle NN% -> idle NN%`)."""
+    events = [
+        _ev(0.0, "session.start", trace="T", span="r", prog="x"),
+        _ev(50.0, "step.start", trace="T", span="a", parent="r"),
+        _ev(50.1, "step.end", trace="T", span="a", parent="r"),
+        _ev(100.0, "session.end", trace="T", span="r"),
+    ]
+    cp = critical_path.compute(events, min_share=0.01)
+    assert [s["label"] for s in cp["segments"]] == ["idle"]
+    assert cp["segments"][0]["share"] == pytest.approx(1.0, abs=0.01)
+
+
+def test_span_medians_exclude_cut_spans():
+    events = [
+        _ev(0.0, "step.start", span="a", trace="T"),
+        _ev(2.0, "step.end", span="a", trace="T"),
+        _ev(3.0, "step.start", span="b", trace="T"),   # torn open
+        _ev(9.0, "trace.cut", trace="T"),
+    ]
+    assert critical_path.span_medians(events) == {"step": 2.0}
+
+
+def test_summary_markdown_has_critical_path_section():
+    events = [
+        _ev(0.0, "session.start", trace="T", span="r", prog="x", pid=7),
+        _ev(1.0, "staging.start", trace="T", span="s", parent="r",
+            pid=7),
+        _ev(3.0, "staging.end", trace="T", span="s", parent="r", pid=7),
+        _ev(4.0, "session.end", trace="T", span="r", pid=7),
+    ]
+    md = summary_markdown(summarize("l", events, 0))
+    assert "### critical path" in md
+    assert "window bounded by: " in md
+    assert "staging" in md
+
+
+def test_markdown_empty_when_no_critical_path():
+    assert critical_path.markdown(None) == []
+    assert critical_path.compute([]) is None

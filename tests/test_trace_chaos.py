@@ -1,0 +1,149 @@
+"""Trace continuity across window deaths (ISSUE 12 satellite 3): the
+causal context must survive the same deaths the plan state already does
+(tests/test_chaos_e2e.py). Two real `python -m tpu_reductions.sched`
+invocations share one TPU_REDUCTIONS_TRACE_CTX (the chip_session
+sidecar contract): the first dies at a task's watchdog-style exit 3
+with a span torn open by os._exit, the second resumes the SAME trace,
+marks the seam with trace.cut, and the export closes the torn spans at
+the cut — the tree is never torn. Plus the `--next --emit=shell`
+propagation path the chip_session loop uses."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from tpu_reductions.lint.grammar import TRACE_ENV
+from tpu_reductions.obs import trace
+from tpu_reductions.obs.timeline import read_ledger
+from tpu_reductions.obs.trace_export import build_spans, chrome_trace
+
+REPO = Path(__file__).resolve().parent.parent
+WIRE_CTX = "aaaa1111:bbbb2222"   # what the chip_session sidecar reuses
+
+
+def _write_flaky_task(tmp_path):
+    """One sched task whose first run arms the recorder, opens a span,
+    and dies via os._exit(3) — the watchdog's code, atexit bypassed, so
+    both its session.start and work.start are left without closers
+    (exactly the tear a real exit 3 leaves). The second run finds the
+    flag file and completes."""
+    (tmp_path / "task.py").write_text(
+        "import os, sys\n"
+        "if os.path.exists('flag'):\n"
+        "    open('flaky.json', 'w').write('{\"complete\": true}')\n"
+        "    sys.exit(0)\n"
+        "open('flag', 'w').close()\n"
+        "open('ctx.txt', 'w').write(\n"
+        f"    os.environ.get({TRACE_ENV!r}, ''))\n"
+        "from tpu_reductions.obs import ledger, spans\n"
+        "ledger.arm_session('flaky.task')\n"
+        "ctx = spans.span('work')\n"
+        "ctx.__enter__()\n"
+        "os._exit(3)\n")
+    spec = [{"name": "flaky", "value": 10, "budget_s": 60,
+             "command": f"{sys.executable} task.py",
+             "artifacts": ["flaky.json"],
+             "done_artifact": "flaky.json"}]
+    (tmp_path / "sched_tasks.json").write_text(json.dumps(spec))
+
+
+def _env(led):
+    return {**os.environ,
+            "PYTHONPATH": str(REPO),
+            "TPU_REDUCTIONS_LEDGER": str(led),
+            TRACE_ENV: WIRE_CTX,
+            # untunneled: the executor's relay gate must stay out of
+            # the way (this is a trace test, not a relay test)
+            "TPU_REDUCTIONS_RELAY_MARKER": str(led) + ".absent"}
+
+
+def _sched(tmp_path, env, *args):
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_reductions.sched",
+         "--tasks=sched_tasks.json", "--state=sched_state.json", *args],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=120)
+
+
+def test_exit3_resume_continues_trace_and_closes_torn_spans(tmp_path):
+    led = tmp_path / "obs_ledger.jsonl"
+    _write_flaky_task(tmp_path)
+    env = _env(led)
+
+    p1 = _sched(tmp_path, env)
+    assert p1.returncode == 3, p1.stdout + p1.stderr
+
+    # the task subprocess received a propagated context of the SAME
+    # trace (the executor re-exports its own span, not the inherited
+    # wire context verbatim)
+    ctx = trace.decode((tmp_path / "ctx.txt").read_text())
+    assert ctx is not None and ctx.trace_id == "aaaa1111"
+    assert ctx.span_id != "bbbb2222"
+
+    p2 = _sched(tmp_path, env)
+    assert p2.returncode == 0, p2.stdout + p2.stderr
+    state = json.loads((tmp_path / "sched_state.json").read_text())
+    assert state["complete"] is True
+    assert state["tasks"]["flaky"]["status"] == "done"
+
+    events, torn = read_ledger(led)
+    assert torn == 0
+    # one trace across every pid of both invocations
+    traced = [e for e in events if "trace" in e]
+    assert traced and {e["trace"] for e in traced} == {"aaaa1111"}
+    assert len({e["pid"] for e in traced}) >= 3   # 2 executors + task
+
+    # the resume marked the seam, naming the torn task
+    (cut,) = [e for e in events if e["ev"] == "trace.cut"]
+    assert cut["reason"] == "window-death-resume"
+    assert cut["tasks"] == ["flaky"]
+    # the cut came from the SECOND invocation, after the death
+    death_pid = next(e["pid"] for e in events
+                     if e["ev"] == "session.start"
+                     and e.get("prog") == "flaky.task")
+    assert cut["pid"] != death_pid
+
+    # no torn tree: the os._exit'd task's session + work spans close
+    # AT the cut, flagged; everything else paired normally
+    spans = build_spans(events)
+    cut_spans = [s for s in spans if s["cut"]]
+    assert {s["name"] for s in cut_spans} == {"session", "work"}
+    assert all(s["pid"] == death_pid for s in cut_spans)
+    assert all(s["t1"] == cut["t"] for s in cut_spans)
+    # the torn work span still parents into the executor's tree: walk
+    # parent ids up from `work` and land on the run-1 executor session
+    by_span = {s["span"]: s for s in spans if s["span"]}
+    node = next(s for s in cut_spans if s["name"] == "work")
+    seen_pids = set()
+    while node is not None:
+        seen_pids.add(node["pid"])
+        node = by_span.get(node["parent"])
+    assert len(seen_pids) >= 2    # crossed the process boundary
+
+    # and the whole thing exports as loadable Chrome-trace JSON with a
+    # propagation flow arrow across that boundary
+    doc = json.loads(json.dumps(chrome_trace(events)))
+    assert any(e["ph"] == "s" for e in doc["traceEvents"])
+    assert any(e["ph"] == "X" and e["args"].get("cut")
+               for e in doc["traceEvents"])
+
+
+def test_next_emit_shell_stamps_propagated_context(tmp_path):
+    """The chip_session loop's interface: `sched --next --emit=shell`
+    under a propagated TPU_REDUCTIONS_TRACE_CTX stamps its plan/pick
+    events with the env trace id, parented under the env span — the
+    shell steps and the scheduler share one tree without chip_session
+    doing anything but exporting the variable."""
+    led = tmp_path / "obs_ledger.jsonl"
+    _write_flaky_task(tmp_path)
+    p = _sched(tmp_path, _env(led), "--next", "--emit=shell")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "SCHED_TASK_CMD=" in p.stdout
+    events, _ = read_ledger(led)
+    picks = [e for e in events if e["ev"] == "sched.pick"]
+    assert picks, [e["ev"] for e in events]
+    for e in picks + [e for e in events if e["ev"] == "sched.plan"]:
+        assert e["trace"] == "aaaa1111"
+        assert e["parent"] == "bbbb2222"

@@ -233,7 +233,7 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
         local_view_and_selection, make_collective_reduce,
         mesh_spans_processes, select_algorithm, shard_payload)
     from tpu_reductions.faults.inject import fault_point
-    from tpu_reductions.obs import ledger
+    from tpu_reductions.obs import ledger, trace
     from tpu_reductions.parallel.mesh import build_mesh
 
     mesh = build_mesh(num_devices=cfg.num_devices,
@@ -353,6 +353,12 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
     # scripted stall/raise here is how a relay flap mid-sweep is
     # rehearsed (tests/test_chaos_e2e.py's sweep-resume pipeline)
     fault_point("collective.hop")
+    # one span per hop program (ISSUE 12): the launch/done bracket
+    # shares a child trace context, held open across the warm-up and
+    # timed phases so the chained trips nest under it in the span tree
+    import contextlib
+    _hop_span = contextlib.ExitStack()
+    _hop_span.enter_context(trace.child())
     ledger.emit("collective.launch", algorithm=algorithm,
                 method=method, dtype=dtype, ranks=k, n=int(cfg.n))
     _t_launch = Stopwatch()
@@ -363,6 +369,7 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
                     method=method, dtype=dtype, ranks=k,
                     wall_s=round(_t_launch.stop(), 6),
                     rows=len(results))
+        _hop_span.close()
 
     # warm-up collective (reduce.c:61-64). Guarded: this is the first
     # blocking dispatch of the run — the timed path below guards itself

@@ -116,79 +116,92 @@ def measure_cell(method: str, dtype: str, bits: int, k: int, n: int,
     # same draw for every (bits, op) at one (dtype, k): curves compare
     # bit widths on identical data
     rng = np.random.default_rng([seed, k])
-    ledger.emit("collective.launch", algorithm=sel_q.algorithm,
-                method=method, dtype=dtype, ranks=k, n=int(n))
-    from tpu_reductions.utils.timing import Stopwatch
-    watch = Stopwatch()
-    watch.start()
-    # the cell's one blocking device region: quantized collective
-    # dispatch + result materialization. Guarded so a relay that
-    # stalls mid-cell trips the heartbeat (exit 4) instead of
-    # hanging with live ports (redlint RED019).
-    from tpu_reductions.utils import heartbeat
-    with heartbeat.guard("quant.cell"):
-        if dd:
-            x64 = rng.standard_normal(n)
-            m_abs = float(np.abs(x64).max())
-            if method == "SUM":
-                from tpu_reductions.ops.dd_reduce import host_split
-                hi, lo = host_split(x64)
-                fn = make_quant_sum_all_reduce(mesh, bits=bits, dtype=dtype)
-                o_hi, o_lo = fn(shard_payload(hi, mesh, "ranks"),
-                                shard_payload(lo, mesh, "ranks"))
-                got = (np.asarray(jax.device_get(o_hi)).astype(np.float64)
-                       + np.asarray(jax.device_get(o_lo)))
-                want = x64.reshape(k, -1).sum(axis=0)
+    # one span per cell (ISSUE 12): the launch/done bracket shares a
+    # child trace context so the export nests the device region under
+    # whatever ran the cell (sweep task, chaos suite, driver)
+    from tpu_reductions.obs import trace
+    with trace.child():
+        ledger.emit("collective.launch", algorithm=sel_q.algorithm,
+                    method=method, dtype=dtype, ranks=k, n=int(n))
+        from tpu_reductions.utils.timing import Stopwatch
+        watch = Stopwatch()
+        watch.start()
+        # the cell's one blocking device region: quantized collective
+        # dispatch + result materialization. Guarded so a relay that
+        # stalls mid-cell trips the heartbeat (exit 4) instead of
+        # hanging with live ports (redlint RED019).
+        from tpu_reductions.utils import heartbeat
+        with heartbeat.guard("quant.cell"):
+            if dd:
+                x64 = rng.standard_normal(n)
+                m_abs = float(np.abs(x64).max())
+                if method == "SUM":
+                    from tpu_reductions.ops.dd_reduce import host_split
+                    hi, lo = host_split(x64)
+                    fn = make_quant_sum_all_reduce(mesh, bits=bits,
+                                                   dtype=dtype)
+                    o_hi, o_lo = fn(shard_payload(hi, mesh, "ranks"),
+                                    shard_payload(lo, mesh, "ranks"))
+                    got = (np.asarray(jax.device_get(o_hi))
+                           .astype(np.float64)
+                           + np.asarray(jax.device_get(o_lo)))
+                    want = x64.reshape(k, -1).sum(axis=0)
+                else:
+                    from tpu_reductions.ops.dd_reduce import (
+                        host_key_decode, host_key_encode)
+                    k_hi, k_lo = host_key_encode(x64)
+                    fn = make_quant_key_minmax_all_reduce(
+                        method, mesh, bits=bits, dtype=dtype)
+                    m_hi, m_lo = fn(shard_payload(k_hi, mesh, "ranks"),
+                                    shard_payload(k_lo, mesh, "ranks"))
+                    got = host_key_decode(
+                        np.asarray(jax.device_get(m_hi)),
+                        np.asarray(jax.device_get(m_lo)))
+                    reduce = np.minimum if method == "MIN" \
+                        else np.maximum
+                    want = reduce.reduce(x64.reshape(k, -1), axis=0)
             else:
-                from tpu_reductions.ops.dd_reduce import (host_key_decode,
-                                                          host_key_encode)
-                k_hi, k_lo = host_key_encode(x64)
-                fn = make_quant_key_minmax_all_reduce(method, mesh, bits=bits,
-                                                      dtype=dtype)
-                m_hi, m_lo = fn(shard_payload(k_hi, mesh, "ranks"),
-                                shard_payload(k_lo, mesh, "ranks"))
-                got = host_key_decode(np.asarray(jax.device_get(m_hi)),
-                                      np.asarray(jax.device_get(m_lo)))
-                reduce = np.minimum if method == "MIN" else np.maximum
-                want = reduce.reduce(x64.reshape(k, -1), axis=0)
-        else:
-            import jax.numpy as jnp
-            x = rng.standard_normal(n).astype(np.float32)
-            if dtype == "bfloat16":
-                # redlint: disable=RED015 -- <= 4 MiB host-side dtype round-trip (n <= 2^20 f32), far under the 512 MiB staging bound
-                x = np.asarray(jnp.asarray(x, dtype=jnp.bfloat16))
-            m_abs = float(np.abs(x.astype(np.float32)).max())
-            xs = shard_payload(x, mesh, "ranks")
-            x64 = x.astype(np.float32).astype(np.float64)
-            if method == "SUM":
-                fn = make_quant_sum_all_reduce(mesh, bits=bits, dtype=dtype)
-                got = np.asarray(jax.device_get(fn(xs)).astype(jnp.float32)
-                                 ).astype(np.float64)
-                want = x64.reshape(k, -1).sum(axis=0)
-            else:
-                fn = make_quant_key_minmax_all_reduce(method, mesh, bits=bits,
-                                                      dtype=dtype)
-                got = np.asarray(jax.device_get(fn(xs)).astype(jnp.float32)
-                                 ).astype(np.float64)
-                reduce = np.minimum if method == "MIN" else np.maximum
-                want = reduce.reduce(x64.reshape(k, -1), axis=0)
-    wall_s = watch.stop()
-    bound = quant_error_bound(method, dtype, bits, k, m_abs)
-    max_err = float(np.abs(got - want).max())
-    exact = bool(np.array_equal(got, want))
-    ok = exact if bound == 0.0 else max_err <= bound
-    row = {"method": method, "dtype": dtype, "bits": bits, "ranks": k,
-           "n": int(n),
-           "algorithm": sel_q.algorithm,
-           "baseline_algorithm": sel_b.algorithm,
-           "wire_factor": sel_q.wire_factor,
-           "baseline_wire_factor": sel_b.wire_factor,
-           "wire_reduction": sel_b.wire_factor / sel_q.wire_factor,
-           "max_err": max_err, "bound": bound, "exact": exact,
-           "status": "PASSED" if ok else "FAILED"}
-    ledger.emit("collective.done", algorithm=sel_q.algorithm,
-                method=method, dtype=dtype, ranks=k,
-                wall_s=round(wall_s, 6), rows=1)
+                import jax.numpy as jnp
+                x = rng.standard_normal(n).astype(np.float32)
+                if dtype == "bfloat16":
+                    # redlint: disable=RED015 -- <= 4 MiB host-side dtype round-trip (n <= 2^20 f32), far under the 512 MiB staging bound
+                    x = np.asarray(jnp.asarray(x, dtype=jnp.bfloat16))
+                m_abs = float(np.abs(x.astype(np.float32)).max())
+                xs = shard_payload(x, mesh, "ranks")
+                x64 = x.astype(np.float32).astype(np.float64)
+                if method == "SUM":
+                    fn = make_quant_sum_all_reduce(mesh, bits=bits,
+                                                   dtype=dtype)
+                    got = np.asarray(jax.device_get(fn(xs))
+                                     .astype(jnp.float32)
+                                     ).astype(np.float64)
+                    want = x64.reshape(k, -1).sum(axis=0)
+                else:
+                    fn = make_quant_key_minmax_all_reduce(
+                        method, mesh, bits=bits, dtype=dtype)
+                    got = np.asarray(jax.device_get(fn(xs))
+                                     .astype(jnp.float32)
+                                     ).astype(np.float64)
+                    reduce = np.minimum if method == "MIN" \
+                        else np.maximum
+                    want = reduce.reduce(x64.reshape(k, -1), axis=0)
+        wall_s = watch.stop()
+        bound = quant_error_bound(method, dtype, bits, k, m_abs)
+        max_err = float(np.abs(got - want).max())
+        exact = bool(np.array_equal(got, want))
+        ok = exact if bound == 0.0 else max_err <= bound
+        row = {"method": method, "dtype": dtype, "bits": bits,
+               "ranks": k, "n": int(n),
+               "algorithm": sel_q.algorithm,
+               "baseline_algorithm": sel_b.algorithm,
+               "wire_factor": sel_q.wire_factor,
+               "baseline_wire_factor": sel_b.wire_factor,
+               "wire_reduction": sel_b.wire_factor / sel_q.wire_factor,
+               "max_err": max_err, "bound": bound, "exact": exact,
+               "status": "PASSED" if ok else "FAILED"}
+        ledger.emit("collective.done", algorithm=sel_q.algorithm,
+                    method=method, dtype=dtype, ranks=k,
+                    wall_s=round(wall_s, 6), rows=1)
     return row
 
 

@@ -87,6 +87,85 @@ def collect_averages(grid_dir: Path, grid: dict | None = None,
     return out
 
 
+def find_round_metrics(out_dir: Path) -> List[Path]:
+    """Locate the committed per-round headline artifacts
+    (BENCH_r01.json..) by walking up from the experiment dir to the
+    repo root (they live at the top level, next to ROADMAP.md), falling
+    back to the cwd. Snapshot side-files are excluded — they are a
+    round's provenance, not a round.
+
+    No reference analog (TPU-native).
+    """
+    for cand in (out_dir.resolve(), *out_dir.resolve().parents,
+                 Path.cwd()):
+        hits = sorted(f for f in cand.glob("BENCH_r[0-9]*.json")
+                      if "snapshot" not in f.name)
+        if hits:
+            return hits
+    return []
+
+
+def trajectory_markdown(files: List[Path],
+                        single_chip: Dict[Tuple[str, str], float]
+                        | None = None) -> str:
+    """The cross-round headline trajectory table (ISSUE 12 satellite):
+    every committed round metric (bench.py's one JSON line, persisted
+    as BENCH_rNN.json) in one table — int32 flagship GB/s, the
+    vs-baseline multiple, and the measurement standing (measured /
+    carried-stale / outage) — so a regression across windows is
+    visible in one place instead of five files. The f64 column reads
+    the round row when it carries one (`doubles_gbps`), else the
+    current flagship DOUBLE SUM average stands underneath as context
+    (the per-round files predate the DOUBLE scoreboard).
+
+    No reference analog (TPU-native).
+    """
+    rows = []
+    for f in files:
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        p = d.get("parsed") or {}
+        v = p.get("value")
+        if not isinstance(v, (int, float)):
+            continue
+        rows.append({"round": d.get("n") or f.stem, "value": float(v),
+                     "vs": p.get("vs_baseline"),
+                     "doubles": p.get("doubles_gbps"),
+                     "stale": bool(p.get("stale")),
+                     "unit": p.get("unit") or "GB/s"})
+    if not rows:
+        return ""
+    lines = ["## headline trajectory (cross-round)", "",
+             "| round | int32 SUM GB/s | vs baseline | f64 GB/s "
+             "| standing |", "|---|---|---|---|---|"]
+    for r in rows:
+        if r["value"] <= 0:
+            standing = "outage (no measurement landed)"
+        elif r["stale"]:
+            standing = "carried (stale; accelerator unavailable)"
+        else:
+            standing = "measured live"
+        vs = f"{r['vs']:.1f}x" if isinstance(r["vs"], (int, float)) \
+            and r["vs"] > 0 else "-"
+        dbl = f"{r['doubles']:.1f}" \
+            if isinstance(r["doubles"], (int, float)) else "-"
+        label = f"r{r['round']:02d}" if isinstance(r["round"], int) \
+            else str(r["round"])
+        lines.append(f"| {label} | {r['value']:.1f} | {vs} "
+                     f"| {dbl} | {standing} |")
+    if single_chip:
+        dbl_now = single_chip.get(("DOUBLE", "SUM"))
+        if isinstance(dbl_now, (int, float)):
+            lines.append("")
+            lines.append(f"current flagship DOUBLE SUM average: "
+                         f"{dbl_now:.1f} GB/s (single_chip/"
+                         "averages.json; the per-round files carry "
+                         "only the int32 headline)")
+    return "\n".join(lines)
+
+
 def regenerate(out_dir: str | Path, device_kind: str | None = None,
                log=print) -> bool:
     """Re-collate out_dir's report artifacts from disk. Returns False
@@ -252,6 +331,14 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                     "(compile_ledger.json)")
         except (OSError, ValueError, KeyError, TypeError) as e:
             log(f"regen: compile_ledger.json unusable ({e}); skipped")
+    # the cross-round headline trajectory (ISSUE 12 satellite): the
+    # committed BENCH_rNN.json round metrics collated into one table
+    # so regressions across windows are visible in one place
+    traj = trajectory_markdown(find_round_metrics(out), single_chip=sc)
+    if traj:
+        with open(paths["md"], "a") as f:
+            f.write("\n" + traj + "\n")
+        log("regen: appended headline-trajectory table (BENCH_r*.json)")
     pdf = generate_pdf(out, platform=platform,
                        data={"avgs": {}, "single_chip": sc or None,
                              "calibration": cal,

@@ -246,6 +246,11 @@ def main(argv=None) -> int:
         phases = load_schedule(ns.schedule)
     except ValueError as e:
         p.error(str(e))
+    # flight recorder + trace adoption (ISSUE 12): a chaos relay run
+    # under an armed session inherits TPU_REDUCTIONS_TRACE_CTX, so its
+    # session/phase events parent under the rehearsal that spawned it
+    from tpu_reductions.obs import ledger
+    ledger.arm_session("faults.relay", argv=sys.argv[1:])
     relay = FakeRelay(phases, port=ns.port)
     relay.start()
     print(f"fake relay: listening on 127.0.0.1:{relay.port} "

@@ -90,6 +90,21 @@ QUANT_CURVE_ROW_RE = re.compile(
 # attempt at an event row and must come from a sanctioned producer
 EVENT_KEY = '"ev":'
 
+# the causal-identity fields obs/trace.py stamps onto every event
+# (ISSUE 12): trace = the tree, span = this node, parent = what it
+# nests under. Reserved vocabulary — RED012's trace extension bans
+# minting them as emit kwargs outside obs/ (the contextvar context and
+# trace.request_fields are the sanctioned producers), and the offline
+# analyzers (obs/trace_export.py, obs/critical_path.py) key on exactly
+# these names
+TRACE_FIELDS = ("trace", "span", "parent")
+
+# cross-process propagation env knob (docs/RESILIENCE.md knob table):
+# `<trace_id>:<span_id>` — sched/executor.py injects it into task
+# subprocesses, scripts/chip_session.sh exports it per window,
+# scripts/obs_event.sh stamps shell events from it
+TRACE_ENV = "TPU_REDUCTIONS_TRACE_CTX"
+
 # legal event-type names: dotted lowercase (session.start, hb.phase,
 # watchdog.exit, ...) — obs/ledger.py validates every emit against this
 EVENT_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_]+)*$")
@@ -160,13 +175,14 @@ CORE_EVENTS = (
     "fault.fire",                                      # faults/inject.py
     "firstrow.mark",                                   # bench/firstrow.py
     "sweep.cell", "sweep.rank",                        # bench/sweep.py
+    "trace.cut",                                       # obs/trace.py
 )
 
 # the shell producer's vocabulary (scripts/obs_event.sh call sites in
 # scripts/*.sh) — same registry, same drift gate
 SHELL_EVENTS = (
     "session.start", "session.end", "session.abort", "session.fallback",
-    "step.start", "step.end",
+    "step.start", "step.end", "trace.cut",
     "watcher.arm", "watcher.fire", "watcher.session_end",
     "watcher.rearm", "watcher.defer", "watcher.retire", "watcher.expire",
     "supervisor.spawn", "supervisor.respawn", "supervisor.retire",

@@ -46,6 +46,15 @@ OBS_SCOPE_DIRS = ("utils", "bench", "obs", "faults", "serve", "sched")
 # compile.* event (obs/compile.compile_span)
 COMPILE_TIMING_WHITELIST = ("obs/ledger.py", "obs/compile.py",
                             "bench/warm.py")
+# RED012's trace extension (ISSUE 12): the causal-identity fields
+# (grammar.TRACE_FIELDS: trace/span/parent) are minted ONLY by the
+# contextvar context in obs/trace.py — an emit call site passing them
+# as literal kwargs anywhere else is inventing span identity the
+# offline tree builder cannot reconcile (the sanctioned spellings are
+# obs.spans.span / trace.child() for nesting and
+# **trace.request_fields(rid) for per-request traces)
+TRACE_FIELD_WHITELIST = ("obs/trace.py", "obs/ledger.py",
+                         "obs/spans.py", "obs/compile.py")
 # RED013: wall-clock budgets / step orderings live in the scheduler's
 # task registry and nowhere else (ISSUE 5; docs/SCHEDULER.md)
 SCHED_WHITELIST = ("sched/tasks.py",)
@@ -742,6 +751,21 @@ def _red012(rel: str, ctx: _FileContext) -> List[RawFinding]:
         if not isinstance(node, ast.Call):
             continue
         chain = _attr_chain(node.func)
+        # trace extension: emit kwargs named after grammar.TRACE_FIELDS
+        # outside the trace module mint ad-hoc span identity
+        if chain.rsplit(".", 1)[-1] == "emit" and \
+                not _suffix_match(rel, TRACE_FIELD_WHITELIST):
+            minted = sorted(kw.arg for kw in node.keywords
+                            if kw.arg in grammar.TRACE_FIELDS)
+            if minted:
+                out.append(RawFinding(
+                    "RED012", node.lineno,
+                    f"ad-hoc trace identity ({', '.join(minted)}=) "
+                    "minted outside obs/ — span/trace ids are "
+                    "contextvar-scoped (obs/trace.py): nest with "
+                    "obs.spans.span / trace.child(), stamp "
+                    "per-request traces via "
+                    "**trace.request_fields(rid)"))
         is_print = chain == "print"
         is_write = isinstance(node.func, ast.Attribute) and \
             node.func.attr in ("write", "write_text")

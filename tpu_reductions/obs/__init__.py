@@ -27,6 +27,17 @@ is the machine-readable record:
     cold/warm verdict (utils/compile_cache fingerprints), persisted
     per-surface into compile_ledger.json; the scheduler's cold/warm
     duration priors and the report's compile-latency table read it.
+  * `obs.trace` — causal identity (ISSUE 12): contextvar-scoped
+    trace/span/parent ids stamped onto every emitted event, propagated
+    across process boundaries via TPU_REDUCTIONS_TRACE_CTX (sched task
+    subprocesses, shell steps, chaos relays all parent under one
+    session trace; exit-3/4 re-invocations continue the trace past an
+    explicit `trace.cut` marker).
+  * `obs.trace_export` — offline Chrome-trace/Perfetto JSON export of
+    the reconstructed span tree (pid/tid = process/trace lanes).
+  * `obs.critical_path` — the longest dependent chain per session/
+    request: "window bounded by: compile 38% -> staging 22% -> chain
+    31%", folded into timeline --summary-md and report.md.
 
 Strictly host-side by contract: instrumentation adds no device work, no
 sync, and never emits inside a timed region (docs/OBSERVABILITY.md has

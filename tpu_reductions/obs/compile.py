@@ -185,32 +185,37 @@ def compile_span(surface: str, **fields):
     compile.end event and the persisted row. Never raises on its own:
     the observed compile's exceptions pass through untouched, recorded
     as `error` on the end event."""
+    from tpu_reductions.obs import trace
     before = compile_cache.fingerprint()
-    ledger.emit("compile.start", surface=surface, **fields)
-    obs: dict = {}
-    t0 = time.monotonic()
-    err = None
-    try:
-        yield obs
-    except BaseException as e:
-        err = f"{type(e).__name__}: {e}"[:200]
-        raise
-    finally:
-        dur = round(time.monotonic() - t0, 6)
-        after = compile_cache.fingerprint()
-        verdict = compile_cache.verdict(before, after)
-        row = {"surface": surface, "platform": _platform(),
-               "verdict": verdict, "dur_s": dur,
-               "cache_new": len(after - before), **fields, **obs}
-        if err is not None:
-            row["error"] = err
-        global _last
-        _last = row
-        ledger.emit("compile.end", **row)
-        store = arm()
-        if store is not None and err is None:
-            store.record({k: v for k, v in row.items()
-                          if k != "cache_new"})
+    # one child trace context for the whole seam (ISSUE 12): the
+    # start/end pair share a span id and nested emits parent under it,
+    # so compile spans gain causal parentage in the trace tree for free
+    with trace.child():
+        ledger.emit("compile.start", surface=surface, **fields)
+        obs: dict = {}
+        t0 = time.monotonic()
+        err = None
+        try:
+            yield obs
+        except BaseException as e:
+            err = f"{type(e).__name__}: {e}"[:200]
+            raise
+        finally:
+            dur = round(time.monotonic() - t0, 6)
+            after = compile_cache.fingerprint()
+            verdict = compile_cache.verdict(before, after)
+            row = {"surface": surface, "platform": _platform(),
+                   "verdict": verdict, "dur_s": dur,
+                   "cache_new": len(after - before), **fields, **obs}
+            if err is not None:
+                row["error"] = err
+            global _last
+            _last = row
+            ledger.emit("compile.end", **row)
+            store = arm()
+            if store is not None and err is None:
+                store.record({k: v for k, v in row.items()
+                              if k != "cache_new"})
 
 
 def probe_lower_compile(fn, *args, surface: str, **fields):

@@ -31,7 +31,10 @@ leading keys are fixed and the schema lives in lint/grammar.py
 suite emits; redlint RED012 bans ad-hoc emission outside this module
 and scripts/obs_event.sh. Events carry the current heartbeat phase
 (utils/heartbeat.py) when one is active, so ack-vs-materialization
-attribution stays honest per docs/TIMING.md.
+attribution stays honest per docs/TIMING.md — and, when a trace
+context is active (obs/trace.py), the causal identity fields
+`trace`/`span`/`parent` (lint/grammar.py TRACE_FIELDS), so the
+offline analyzers rebuild the span tree from the rows alone.
 
 This is the shrLog/shrLogEx master-log multiplex of the reference
 (cuda/shared/src/shrUtils.cpp:157,173-280) rebuilt as a typed,
@@ -141,6 +144,24 @@ def disarm() -> None:
         except OSError:
             pass
     _fd, _path, _session_open, _max_bytes = None, None, False, None
+    try:
+        # a disarmed recorder sheds its trace identity too (tests
+        # re-arm fresh sessions; a stale root would chain them)
+        from tpu_reductions.obs import trace
+        trace.reset()
+    except Exception:
+        pass
+
+
+def _current_trace():
+    """The active trace context, lazily (same cycle discipline as the
+    heartbeat read below: obs/trace.py never imports this module's
+    emit path at import time)."""
+    try:
+        from tpu_reductions.obs import trace
+        return trace.active()
+    except Exception:
+        return None
 
 
 def _current_phase() -> Optional[str]:
@@ -188,6 +209,16 @@ def emit(ev: str, **fields) -> bool:
             phase = _current_phase()
             if phase is not None:
                 rec["phase"] = phase
+        if "trace" not in fields:
+            # causal identity (obs/trace.py): stamped from the ambient
+            # context unless the caller carries an explicit one (the
+            # serving engine's per-request traces)
+            tr = _current_trace()
+            if tr is not None:
+                rec["trace"] = tr.trace_id
+                rec["span"] = tr.span_id
+                if tr.parent_id is not None:
+                    rec["parent"] = tr.parent_id
         for k, v in fields.items():
             rec[str(k)] = _clean(v)
         line = (json.dumps(rec) + "\n").encode("utf-8", "replace")
@@ -251,6 +282,14 @@ def arm_session(prog: str, argv=None, **fields) -> Optional[str]:
     path = arm()
     if path is None:
         return None
+    try:
+        # root the process span tree BEFORE the first emit: session.*
+        # and everything after carry the trace — adopted from
+        # TPU_REDUCTIONS_TRACE_CTX when a parent propagated one
+        from tpu_reductions.obs import trace
+        trace.ensure_root()
+    except Exception:
+        pass
     emit("session.start", prog=prog,
          argv=list(argv) if argv is not None else None, **fields)
     if not _session_open:

@@ -8,6 +8,12 @@ cutil.cpp:1567-1692) re-pointed at the event ledger instead of an
 in-memory average — the duration lands in the crash-ordered record, so
 it survives the process.
 
+Since ISSUE 12 every span is also a node of the causal trace tree
+(obs/trace.py): entering a span pushes a child trace context, so the
+`.start`/`.end` pair share one span id, nested spans parent under it,
+and every point event emitted inside carries the span's identity —
+obs/trace_export.py rebuilds the tree offline.
+
 Spans are strictly host-side instrumentation: they never sync a
 device, and the instrumented seams only open spans OUTSIDE timed
 regions (utils/timing.py emits after its perf_counter windows close;
@@ -21,7 +27,7 @@ from __future__ import annotations
 import contextlib
 import time
 
-from tpu_reductions.obs import ledger
+from tpu_reductions.obs import ledger, trace
 
 event = ledger.emit     # alias: seams import one module for both
 
@@ -31,17 +37,20 @@ def span(name: str, **fields):
     """Bracket one host-side region with `<name>.start` / `<name>.end`
     events; `dur_s` is monotonic wall-clock, `error` records a raising
     region (the exception is re-raised untouched — spans observe,
-    never contain)."""
+    never contain). The pair share a child trace context so the region
+    is one node of the span tree."""
     if not ledger.armed():
         yield
         return
-    ledger.emit(name + ".start", **fields)
-    t0 = time.monotonic()
-    try:
-        yield
-    except BaseException as e:
+    with trace.child():
+        ledger.emit(name + ".start", **fields)
+        t0 = time.monotonic()
+        try:
+            yield
+        except BaseException as e:
+            ledger.emit(name + ".end",
+                        dur_s=round(time.monotonic() - t0, 6),
+                        error=f"{type(e).__name__}: {e}"[:200], **fields)
+            raise
         ledger.emit(name + ".end", dur_s=round(time.monotonic() - t0, 6),
-                    error=f"{type(e).__name__}: {e}"[:200], **fields)
-        raise
-    ledger.emit(name + ".end", dur_s=round(time.monotonic() - t0, 6),
-                **fields)
+                    **fields)

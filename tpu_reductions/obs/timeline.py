@@ -21,7 +21,16 @@ per pid) and for the window as a whole:
     stalled guard's bucket (watchdog.exit events) — so "where did the
     minutes go" has a machine answer;
   * window-utilization metrics: the fraction of recorded seconds spent
-    measuring vs compiling vs staging vs retrying vs stalled.
+    measuring vs compiling vs staging vs retrying vs stalled;
+  * critical-path attribution over the causal span tree (ISSUE 12;
+    obs/critical_path.py): the longest dependent chain and its
+    per-segment shares — "window bounded by: compile 38% -> staging
+    22% -> chain 31%" — rendered into the --summary-md output.
+
+A ledger whose size cap rotated it mid-session is read WHOLE: the
+`<ledger>.1` segment is stitched back in front of the live file by
+read_ledger, for every consumer (timeline, obs/trace_export,
+sched/priors).
 
 Outputs: a text report (default), `--json OUT` (summary JSON written
 atomically via utils/jsonio — bench/regen collates it into report.md),
@@ -65,11 +74,18 @@ def _bucket(phase: Optional[str]) -> str:
 def read_ledger(path) -> Tuple[List[dict], int]:
     """Parse a JSONL ledger -> (events sorted by t, torn_line_count).
     A line that fails to parse, or parses to something that is not an
-    event row, counts as torn."""
+    event row, counts as torn. A rotated predecessor segment
+    `<path>.1` (obs/ledger.py's size-cap rotation renames the full
+    file there) is stitched back IN FRONT of the live file, so a
+    session whose ledger rolled over mid-run reads whole — every
+    consumer of this reader (timeline, trace_export, sched/priors)
+    gets the stitch for free. OSError only when no segment exists."""
     events: List[dict] = []
     torn = 0
-    with open(path, errors="replace") as f:
-        for line in f:
+
+    def _parse(fobj) -> None:
+        nonlocal torn
+        for line in fobj:
             line = line.strip()
             if not line:
                 continue
@@ -84,6 +100,21 @@ def read_ledger(path) -> Tuple[List[dict], int]:
                 events.append(rec)
             else:
                 torn += 1
+
+    rotated = f"{path}.1"
+    stitched = False
+    try:
+        with open(rotated, errors="replace") as f:
+            _parse(f)
+        stitched = True
+    except OSError:
+        pass
+    try:
+        with open(path, errors="replace") as f:
+            _parse(f)
+    except OSError:
+        if not stitched:
+            raise
     events.sort(key=lambda e: e["t"])
     return events, torn
 
@@ -224,7 +255,16 @@ def serve_summary(events: List[dict]) -> Optional[dict]:
     answer ISSUE 6 requires: how many requests, how they resolved,
     where their milliseconds went (queued vs in-launch — the engine
     stamps queue_s/latency_s on every respond event), and how hard
-    coalescing worked (batches, mean size). None when no engine ran."""
+    coalescing worked (batches, mean size). None when no engine ran.
+
+    Requests JOIN BY ID (ISSUE 12 satellite): the `req` field — the
+    request's trace id, obs/trace.request_context — keys every
+    enqueue→respond pair, so the latency split never misaligns under
+    reordered completion, and the mismatches are FLAGGED (`orphans`):
+    an admitted request that never got a respond (a torn session), or
+    a non-rejected respond with no enqueue (rejected responds are
+    legitimately enqueue-less — admission control sheds before the
+    queue)."""
     enq = [e for e in events if e["ev"] == "serve.enqueue"]
     responds = [e for e in events if e["ev"] == "serve.respond"]
     launches = [e for e in events if e["ev"] == "serve.launch"]
@@ -242,13 +282,26 @@ def serve_summary(events: List[dict]) -> Optional[dict]:
              if isinstance(e.get("size"), int)]
     if sizes:
         out["mean_batch"] = round(sum(sizes) / len(sizes), 2)
-    ok_lat = sorted(e["latency_s"] for e in responds
+    pending = {e["req"] for e in enq if isinstance(e.get("req"), str)}
+    joined: List[dict] = []
+    orphan_responses = 0
+    for e in responds:
+        rid = e.get("req")
+        if isinstance(rid, str) and rid in pending:
+            pending.discard(rid)
+            joined.append(e)
+        elif e.get("status") != "rejected":
+            orphan_responses += 1
+    if pending or orphan_responses:
+        out["orphans"] = {"requests": len(pending),
+                          "responses": orphan_responses}
+    ok_lat = sorted(e["latency_s"] for e in joined
                     if e.get("status") == "ok"
                     and isinstance(e.get("latency_s"), (int, float)))
     if ok_lat:
         out["latency_s"] = {"p50": round(_percentile(ok_lat, 0.5), 6),
                             "p99": round(_percentile(ok_lat, 0.99), 6)}
-    queued = sorted(e["queue_s"] for e in responds
+    queued = sorted(e["queue_s"] for e in joined
                     if isinstance(e.get("queue_s"), (int, float)))
     if queued:
         out["queue_s"] = {"p50": round(_percentile(queued, 0.5), 6),
@@ -397,6 +450,10 @@ def summarize(path, events: List[dict], torn: int) -> dict:
     comp = compile_summary(events)
     if comp is not None:
         out["compile"] = comp
+    from tpu_reductions.obs import critical_path as _cp
+    cp = _cp.compute(events)
+    if cp is not None:
+        out["critical_path"] = cp
     if events:
         t0, t1 = events[0]["t"], events[-1]["t"]
         wall = max(t1 - t0, 0.0)
@@ -481,6 +538,15 @@ def summary_markdown(summary: dict) -> str:
             f"stalled {u['stalled']:.0%}, host {u['host']:.0%}"
             + (f"; {summary['torn_lines']} torn line(s)"
                if summary.get("torn_lines") else ""))
+    cp = summary.get("critical_path")
+    if cp:
+        # the span tree's longest dependent chain (ISSUE 12): at every
+        # instant, the DEEPEST open span holds the wall clock —
+        # obs/critical_path.py has the model and the markdown
+        from tpu_reductions.obs.critical_path import markdown as _cp_md
+        lines.append("")
+        lines.extend(_cp_md(cp))
+        lines.pop()     # the section's trailing blank: joined below
     sched = summary.get("sched")
     if sched:
         # the scheduler's plan-vs-actual record (ISSUE 5 satellite):

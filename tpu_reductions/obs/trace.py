@@ -1,0 +1,203 @@
+"""Causal trace context for the flight recorder — every event gets an
+identity in ONE span tree.
+
+The ledger (obs/ledger.py) records *what* happened; this module records
+*why it took that long*: a (trace_id, span_id, parent_id) context,
+contextvar-scoped so threads and async tasks each see their own span
+stack, that `ledger.emit` stamps onto every event and `obs/spans.span`
+/ `compile_span` / the instrumented seams push children onto. The
+analysis layer (obs/trace_export.py Perfetto export,
+obs/critical_path.py longest-chain attribution) rebuilds the tree
+offline from nothing but the stamped events.
+
+Cross-process propagation: `TPU_REDUCTIONS_TRACE_CTX` carries
+`<trace_id>:<span_id>` into subprocesses (sched/executor.py task
+launches, scripts/chip_session.sh steps, scripts/obs_event.sh shell
+events, faults/relay.py chaos runs). A process that finds the env var
+adopts the trace id and parents its root span under the propagated
+span — so one live window is ONE trace across every pid that served
+it. A re-invocation after a watchdog exit 3/4 continues the same
+trace and marks the discontinuity with an explicit `trace.cut` event
+(registered in lint/grammar.py); the analysis layer closes spans the
+death tore open at the cut, never leaving a torn tree.
+
+Overhead contract (docs/OBSERVABILITY.md): pure host-side id
+bookkeeping — no jax import, no device call, no syscall beyond
+os.urandom at id mint. When the ledger is unarmed nothing here runs at
+all (the span helpers bail before touching this module).
+
+This is the reference's named-stopwatch registry (cutCreateTimer,
+cutil.cpp:1567-1692) grown the way serving stacks grew it: the name
+became a span, the registry became a tree, the tree became portable
+across processes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import re
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+ENV_CTX = "TPU_REDUCTIONS_TRACE_CTX"
+
+# ids propagated through env/shell: keep them shell-quoting-proof
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]{0,63}$")
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One node of the span tree: the trace it belongs to, its own span
+    id, and the span it nests under (None for a trace root)."""
+
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str] = None
+
+    def encode(self) -> str:
+        """The TPU_REDUCTIONS_TRACE_CTX wire form: `trace:span`."""
+        return f"{self.trace_id}:{self.span_id}"
+
+
+def new_id(nbytes: int = 6) -> str:
+    """A fresh hex id (os.urandom — no Math.random/clock coupling)."""
+    return os.urandom(nbytes).hex()
+
+
+def decode(value: Optional[str]) -> Optional[TraceContext]:
+    """Parse the `trace:span` wire form; malformed input is None (a
+    corrupt env var must never take down the session it describes)."""
+    if not value:
+        return None
+    trace_id, sep, span_id = value.partition(":")
+    if not sep or not _ID_RE.match(trace_id) or not _ID_RE.match(span_id):
+        return None
+    return TraceContext(trace_id=trace_id, span_id=span_id)
+
+
+_cv: contextvars.ContextVar = contextvars.ContextVar(
+    "tpu_reductions_trace", default=None)
+_root: Optional[TraceContext] = None
+_root_adopted = False
+_lock = threading.Lock()
+
+
+def active() -> Optional[TraceContext]:
+    """The context events stamp right now: the innermost open span in
+    this thread/task, else the process root, else a root adopted from
+    TPU_REDUCTIONS_TRACE_CTX on first use, else None (untraced)."""
+    ctx = _cv.get()
+    if ctx is not None:
+        return ctx
+    if _root is not None:
+        return _root
+    if os.environ.get(ENV_CTX):
+        return ensure_root()
+    return None
+
+
+def ensure_root() -> TraceContext:
+    """Create (once) the process root span: adopt the trace id from
+    TPU_REDUCTIONS_TRACE_CTX and parent under its span when propagated,
+    else mint a fresh trace. Idempotent; thread-safe."""
+    global _root, _root_adopted
+    with _lock:
+        if _root is None:
+            inherited = decode(os.environ.get(ENV_CTX))
+            if inherited is not None:
+                _root = TraceContext(trace_id=inherited.trace_id,
+                                     span_id=new_id(),
+                                     parent_id=inherited.span_id)
+                _root_adopted = True
+            else:
+                _root = TraceContext(trace_id=new_id(8), span_id=new_id())
+                _root_adopted = False
+        return _root
+
+
+def adopted() -> bool:
+    """Whether the root came from a propagated context (the marker the
+    trace.cut sites key on: only a continued trace has a cut)."""
+    return _root is not None and _root_adopted
+
+
+def reset() -> None:
+    """Drop the process root (tests; ledger.disarm calls this so a
+    disarmed recorder also sheds its trace identity)."""
+    global _root, _root_adopted
+    with _lock:
+        _root = None
+        _root_adopted = False
+
+
+@contextlib.contextmanager
+def child():
+    """Open a child span context: a fresh span id parented under the
+    innermost active span (a process root is created on demand).
+    Events emitted inside carry the child's identity; the contextvar
+    token discipline makes nesting thread- and async-safe. With the
+    ledger unarmed this is a no-op yielding None — identity without a
+    recorder is pure overhead (the contract in the module
+    docstring)."""
+    from tpu_reductions.obs import ledger
+    if not ledger.armed():
+        yield None
+        return
+    parent = active() or ensure_root()
+    ctx = TraceContext(trace_id=parent.trace_id, span_id=new_id(),
+                       parent_id=parent.span_id)
+    token = _cv.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _cv.reset(token)
+
+
+@contextlib.contextmanager
+def activate(ctx: TraceContext):
+    """Run a block under an explicit context (the serving engine's
+    per-request traces re-enter their request context this way)."""
+    token = _cv.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _cv.reset(token)
+
+
+def request_context(request_id: str) -> TraceContext:
+    """One trace PER serving request: the request id IS the trace id
+    (and the root span id), so loadgen/timeline join latencies by id
+    instead of positionally and a p99 outlier decomposes into its own
+    span tree (docs/SERVING.md; ISSUE 12)."""
+    rid = str(request_id)
+    return TraceContext(trace_id=rid, span_id=rid)
+
+
+def request_fields(request_id: str) -> dict:
+    """The explicit stamp for per-request events (serve/engine.py):
+    `{"trace": rid, "span": rid}` — passed as **fields so ledger.emit
+    skips ambient stamping for them. The ONE sanctioned way to mint
+    trace identity outside this module (redlint RED012)."""
+    rid = str(request_id)
+    return {"trace": rid, "span": rid}
+
+
+def propagation_env() -> dict:
+    """The env fragment that parents a subprocess under the current
+    span: `{TPU_REDUCTIONS_TRACE_CTX: "trace:span"}` (sched/executor.py
+    merges it into every task launch; chip_session.sh exports the same
+    variable for its shell steps)."""
+    ctx = active() or ensure_root()
+    return {ENV_CTX: ctx.encode()}
+
+
+def cut(reason: str, **fields) -> bool:
+    """Record a trace discontinuity: the previous process serving this
+    trace died (watchdog exit 3/4, SIGKILL) and this invocation
+    continues the same trace. The analysis layer closes orphaned spans
+    at the cut instead of leaving the tree torn."""
+    from tpu_reductions.obs import ledger
+    return ledger.emit("trace.cut", reason=reason, **fields)

@@ -435,7 +435,7 @@ def run_stream(flat: np.ndarray, method: str, *,
     import time
 
     from tpu_reductions.faults.inject import fault_point
-    from tpu_reductions.obs import ledger
+    from tpu_reductions.obs import ledger, trace
     from tpu_reductions.utils import heartbeat
 
     flat = np.ravel(flat)
@@ -446,55 +446,70 @@ def run_stream(flat: np.ndarray, method: str, *,
         raise ValueError(f"start_chunk {start_chunk} outside plan "
                          f"(0..{plan.num_chunks})")
     sync_every = max(1, int(sync_every))
-    ledger.emit("stream.start", method=r.method, dtype=r.dtype,
-                n=plan.n, nbytes=int(flat.nbytes),
-                chunk_elems=plan.chunk_elems,
-                num_chunks=plan.num_chunks, start_chunk=start_chunk,
-                sync_every=sync_every)
-    t0 = time.monotonic()
-    partial = None
-    syncs = 0
-    with heartbeat.guard("stream"):
-        r.restore(init_partial)
-        if start_chunk < plan.num_chunks:
-            inflight = r.stage(flat, start_chunk)
-        for i in range(start_chunk, plan.num_chunks):
-            # chaos hook: the relay dying mid-chunk IS the round-2
-            # death shape this pipeline exists to survive
-            # (tests/test_stream_chaos.py drives this point)
-            fault_point("stream.chunk")
-            nxt = r.stage(flat, i + 1) if i + 1 < plan.num_chunks \
-                else None
-            r.fold(inflight)           # overlaps nxt's transfer
-            inflight = nxt
-            heartbeat.tick()
-            done = i + 1
-            ledger.emit("stream.chunk", chunk=i, chunks_done=done,
-                        total=plan.num_chunks)
-            if done % sync_every == 0 or done == plan.num_chunks:
-                partial = r.partial()  # honest materialization point
-                syncs += 1
+    # one span per stream (ISSUE 12): the start/end bracket shares a
+    # child trace context, and every chunk/sync event inside carries
+    # it — trace_export renders the pipeline as one slice with the
+    # per-chunk stage-vs-fold overlap split in its events
+    with trace.child():
+        ledger.emit("stream.start", method=r.method, dtype=r.dtype,
+                    n=plan.n, nbytes=int(flat.nbytes),
+                    chunk_elems=plan.chunk_elems,
+                    num_chunks=plan.num_chunks, start_chunk=start_chunk,
+                    sync_every=sync_every)
+        t0 = time.monotonic()
+        partial = None
+        syncs = 0
+        with heartbeat.guard("stream"):
+            r.restore(init_partial)
+            if start_chunk < plan.num_chunks:
+                inflight = r.stage(flat, start_chunk)
+            for i in range(start_chunk, plan.num_chunks):
+                # chaos hook: the relay dying mid-chunk IS the round-2
+                # death shape this pipeline exists to survive
+                # (tests/test_stream_chaos.py drives this point)
+                fault_point("stream.chunk")
+                t_stage = time.monotonic()
+                nxt = r.stage(flat, i + 1) if i + 1 < plan.num_chunks \
+                    else None
+                t_fold = time.monotonic()
+                r.fold(inflight)           # overlaps nxt's transfer
+                t_done = time.monotonic()
+                inflight = nxt
                 heartbeat.tick()
-                ledger.emit("stream.sync", chunks_done=done,
+                done = i + 1
+                # stage_s/fold_s are DISPATCH-side wall clock (the
+                # honest-timing doctrine: device completion is only
+                # observable at the periodic materialization) — enough
+                # to see the double-buffer overlap, not a device timing
+                ledger.emit("stream.chunk", chunk=i, chunks_done=done,
                             total=plan.num_chunks,
-                            elapsed_s=round(time.monotonic() - t0, 6))
-                if on_sync is not None:
-                    on_sync(done, partial)
-        if partial is None:            # resumed-at-end degenerate case
-            partial = r.partial()
-    wall = time.monotonic() - t0
-    value = r.finish(partial)
-    span = plan.chunk_span(start_chunk)[0] if start_chunk \
-        < plan.num_chunks else plan.n
-    nbytes = int(flat.nbytes) - span * flat.dtype.itemsize
-    res = StreamResult(value=value, chunks_done=plan.num_chunks,
-                       num_chunks=plan.num_chunks, nbytes=nbytes,
-                       wall_s=wall, syncs=syncs,
-                       resumed_from=start_chunk)
-    ledger.emit("stream.end", chunks=plan.num_chunks,
-                resumed_from=start_chunk, wall_s=round(wall, 6),
-                gbps=round(res.gbps, 4),
-                chunks_per_s=round(res.chunks_per_s, 4))
+                            stage_s=round(t_fold - t_stage, 6),
+                            fold_s=round(t_done - t_fold, 6))
+                if done % sync_every == 0 or done == plan.num_chunks:
+                    partial = r.partial()  # honest materialization
+                    syncs += 1
+                    heartbeat.tick()
+                    ledger.emit("stream.sync", chunks_done=done,
+                                total=plan.num_chunks,
+                                elapsed_s=round(
+                                    time.monotonic() - t0, 6))
+                    if on_sync is not None:
+                        on_sync(done, partial)
+            if partial is None:        # resumed-at-end degenerate case
+                partial = r.partial()
+        wall = time.monotonic() - t0
+        value = r.finish(partial)
+        span = plan.chunk_span(start_chunk)[0] if start_chunk \
+            < plan.num_chunks else plan.n
+        nbytes = int(flat.nbytes) - span * flat.dtype.itemsize
+        res = StreamResult(value=value, chunks_done=plan.num_chunks,
+                           num_chunks=plan.num_chunks, nbytes=nbytes,
+                           wall_s=wall, syncs=syncs,
+                           resumed_from=start_chunk)
+        ledger.emit("stream.end", chunks=plan.num_chunks,
+                    resumed_from=start_chunk, wall_s=round(wall, 6),
+                    gbps=round(res.gbps, 4),
+                    chunks_per_s=round(res.chunks_per_s, 4))
     return res
 
 

@@ -39,7 +39,7 @@ import time
 from typing import List, Optional, Sequence
 
 from tpu_reductions.faults.inject import fault_point
-from tpu_reductions.obs import ledger
+from tpu_reductions.obs import ledger, trace
 from tpu_reductions.sched import planner
 from tpu_reductions.sched.priors import Priors
 from tpu_reductions.sched.state import PlanState
@@ -132,6 +132,16 @@ def run_plan(tasks: Sequence[Task], state: PlanState, priors: Priors,
     until the plan runs dry (finalize, exit 0) or the window dies
     (exit 3/4, plan state resumable). `_run` is injectable for
     tests."""
+    # trace continuity (ISSUE 12): a resumed plan whose prior
+    # invocation died mid-task (an "aborted" record, or a "picked" one
+    # the death left unsettled) marks the seam with an explicit
+    # trace.cut — the export closes the torn spans there, and the work
+    # below continues under the SAME trace when the re-invocation
+    # inherited TPU_REDUCTIONS_TRACE_CTX
+    torn = sorted(n for n, rec in state.tasks.items()
+                  if rec.get("status") in ("aborted", "picked"))
+    if torn:
+        trace.cut("window-death-resume", tasks=torn)
     for t in excluded:
         if not state.attempted(t.name):
             ledger.emit("sched.skip", task=t.name, reason="chip-only")
@@ -144,6 +154,10 @@ def run_plan(tasks: Sequence[Task], state: PlanState, priors: Priors,
     # the window epoch doubles as FIRSTROW_T0 for task commands that
     # reference it (headline_bench's doubles-suppression mtime check)
     env.setdefault("FIRSTROW_T0", f"{state.window_t0:.2f}")
+    # cross-process propagation: every task subprocess parents its
+    # events under the executor's span via TPU_REDUCTIONS_TRACE_CTX
+    # (obs/trace.py adopts it at arm time) — one trace per session
+    env.update(trace.propagation_env())
     replan = False
     while True:
         p = planner.plan(tasks, state, priors)

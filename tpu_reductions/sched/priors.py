@@ -51,10 +51,16 @@ WINDOW_GAP_S = 1800.0
 
 def scan_history(paths: Iterable[str]) -> dict:
     """Parse ledger files into {'durations': {name: [s, ...]},
-    'windows': [s, ...]}. Unreadable/empty files are skipped — history
-    is an optimization, never a failure."""
+    'windows': [s, ...], 'spans': {name: [s, ...]}}. Unreadable/empty
+    files are skipped — history is an optimization, never a failure.
+    The 'spans' pool is the causal-trace evidence (ISSUE 12;
+    obs/critical_path.span_medians): per-span-name durations from the
+    reconstructed tree, the sub-task axis `Priors.span_median` serves
+    back to any consumer that wants finer grain than whole tasks.
+    read_ledger stitches a rotated `<path>.1` segment automatically."""
     durations: Dict[str, List[float]] = {}
     windows: List[float] = []
+    spans: Dict[str, List[float]] = {}
     for path in paths:
         if not path or not os.path.exists(path):
             continue
@@ -66,7 +72,8 @@ def scan_history(paths: Iterable[str]) -> dict:
             continue
         _scan_durations(events, durations)
         windows.extend(_cluster_windows(events))
-    return {"durations": durations, "windows": windows}
+        _scan_spans(events, spans)
+    return {"durations": durations, "windows": windows, "spans": spans}
 
 
 def _scan_durations(events: Sequence[dict],
@@ -87,6 +94,21 @@ def _scan_durations(events: Sequence[dict],
             a = e.get("actual_s")
             if isinstance(a, (int, float)) and a > 0:
                 durations.setdefault(e["task"], []).append(float(a))
+
+
+def _scan_spans(events: Sequence[dict],
+                spans: Dict[str, List[float]]) -> None:
+    """Fold one ledger's reconstructed span durations into the pool
+    (cut/synthetic closes excluded — a span the death clipped is not a
+    duration sample)."""
+    try:
+        from tpu_reductions.obs.critical_path import span_medians
+        for name, med in span_medians(events).items():
+            spans.setdefault(name, []).append(med)
+    except Exception:
+        # span evidence is gravy: a malformed ledger must not stop the
+        # planner from estimating with the coarser pools
+        pass
 
 
 def _cluster_windows(events: Sequence[dict]) -> List[float]:
@@ -133,6 +155,8 @@ class Priors:
         self._durations: Dict[str, List[float]] = {
             k: list(v) for k, v in history.get("durations", {}).items()}
         self._windows: List[float] = list(history.get("windows", []))
+        self._spans: Dict[str, List[float]] = {
+            k: list(v) for k, v in history.get("spans", {}).items()}
         self._online: Dict[str, float] = {}
         self._compile = compile_model   # obs/compile.CompileModel
 
@@ -189,6 +213,15 @@ class Priors:
         if self._compile is None or not task.surfaces:
             return "-"
         return self._compile.status(task.surfaces)
+
+    def span_median(self, name: str) -> Optional[float]:
+        """Median duration for one span name across the scanned ledger
+        history (ISSUE 12: the sub-task evidence the causal trace adds
+        — e.g. the 'compile' span median prices a cold surface with
+        MEASURED tunnel-compile seconds instead of the static 20-40 s
+        folklore). None when the history never saw the span."""
+        samples = self._spans.get(name)
+        return _median(samples) if samples else None
 
     def window_quantile(self, q: float = 0.5) -> float:
         """The window-length model: quantile of recorded flap history,

@@ -45,7 +45,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional
 
-from tpu_reductions.obs import ledger
+from tpu_reductions.obs import ledger, trace
 from tpu_reductions.serve.coalesce import (Batch, CostModel, coalesce,
                                            plan_round)
 from tpu_reductions.serve.request import (PendingResponse, ReduceRequest,
@@ -183,7 +183,7 @@ class ServeEngine:
             resp = ReduceResponse(rid, "rejected", request.method,
                                   request.dtype, request.n, error=reason)
             ledger.emit("serve.respond", req=rid, status="rejected",
-                        reason=reason)
+                        reason=reason, **trace.request_fields(rid))
             pending.resolve(resp)
             return pending
         now = time.monotonic()
@@ -197,9 +197,13 @@ class ServeEngine:
             self._queue.append(adm)
             depth = len(self._queue)
             self._cond.notify_all()
+        # one trace per request (ISSUE 12): the request id IS the
+        # trace id, so every event of its lifecycle shares identity
+        # and trace_export renders one lane per request
         ledger.emit("serve.enqueue", req=rid, method=request.method,
                     dtype=request.dtype, n=request.n, depth=depth,
-                    streamed=adm.streamed)
+                    streamed=adm.streamed,
+                    **trace.request_fields(rid))
         return pending
 
     def _admission_reason(self, request: ReduceRequest) -> Optional[str]:
@@ -257,7 +261,8 @@ class ServeEngine:
                               batch_size=adm.batch_size)
         fields = {"req": adm.request_id, "status": status,
                   "latency_s": resp.latency_s, "queue_s": resp.queue_s,
-                  "batch_size": adm.batch_size}
+                  "batch_size": adm.batch_size,
+                  **trace.request_fields(adm.request_id)}
         if error:
             fields["reason"] = error[:120]
         ledger.emit("serve.respond", **fields)
@@ -417,7 +422,8 @@ class ServeEngine:
             return
         r = adm.request
         ledger.emit("serve.stream", req=adm.request_id, method=r.method,
-                    dtype=r.dtype, n=r.n, nbytes=r.nbytes)
+                    dtype=r.dtype, n=r.n, nbytes=r.nbytes,
+                    **trace.request_fields(adm.request_id))
         t0 = time.monotonic()
         adm.t_launch = t0
         adm.batch_size = 1
@@ -441,7 +447,8 @@ class ServeEngine:
         self.stats["batched_requests"] += 1
         ledger.emit("serve.verify", batch=f"s-{adm.request_id}",
                     ok=int(res["ok"]), failed=int(not res["ok"]),
-                    exec_s=round(dt, 6))
+                    exec_s=round(dt, 6),
+                    **trace.request_fields(adm.request_id))
         if adm.expired(time.monotonic()):
             self._respond(adm, "expired",
                           error="deadline passed before response")

@@ -72,7 +72,12 @@ def _client_loop(submit, client: int, requests: int, methods: List[str],
                         "latency_s": time.monotonic() - t0,
                         "error": f"{type(e).__name__}: {e}"})
             continue
-        out.append({"status": resp.status,
+        # the request id is the request's trace id (ISSUE 12): stamped
+        # through the response path so rows join the ledger's
+        # serve.enqueue/respond events BY ID, never positionally
+        # (obs/timeline.serve_summary flags the orphans)
+        out.append({"req": resp.request_id,
+                    "status": resp.status,
                     "latency_s": (resp.latency_s
                                   if resp.latency_s is not None
                                   else time.monotonic() - t0),

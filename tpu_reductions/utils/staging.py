@@ -36,7 +36,7 @@ import numpy as np
 
 from tpu_reductions.config import stage_chunk_bytes, stage_threshold_bytes
 from tpu_reductions.faults.inject import fault_point
-from tpu_reductions.obs import ledger
+from tpu_reductions.obs import ledger, trace
 from tpu_reductions.utils import heartbeat
 
 # The chunk/threshold bounds (formerly two hardcoded constants here)
@@ -91,29 +91,35 @@ def device_put_chunked(flat: np.ndarray, rows: int, lanes: int,
     # docstring), so per-chunk events cost wall-clock only — and the
     # chunk loop is exactly the region the round-2 postmortems could
     # never reconstruct (which chunk was in flight when the relay died)
-    ledger.emit("staging.start", nbytes=int(flat.nbytes), rows=rows,
-                lanes=lanes, chunk_bytes=int(chunk_bytes))
-    with heartbeat.guard("staging"):
-        for r in range(0, full_rows, row_step):
-            # chaos hook: the round-2 killer was a relay death mid-
-            # payload — an injected fault here rehearses that exact
-            # interruption point (faults/inject.py; tests/test_staging.
-            # py proves no partially-staged buffer survives it)
-            fault_point("staging.chunk")
-            k = min(row_step, full_rows - r)
-            chunk = np.ascontiguousarray(
-                flat[r * lanes:(r + k) * lanes]).reshape(k, lanes)
-            buf = insert(buf, jax.device_put(chunk), jnp.int32(r))
-            heartbeat.tick()
-            ledger.emit("staging.chunk", row=r,
-                        rows_done=min(r + k, full_rows),
-                        total_rows=full_rows)
-        tail = flat[full_rows * lanes:]
-        if tail.size:
-            last = np.full((1, lanes), identity, dtype=flat.dtype)
-            last[0, :tail.size] = tail
-            buf = insert(buf, jax.device_put(last), jnp.int32(full_rows))
-    ledger.emit("staging.end", rows=rows, lanes=lanes)
+    # one span per staged payload (ISSUE 12): start/chunk/end share a
+    # child trace context, so a relay death mid-payload leaves a span
+    # the export closes at the trace.cut — with the dying chunk visible
+    with trace.child():
+        ledger.emit("staging.start", nbytes=int(flat.nbytes), rows=rows,
+                    lanes=lanes, chunk_bytes=int(chunk_bytes))
+        with heartbeat.guard("staging"):
+            for r in range(0, full_rows, row_step):
+                # chaos hook: the round-2 killer was a relay death mid-
+                # payload — an injected fault here rehearses that exact
+                # interruption point (faults/inject.py; tests/
+                # test_staging.py proves no partially-staged buffer
+                # survives it)
+                fault_point("staging.chunk")
+                k = min(row_step, full_rows - r)
+                chunk = np.ascontiguousarray(
+                    flat[r * lanes:(r + k) * lanes]).reshape(k, lanes)
+                buf = insert(buf, jax.device_put(chunk), jnp.int32(r))
+                heartbeat.tick()
+                ledger.emit("staging.chunk", row=r,
+                            rows_done=min(r + k, full_rows),
+                            total_rows=full_rows)
+            tail = flat[full_rows * lanes:]
+            if tail.size:
+                last = np.full((1, lanes), identity, dtype=flat.dtype)
+                last[0, :tail.size] = tail
+                buf = insert(buf, jax.device_put(last),
+                             jnp.int32(full_rows))
+        ledger.emit("staging.end", rows=rows, lanes=lanes)
     return buf
 
 

@@ -24,7 +24,7 @@ from typing import Callable, Dict, Optional
 import jax
 
 from tpu_reductions.faults.inject import fault_point
-from tpu_reductions.obs import ledger
+from tpu_reductions.obs import ledger, trace
 from tpu_reductions.utils import heartbeat
 
 
@@ -216,12 +216,18 @@ def time_chained(chained_fn, x, k_lo: int, k_hi: int, reps: int = 5,
                     dur_s=round(dt, 9), phase=phase)
         return dt
 
-    run(k_lo)   # warm-up: compile (k is traced — one executable for both)
-    run(k_hi)   # warm-up: queue drain at the long trip count
-    for rep in range(reps):
-        slope = (run(k_hi) - run(k_lo)) / span
-        sw.total_s += slope
-        sw.sessions += 1
-        sw.samples.append(slope)
-        ledger.emit("chain.slope", rep=rep, slope_s=round(slope, 12))
+    # one span per chained measurement (ISSUE 12): every trip/slope
+    # event shares a child trace context, so the export nests the
+    # whole slope ladder under its caller — identity bookkeeping only,
+    # outside the perf_counter windows, so the timing contract holds
+    with trace.child():
+        run(k_lo)   # warm-up: compile (k traced — one executable for both)
+        run(k_hi)   # warm-up: queue drain at the long trip count
+        for rep in range(reps):
+            slope = (run(k_hi) - run(k_lo)) / span
+            sw.total_s += slope
+            sw.sessions += 1
+            sw.samples.append(slope)
+            ledger.emit("chain.slope", rep=rep,
+                        slope_s=round(slope, 12))
     return sw
