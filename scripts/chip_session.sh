@@ -451,6 +451,15 @@ fallback_static_session() {
         python -m tpu_reductions.bench.quant_curve --platform=cpu \
             --out=examples/rank_scaling/quant_curve.json
 
+    # off-chip by design too: the open-loop serving scale grid rides
+    # virtual devices + the local chaos relay, so it is flap-time
+    # filler exactly as the scheduler prices it (docs/SERVING.md
+    # scaling tier)
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py serving_scale
+    step "open-loop serving scale curve" 600 \
+            examples/tpu_run/serving_scale.json -- \
+        bash scripts/run_serving_scale.sh
+
     # 3 h: the long tail (hazard cells last), and the watcher re-arms
     # on abort — a flagship that wedges slow-but-alive must not pin the
     # watcher past the round

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Refresh the committed open-loop serving SCALING curve (ISSUE 13;
+# docs/SERVING.md "scaling tier") — off-chip by construction, safe
+# with the relay dead: the loadgen's --scale grid drives
+# sequential / coalesced / routerN (serve/router.py replica tier)
+# over the same seeded open-loop workload (Poisson + bursty) on
+# --platform=cpu with 8 virtual devices, every series gating launches
+# through one local chaos relay in `slow` mode, and lands the
+# device-parallel sharded row (an oversized request split across the
+# 8 devices and finished with the selected collective — the
+# collective.select evidence parses back out of the armed ledger into
+# the artifact). Then the curve is folded into the flagship report
+# next to the closed-loop serving curve (bench/regen.py).
+#
+# Usage: bash scripts/run_serving_scale.sh [out.json] [experiment_dir]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exp="${2:-examples/tpu_run}"
+out="${1:-$exp/serving_scale.json}"
+
+python -m tpu_reductions.serve.loadgen --platform=cpu --devices=8 \
+    --scale --scale-clients=64,256,1024 --replicas=4 --seed=0 \
+    --out="$out"
+
+if [ -d "$exp" ]; then
+    python -m tpu_reductions.bench.regen "$exp"
+fi

@@ -486,6 +486,24 @@ def test_red014_whitelists_executor_and_ignores_other_packages(tmp_path):
         == []
 
 
+def test_red014_flags_multidevice_spellings_in_serve(tmp_path):
+    # the ISSUE 13 extension: the sharded path's jax multi-device
+    # vocabulary is fenced to the executor like the single-device calls
+    src = (
+        "def combine(mesh, shards, spec):\n"
+        "    import jax\n"
+        "    g = jax.make_array_from_single_device_arrays(\n"
+        "        (8,), spec, shards)\n"
+        "    return psum(g, 'ranks')\n"
+    )
+    findings = _lint_src(tmp_path, src, name="serve/router2.py")
+    # jax import + make_array_from_single_device_arrays + psum
+    assert _rules(findings).count("RED014") == 3
+    # the same spellings are the executor's sanctioned vocabulary
+    assert "RED014" not in _rules(_lint_src(tmp_path, src,
+                                            name="serve/executor.py"))
+
+
 # ---------------------------------------------------------------- RED015
 
 

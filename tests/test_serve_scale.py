@@ -1,0 +1,567 @@
+"""Scaling-tier coverage (ISSUE 13; tpu_reductions/serve/router.py +
+the engine's multi-tenancy and device-parallel sharded path): affinity
+vs balanced routing, replica-death re-routing under chaos (every
+request resolves to one of the five terminal statuses), tenant quotas
+and priority preemption deterministic under the fake relay's `slow`
+mode, p99-aware SLO shedding, executor.run_sharded against the oracle
+(exact and quantized wire), the seeded open-loop load generator, and
+the timeline's per-replica attribution — all on the 8-device virtual
+CPU platform (tests/conftest.py)."""
+
+import random
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from tpu_reductions.faults.relay import FakeRelay
+from tpu_reductions.faults.schedule import Phase
+from tpu_reductions.obs import ledger
+from tpu_reductions.ops import oracle
+from tpu_reductions.serve.engine import ServeEngine, _SLOTracker
+from tpu_reductions.serve.loadgen import (open_arrivals, plan_workload,
+                                          run_open_load, scale_markdown)
+from tpu_reductions.serve.request import (ReduceRequest, ReduceResponse,
+                                          STATUSES)
+from tpu_reductions.serve.router import (LocalReplica, ProcessReplica,
+                                         ReplicaRouter, local_router,
+                                         replica_failure)
+from tpu_reductions.serve.transport import RelayTransport
+
+
+class FakeExecutor:
+    """Same deterministic device stand-in as tests/test_serve.py:
+    resolves with the payload's real oracle value, no jax."""
+
+    def __init__(self, delay_s=0.0, hold=None):
+        self.delay_s = delay_s
+        self.hold = hold              # threading.Event: block until set
+        self.launches = []
+
+    def capabilities(self):
+        return {"backend": "cpu", "supports_f64": True}
+
+    def run_batch(self, method, dtype, n, seeds):
+        self.launches.append((method, dtype, n, tuple(seeds)))
+        if self.hold is not None:
+            assert self.hold.wait(timeout=30)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        out = []
+        from tpu_reductions.utils.rng import host_data
+        for s in seeds:
+            host = oracle.host_reduce(host_data(n, dtype, seed=s), method)
+            v = float(np.asarray(host, dtype=np.float64))
+            out.append({"result": v, "ok": True, "host": v, "diff": 0.0})
+        return out
+
+
+def _replicas(n, **executor_kw):
+    """(replicas, executors): one engine + FakeExecutor per replica so
+    tests can see exactly which replica served what."""
+    exs = [FakeExecutor(**executor_kw) for _ in range(n)]
+    reps = [LocalReplica(f"r{i}", ServeEngine(executor=exs[i],
+                                              coalesce_window_s=0.0))
+            for i in range(n)]
+    return reps, exs
+
+
+def _affine_n(idx, n_alive, method="SUM", dtype="int32", start=64):
+    """Smallest n >= start whose jit-bucket key hashes to alive-list
+    index `idx` — the router's own crc32 spelling, recomputed so the
+    tests pin placement without guessing."""
+    n = start
+    while zlib.crc32(f"{method}:{dtype}:{n}".encode()) % n_alive != idx:
+        n += 1
+    return n
+
+
+def _oracle_value(method, n, dtype, seed):
+    from tpu_reductions.utils.rng import host_data
+    x = oracle.native_fill(n, dtype, rank=0, seed=seed)
+    if x is None:
+        x = host_data(n, dtype, seed=seed)
+    return float(np.asarray(oracle.host_reduce(x, method),
+                            dtype=np.float64))
+
+
+# ------------------------------------------------------------- routing
+
+
+def test_affinity_routes_repeated_key_to_one_replica():
+    """Small requests hash-route on (method, dtype, n): every
+    recurrence of one key lands on ONE replica's executor (jit bucket
+    cache affinity), never spread across the fleet."""
+    reps, exs = _replicas(3)
+    router = ReplicaRouter(reps).start()
+    try:
+        n = _affine_n(1, 3)
+        pend = [router.submit(ReduceRequest(method="SUM", dtype="int",
+                                            n=n, seed=i))
+                for i in range(6)]
+        assert all(p.result(timeout=30).status == "ok" for p in pend)
+        served = [len(ex.launches) > 0 for ex in exs]
+        assert served == [False, True, False], served
+        assert router.stats["affinity"] == 6
+        assert router.stats["balanced"] == 0
+    finally:
+        router.stop()
+
+
+def test_large_requests_balance_by_outstanding():
+    """Above affinity_bytes, routing is least-outstanding: two
+    concurrent requests land on two different replicas."""
+    reps, exs = _replicas(2, delay_s=0.3)
+    router = ReplicaRouter(reps, affinity_bytes=0).start()
+    try:
+        a = router.submit(ReduceRequest(method="SUM", dtype="int", n=64))
+        time.sleep(0.05)             # a is outstanding on r0
+        b = router.submit(ReduceRequest(method="SUM", dtype="int", n=64))
+        assert a.result(timeout=30).status == "ok"
+        assert b.result(timeout=30).status == "ok"
+        assert [len(ex.launches) for ex in exs] == [1, 1]
+        assert router.stats["balanced"] == 2
+    finally:
+        router.stop()
+
+
+def test_replica_death_midbatch_reroutes_everything(tmp_path):
+    """THE scaling-tier chaos pipeline: traffic pinned to one replica,
+    that replica dies mid-batch, its queued work sheds with
+    engine-stopped — and the router re-routes every shed request to
+    the survivor. Every submitted request resolves to one of the five
+    terminal statuses (the no-hang contract), and the whole story
+    lands in the ledger: route.reroute per moved request, replica.down
+    with the kill reason, per-replica attribution in the summary."""
+    led = tmp_path / "ledger.jsonl"
+    ledger.arm(str(led))
+    try:
+        reps, exs = _replicas(2)
+        hold = threading.Event()
+        exs[0].hold = hold
+        router = ReplicaRouter(reps, max_retries=2).start()
+        n = _affine_n(0, 2)          # every request hashes to r0
+        inflight = router.submit(ReduceRequest(method="SUM", dtype="int",
+                                               n=n, seed=0))
+        deadline = time.monotonic() + 30
+        while not exs[0].launches:   # r0's batch is in the executor
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        queued = [router.submit(ReduceRequest(method="SUM", dtype="int",
+                                              n=n, seed=1 + i))
+                  for i in range(4)]
+        # the kill sheds r0's queue (engine-stopped -> re-route) then
+        # blocks joining the worker that is held in the executor — so
+        # it runs on its own thread and the hold releases it below
+        killer = threading.Thread(target=reps[0].kill)
+        killer.start()
+        rerouted = [p.result(timeout=30) for p in queued]
+        hold.set()
+        final = inflight.result(timeout=30)
+        killer.join(timeout=30)
+        assert not killer.is_alive()
+
+        resolved = [final, *rerouted]
+        assert all(r.status in STATUSES for r in resolved)
+        # in-flight work past the gate completes; shed work re-routes
+        # to the survivor and SERVES (not just resolves)
+        assert final.status == "ok", (final.status, final.error)
+        assert [r.status for r in rerouted] == ["ok"] * 4
+        assert router.stats["rerouted"] == 4
+        assert all(len(ex.launches) > 0 for ex in exs)
+        router.stop()
+    finally:
+        ledger.disarm()
+
+    from tpu_reductions.obs.timeline import (read_ledger, summarize,
+                                             summary_markdown)
+    events, torn = read_ledger(led)
+    assert torn == 0
+    names = [e["ev"] for e in events]
+    assert names.count("route.reroute") == 4
+    down = next(e for e in events if e["ev"] == "replica.down")
+    assert down["replica"] == "r0" and down["reason"] == "killed"
+    summary = summarize(led, events, torn)
+    rt = summary["serve"]["router"]
+    assert rt["routed"] == 5 and rt["reroutes"] == 4
+    assert rt["replica_downs"] == [{"replica": "r0", "reason": "killed"}]
+    assert rt["replicas"]["r1"]["ok"] == 4
+    md = summary_markdown(summary)
+    assert "router (per-replica attribution)" in md
+    assert "r0 (killed)" in md
+
+
+def test_no_alive_replica_resolves_not_hangs():
+    """All replicas dead: submit still resolves — immediately, with an
+    explicit no-replica-alive error (never a hang)."""
+    reps, _ = _replicas(1)
+    router = ReplicaRouter(reps).start()
+    reps[0].kill()
+    p = router.submit(ReduceRequest(method="SUM", dtype="int", n=64))
+    r = p.result(timeout=5)
+    assert r.status == "error" and "no-replica-alive" in r.error
+    assert router.stats["no_replica"] == 1
+    router.stop()
+
+
+def test_replica_failure_predicate_pins_the_reroute_vocabulary():
+    """Exactly the replica-blaming marks re-route; request-blaming
+    failures (verification, malformed, deadline) do not."""
+    def resp(status, error=None):
+        return ReduceResponse("r0", status, "SUM", "int32", 64,
+                              error=error)
+    assert replica_failure(resp("error", "replica-dead: r0 gone"))
+    assert replica_failure(resp("error", "replica-timeout: r0 silent"))
+    assert replica_failure(resp("error", "relay dead: probe refused"))
+    assert replica_failure(resp("shed", "relay-dead"))
+    assert replica_failure(resp("rejected", "engine-stopped"))
+    assert not replica_failure(resp("ok"))
+    assert not replica_failure(resp("error", "verification failed: ..."))
+    assert not replica_failure(resp("rejected", "queue full (depth 64)"))
+    assert not replica_failure(resp("expired", "deadline passed"))
+
+
+def test_process_replica_tier_survives_a_kill():
+    """Process-per-replica e2e (the production shape): two real
+    `python -m tpu_reductions.serve` children serve routed traffic;
+    after one is SIGKILLed, a direct submit to the corpse resolves
+    replica-dead (no hang) and the router keeps serving through the
+    survivor."""
+    reps = [ProcessReplica(f"p{i}", platform="cpu") for i in range(2)]
+    router = ReplicaRouter(reps, max_retries=2).start()
+    try:
+        first = [router.submit(ReduceRequest(method="SUM", dtype="int",
+                                             n=256, seed=i))
+                 for i in range(4)]
+        assert all(p.result(timeout=120).status == "ok" for p in first)
+        reps[0].kill()
+        reps[0]._proc.wait(timeout=10)   # SIGKILL lands asynchronously
+        assert not reps[0].alive()
+        dead = reps[0].submit(ReduceRequest(method="SUM", dtype="int",
+                                            n=256))
+        r = dead.result(timeout=10)
+        assert r.status == "error" and "replica-dead" in r.error
+        after = [router.submit(ReduceRequest(method="MIN", dtype="int",
+                                             n=256, seed=i))
+                 for i in range(4)]
+        res = [p.result(timeout=120) for p in after]
+        assert all(x.status in STATUSES for x in res)
+        assert all(x.status == "ok" for x in res), \
+            [(x.status, x.error) for x in res]
+    finally:
+        router.stop()
+
+
+def test_local_router_factory_wires_transports_per_replica():
+    """local_router's engine_kwargs['transports'] hands each replica
+    its own transport — the 1-vs-N fairness seam the scaling run
+    uses (one shared slow relay, one connection per replica)."""
+    with FakeRelay() as relay:
+        transports = [RelayTransport(ports=(relay.port,),
+                                     assume_tunneled=True, drain=True,
+                                     connect_timeout_s=0.5)
+                      for _ in range(2)]
+        router = local_router(
+            2, engine_kwargs={"transports": transports,
+                              "executor": FakeExecutor(),
+                              "coalesce_window_s": 0.0})
+        router.start()
+        try:
+            p = router.submit(ReduceRequest(method="SUM", dtype="int",
+                                            n=64))
+            assert p.result(timeout=30).status == "ok"
+        finally:
+            router.stop()
+
+
+# ------------------------------------------------- multi-tenancy (slow)
+
+
+def _relay_engine(relay, **kw):
+    kw.setdefault("coalesce_window_s", 0.0)
+    kw.setdefault("executor", FakeExecutor())
+    return ServeEngine(transport=RelayTransport(ports=(relay.port,),
+                                                assume_tunneled=True,
+                                                drain=True,
+                                                connect_timeout_s=0.5),
+                       **kw)
+
+
+def test_tenant_quota_deterministic_under_slow_relay():
+    """Per-tenant queued-depth quota under the relay's `slow` mode: the
+    injected gate latency pins the queue populated, so the quota
+    verdicts are scripted, not raced — the over-quota tenant bounces,
+    the other tenant is untouched, everyone admitted serves."""
+    with FakeRelay([Phase("slow", delay_s=0.3)]) as relay:
+        eng = _relay_engine(relay, tenant_quota=2, max_queue=16)
+        eng.start()
+        try:
+            flight = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                              n=64, tenant="a"))
+            time.sleep(0.1)          # gathered: holding at the gate
+            qa = [eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                           n=64, seed=i, tenant="a"))
+                  for i in range(2)]
+            over = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                            n=64, seed=9, tenant="a"))
+            other = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                             n=64, tenant="b"))
+            r = over.result(timeout=5)
+            assert r.status == "rejected" and "tenant quota" in r.error
+            for p in (flight, *qa, other):
+                assert p.result(timeout=30).status == "ok"
+        finally:
+            eng.stop()
+
+
+def test_priority_preemption_deterministic_under_slow_relay():
+    """A full queue admits a higher-priority arrival by shedding the
+    newest lowest-priority queued request — deterministic under the
+    slow relay because no device state is consulted."""
+    with FakeRelay([Phase("slow", delay_s=0.3)]) as relay:
+        eng = _relay_engine(relay, max_queue=2)
+        eng.start()
+        try:
+            flight = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                              n=64))
+            time.sleep(0.1)
+            q1 = eng.submit(ReduceRequest(method="MIN", dtype="int",
+                                          n=64))
+            q2 = eng.submit(ReduceRequest(method="MAX", dtype="int",
+                                          n=64))
+            high = eng.submit(ReduceRequest(method="SUM", dtype="int",
+                                            n=64, seed=7, priority=2))
+            victim = q2.result(timeout=5)
+            assert victim.status == "shed", (victim.status, victim.error)
+            assert "priority-preempted" in victim.error
+            for p in (flight, q1, high):
+                assert p.result(timeout=30).status == "ok"
+            assert eng.stats["preempted"] == 1
+        finally:
+            eng.stop()
+
+
+def test_unknown_slo_class_rejected_at_admission():
+    eng = ServeEngine(executor=FakeExecutor(), coalesce_window_s=0.0,
+                      slo_classes={"fast": 0.5})
+    eng.start()
+    try:
+        r = eng.submit(ReduceRequest(method="SUM", dtype="int", n=64,
+                                     slo="bulk")).result(timeout=5)
+        assert r.status == "rejected" and "unknown slo class" in r.error
+        ok = eng.submit(ReduceRequest(method="SUM", dtype="int", n=64,
+                                      slo="fast")).result(timeout=30)
+        assert ok.status == "ok"
+    finally:
+        eng.stop()
+
+
+def test_p99_aware_shedding_uses_observed_tail():
+    """When a class's rolling p99 already blows its deadline, new
+    arrivals of that class shed at admission (the device work would
+    expire anyway); a cold class with no tail evidence is never shed."""
+    eng = ServeEngine(executor=FakeExecutor(), coalesce_window_s=0.0,
+                      slo_classes={"fast": 0.1, "cold": 0.1})
+    eng.start()
+    try:
+        for _ in range(8):           # min_samples of over-deadline tail
+            eng._slo.observe("fast", 0.2)
+        r = eng.submit(ReduceRequest(method="SUM", dtype="int", n=64,
+                                     slo="fast")).result(timeout=5)
+        assert r.status == "shed" and "p99-over-slo" in r.error
+        cold = eng.submit(ReduceRequest(method="SUM", dtype="int", n=64,
+                                        slo="cold")).result(timeout=30)
+        assert cold.status == "ok", (cold.status, cold.error)
+    finally:
+        eng.stop()
+
+
+def test_slo_tracker_nearest_rank_p99():
+    t = _SLOTracker(min_samples=8)
+    for i in range(7):
+        t.observe("c", 0.01 * i)
+    assert t.p99("c") is None        # below min_samples: no verdict
+    t.observe("c", 5.0)
+    assert t.p99("c") == 5.0         # nearest-rank p99 of 8 = max
+    assert t.p99("never-seen") is None
+
+
+# ------------------------------------------------- device-parallel shard
+
+
+def test_run_sharded_matches_oracle_exact():
+    """The sharded path's correctness floor: per-device chunked folds
+    + the selected collective combine reproduce the oracle exactly for
+    int32 SUM (mod 2^32) and MIN, across multiple chunks per shard."""
+    from tpu_reductions.serve.executor import BatchExecutor
+    ex = BatchExecutor()
+    for method in ("SUM", "MIN"):
+        res = ex.run_sharded(method, "int32", 1 << 16, 3,
+                             chunk_bytes=1 << 14)
+        assert res["ok"], res
+        assert res["devices"] == 8
+        assert res["algorithm"]
+        assert res["per_device_chunks"] >= 2
+        assert res["result"] == _oracle_value(method, 1 << 16, "int32", 3)
+
+
+def test_run_sharded_quantized_wire_within_declared_bound():
+    """With quantized=True the combine rides the block-scaled wire:
+    fewer wire bytes (wire_factor < 1 vs the exact ring), verification
+    passes within the declared bound, algorithm recorded."""
+    from tpu_reductions.serve.executor import BatchExecutor
+    res = BatchExecutor().run_sharded("SUM", "float32", 1 << 16, 5,
+                                      quantized=True, quant_bits=8)
+    assert res["ok"], res
+    assert res["quantized"] is True
+    assert res["algorithm"]
+    assert res["wire_factor"] < 1.0
+
+
+def test_run_sharded_refuses_float64():
+    from tpu_reductions.serve.executor import BatchExecutor
+    with pytest.raises(ValueError, match="float64"):
+        BatchExecutor().run_sharded("SUM", "float64", 1 << 16, 0)
+
+
+def test_should_shard_gates_on_threshold_devices_and_dtype():
+    class Caps:
+        def __init__(self, device_count):
+            self._n = device_count
+
+        def capabilities(self):
+            return {"backend": "cpu", "supports_f64": True,
+                    "device_count": self._n}
+
+    from tpu_reductions.serve.engine import _Admitted
+
+    def adm(dtype, n):
+        return _Admitted(request=ReduceRequest(method="SUM", dtype=dtype,
+                                               n=n),
+                         request_id="r0", pending=None, t_enqueue=0.0,
+                         t_deadline=None)
+
+    eng = ServeEngine(executor=Caps(8), shard_threshold_bytes=1 << 10)
+    assert eng._should_shard(adm("int", 1 << 12))        # 16 KiB > 1 KiB
+    assert not eng._should_shard(adm("int", 64))         # under threshold
+    assert not eng._should_shard(adm("double", 1 << 12))  # f64: dd stream
+    solo = ServeEngine(executor=Caps(1), shard_threshold_bytes=1 << 10)
+    assert not solo._should_shard(adm("int", 1 << 12))   # one device
+
+
+def test_engine_routes_oversized_through_sharded_path(tmp_path):
+    """End to end through the engine: a request above the (lowered)
+    shard threshold leaves the coalesced path, launches device-parallel
+    (serve.shard), records its collective choice (collective.select),
+    verifies against the oracle, and the timeline counts the launch."""
+    from tpu_reductions.serve.executor import BatchExecutor
+    led = tmp_path / "ledger.jsonl"
+    ledger.arm(str(led))
+    try:
+        eng = ServeEngine(executor=BatchExecutor(),
+                          coalesce_window_s=0.0,
+                          shard_threshold_bytes=1 << 20)
+        eng.start()
+        n = 1 << 19                  # 2 MiB int32: over the 1 MiB line
+        r = eng.submit(ReduceRequest(method="SUM", dtype="int", n=n,
+                                     seed=11)).result(timeout=60)
+        assert r.status == "ok", (r.status, r.error)
+        assert r.result == _oracle_value("SUM", n, "int32", 11)
+        assert eng.stats["sharded"] == 1
+        eng.stop()
+    finally:
+        ledger.disarm()
+
+    from tpu_reductions.obs.timeline import (read_ledger, summarize,
+                                             summary_markdown)
+    events, torn = read_ledger(led)
+    assert torn == 0
+    names = [e["ev"] for e in events]
+    assert "serve.shard" in names and "collective.select" in names
+    sel = next(e for e in events if e["ev"] == "collective.select")
+    assert sel["algorithm"] and sel["ranks"] == 8
+    summary = summarize(led, events, torn)
+    assert summary["serve"]["sharded_launches"] == 1
+    assert "device-parallel sharded launch(es)" \
+        in summary_markdown(summary)
+
+
+# ------------------------------------------------------------ open loop
+
+
+def test_plan_workload_is_seed_deterministic():
+    kw = dict(count=50, methods=("SUM", "MIN"), dtype="int32",
+              n_choices=(64, 128), rate_rps=500.0)
+    a = plan_workload(7, **kw)
+    b = plan_workload(7, **kw)
+    assert len(a) == 50
+    assert [(off, r.method, r.n, r.seed) for off, r in a] \
+        == [(off, r.method, r.n, r.seed) for off, r in b]
+    c = plan_workload(8, **kw)
+    assert [(off, r.seed) for off, r in a] \
+        != [(off, r.seed) for off, r in c]
+
+
+def test_bursty_arrivals_group_at_shared_epochs():
+    offs = open_arrivals(random.Random(0), count=64, rate_rps=1000.0,
+                         process="bursty", burst=16)
+    assert len(offs) == 64
+    assert len(set(offs)) == 4       # 4 epochs of 16 back-to-back
+    assert offs == sorted(offs)
+
+
+def test_open_arrivals_validate_inputs():
+    rng = random.Random(0)
+    with pytest.raises(ValueError):
+        open_arrivals(rng, count=0, rate_rps=10.0)
+    with pytest.raises(ValueError):
+        open_arrivals(rng, count=4, rate_rps=0.0)
+    with pytest.raises(ValueError):
+        open_arrivals(rng, count=4, rate_rps=10.0, process="weird")
+
+
+def test_run_open_load_resolves_every_arrival():
+    """The open loop dispatches at offsets and collects via callbacks:
+    every planned request resolves and lands in the distilled row."""
+    eng = ServeEngine(executor=FakeExecutor(), coalesce_window_s=0.0,
+                      max_batch=8, max_queue=256)
+    eng.start()
+    try:
+        plan = plan_workload(1, count=40, methods=("SUM",),
+                             dtype="int32", n_choices=(64,),
+                             rate_rps=2000.0)
+        row = run_open_load(eng.submit, plan, timeout_s=60)
+    finally:
+        eng.stop()
+    assert row["requests"] == 40
+    assert row["ok"] == 40
+    assert set(row["by_status"]) <= set(STATUSES)
+    assert row["rps"] > 0 and "p50_ms" in row
+
+
+def test_scale_markdown_headline_and_sharded_row():
+    artifact = {
+        "dtype": "int32", "replicas": 4, "seed": 0,
+        "rows": [
+            {"series": "coalesced", "clients": 256, "process": "poisson",
+             "key": "coalesced@256@poisson", "rps": 100.0,
+             "p50_ms": 5.0, "p99_ms": 9.0, "ok": 256,
+             "by_status": {"ok": 256}},
+            {"series": "router4", "clients": 256, "process": "poisson",
+             "key": "router4@256@poisson", "rps": 250.0,
+             "p50_ms": 2.0, "p99_ms": 4.0, "ok": 256,
+             "by_status": {"ok": 256}},
+            {"series": "sharded", "n": 160_000_000,
+             "nbytes": 640_000_000, "status": "ok",
+             "algorithm": "all_reduce", "devices": 8,
+             "shard_threshold_mib": 512.0, "latency_s": 1.5},
+        ]}
+    md = scale_markdown(artifact)
+    assert "## serving scale-out" in md
+    assert "| router4 | 256 | poisson | 250.0 |" in md
+    assert "replica scale-out at 256 open-loop clients" in md
+    assert "2.50x" in md
+    assert "device-parallel sharded row" in md
+    assert "algorithm=all_reduce on 8 devices" in md

@@ -276,6 +276,21 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                 "(serving_curve.json)")
         except (OSError, ValueError, KeyError, TypeError) as e:
             log(f"regen: serving_curve.json unusable ({e}); skipped")
+    # the open-loop scaling curve (ISSUE 13): requests/s + p50/p99 vs
+    # clients across sequential/coalesced/routerN plus the
+    # device-parallel sharded row, committed by serve/loadgen.py
+    # --scale (scripts/run_serving_scale.sh)
+    sc_file = out / "serving_scale.json"
+    if sc_file.exists():
+        try:
+            from tpu_reductions.serve.loadgen import scale_markdown
+            sc = json.loads(sc_file.read_text())
+            with open(paths["md"], "a") as f:
+                f.write("\n" + scale_markdown(sc) + "\n")
+            log("regen: appended serving-scale table "
+                "(serving_scale.json)")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: serving_scale.json unusable ({e}); skipped")
     # the streaming pipeline's committed probes (ISSUE 7 evidence,
     # ISSUE 8 relocation: the ONE copy lives in the experiment dir —
     # the PR-6 serving_curve dedup rule applied to stream artifacts)

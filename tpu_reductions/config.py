@@ -75,6 +75,12 @@ DEFAULT_STAGE_CHUNK_BYTES = 256 << 20
 # Payloads at or under this stage in ONE message (no reason to multiply
 # round-trips for the common case). Default: 2x the chunk bound.
 DEFAULT_STAGE_THRESHOLD_BYTES = 512 << 20
+# Serving shard threshold: a request above this goes device-parallel
+# (split across local devices, combined through the collectives
+# registry — serve/executor.run_sharded) instead of streaming through
+# one device. Same line as the per-request byte cap by default: the
+# payloads the cap used to reject are exactly the ones worth sharding.
+DEFAULT_SHARD_THRESHOLD_BYTES = 512 << 20
 
 
 def _env_bytes(name: str) -> Optional[int]:
@@ -107,6 +113,21 @@ def stage_threshold_bytes(override: Optional[int] = None) -> int:
         return int(override)
     return _env_bytes("TPU_REDUCTIONS_STAGE_THRESHOLD_BYTES") \
         or 2 * stage_chunk_bytes()
+
+
+def shard_threshold_bytes(override: Optional[int] = None) -> int:
+    """The device-parallel shard threshold of the serving tier: a
+    request whose payload exceeds it splits across local devices
+    (bounded per-device chunks, collective combine —
+    serve/executor.run_sharded) when the backend has more than one
+    device; at or under it, the single-device batch/stream paths
+    apply. Explicit argument (the engine's shard_threshold_bytes
+    knob), else TPU_REDUCTIONS_SHARD_THRESHOLD_BYTES, else 512 MiB
+    (docs/RESILIENCE.md knob table; docs/SERVING.md scaling tier)."""
+    if override is not None and override > 0:
+        return int(override)
+    return _env_bytes("TPU_REDUCTIONS_SHARD_THRESHOLD_BYTES") \
+        or DEFAULT_SHARD_THRESHOLD_BYTES
 
 # Kernel ids: the reference kept only kernel 6 live and emptied 0-5
 # (reduction_kernel.cu:278-289). We map 6 -> single-pass fold-accumulator

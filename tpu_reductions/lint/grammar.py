@@ -126,7 +126,20 @@ SCHED_EVENTS = ("sched.plan", "sched.pick", "sched.skip", "sched.done",
 # attribution
 SERVE_EVENTS = ("serve.start", "serve.enqueue", "serve.coalesce",
                 "serve.launch", "serve.verify", "serve.respond",
-                "serve.shed", "serve.stop", "serve.stream")
+                "serve.shed", "serve.stop", "serve.stream",
+                "serve.shard")
+
+# the replica router's typed events (serve/router.py; ISSUE 13 —
+# docs/SERVING.md "scaling tier"): route.start/stop bracket the router
+# lifetime (paired via obs/trace_export.OPENER_CLOSERS), route.request
+# records each placement decision (replica + affinity/balanced
+# policy), route.reroute each failure-driven re-submission, route.done
+# the terminal outcome with end-to-end latency; replica.spawn/up/down
+# are the per-replica lifecycle. Consumer: obs/timeline.py's
+# serve_summary per-replica attribution
+ROUTE_EVENTS = ("route.start", "route.request", "route.reroute",
+                "route.done", "route.stop")
+REPLICA_EVENTS = ("replica.spawn", "replica.up", "replica.down")
 
 # the streaming pipeline's typed events (ops/stream.py +
 # bench/stream.py; docs/STREAMING.md) — start -> per-chunk fold ->
@@ -191,7 +204,8 @@ SHELL_EVENTS = (
 
 REGISTERED_EVENTS = frozenset(CORE_EVENTS + SHELL_EVENTS + SCHED_EVENTS
                               + SERVE_EVENTS + STREAM_EVENTS
-                              + COMPILE_EVENTS + COLLECTIVE_EVENTS)
+                              + COMPILE_EVENTS + COLLECTIVE_EVENTS
+                              + ROUTE_EVENTS + REPLICA_EVENTS)
 
 
 def event_registered(name: str) -> bool:

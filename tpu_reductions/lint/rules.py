@@ -620,7 +620,18 @@ def _red013(rel: str, ctx: _FileContext) -> List[RawFinding]:
 
 _SERVE_DEVICE_CALLS = {"run_benchmark", "run_benchmark_batch",
                        "device_get", "device_put", "block_until_ready",
-                       "device_put_chunked", "maybe_chunked_stage"}
+                       "device_put_chunked", "maybe_chunked_stage",
+                       # the sharded device-parallel path (ISSUE 13):
+                       # the jax multi-device spellings it is built
+                       # from — a router/engine/loadgen module
+                       # reaching for any of these is launching
+                       # collectives outside the admission-controlled
+                       # executor path (the executor OBJECT's
+                       # run_batch/run_stream/run_sharded methods are
+                       # that path and stay callable)
+                       "make_array_from_single_device_arrays",
+                       "shard_map", "pmap", "psum", "pmin", "pmax",
+                       "ppermute", "all_gather"}
 
 
 def _red014(rel: str, ctx: _FileContext) -> List[RawFinding]:

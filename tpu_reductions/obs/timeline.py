@@ -269,7 +269,8 @@ def serve_summary(events: List[dict]) -> Optional[dict]:
     responds = [e for e in events if e["ev"] == "serve.respond"]
     launches = [e for e in events if e["ev"] == "serve.launch"]
     sheds = [e for e in events if e["ev"] == "serve.shed"]
-    if not enq and not responds:
+    routes = [e for e in events if e["ev"] == "route.done"]
+    if not enq and not responds and not routes:
         return None
     by_status: dict = {}
     for e in responds:
@@ -278,6 +279,12 @@ def serve_summary(events: List[dict]) -> Optional[dict]:
     out = {"requests": len(enq), "responses": len(responds),
            "by_status": by_status, "batches": len(launches),
            "shed_episodes": len(sheds)}
+    shards = sum(1 for e in events if e["ev"] == "serve.shard")
+    if shards:
+        out["sharded_launches"] = shards
+    router = _router_summary(events, routes)
+    if router:
+        out["router"] = router
     sizes = [e["size"] for e in launches
              if isinstance(e.get("size"), int)]
     if sizes:
@@ -307,6 +314,47 @@ def serve_summary(events: List[dict]) -> Optional[dict]:
         out["queue_s"] = {"p50": round(_percentile(queued, 0.5), 6),
                           "p99": round(_percentile(queued, 0.99), 6)}
     return out
+
+
+def _router_summary(events: List[dict],
+                    routes: List[dict]) -> Optional[dict]:
+    """Per-replica attribution from the router's typed events
+    (lint/grammar.py ROUTE_EVENTS/REPLICA_EVENTS; serve/router.py —
+    the ISSUE 13 satellite): per replica, how many terminal outcomes
+    it served with what latency tail and how much of the shed/error
+    weight it carried; plus the re-route and replica-death record
+    (how much work moved because a replica failed). None when no
+    router ran."""
+    reroutes = [e for e in events if e["ev"] == "route.reroute"]
+    downs = [e for e in events if e["ev"] == "replica.down"]
+    if not routes and not reroutes and not downs:
+        return None
+    per: dict = {}
+    for e in routes:
+        rep = e.get("replica") or "(none)"
+        d = per.setdefault(rep, {"requests": 0, "ok": 0, "shed": 0,
+                                 "error": 0, "_lat": []})
+        d["requests"] += 1
+        s = e.get("status")
+        if s in d:
+            d[s] += 1
+        if s == "ok" and isinstance(e.get("latency_s"), (int, float)):
+            d["_lat"].append(e["latency_s"])
+    for e in reroutes:
+        rep = e.get("replica") or "(none)"
+        d = per.setdefault(rep, {"requests": 0, "ok": 0, "shed": 0,
+                                 "error": 0, "_lat": []})
+        d["rerouted_away"] = d.get("rerouted_away", 0) + 1
+    for rep, d in per.items():
+        lat = sorted(d.pop("_lat"))
+        if lat:
+            d["latency_s"] = {"p50": round(_percentile(lat, 0.5), 6),
+                              "p99": round(_percentile(lat, 0.99), 6)}
+    return {"routed": len(routes), "reroutes": len(reroutes),
+            "replica_downs": [{"replica": e.get("replica"),
+                               "reason": e.get("reason")}
+                              for e in downs],
+            "replicas": per}
 
 
 def stream_summary(events: List[dict]) -> Optional[dict]:
@@ -595,6 +643,40 @@ def summary_markdown(summary: dict) -> str:
                 f"p99 {lat['p99'] * 1e3:.2f} ms"
                 + (f"; queued p50 {q['p50'] * 1e3:.2f} ms / "
                    f"p99 {q['p99'] * 1e3:.2f} ms" if q else ""))
+        if serve.get("sharded_launches"):
+            lines.append(f"{serve['sharded_launches']} device-parallel "
+                         "sharded launch(es)")
+        router = serve.get("router")
+        if router:
+            # the scaling tier's record (ISSUE 13): per replica, the
+            # terminal outcomes it served and the shed/error weight it
+            # carried, plus what moved because a replica failed
+            lines.append("")
+            lines.append("### router (per-replica attribution)")
+            lines.append("")
+            lines.append("| replica | requests | ok | shed | error "
+                         "| rerouted away | p50 ms | p99 ms |")
+            lines.append("|---|---|---|---|---|---|---|---|")
+            for rep in sorted(router["replicas"]):
+                d = router["replicas"][rep]
+                lat = d.get("latency_s")
+                lines.append(
+                    f"| {rep} | {d['requests']} | {d['ok']} "
+                    f"| {d['shed']} | {d['error']} "
+                    f"| {d.get('rerouted_away', 0)} "
+                    f"| {lat['p50'] * 1e3:.2f} | {lat['p99'] * 1e3:.2f} |"
+                    if lat else
+                    f"| {rep} | {d['requests']} | {d['ok']} "
+                    f"| {d['shed']} | {d['error']} "
+                    f"| {d.get('rerouted_away', 0)} | - | - |")
+            downs = router.get("replica_downs") or []
+            lines.append("")
+            lines.append(
+                f"{router['routed']} routed, {router['reroutes']} "
+                f"re-route(s), {len(downs)} replica death(s)"
+                + (": " + ", ".join(
+                    f"{d.get('replica')} ({d.get('reason')})"
+                    for d in downs) if downs else ""))
     stream = summary.get("stream")
     if stream:
         # the streaming pipeline's record (ISSUE 7): chunk throughput,

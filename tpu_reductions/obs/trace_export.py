@@ -54,7 +54,8 @@ from typing import Dict, List, Optional, Tuple
 # legacy-named bracket pairs that predate the `.start`/`.end` span
 # convention — registered event names (lint/grammar.py), paired here
 OPENER_CLOSERS = {"collective.launch": "collective.done",
-                  "serve.start": "serve.stop"}
+                  "serve.start": "serve.stop",
+                  "route.start": "route.stop"}
 CLOSER_SUFFIX = ".end"
 OPENER_SUFFIX = ".start"
 # point-event duration fields, in precedence order (the emitters close

@@ -244,6 +244,23 @@ SESSION_TASKS: Tuple[Task, ...] = (
                             "--out=quant_curve.json"),
          artifacts=("examples/rank_scaling/quant_curve.json",),
          done_artifact="examples/rank_scaling/quant_curve.json"),
+    Task("serving_scale", "open-loop serving scale curve", value=110.0,
+         budget_s=600,
+         # off-chip by design (ISSUE 13; docs/SERVING.md scaling tier):
+         # the open-loop grid drives in-process engines and the replica
+         # router on --platform=cpu with the per-launch tunnel RTT
+         # modeled through a local slow relay — safe with the relay
+         # dead, so it is flap-time filler like quant_curve; the ONE
+         # committed artifact lives in the experiment dir and
+         # bench/regen folds scale_markdown into report.md from there
+         command="bash scripts/run_serving_scale.sh",
+         rehearsal_command=("python -m tpu_reductions.serve.loadgen "
+                            "--platform=cpu --devices=8 --scale "
+                            "--scale-clients=16,64 --replicas=2 "
+                            "--n=8192 --skip-sharded "
+                            "--out=serving_scale.json"),
+         artifacts=("examples/tpu_run/serving_scale.json",),
+         done_artifact="examples/tpu_run/serving_scale.json"),
     Task("flagship", "flagship experiment", value=300.0, budget_s=10800,
          command="bash scripts/run_tpu_experiment.sh examples/tpu_run",
          artifacts=("examples/tpu_run",),

@@ -52,7 +52,10 @@ def _make_handler(engine, request_timeout_s: float):
                         n=int(spec.get("n", 1 << 16)),
                         seed=int(spec.get("seed", 0)),
                         deadline_s=spec.get("deadline_s"),
-                        value=float(spec.get("value", 1.0)))
+                        value=float(spec.get("value", 1.0)),
+                        tenant=spec.get("tenant", "default"),
+                        priority=int(spec.get("priority", 1)),
+                        slo=spec.get("slo"))
                 except (KeyError, TypeError, ValueError) as e:
                     resp = {"status": "rejected",
                             "error": f"malformed request: {e}"}
@@ -94,6 +97,14 @@ def main(argv=None) -> int:
     p.add_argument("--max-seconds", type=float, default=None,
                    help="total runtime bound (default: until killed)")
     p.add_argument("--platform", default=None, choices=("cpu", "tpu"))
+    p.add_argument("--devices", dest="num_devices", type=int,
+                   default=None,
+                   help="virtual CPU device count (--platform=cpu; the "
+                        "sharded path needs >1)")
+    p.add_argument("--relay-port", type=int, default=None,
+                   help="gate launches against this relay port (a "
+                        "router parent's chaos relay — every replica "
+                        "pays the same modeled transport RTT)")
     ns = p.parse_args(argv)
     _apply_platform(ns)
 
@@ -103,10 +114,16 @@ def main(argv=None) -> int:
     maybe_arm_for_tpu()   # a server hung on a dead relay serves nothing
 
     from tpu_reductions.serve.engine import ServeEngine
+    transport = None
+    if ns.relay_port is not None:
+        from tpu_reductions.serve.transport import RelayTransport
+        transport = RelayTransport(ports=(ns.relay_port,),
+                                   assume_tunneled=True, drain=True)
     engine = ServeEngine(
         max_queue=ns.max_queue, max_batch=ns.max_batch,
         coalesce_window_s=ns.coalesce_window_ms / 1e3,
-        device_window_s=ns.device_window_ms / 1e3).start()
+        device_window_s=ns.device_window_ms / 1e3,
+        transport=transport).start()
 
     server = _Server((ns.host, ns.port),
                      _make_handler(engine, ns.request_timeout_s))

@@ -45,6 +45,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional
 
+from tpu_reductions import config
 from tpu_reductions.obs import ledger, trace
 from tpu_reductions.serve.coalesce import (Batch, CostModel, coalesce,
                                            plan_round)
@@ -56,6 +57,46 @@ from tpu_reductions.serve.transport import RelayTransport
 # reconstruct the 4 GiB single-message relay killer (round 2, twice;
 # utils/staging.py's chunk threshold is the same 512 MiB line)
 DEFAULT_MAX_REQUEST_BYTES = 512 << 20
+
+# dtypes the quantized collective wire can carry for SUM (static
+# knowledge mirrored from collectives/quant.SUM_DTYPES — spelled here
+# so the jax-free engine can test eligibility without importing the
+# collectives stack; executor.run_sharded re-checks quant_supported
+# and falls back to the exact wire on disagreement)
+_QUANT_SUM_DTYPES = ("float32", "bfloat16")
+
+
+class _SLOTracker:
+    """Rolling per-SLO-class p99 over recent ok latencies. Nearest-rank
+    p99 over a bounded window (newest 64): the admission-time signal
+    for p99-aware shedding — when a class's observed tail already
+    misses its deadline, admitting more of that class just converts
+    future `ok`s into `expired`s after the device did the work."""
+
+    def __init__(self, window: int = 64, min_samples: int = 8) -> None:
+        self._window = window
+        self.min_samples = min_samples
+        self._samples: Dict[str, Deque[float]] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, slo: str, latency_s: float) -> None:
+        with self._lock:
+            dq = self._samples.get(slo)
+            if dq is None:
+                dq = self._samples[slo] = deque(maxlen=self._window)
+            dq.append(latency_s)
+
+    def p99(self, slo: str) -> Optional[float]:
+        """Nearest-rank p99 of the class window, or None below
+        min_samples (a cold class is never shed on tail evidence it
+        does not have)."""
+        with self._lock:
+            dq = self._samples.get(slo)
+            if dq is None or len(dq) < self.min_samples:
+                return None
+            vals = sorted(dq)
+        rank = max(0, -(-99 * len(vals) // 100) - 1)
+        return vals[rank]
 
 
 @dataclass
@@ -76,6 +117,10 @@ class _Admitted:
     def expired(self, now: float) -> bool:
         return self.t_deadline is not None and now > self.t_deadline
 
+    @property
+    def priority(self) -> int:
+        return self.request.priority
+
 
 class ServeEngine:
     """The multi-tenant serving engine (module docstring)."""
@@ -86,10 +131,18 @@ class ServeEngine:
                  max_request_bytes: int = DEFAULT_MAX_REQUEST_BYTES,
                  stream_oversized: bool = True,
                  stream_chunk_bytes: Optional[int] = None,
+                 shard_oversized: bool = True,
+                 shard_threshold_bytes: Optional[int] = None,
+                 tenant_quota: Optional[int] = None,
+                 slo_classes: Optional[Dict[str, float]] = None,
+                 slo_min_samples: int = 8,
+                 quant_slack_factor: float = 2.0,
                  executor=None, transport=None,
                  cost_model: Optional[CostModel] = None) -> None:
         if max_queue <= 0 or max_batch <= 0:
             raise ValueError("max_queue/max_batch must be positive")
+        if tenant_quota is not None and tenant_quota <= 0:
+            raise ValueError("tenant_quota must be positive (or None)")
         self._max_queue = max_queue
         self._max_batch = max_batch
         self._coalesce_window_s = coalesce_window_s
@@ -102,6 +155,24 @@ class ServeEngine:
         # with every message bounded (ops/stream.py, docs/STREAMING.md)
         self._stream_oversized = stream_oversized
         self._stream_chunk_bytes = stream_chunk_bytes
+        # ...and above the shard threshold they go device-PARALLEL when
+        # the backend has >1 device: staging-bounded per-device chunk
+        # folds finished by a collective combine picked through
+        # collectives/algorithms.select_algorithm (executor.run_sharded;
+        # docs/SERVING.md scaling tier). f64 stays on the stream/dd
+        # path — the collective registry's dd planes are a different
+        # launch shape than the per-device fold accumulators.
+        self._shard_oversized = shard_oversized
+        self._shard_threshold = config.shard_threshold_bytes(
+            shard_threshold_bytes)
+        # multi-tenancy: per-tenant queued-depth quota, priority
+        # preemption on a full queue, SLO classes (name -> deadline_s
+        # applied when the request names no deadline of its own) with
+        # p99-aware admission shedding
+        self._tenant_quota = tenant_quota
+        self._slo_classes = dict(slo_classes or {})
+        self._slo = _SLOTracker(min_samples=slo_min_samples)
+        self._quant_slack_factor = quant_slack_factor
         self._executor = executor          # lazy BatchExecutor when None
         self._transport = transport if transport is not None \
             else RelayTransport()
@@ -114,7 +185,8 @@ class ServeEngine:
         self._ids = itertools.count()
         self.stats: Dict[str, float] = {
             "submitted": 0, "ok": 0, "error": 0, "rejected": 0,
-            "expired": 0, "shed": 0, "batches": 0, "batched_requests": 0}
+            "expired": 0, "shed": 0, "batches": 0, "batched_requests": 0,
+            "preempted": 0, "sharded": 0}
 
     # -- lifecycle ----------------------------------------------------
 
@@ -172,43 +244,137 @@ class ServeEngine:
     # -- admission ----------------------------------------------------
 
     def submit(self, request: ReduceRequest) -> PendingResponse:
-        """Admit or reject one request; always returns a
-        PendingResponse (rejections come back already resolved)."""
+        """Admit, reject, or shed one request; always returns a
+        PendingResponse (rejections and admission-time sheds come back
+        already resolved). Admission order: static servability ->
+        SLO-class resolution -> p99-aware shed -> tenant quota ->
+        queue bound (with priority preemption)."""
         rid = f"r{next(self._ids):06d}"
         pending = PendingResponse(rid)
         self.stats["submitted"] += 1
         reason = self._admission_reason(request)
         if reason is not None:
-            self.stats["rejected"] += 1
-            resp = ReduceResponse(rid, "rejected", request.method,
-                                  request.dtype, request.n, error=reason)
-            ledger.emit("serve.respond", req=rid, status="rejected",
-                        reason=reason, **trace.request_fields(rid))
-            pending.resolve(resp)
-            return pending
+            return self._resolve_at_admission(request, rid, pending,
+                                              "rejected", reason)
+        deadline_s = self._effective_deadline(request)
+        # p99-aware shedding (docs/SERVING.md scaling tier): when the
+        # class's observed tail already blows its deadline, the honest
+        # terminal status is `shed` (load), not `rejected` (malformed/
+        # unservable) — the device work the request would trigger is
+        # predicted to expire anyway
+        if request.slo is not None and deadline_s is not None:
+            p99 = self._slo.p99(request.slo)
+            if p99 is not None and p99 > deadline_s:
+                return self._resolve_at_admission(
+                    request, rid, pending, "shed",
+                    f"p99-over-slo: class {request.slo!r} p99 "
+                    f"{p99:.3f}s > deadline {deadline_s:.3f}s")
         now = time.monotonic()
         adm = _Admitted(request=request, request_id=rid, pending=pending,
                         t_enqueue=now,
-                        t_deadline=(now + request.deadline_s
-                                    if request.deadline_s else None),
+                        t_deadline=(now + deadline_s
+                                    if deadline_s else None),
                         streamed=(request.nbytes
-                                  > self._max_request_bytes))
+                                  > self._max_request_bytes
+                                  # above the shard threshold the
+                                  # request leaves the coalesced path
+                                  # even when it fits the byte cap:
+                                  # the stream fork then picks
+                                  # device-parallel vs chunked-serial
+                                  # (_should_shard)
+                                  or (self._shard_oversized
+                                      and request.dtype != "float64"
+                                      and request.nbytes
+                                      > self._shard_threshold)))
         with self._cond:
-            self._queue.append(adm)
+            reason = self._enqueue_locked(adm)
             depth = len(self._queue)
-            self._cond.notify_all()
+            if reason is None:
+                self._cond.notify_all()
+        if reason is not None:
+            return self._resolve_at_admission(request, rid, pending,
+                                              "rejected", reason)
         # one trace per request (ISSUE 12): the request id IS the
         # trace id, so every event of its lifecycle shares identity
         # and trace_export renders one lane per request
         ledger.emit("serve.enqueue", req=rid, method=request.method,
                     dtype=request.dtype, n=request.n, depth=depth,
-                    streamed=adm.streamed,
+                    streamed=adm.streamed, tenant=request.tenant,
+                    priority=request.priority,
                     **trace.request_fields(rid))
         return pending
+
+    def _resolve_at_admission(self, request: ReduceRequest, rid: str,
+                              pending: PendingResponse, status: str,
+                              reason: str) -> PendingResponse:
+        """Terminal verdict before the queue: resolve the slot now
+        (never entered the queue, so no latency split to report)."""
+        self.stats[status] = self.stats.get(status, 0) + 1
+        resp = ReduceResponse(rid, status, request.method,
+                              request.dtype, request.n, error=reason)
+        ledger.emit("serve.respond", req=rid, status=status,
+                    reason=reason[:120], **trace.request_fields(rid))
+        pending.resolve(resp)
+        return pending
+
+    def _effective_deadline(self,
+                            request: ReduceRequest) -> Optional[float]:
+        """The request's own deadline wins; else its SLO class's
+        (validated in _admission_reason, so the lookup here hits)."""
+        if request.deadline_s is not None:
+            return request.deadline_s
+        if request.slo is not None:
+            return self._slo_classes.get(request.slo)
+        return None
+
+    def _enqueue_locked(self, adm: _Admitted) -> Optional[str]:
+        """Append under the lock, enforcing the per-tenant quota and
+        the queue bound. A full queue admits a higher-priority arrival
+        by preempting (shedding) the newest lowest-priority queued
+        request — deterministic under any relay behavior because no
+        device state is consulted. Returns a rejection reason or
+        None."""
+        request = adm.request
+        if self._tenant_quota is not None:
+            depth_t = sum(1 for a in self._queue
+                          if a.request.tenant == request.tenant)
+            if depth_t >= self._tenant_quota:
+                return (f"tenant quota: {request.tenant!r} already has "
+                        f"{depth_t} queued (quota {self._tenant_quota})")
+        if len(self._queue) >= self._max_queue:
+            victim = self._preempt_victim_locked(request.priority)
+            if victim is None:
+                return f"queue full (depth {len(self._queue)})"
+            self._queue.remove(victim)
+            self.stats["preempted"] += 1
+            self._respond(victim, "shed",
+                          error=(f"priority-preempted: displaced by "
+                                 f"priority {request.priority} arrival"))
+        self._queue.append(adm)
+        return None
+
+    def _preempt_victim_locked(self,
+                               priority: int) -> Optional[_Admitted]:
+        """The newest queued request of the lowest priority class,
+        when that class is strictly below the arrival's (never shed
+        an equal-priority peer: FIFO fairness within a class)."""
+        if not self._queue:
+            return None
+        lowest = min(a.priority for a in self._queue)
+        if lowest >= priority:
+            return None
+        for a in reversed(self._queue):
+            if a.priority == lowest:
+                return a
+        return None
 
     def _admission_reason(self, request: ReduceRequest) -> Optional[str]:
         if self._stopping or self._stopped:
             return "engine-stopped"
+        if request.slo is not None \
+                and request.slo not in self._slo_classes:
+            return (f"unknown slo class {request.slo!r} (configured: "
+                    f"{sorted(self._slo_classes) or 'none'})")
         oversized = request.nbytes > self._max_request_bytes
         if oversized and not self._stream_oversized:
             return (f"payload {request.nbytes} B exceeds the "
@@ -224,9 +390,6 @@ class ServeEngine:
                 return ("float64 unservable on this backend "
                         f"({caps.get('backend', '?')}): device f64 is "
                         "the dd pair path's job (ops/dd_reduce.py)")
-        with self._cond:
-            if len(self._queue) >= self._max_queue:
-                return f"queue full (depth {len(self._queue)})"
         return None
 
     def _capabilities(self) -> dict:
@@ -265,6 +428,11 @@ class ServeEngine:
                   **trace.request_fields(adm.request_id)}
         if error:
             fields["reason"] = error[:120]
+        if status == "ok" and r.slo is not None:
+            # feed the class tail estimate that p99-aware admission
+            # shedding consults (only ok latencies: a shed/rejected
+            # request's instant resolution says nothing about service)
+            self._slo.observe(r.slo, latency)
         ledger.emit("serve.respond", **fields)
         adm.pending.resolve(resp)
 
@@ -325,9 +493,13 @@ class ServeEngine:
                 live.append(adm)
         for adm in streams:
             # oversized requests never coalesce (one stream already
-            # saturates the transfer pipeline); they launch singly
-            # through the streaming path
-            self._launch_stream(adm)
+            # saturates the transfer pipeline); they launch singly —
+            # device-parallel above the shard threshold when the
+            # backend has devices to split across, else streaming
+            if self._should_shard(adm):
+                self._launch_sharded(adm)
+            else:
+                self._launch_stream(adm)
         if not live:
             return
         batches = coalesce(live, max_batch=self._max_batch,
@@ -407,6 +579,99 @@ class ServeEngine:
                                      f"{res['result']!r} vs oracle "
                                      f"{res['host']!r} "
                                      f"(diff {res['diff']:g})"))
+
+    def _should_shard(self, adm: _Admitted) -> bool:
+        """Device-parallel eligibility for one oversized request:
+        above the shard threshold (config.shard_threshold_bytes /
+        TPU_REDUCTIONS_SHARD_THRESHOLD_BYTES), more than one local
+        device, and not f64 (dd pair planes stay on the streaming
+        path — their plane encoding is not the per-device fold's
+        accumulator shape)."""
+        r = adm.request
+        if not self._shard_oversized or r.dtype == "float64":
+            return False
+        if r.nbytes <= self._shard_threshold:
+            return False
+        return self._capabilities().get("device_count", 1) > 1
+
+    def _quant_wire(self, adm: _Admitted, est_s: float) -> bool:
+        """Quantized collective wire eligibility (EQuARX-style,
+        docs/COLLECTIVES.md): opt in only when the request carries a
+        deadline whose remaining slack is tight against the cost
+        model's estimate (slack < quant_slack_factor x estimate) — the
+        loaded-tier regime where wire bytes buy latency — and the
+        (method, dtype) is statically quantizable for SUM. The
+        executor re-checks quant_supported and falls back to the
+        exact wire, so a stale static table degrades accuracy of the
+        CHOICE, never correctness."""
+        if adm.t_deadline is None:
+            return False
+        r = adm.request
+        if r.method != "SUM" or r.dtype not in _QUANT_SUM_DTYPES:
+            return False
+        slack = adm.t_deadline - time.monotonic()
+        return slack < self._quant_slack_factor * max(est_s, 1e-6)
+
+    def _launch_sharded(self, adm: _Admitted) -> None:
+        """Serve one oversized request device-parallel: split across
+        local devices in utils/staging-bounded per-device chunks,
+        per-device fold, then a collective combine whose algorithm
+        comes from collectives/algorithms.select_algorithm
+        (executor.run_sharded — all device work stays behind RED014's
+        whitelist). Same transport gate, deadline checks, crash
+        containment and response vocabulary as every other launch."""
+        now = time.monotonic()
+        if adm.expired(now):
+            self._respond(adm, "expired",
+                          error="deadline passed before launch")
+            return
+        r = adm.request
+        est = self._cost_model.estimate((r.method, r.dtype, r.n))
+        quantized = self._quant_wire(adm, est)
+        ledger.emit("serve.shard", req=adm.request_id, method=r.method,
+                    dtype=r.dtype, n=r.n, nbytes=r.nbytes,
+                    quantized=quantized,
+                    **trace.request_fields(adm.request_id))
+        t0 = time.monotonic()
+        adm.t_launch = t0
+        adm.batch_size = 1
+        try:
+            self._transport.gate()
+            res = self._ensure_executor().run_sharded(
+                r.method, r.dtype, r.n, r.seed,
+                chunk_bytes=self._stream_chunk_bytes,
+                quantized=quantized)
+        except TransportDead as e:
+            self._respond(adm, "error", error=f"relay dead: {e}")
+            with self._cond:
+                self._shed_locked("relay-dead")
+            return
+        except Exception as e:
+            self._respond(adm, "error",
+                          error=f"{type(e).__name__}: {e}")
+            return
+        dt = time.monotonic() - t0
+        self._cost_model.observe((r.method, r.dtype, r.n), dt)
+        self.stats["batches"] += 1
+        self.stats["batched_requests"] += 1
+        self.stats["sharded"] += 1
+        ledger.emit("serve.verify", batch=f"p-{adm.request_id}",
+                    ok=int(res["ok"]), failed=int(not res["ok"]),
+                    exec_s=round(dt, 6),
+                    algorithm=res.get("algorithm"),
+                    devices=res.get("devices"),
+                    **trace.request_fields(adm.request_id))
+        if adm.expired(time.monotonic()):
+            self._respond(adm, "expired",
+                          error="deadline passed before response")
+        elif res["ok"]:
+            self._respond(adm, "ok", result=res["result"])
+        else:
+            self._respond(adm, "error",
+                          error=(f"verification failed: device "
+                                 f"{res['result']!r} vs oracle "
+                                 f"{res['host']!r} "
+                                 f"(diff {res['diff']:g})"))
 
     def _launch_stream(self, adm: _Admitted) -> None:
         """Serve one oversized request through the streaming pipeline

@@ -51,6 +51,38 @@ def _bucket(k: int) -> int:
 _observed_buckets: set = set()
 
 
+@functools.lru_cache(maxsize=16)
+def _jit_shard_fold(method: str, acc_dtype: str, width: int):
+    """Jitted (acc, chunk2d) -> acc fold for the device-parallel path:
+    ops/stream._jit_fold widened to a `width`-block accumulator so the
+    per-device partial is long enough for the quantized collective
+    ring's block alignment (collectives/quant.quant_ring_applies). One
+    executable per (method, acc dtype, width); jax dispatches it on
+    whichever device the arguments are committed to, so all shards
+    share it."""
+    import jax
+
+    from tpu_reductions.ops.registry import get_op
+    from tpu_reductions.ops.stream import _LANES, _SUBLANES
+    op = get_op(method)
+
+    def fold(acc, chunk2d):
+        folded = op.jnp_reduce(
+            chunk2d.reshape(-1, width * _SUBLANES, _LANES), axis=0)
+        return op.jnp_combine(acc, folded.astype(acc.dtype))
+
+    return jax.jit(fold)
+
+
+@functools.lru_cache(maxsize=1)
+def _jit_flatten():
+    """Jitted on-device reshape (W*SUBLANES, LANES) -> (W*BLOCK,): the
+    per-device accumulator becomes one shard of the collective's
+    global array without a host round-trip."""
+    import jax
+    return jax.jit(lambda a: a.reshape(-1))
+
+
 @functools.lru_cache(maxsize=8)
 def _jit_row_reduce(method: str):
     """One jitted stacked row-reduce per op; jax's own trace cache
@@ -88,7 +120,10 @@ class BatchExecutor:
             supports_f64 = backend != "tpu" and \
                 bool(jax.config.jax_enable_x64)
             self._caps = {"backend": backend,
-                          "supports_f64": supports_f64}
+                          "supports_f64": supports_f64,
+                          # the engine's shard gate: device-parallel
+                          # oversized requests need >1 local device
+                          "device_count": len(jax.local_devices())}
         return self._caps
 
     def run_batch(self, method: str, dtype: str, n: int,
@@ -203,4 +238,176 @@ class BatchExecutor:
             "diff": float(diff),
             "chunks": res.num_chunks,
             "gbps": round(res.gbps, 4),
+        }
+
+    def run_sharded(self, method: str, dtype: str, n: int, seed: int,
+                    *, chunk_bytes: Optional[int] = None,
+                    quantized: bool = False, quant_bits: int = 8,
+                    devices=None) -> Dict:
+        """Execute ONE oversized request device-parallel (the serving
+        tier's vertical scale path, docs/SERVING.md): the payload
+        splits into contiguous per-device shards, each shard folds
+        chunk-by-chunk — every host->device message bounded by the
+        staging doctrine (config.stage_chunk_bytes) — into a resident
+        per-device partial block, and the k partials finish with ONE
+        collective combine whose algorithm comes from
+        collectives/algorithms.select_algorithm (recorded in a
+        `collective.select` ledger event, launch/done bracketed). With
+        `quantized`, the combine rides the EQuARX-style block-scaled
+        wire (collectives/quant.py) when the geometry supports it;
+        verification then accepts the declared error bound instead of
+        the exact tolerance. Same response shape as run_batch."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from tpu_reductions.collectives.algorithms import select_algorithm
+        from tpu_reductions.collectives.core import make_collective_reduce
+        from tpu_reductions.collectives.quant import (
+            make_quant_sum_all_reduce, quant_error_bound, quant_supported)
+        from tpu_reductions.obs import ledger, trace
+        from tpu_reductions.ops import oracle as oracle_mod
+        from tpu_reductions.ops.registry import accum_dtype, get_op
+        from tpu_reductions.ops.stream import (_BLOCK, _LANES, _SUBLANES,
+                                               iter_chunks, plan_chunks)
+        from tpu_reductions.utils.retry import retry_device_call
+        from tpu_reductions.utils.rng import host_data
+
+        fault_point("serve.batch")
+
+        method = method.upper()
+        if dtype == "float64":
+            raise ValueError("float64 shards through the dd stream "
+                             "path, not run_sharded (serve/engine.py "
+                             "_should_shard)")
+        devs = list(devices) if devices is not None \
+            else list(jax.local_devices())
+        k = min(len(devs), n)
+        if k <= 1:
+            # degenerate geometry: the streaming path IS the sharded
+            # path at k=1 (same bounded messages, no wire)
+            return self.run_stream(method, dtype, n, seed,
+                                   chunk_bytes=chunk_bytes)
+        devs = devs[:k]
+
+        x = oracle_mod.native_fill(n, dtype, rank=0, seed=seed)
+        if x is None:
+            x = host_data(n, dtype, rank=0, seed=seed)
+        x = np.ravel(x)
+
+        op = get_op(method)
+        acc_dt = np.dtype(accum_dtype(dtype)) if method == "SUM" \
+            else np.dtype(dtype)
+        base = -(-n // k)                       # per-shard length
+        plan = plan_chunks(base, dtype, chunk_bytes)
+        # accumulator width: wide enough (16 blocks when the chunk
+        # allows) that per_rank divides by k*QUANT_BLOCK at k=8, so the
+        # quantized ring genuinely applies to the combine instead of
+        # always falling back to the exact psum
+        width = min(16, plan.chunk_elems // _BLOCK)
+        per_rank = width * _BLOCK
+        fold = _jit_shard_fold(method, str(acc_dt), width)
+
+        def fold_shard(rank: int, dev):
+            lo_i = rank * base
+            shard = x[lo_i:min(n, lo_i + base)]
+            acc = jax.device_put(  # redlint: disable=RED003 -- identity accumulator, width*8*128 elements, orders of magnitude under the chunk bound
+                np.full((width * _SUBLANES, _LANES),
+                        op.identity(acc_dt), acc_dt), dev)
+            chunks = -(-shard.size // plan.chunk_elems)
+            for c in range(chunks):
+                piece = shard[c * plan.chunk_elems:
+                              (c + 1) * plan.chunk_elems]
+                pad = plan.chunk_elems - piece.size
+                if pad:
+                    piece = np.pad(
+                        piece, (0, pad),
+                        constant_values=op.identity(piece.dtype))
+                # one bounded message per chunk (plan_chunks fits the
+                # chunk under config.stage_chunk_bytes — the per-device
+                # spelling of the utils/staging relay-hazard doctrine)
+                staged = jax.device_put(  # redlint: disable=RED003 -- one plan_chunks-bounded chunk (<= config.stage_chunk_bytes) per message, per-device sharded staging
+                    piece.reshape(-1, _LANES), dev)
+                acc = fold(acc, staged)
+            return acc
+
+        accs = [retry_device_call(lambda r=r, d=d: fold_shard(r, d),
+                                  phase="serve")
+                for r, d in enumerate(devs)]
+
+        # combine dtype: what the partials actually hold (bf16 SUM
+        # accumulates f32 — ops/registry.accum_dtype)
+        combine_dtype = str(acc_dt)
+        use_quant = bool(quantized) and method == "SUM" \
+            and quant_supported(method, combine_dtype, quant_bits)
+        selection = select_algorithm(method, combine_dtype, k, per_rank,
+                                     quantized=use_quant,
+                                     bits=quant_bits)
+        ledger.emit("collective.select", algorithm=selection.algorithm,
+                    method=method, dtype=combine_dtype, ranks=k,
+                    wire_factor=round(selection.wire_factor, 6),
+                    quantized=use_quant,
+                    bits=(quant_bits if use_quant else None))
+
+        mesh = Mesh(np.array(devs), ("ranks",))
+        flats = [_jit_flatten()(a) for a in accs]
+        garr = jax.make_array_from_single_device_arrays(
+            (k * per_rank,), NamedSharding(mesh, P("ranks")), flats)
+        if use_quant:
+            coll = make_quant_sum_all_reduce(mesh, bits=quant_bits,
+                                             dtype=combine_dtype)
+        else:
+            coll = make_collective_reduce(method, mesh, "ranks",
+                                          rooted="none")
+        with trace.child():
+            ledger.emit("collective.launch",
+                        algorithm=selection.algorithm, method=method,
+                        dtype=combine_dtype, ranks=k, n=int(per_rank))
+            import time as _time
+            t0 = _time.perf_counter()
+            block = np.asarray(jax.device_get(
+                retry_device_call(lambda: coll(garr), phase="serve")))
+            ledger.emit("collective.done",
+                        algorithm=selection.algorithm, method=method,
+                        dtype=combine_dtype, ranks=k,
+                        wall_s=round(_time.perf_counter() - t0, 6),
+                        rows=1)
+
+        # host collapse of the replicated combined block — the
+        # StreamReducer.finish discipline (int32 SUM wraps mod 2^32)
+        if method == "SUM" and block.dtype == np.int32:
+            value = np.int64(block.sum(dtype=np.int64)
+                             ).astype(np.int32)[()]
+        elif method == "SUM":
+            value = np.float64(block.astype(np.float64).sum())
+        else:
+            value = op.np_reduce(block)
+
+        oracle = oracle_mod.IncrementalOracle(method, dtype)
+        for chunk in iter_chunks(x, plan_chunks(n, dtype, chunk_bytes)):
+            oracle.update(chunk)
+        ok, diff = oracle_mod.verify(value, oracle.value(),
+                                     method, dtype, n)
+        bound = None
+        if not ok and use_quant:
+            # the quantized wire is approximate BY CONTRACT: accept the
+            # declared per-element bound summed over the combined block
+            # (collectives/quant.quant_error_bound; docs/COLLECTIVES.md)
+            max_abs = max(float(np.abs(np.asarray(
+                jax.device_get(a), dtype=np.float64)).max())
+                for a in accs)
+            bound = quant_error_bound(method, combine_dtype, quant_bits,
+                                      k, max_abs) * per_rank
+            ok = float(diff) <= bound
+        return {
+            "result": float(np.asarray(value, dtype=np.float64)),
+            "ok": bool(ok),
+            "host": float(np.asarray(oracle.value(), dtype=np.float64)),
+            "diff": float(diff),
+            "algorithm": selection.algorithm,
+            "wire_factor": round(selection.wire_factor, 6),
+            "quantized": use_quant,
+            "quant_bound": bound,
+            "devices": k,
+            "per_device_chunks": plan.num_chunks,
+            "chunk_bytes": plan.chunk_bytes,
         }

@@ -1,40 +1,56 @@
-"""Closed-loop load generator + the committed serving curve.
+"""Load generator: the closed-loop serving curve AND the open-loop
+scaling curve (docs/SERVING.md).
 
-N client threads each drive a closed loop of reduction requests
-against the engine (submit, wait, submit — concurrency == clients, the
-classic closed-loop load model) and the run distills into the serving
-curve next to GB/s: requests/s and p50/p99 latency at N concurrent
-clients. Two modes run back to back on the SAME workload and executor:
+Closed loop (ISSUE 6, `serving_curve.json`): N client threads each
+drive submit-wait-submit — concurrency == clients — and the run
+distills into requests/s + p50/p99 at N concurrent clients, coalesced
+vs `sequential` (max_batch=1) on the SAME workload and executor.
 
-  * `coalesced`  — the engine as shipped (compatible concurrent
-    requests fuse into stacked launches);
-  * `sequential` — max_batch=1: N single-request launches, the
-    pre-engine baseline.
+Open loop (ISSUE 13, `serving_scale.json`, `--scale`): arrivals come
+from a seeded arrival PROCESS (Poisson exponential gaps, or bursty —
+Poisson burst epochs of `--burst` back-to-back arrivals), dispatched
+at their planned offsets regardless of completions, so 1000+ clients
+cost one dispatcher thread plus completion callbacks
+(PendingResponse.add_done_callback), never 1000 waiter threads. The
+scaling grid runs `sequential` / `coalesced` / `routerN`
+(serve/router.py, N in-process replicas) over the same seeded
+workload at each client count, every series gating launches through
+ONE shared chaos relay in `slow` mode (faults/relay.py holds each
+connection in its own thread, so N replicas genuinely overlap their
+modeled per-launch RTTs) — the 1-vs-N-replica series the ISSUE 13
+acceptance reads. `--scale` also lands one `sharded` row: an
+oversized (> shard threshold) request through the engine's
+device-parallel path, with the `collective.select` algorithm choice
+parsed back out of the armed ledger.
 
-The ratio of their requests/s is the acceptance number of ISSUE 6
-("coalesced batched launches demonstrably beat N sequential
-single-request launches on the same off-chip workload"). Entirely
-runnable on --platform=cpu with the relay dead.
+Everything is seeded (`--seed`): same seed -> byte-identical workload
+plan (arrival offsets AND request specs), closed loop included.
 
-Artifact: bench/resume.Checkpoint shape ({meta, complete, rows}), one
-row per mode, persisted the moment each mode finishes;
-`bench/regen.py` folds it into report.md via `curve_markdown`.
+Artifacts: bench/resume.Checkpoint shape ({meta, complete, rows}),
+one row per mode / per (series, clients, process) cell, persisted the
+moment each lands; `bench/regen.py` folds them into report.md via
+`curve_markdown` / `scale_markdown`.
 
 CLI:
     python -m tpu_reductions.serve.loadgen --platform=cpu --clients=8 \
         [--requests=32 --n=65536 --methods=SUM,MIN,MAX --type=int] \
         [--connect HOST:PORT] --out=serving_curve.json
+    python -m tpu_reductions.serve.loadgen --platform=cpu --scale \
+        [--scale-clients=64,256,1024 --replicas=4 --seed=0] \
+        --out=examples/tpu_run/serving_scale.json
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import random
 import socket
 import sys
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from tpu_reductions.config import DTYPE_ALIASES, METHODS, _apply_platform
 
@@ -51,7 +67,8 @@ def percentile(sorted_vals: List[float], q: float) -> float:
 
 def _client_loop(submit, client: int, requests: int, methods: List[str],
                  dtype: str, n: int, deadline_s: Optional[float],
-                 out: List[dict], barrier: threading.Barrier) -> None:
+                 out: List[dict], barrier: threading.Barrier,
+                 seed: int) -> None:
     from tpu_reductions.serve.request import ReduceRequest
     barrier.wait()
     for i in range(requests):
@@ -62,7 +79,7 @@ def _client_loop(submit, client: int, requests: int, methods: List[str],
         # measure the scheduler instead of the batcher)
         req = ReduceRequest(method=methods[i % len(methods)],
                             dtype=dtype, n=n,
-                            seed=client * 100003 + i,
+                            seed=seed * 1000003 + client * 100003 + i,
                             deadline_s=deadline_s)
         t0 = time.monotonic()
         try:
@@ -85,8 +102,8 @@ def _client_loop(submit, client: int, requests: int, methods: List[str],
 
 
 def run_load(submit, *, clients: int, requests: int, methods: List[str],
-             dtype: str, n: int,
-             deadline_s: Optional[float] = None) -> dict:
+             dtype: str, n: int, deadline_s: Optional[float] = None,
+             seed: int = 0) -> dict:
     """Drive the closed loop; `submit(req) -> ReduceResponse` is either
     the in-process engine (resolved PendingResponse) or the TCP client.
     Returns the raw per-mode measurement (one curve row, mode-less)."""
@@ -95,7 +112,7 @@ def run_load(submit, *, clients: int, requests: int, methods: List[str],
     threads = [threading.Thread(
         target=_client_loop,
         args=(submit, c, requests, methods, dtype, n, deadline_s,
-              per_client[c], barrier), daemon=True)
+              per_client[c], barrier, seed), daemon=True)
         for c in range(clients)]
     for t in threads:
         t.start()
@@ -105,6 +122,12 @@ def run_load(submit, *, clients: int, requests: int, methods: List[str],
         t.join()
     wall = max(time.monotonic() - t0, 1e-9)
     rows = [r for recs in per_client for r in recs]
+    return {"clients": clients, **_distill(rows, wall)}
+
+
+def _distill(rows: List[dict], wall: float) -> dict:
+    """One curve/scale row from per-request records (shared by the
+    closed and open loops so the two artifacts' columns line up)."""
     by_status: Dict[str, int] = {}
     for r in rows:
         by_status[r["status"]] = by_status.get(r["status"], 0) + 1
@@ -114,7 +137,6 @@ def run_load(submit, *, clients: int, requests: int, methods: List[str],
     sizes = [r["batch_size"] for r in rows
              if isinstance(r.get("batch_size"), int)]
     row = {
-        "clients": clients,
         "requests": len(rows),
         "wall_s": round(wall, 6),
         "rps": round(len(rows) / wall, 2),
@@ -127,6 +149,112 @@ def run_load(submit, *, clients: int, requests: int, methods: List[str],
         row["p50_ms"] = round(percentile(ok_lat, 0.50) * 1e3, 3)
         row["p99_ms"] = round(percentile(ok_lat, 0.99) * 1e3, 3)
     return row
+
+
+# --------------------------------------------------------------------------
+# Open loop (ISSUE 13): seeded arrival processes + callback completion
+# --------------------------------------------------------------------------
+
+def open_arrivals(rng: random.Random, *, count: int, rate_rps: float,
+                  process: str = "poisson",
+                  burst: int = 32) -> List[float]:
+    """`count` arrival offsets (seconds from t0) drawn from the named
+    process at aggregate `rate_rps`:
+
+      * poisson — i.i.d. exponential gaps (the memoryless open-loop
+        default);
+      * bursty  — Poisson BURST epochs, `burst` back-to-back arrivals
+        each (same long-run rate, pathological short-run concurrency —
+        the coalescing window's stress shape).
+    """
+    if count <= 0 or rate_rps <= 0:
+        raise ValueError("count and rate_rps must be positive")
+    offsets: List[float] = []
+    t = 0.0
+    if process == "poisson":
+        for _ in range(count):
+            t += rng.expovariate(rate_rps)
+            offsets.append(t)
+    elif process == "bursty":
+        while len(offsets) < count:
+            t += rng.expovariate(rate_rps / burst)
+            offsets.extend([t] * min(burst, count - len(offsets)))
+    else:
+        raise ValueError(f"unknown arrival process {process!r} "
+                         "(poisson|bursty)")
+    return offsets
+
+
+def plan_workload(seed: int, *, count: int, methods: Sequence[str],
+                  dtype: str, n_choices: Sequence[int],
+                  rate_rps: float, process: str = "poisson",
+                  burst: int = 32,
+                  deadline_s: Optional[float] = None) -> List[Tuple]:
+    """The seeded open-loop plan: `count` (offset_s, ReduceRequest)
+    pairs, fully determined by `seed` (same seed -> identical offsets
+    AND request specs — tests/test_loadgen pins this), so every series
+    of a scaling run replays the SAME workload."""
+    from tpu_reductions.serve.request import ReduceRequest
+    rng = random.Random(seed)
+    offsets = open_arrivals(rng, count=count, rate_rps=rate_rps,
+                            process=process, burst=burst)
+    plan = []
+    for off in offsets:
+        plan.append((off, ReduceRequest(
+            method=rng.choice(list(methods)), dtype=dtype,
+            n=rng.choice(list(n_choices)),
+            seed=rng.randrange(1 << 30), deadline_s=deadline_s)))
+    return plan
+
+
+def run_open_load(submit_async, plan: List[Tuple], *,
+                  timeout_s: float = 600.0) -> dict:
+    """Dispatch the planned arrivals at their offsets regardless of
+    completions (open loop) and collect terminal outcomes via
+    `PendingResponse.add_done_callback` — one dispatcher thread total,
+    so 1000+ clients are cheap. `submit_async(req)` must return a
+    PendingResponse (ServeEngine.submit or ReplicaRouter.submit).
+    Latency per request = dispatch-to-resolution wall clock."""
+    rows: List[dict] = []
+    lock = threading.Lock()
+    done = threading.Event()
+    remaining = [len(plan)]
+    t_last = [0.0]
+
+    def _record(resp, t_sub):
+        now = time.monotonic()
+        with lock:
+            rows.append({"req": resp.request_id, "status": resp.status,
+                         "latency_s": now - t_sub,
+                         "batch_size": resp.batch_size})
+            t_last[0] = max(t_last[0], now)
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                done.set()
+
+    t0 = time.monotonic()
+    for off, req in plan:
+        delay = t0 + off - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t_sub = time.monotonic()
+        try:
+            pending = submit_async(req)
+        except Exception as e:
+            _record(type("R", (), {"request_id": "?",
+                                   "status": "client-error",
+                                   "batch_size": None,
+                                   "error": str(e)})(), t_sub)
+            continue
+        pending.add_done_callback(
+            lambda resp, ts=t_sub: _record(resp, ts))
+    if not done.wait(timeout_s):
+        raise TimeoutError(f"open loop: {remaining[0]} of {len(plan)} "
+                           f"requests unresolved after {timeout_s}s — "
+                           "the no-hang contract is broken upstream")
+    wall = max(t_last[0] - t0, 1e-9)
+    with lock:
+        return _distill(list(rows), wall)
 
 
 def curve_markdown(artifact: dict) -> str:
@@ -163,6 +291,236 @@ def curve_markdown(artifact: dict) -> str:
                       "(same workload, same executor, batch size 1 vs "
                       "coalesced)"]
     return "\n".join(lines)
+
+
+def scale_markdown(artifact: dict) -> str:
+    """The report.md section for the open-loop scaling curve
+    (bench/regen.py folds it next to the closed-loop serving curve)."""
+    lines = ["## serving scale-out (open loop: requests/s and latency "
+             "vs clients)", ""]
+    meta = ", ".join(f"{k}={artifact[k]}"
+                     for k in ("dtype", "methods", "n_choices",
+                               "replicas", "seed", "launch_latency_ms",
+                               "platform")
+                     if artifact.get(k) is not None)
+    if meta:
+        lines += [f"workload: {meta}", ""]
+    rows = [r for r in artifact.get("rows", []) if isinstance(r, dict)]
+    grid = [r for r in rows if r.get("series") != "sharded"]
+    if grid:
+        lines.append("| series | clients | process | req/s | p50 ms "
+                     "| p99 ms | ok | other |")
+        lines.append("|---|---|---|---|---|---|---|---|")
+        for r in sorted(grid, key=lambda r: (r.get("process", ""),
+                                             r.get("clients", 0),
+                                             r.get("series", ""))):
+            other = ", ".join(
+                f"{k}:{v}" for k, v in sorted(r.get("by_status",
+                                                    {}).items())
+                if k != "ok") or "-"
+            lines.append(
+                f"| {r.get('series', '-')} | {r.get('clients', '-')} "
+                f"| {r.get('process', '-')} | {r.get('rps', '-')} "
+                f"| {r.get('p50_ms', '-')} | {r.get('p99_ms', '-')} "
+                f"| {r.get('ok', '-')} | {other} |")
+    by_key = {r.get("key"): r for r in grid}
+    router_series = sorted({r["series"] for r in grid
+                            if str(r.get("series", "")).startswith(
+                                "router")})
+    for rs in router_series:
+        # the 1-vs-N record at every client count both series ran (one
+        # line per count: the scaling story, not a cherry-picked point)
+        for clients in sorted({r.get("clients") for r in grid
+                               if isinstance(r.get("clients"), int)}):
+            ro = by_key.get(f"{rs}@{clients}@poisson")
+            co = by_key.get(f"coalesced@{clients}@poisson")
+            if ro and co and co.get("rps"):
+                lines += ["", f"replica scale-out at {clients} "
+                              f"open-loop clients: {rs} serves "
+                              f"{ro['rps'] / co['rps']:.2f}x the "
+                              "single coalesced engine's requests/s "
+                              "(same seeded workload, same shared "
+                              "slow relay)"]
+    sh = next((r for r in rows if r.get("series") == "sharded"), None)
+    if sh:
+        mib = (sh.get("nbytes") or 0) / (1 << 20)
+        lines += ["", f"device-parallel sharded row: n={sh.get('n')} "
+                      f"({mib:.0f} MiB, over the "
+                      f"{sh.get('shard_threshold_mib', 512):.0f} MiB "
+                      f"shard threshold) -> status={sh.get('status')} "
+                      f"via algorithm={sh.get('algorithm')} on "
+                      f"{sh.get('devices')} devices "
+                      f"(collective.select in the armed ledger; "
+                      f"latency {sh.get('latency_s')}s)"]
+    return "\n".join(lines)
+
+
+def _run_scale(ns, methods: List[str]) -> int:
+    """`--scale`: the ISSUE 13 open-loop scaling grid + sharded row
+    (module docstring). One shared slow relay gates every series."""
+    from tpu_reductions.bench.resume import Checkpoint
+    from tpu_reductions.obs import ledger
+    from tpu_reductions.serve.engine import ServeEngine
+    from tpu_reductions.serve.request import ReduceRequest
+    from tpu_reductions.serve.router import local_router
+
+    n_choices = (max(1024, ns.n // 2), ns.n, ns.n * 2)
+    counts = sorted({int(c) for c in ns.scale_clients.split(",")
+                     if c.strip()})
+    series_router = f"router{ns.replicas}"
+    meta = {"instrument": "serving_scale",
+            "dtype": DTYPE_ALIASES[ns.dtype], "methods": ",".join(methods),
+            "n_choices": list(n_choices), "replicas": ns.replicas,
+            "seed": ns.seed, "rate_factor": ns.rate_factor,
+            "burst": ns.burst,
+            "launch_latency_ms": ns.launch_latency_ms,
+            "platform": ns.platform or "default"}
+    ck = Checkpoint(ns.out, meta, key_fn=lambda r: r.get("key"))
+
+    relay = None
+    if ns.launch_latency_ms > 0:
+        from tpu_reductions.faults.relay import FakeRelay
+        from tpu_reductions.faults.schedule import Phase
+        relay = FakeRelay([Phase("slow",
+                                 delay_s=ns.launch_latency_ms / 1e3)])
+        relay.start()
+
+    def _transport():
+        if relay is None:
+            return None
+        from tpu_reductions.serve.transport import RelayTransport
+        return RelayTransport(ports=(relay.port,), assume_tunneled=True,
+                              drain=True)
+
+    def _prewarm(engines, up_to_batch):
+        for e in engines:
+            for m in methods:
+                for n in n_choices:
+                    e.prewarm(m, ns.dtype, n, up_to_batch=up_to_batch)
+
+    # grid: every series at every client count (poisson), plus the
+    # bursty stress rows at the middle count for the batched series
+    cells = [(s, c, "poisson") for c in counts
+             for s in ("sequential", "coalesced", series_router)]
+    mid = counts[len(counts) // 2] if counts else 0
+    cells += [(s, mid, "bursty") for s in ("coalesced", series_router)]
+    try:
+        for series, clients, process in cells:
+            key = f"{series}@{clients}@{process}"
+            prior = ck.resume(key,
+                              reusable=lambda r: bool(r.get("requests")))
+            if prior is not None:
+                print(f"scale {key}: resumed from prior artifact",
+                      file=sys.stderr)
+                ck.add(prior)
+                continue
+            # same (seed, clients, process) -> same plan for EVERY
+            # series: the 1-vs-N comparison replays one workload
+            plan_seed = (ns.seed * 1_000_003 + clients * 31
+                         + (1 if process == "bursty" else 0))
+            plan = plan_workload(
+                plan_seed, count=clients, methods=methods,
+                dtype=ns.dtype, n_choices=n_choices,
+                rate_rps=ns.rate_factor * clients, process=process,
+                burst=ns.burst)
+            common = dict(max_queue=max(2048, 2 * clients),
+                          device_window_s=ns.device_window_ms / 1e3)
+            if series == "sequential":
+                target = ServeEngine(max_batch=1, coalesce_window_s=0.0,
+                                     transport=_transport(),
+                                     **common).start()
+                submit_async, engines = target.submit, [target]
+                batch = 1
+            elif series == "coalesced":
+                target = ServeEngine(max_batch=ns.max_batch,
+                                     coalesce_window_s=0.0,
+                                     transport=_transport(),
+                                     **common).start()
+                submit_async, engines = target.submit, [target]
+                batch = ns.max_batch
+            else:
+                target = local_router(
+                    ns.replicas,
+                    engine_kwargs=dict(max_batch=ns.max_batch,
+                                       coalesce_window_s=0.0,
+                                       transports=[_transport()
+                                                   for _ in
+                                                   range(ns.replicas)],
+                                       **common)).start()
+                submit_async = target.submit
+                engines = target.replicas
+                batch = ns.max_batch
+            _prewarm(engines, min(batch, 8))
+            row = run_open_load(submit_async, plan, timeout_s=900)
+            target.stop()
+            ck.add({"key": key, "series": series, "clients": clients,
+                    "process": process, **row})
+            print(f"scale {key}: rps={row.get('rps')} "
+                  f"p99_ms={row.get('p99_ms')}", file=sys.stderr)
+
+        # the device-parallel sharded row: one oversized request
+        # through the engine's shard path, algorithm choice read back
+        # from the armed ledger's collective.select event
+        prior = ck.resume("sharded",
+                          reusable=lambda r: r.get("status") == "ok")
+        if prior is not None:
+            ck.add(prior)
+        elif not ns.skip_sharded:
+            ledger_path = ledger.arm(None)
+            if ledger_path is None and ns.out:
+                ledger_path = ledger.arm(ns.out + ".ledger.jsonl")
+            req = ReduceRequest("SUM", "int", ns.sharded_n,
+                                seed=ns.seed)
+            engine = ServeEngine(max_queue=8, max_batch=4,
+                                 transport=_transport()).start()
+            resp = engine.submit(req).result(timeout=900)
+            engine.stop()
+            row = {"key": "sharded", "series": "sharded",
+                   "status": resp.status, "n": req.n,
+                   "nbytes": req.nbytes,
+                   "shard_threshold_mib":
+                       engine._shard_threshold / (1 << 20),
+                   "result": resp.result, "error": resp.error,
+                   "latency_s": resp.latency_s}
+            row.update(_sharded_evidence(ledger_path))
+            ck.add(row)
+    finally:
+        if relay is not None:
+            relay.stop()
+    if ns.out:
+        ck.finalize()
+    artifact = {**meta, "rows": ck.rows}
+    print(scale_markdown(artifact))
+    if ns.out:
+        print(f"wrote {ns.out}")
+    return 0
+
+
+def _sharded_evidence(ledger_path: Optional[str]) -> dict:
+    """Pull the sharded launch's algorithm choice back out of the
+    armed ledger (collective.select / serve.verify events) so the
+    committed artifact row carries the evidence pointer inline."""
+    out: dict = {"ledger": ledger_path}
+    if not ledger_path or not os.path.exists(ledger_path):
+        return out
+    try:
+        with open(ledger_path) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("ev") == "collective.select":
+                    out["algorithm"] = ev.get("algorithm")
+                    out["wire_factor"] = ev.get("wire_factor")
+                    out["quantized"] = ev.get("quantized")
+                    out["ranks"] = ev.get("ranks")
+                elif ev.get("ev") == "serve.verify":
+                    if ev.get("devices") is not None:
+                        out["devices"] = ev.get("devices")
+    except OSError:
+        pass
+    return out
 
 
 def _tcp_submit(addr: str):
@@ -239,6 +597,35 @@ def main(argv=None) -> int:
                    help="HOST:PORT of a running `python -m "
                         "tpu_reductions.serve` (one 'remote' row "
                         "instead of the in-process modes)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="workload RNG seed — same seed, same plan "
+                        "(arrival offsets and request specs)")
+    p.add_argument("--scale", action="store_true",
+                   help="ISSUE 13 mode: the open-loop scaling grid "
+                        "(sequential/coalesced/routerN x "
+                        "--scale-clients x poisson+bursty) plus the "
+                        "device-parallel sharded row; writes "
+                        "serving_scale.json-shaped artifact to --out")
+    p.add_argument("--scale-clients", default="64,256,1024",
+                   help="open-loop client counts for the scale grid")
+    p.add_argument("--replicas", type=int, default=4,
+                   help="router replica count for the routerN series")
+    p.add_argument("--rate-factor", type=float, default=8.0,
+                   help="open-loop aggregate arrival rate = factor x "
+                        "clients req/s (past single-engine saturation "
+                        "by construction, so rps measures capacity)")
+    p.add_argument("--burst", type=int, default=32,
+                   help="arrivals per burst epoch in the bursty process")
+    p.add_argument("--sharded-n", type=int, default=160_000_000,
+                   help="element count of the sharded row's oversized "
+                        "request (default: 640 MiB of int32, over the "
+                        "512 MiB shard threshold)")
+    p.add_argument("--skip-sharded", action="store_true",
+                   help="omit the sharded row from --scale")
+    p.add_argument("--devices", dest="num_devices", type=int,
+                   default=None,
+                   help="virtual CPU device count (--platform=cpu; "
+                        "the sharded row needs >1)")
     p.add_argument("--platform", default=None, choices=("cpu", "tpu"))
     p.add_argument("--out", default=None)
     ns = p.parse_args(argv)
@@ -256,10 +643,17 @@ def main(argv=None) -> int:
     from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
     maybe_arm_for_tpu()   # a loadgen hung on a dead relay reports nothing
 
+    if ns.scale:
+        if ns.connect:
+            p.error("--scale drives in-process engines/routers; "
+                    "--connect is the single-engine TCP mode")
+        return _run_scale(ns, methods)
+
     meta = {"dtype": DTYPE_ALIASES[ns.dtype], "n": ns.n,
             "methods": ",".join(methods), "clients": ns.clients,
             "requests_per_client": ns.requests,
             "launch_latency_ms": ns.launch_latency_ms,
+            "seed": ns.seed,
             "platform": ns.platform or "default"}
     from tpu_reductions.bench.resume import Checkpoint
     ck = Checkpoint(ns.out, meta, key_fn=lambda r: r.get("mode"))
@@ -300,7 +694,7 @@ def main(argv=None) -> int:
             row = run_load(submit, clients=ns.clients,
                            requests=ns.requests, methods=methods,
                            dtype=ns.dtype, n=ns.n,
-                           deadline_s=ns.deadline_s)
+                           deadline_s=ns.deadline_s, seed=ns.seed)
         else:
             from tpu_reductions.serve.engine import ServeEngine
             engine = ServeEngine(
@@ -326,7 +720,7 @@ def main(argv=None) -> int:
             row = run_load(submit, clients=ns.clients,
                            requests=ns.requests, methods=methods,
                            dtype=ns.dtype, n=ns.n,
-                           deadline_s=ns.deadline_s)
+                           deadline_s=ns.deadline_s, seed=ns.seed)
             engine.stop()
         row = {"mode": mode, **row}
         ck.add(row)

@@ -55,6 +55,12 @@ class ReduceRequest:
     seed: int = 0
     deadline_s: Optional[float] = None   # relative to submission
     value: float = 1.0                   # scheduling weight (knapsack)
+    tenant: str = "default"              # per-tenant quota bucket
+    priority: int = 1                    # higher preempts lower on a
+    #                                      full queue (docs/SERVING.md)
+    slo: Optional[str] = None            # SLO class name — resolved to
+    #                                      a deadline by the engine's
+    #                                      slo_classes table
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
@@ -70,6 +76,13 @@ class ReduceRequest:
             raise ValueError("deadline_s must be positive (or None)")
         if self.value <= 0:
             raise ValueError("value must be positive")
+        if not isinstance(self.tenant, str) or not self.tenant:
+            raise ValueError("tenant must be a non-empty string")
+        if not isinstance(self.priority, int) or self.priority < 0:
+            raise ValueError("priority must be a non-negative int")
+        if self.slo is not None and (not isinstance(self.slo, str)
+                                     or not self.slo):
+            raise ValueError("slo must be a non-empty string (or None)")
 
     @property
     def nbytes(self) -> int:
@@ -114,14 +127,34 @@ class PendingResponse:
         self.request_id = request_id
         self._event = threading.Event()
         self._response: Optional[ReduceResponse] = None
+        self._lock = threading.Lock()
+        self._callbacks: list = []
 
     def resolve(self, response: ReduceResponse) -> None:
         """Engine-side: attach the terminal response (first resolution
         wins; a second is a bug upstream and is ignored rather than
         clobbering what a client may already have read)."""
-        if self._response is None:
+        with self._lock:
+            if self._response is not None:
+                return
             self._response = response
-            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        self._event.set()
+        for fn in callbacks:
+            fn(response)
+
+    def add_done_callback(self, fn) -> None:
+        """Run `fn(response)` when this slot resolves — on the
+        resolving thread, or immediately on the calling thread if
+        already resolved. The open-loop loadgen and the replica
+        router's re-route path hang off this instead of burning a
+        waiter thread per in-flight request."""
+        with self._lock:
+            if self._response is None:
+                self._callbacks.append(fn)
+                return
+            response = self._response
+        fn(response)
 
     def done(self) -> bool:
         return self._event.is_set()

@@ -1,0 +1,515 @@
+"""Replica router: the horizontal scale-out tier over N serving
+engines (ROADMAP item 2; docs/SERVING.md "scaling tier").
+
+One `ReplicaRouter` fronts N replicas — in-process `LocalReplica`
+engines (the loadgen's fast path) or process-per-replica
+`ProcessReplica` children (`python -m tpu_reductions.serve` over the
+TCP JSON-lines wire, the production shape) — and routes each request:
+
+  * **bucket affinity**: small requests (<= affinity_bytes) hash-route
+    on (method, dtype, n), so one replica's jit bucket cache
+    (serve/executor.py `_bucket`) serves every recurrence of a key
+    instead of every replica paying the same trace+compile
+    (the .jax_cache doctrine, horizontally);
+  * **load balance**: everything else goes to the alive replica with
+    the fewest outstanding requests;
+  * **death re-routing**: a terminal response that indicates replica
+    failure (dead process, dead relay, stopped engine) re-submits the
+    request to another alive replica (`route.reroute`) up to
+    max_retries times — chaos-tested against faults/relay.FakeRelay —
+    so every routed request still resolves to exactly one of the five
+    terminal statuses (serve/request.STATUSES, the no-hang contract).
+
+The router is jax-free BY CONSTRUCTION (redlint RED014 fences every
+serve/ module except serve/executor.py): it moves requests, never
+payloads — device work happens inside the replicas.
+
+CLI (the process-per-replica tier in one command):
+
+    python -m tpu_reductions.serve.router --replicas 2 \
+        [--port 0 --port-file PATH] [--platform cpu] [--relay-port P]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+from tpu_reductions.obs import ledger, trace
+from tpu_reductions.serve.request import (PendingResponse, ReduceRequest,
+                                          ReduceResponse)
+
+# substrings of a terminal response's error that mean "this REPLICA
+# failed", not "this REQUEST failed" — the re-route predicate. A
+# verification failure or an expired deadline would fail identically
+# anywhere; these would not.
+_REPLICA_FAILURE_MARKS = ("replica-dead", "replica-timeout",
+                          "relay dead", "relay-dead", "engine-stopped")
+
+
+def replica_failure(resp: ReduceResponse) -> bool:
+    """Whether this terminal response blames the replica rather than
+    the request (module docstring) — the router's re-route predicate,
+    exported so the chaos tests pin exactly the statuses that re-route."""
+    if resp.status not in ("error", "shed", "rejected"):
+        return False
+    return any(m in (resp.error or "") for m in _REPLICA_FAILURE_MARKS)
+
+
+class LocalReplica:
+    """One in-process engine behind the router — the loadgen's replica
+    flavor (no subprocess spawn / TCP hop, so the scaling series
+    measures routing + engine behavior, not fork latency)."""
+
+    def __init__(self, replica_id: str, engine) -> None:
+        self.replica_id = replica_id
+        self._engine = engine
+
+    def start(self) -> "LocalReplica":
+        self._engine.start()
+        ledger.emit("replica.up", replica=self.replica_id, kind="local")
+        return self
+
+    def alive(self) -> bool:
+        e = self._engine
+        return (e._thread is not None and e._thread.is_alive()
+                and not e._stopping)
+
+    def submit(self, request: ReduceRequest) -> PendingResponse:
+        return self._engine.submit(request)
+
+    def prewarm(self, method: str, dtype: str, n: int, *,
+                up_to_batch: int = 1) -> None:
+        """Delegate to the engine's jit-bucket warmer (the loadgen's
+        measure-serving-not-compilation discipline)."""
+        self._engine.prewarm(method, dtype, n, up_to_batch=up_to_batch)
+
+    def stop(self) -> None:
+        self._engine.stop(drain=True)
+
+    def kill(self) -> None:
+        """Chaos seam: hard-stop without drain (queued work sheds) —
+        the in-process stand-in for a replica process dying."""
+        ledger.emit("replica.down", replica=self.replica_id,
+                    reason="killed")
+        self._engine.stop(drain=False)
+
+
+class ProcessReplica:
+    """One `python -m tpu_reductions.serve` child behind the router —
+    process-per-replica (the tentpole's production shape): its own
+    interpreter, its own jax runtime, its own engine; the router talks
+    to it over the TCP JSON-lines wire through a small worker pool, so
+    `submit` never blocks the caller. A dead child (or a dead
+    connection) resolves every affected request with a
+    `replica-dead` error — which the router's re-route predicate
+    catches."""
+
+    def __init__(self, replica_id: str, *, platform: str = "cpu",
+                 relay_port: Optional[int] = None, workers: int = 4,
+                 request_timeout_s: float = 600.0,
+                 spawn_timeout_s: float = 90.0,
+                 extra_args: Sequence[str] = ()) -> None:
+        self.replica_id = replica_id
+        self._platform = platform
+        self._relay_port = relay_port
+        self._workers = workers
+        self._request_timeout_s = request_timeout_s
+        self._spawn_timeout_s = spawn_timeout_s
+        self._extra_args = list(extra_args)
+        self._proc: Optional[subprocess.Popen] = None
+        self._port: Optional[int] = None
+        self._jobs: "queue.Queue" = queue.Queue()
+        self._threads: List[threading.Thread] = []
+        self._down_emitted = False
+        self._lock = threading.Lock()
+
+    def start(self) -> "ProcessReplica":
+        import tempfile
+        port_file = os.path.join(tempfile.mkdtemp(prefix="replica-"),
+                                 "port")
+        cmd = [sys.executable, "-m", "tpu_reductions.serve",
+               "--port", "0", "--port-file", port_file]
+        if self._platform:
+            cmd += ["--platform", self._platform]
+        if self._relay_port is not None:
+            cmd += ["--relay-port", str(self._relay_port)]
+        cmd += self._extra_args
+        self._proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                      stderr=subprocess.DEVNULL)
+        ledger.emit("replica.spawn", replica=self.replica_id,
+                    pid=self._proc.pid)
+        deadline = time.monotonic() + self._spawn_timeout_s
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {self.replica_id} died during spawn "
+                    f"(exit {self._proc.returncode})")
+            try:
+                with open(port_file) as f:
+                    self._port = int(f.read().strip())
+                break
+            except (OSError, ValueError):
+                time.sleep(0.05)
+        if self._port is None:
+            self._proc.kill()
+            raise TimeoutError(
+                f"replica {self.replica_id} never published its port "
+                f"within {self._spawn_timeout_s}s")
+        for i in range(self._workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"{self.replica_id}-w{i}")
+            t.start()
+            self._threads.append(t)
+        ledger.emit("replica.up", replica=self.replica_id,
+                    kind="process", port=self._port)
+        return self
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def submit(self, request: ReduceRequest) -> PendingResponse:
+        pending = PendingResponse(f"{self.replica_id}-pending")
+        if not self.alive():
+            self._mark_down("process-exited")
+            pending.resolve(ReduceResponse(
+                pending.request_id, "error", request.method,
+                request.dtype, request.n,
+                error=f"replica-dead: {self.replica_id} not running"))
+            return pending
+        self._jobs.put((request, pending))
+        return pending
+
+    def _worker(self) -> None:
+        """One connection, one blocking round-trip at a time. Every
+        failure mode — dead process, refused/broken connection, read
+        timeout — resolves the in-flight request with a replica-dead
+        error; the job queue itself never drops a request."""
+        import json
+        conn = None
+        rfile = None
+        while True:
+            item = self._jobs.get()
+            if item is None:
+                break
+            request, pending = item
+            try:
+                if conn is None:
+                    conn = socket.create_connection(
+                        ("127.0.0.1", self._port), timeout=5.0)
+                    conn.settimeout(self._request_timeout_s)
+                    rfile = conn.makefile("rb")
+                spec = {"method": request.method, "type": request.dtype,
+                        "n": request.n, "seed": request.seed,
+                        "deadline_s": request.deadline_s,
+                        "value": request.value,
+                        "tenant": request.tenant,
+                        "priority": request.priority,
+                        "slo": request.slo}
+                conn.sendall((json.dumps(spec) + "\n").encode())
+                raw = rfile.readline()
+                if not raw:
+                    raise ConnectionError("connection closed mid-request")
+                d = json.loads(raw)
+                pending.resolve(ReduceResponse(
+                    d.get("request_id", pending.request_id),
+                    d.get("status", "error"), request.method,
+                    request.dtype, request.n,
+                    result=d.get("result"), error=d.get("error"),
+                    latency_s=d.get("latency_s"),
+                    queue_s=d.get("queue_s"),
+                    batch_size=d.get("batch_size")))
+            except socket.timeout:
+                self._drop_conn(conn)
+                conn = rfile = None
+                pending.resolve(ReduceResponse(
+                    pending.request_id, "error", request.method,
+                    request.dtype, request.n,
+                    error=(f"replica-timeout: {self.replica_id} gave "
+                           f"no response in {self._request_timeout_s}s")))
+            except (OSError, ValueError, ConnectionError) as e:
+                self._drop_conn(conn)
+                conn = rfile = None
+                self._mark_down(f"{type(e).__name__}: {e}")
+                pending.resolve(ReduceResponse(
+                    pending.request_id, "error", request.method,
+                    request.dtype, request.n,
+                    error=(f"replica-dead: {self.replica_id} "
+                           f"({type(e).__name__}: {e})")))
+        self._drop_conn(conn)
+
+    @staticmethod
+    def _drop_conn(conn) -> None:
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _mark_down(self, reason: str) -> None:
+        with self._lock:
+            if self._down_emitted:
+                return
+            self._down_emitted = True
+        ledger.emit("replica.down", replica=self.replica_id,
+                    reason=reason[:120])
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            self._jobs.put(None)
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+
+    def kill(self) -> None:
+        """Chaos seam: SIGKILL the child mid-traffic. In-flight
+        round-trips fail to replica-dead errors and the router
+        re-routes them."""
+        self._mark_down("killed")
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+
+
+@dataclasses.dataclass
+class _Routed:
+    """Router-internal record of one in-flight routed request."""
+
+    request: ReduceRequest
+    router_id: str
+    pending: PendingResponse          # the router's own slot
+    t_submit: float
+    attempts: int = 0
+    tried: tuple = ()
+
+
+class ReplicaRouter:
+    """The scale-out front end (module docstring). Interface-compatible
+    with ServeEngine where the front ends care: `submit(request) ->
+    PendingResponse`, `start()`, `stop()`, `stats`."""
+
+    def __init__(self, replicas: Sequence, *,
+                 affinity_bytes: int = 1 << 20,
+                 max_retries: int = 2) -> None:
+        if not replicas:
+            raise ValueError("need at least one replica")
+        self._replicas = list(replicas)
+        self._affinity_bytes = affinity_bytes
+        self._max_retries = max_retries
+        self._outstanding: Dict[str, int] = {
+            r.replica_id: 0 for r in self._replicas}
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self.stats: Dict[str, int] = {
+            "routed": 0, "rerouted": 0, "affinity": 0, "balanced": 0,
+            "no_replica": 0}
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> "ReplicaRouter":
+        for r in self._replicas:
+            r.start()
+        ledger.emit("route.start", replicas=len(self._replicas),
+                    affinity_bytes=self._affinity_bytes,
+                    max_retries=self._max_retries)
+        return self
+
+    def stop(self) -> None:
+        for r in self._replicas:
+            r.stop()
+        ledger.emit("route.stop", **{k: int(v)
+                                     for k, v in self.stats.items()})
+
+    @property
+    def replicas(self) -> List:
+        return list(self._replicas)
+
+    # -- routing ------------------------------------------------------
+
+    def submit(self, request: ReduceRequest) -> PendingResponse:
+        """Route one request; always returns a PendingResponse that
+        WILL resolve (the replicas' no-hang contract plus the
+        no-alive-replica terminal error here)."""
+        rid = f"g{next(self._ids):06d}"
+        pending = PendingResponse(rid)
+        routed = _Routed(request=request, router_id=rid,
+                         pending=pending, t_submit=time.monotonic())
+        self._dispatch(routed)
+        return pending
+
+    def _pick(self, request: ReduceRequest, tried: tuple):
+        """(replica, policy) among alive replicas not yet tried for
+        this request; (None, None) when none qualify. Small requests
+        hash on the jit-bucket key for cache affinity; large ones go
+        least-outstanding."""
+        with self._lock:
+            alive = [r for r in self._replicas
+                     if r.replica_id not in tried and r.alive()]
+            if not alive:
+                return None, None
+            if request.nbytes <= self._affinity_bytes:
+                key = f"{request.method}:{request.dtype}:{request.n}"
+                idx = zlib.crc32(key.encode()) % len(alive)
+                return alive[idx], "affinity"
+            return min(alive, key=lambda r: self._outstanding[
+                r.replica_id]), "balanced"
+
+    def _dispatch(self, routed: _Routed) -> None:
+        replica, policy = self._pick(routed.request, routed.tried)
+        if replica is None:
+            self.stats["no_replica"] += 1
+            self._finish(routed, None, ReduceResponse(
+                routed.router_id, "error", routed.request.method,
+                routed.request.dtype, routed.request.n,
+                error=("no-replica-alive: all replicas dead or "
+                       "already tried for this request")))
+            return
+        routed.attempts += 1
+        routed.tried += (replica.replica_id,)
+        self.stats["routed"] += 1
+        self.stats[policy] += 1
+        with self._lock:
+            self._outstanding[replica.replica_id] += 1
+        ledger.emit("route.request", req=routed.router_id,
+                    replica=replica.replica_id, policy=policy,
+                    attempt=routed.attempts,
+                    **trace.request_fields(routed.router_id))
+        inner = replica.submit(routed.request)
+        inner.add_done_callback(
+            lambda resp, rep=replica: self._on_result(routed, rep, resp))
+
+    def _on_result(self, routed: _Routed, replica,
+                   resp: ReduceResponse) -> None:
+        with self._lock:
+            self._outstanding[replica.replica_id] = max(
+                0, self._outstanding[replica.replica_id] - 1)
+        if replica_failure(resp) \
+                and routed.attempts <= self._max_retries:
+            self.stats["rerouted"] += 1
+            ledger.emit("route.reroute", req=routed.router_id,
+                        replica=replica.replica_id,
+                        attempt=routed.attempts,
+                        reason=(resp.error or "")[:120],
+                        **trace.request_fields(routed.router_id))
+            self._dispatch(routed)
+            return
+        self._finish(routed, replica, resp)
+
+    def _finish(self, routed: _Routed, replica,
+                resp: ReduceResponse) -> None:
+        out = dataclasses.replace(
+            resp, request_id=routed.router_id,
+            latency_s=round(time.monotonic() - routed.t_submit, 6))
+        ledger.emit("route.done", req=routed.router_id,
+                    replica=(replica.replica_id if replica else None),
+                    status=out.status, latency_s=out.latency_s,
+                    attempts=routed.attempts,
+                    **trace.request_fields(routed.router_id))
+        routed.pending.resolve(out)
+
+
+def local_router(n_replicas: int, *, engine_kwargs: Optional[dict] = None,
+                 affinity_bytes: int = 1 << 20,
+                 max_retries: int = 2) -> ReplicaRouter:
+    """N in-process engine replicas behind one router — the loadgen's
+    scaling-series construction (and the chaos tests': each engine can
+    be handed its own transport through engine_kwargs['transports'])."""
+    from tpu_reductions.serve.engine import ServeEngine
+    kwargs = dict(engine_kwargs or {})
+    transports = kwargs.pop("transports", None)
+    replicas = []
+    for i in range(n_replicas):
+        kw = dict(kwargs)
+        if transports is not None:
+            kw["transport"] = transports[i]
+        replicas.append(LocalReplica(f"replica-{i}", ServeEngine(**kw)))
+    return ReplicaRouter(replicas, affinity_bytes=affinity_bytes,
+                         max_retries=max_retries)
+
+
+def main(argv=None) -> int:
+    """CLI: the process-per-replica tier in one command — spawn N
+    `python -m tpu_reductions.serve` children, route over them, serve
+    the same TCP JSON-lines wire the single engine speaks (so every
+    existing client just points at the router port instead)."""
+    import argparse
+
+    from tpu_reductions.config import _apply_platform
+
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.serve.router",
+        description="Replica router over process-per-replica serving "
+                    "engines (docs/SERVING.md scaling tier)")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = ephemeral (printed + --port-file)")
+    p.add_argument("--port-file", default=None)
+    p.add_argument("--affinity-bytes", type=int, default=1 << 20,
+                   help="requests at or under this hash-route for jit "
+                        "bucket affinity; larger ones load-balance")
+    p.add_argument("--max-retries", type=int, default=2,
+                   help="re-route attempts after a replica failure")
+    p.add_argument("--request-timeout-s", type=float, default=600.0)
+    p.add_argument("--max-seconds", type=float, default=None)
+    p.add_argument("--platform", default=None, choices=("cpu", "tpu"))
+    p.add_argument("--relay-port", type=int, default=None,
+                   help="every replica gates launches on this relay "
+                        "port (chaos rehearsals: faults/relay.py)")
+    ns = p.parse_args(argv)
+    _apply_platform(ns)
+
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("serve.router", argv=list(argv) if argv
+                else sys.argv[1:])
+
+    if ns.replicas <= 0:
+        p.error("--replicas must be positive")
+    replicas = [ProcessReplica(f"replica-{i}", platform=ns.platform,
+                               relay_port=ns.relay_port,
+                               request_timeout_s=ns.request_timeout_s)
+                for i in range(ns.replicas)]
+    router = ReplicaRouter(replicas,
+                           affinity_bytes=ns.affinity_bytes,
+                           max_retries=ns.max_retries).start()
+
+    import socketserver
+
+    from tpu_reductions.serve.__main__ import _Server, _make_handler
+    server = _Server((ns.host, ns.port),
+                     _make_handler(router, ns.request_timeout_s))
+    port = server.server_address[1]
+    print(f"routing {ns.replicas} replicas on {ns.host}:{port}",
+          flush=True)
+    if ns.port_file:
+        from tpu_reductions.utils.jsonio import atomic_text_dump
+        atomic_text_dump(ns.port_file, f"{port}\n")
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        if ns.max_seconds is None:
+            while True:
+                time.sleep(0.5)
+        else:
+            time.sleep(ns.max_seconds)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
