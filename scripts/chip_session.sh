@@ -451,6 +451,15 @@ fallback_static_session() {
         python -m tpu_reductions.bench.quant_curve --platform=cpu \
             --out=examples/rank_scaling/quant_curve.json
 
+    # off-chip by design: the redistribution curve runs the reshard
+    # planner's programs on the virtual mesh (docs/RESHARD.md), so it
+    # is flap-time filler exactly as the scheduler prices it
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py reshard_curve
+    step "redistribution curve" 420 \
+            examples/rank_scaling/reshard_curve.json -- \
+        python -m tpu_reductions.bench.reshard_curve --platform=cpu \
+            --out=examples/rank_scaling/reshard_curve.json
+
     # off-chip by design too: the open-loop serving scale grid rides
     # virtual devices + the local chaos relay, so it is flap-time
     # filler exactly as the scheduler prices it (docs/SERVING.md

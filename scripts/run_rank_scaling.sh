@@ -120,3 +120,10 @@ PY
 # bench/regen folds it into report.md from here — docs/COLLECTIVES.md)
 python -m tpu_reductions.bench.quant_curve --platform=cpu \
     --out="$OUT/quant_curve.json"
+
+# refresh the reshard engine's redistribution curve (ISSUE 15;
+# docs/RESHARD.md): planner programs executed + oracle-verified +
+# memory-accounted over the same rank ladder, committed next to the
+# rank-scaling evidence; bench/regen folds it into report.md from here
+python -m tpu_reductions.bench.reshard_curve --platform=cpu \
+    --out="$OUT/reshard_curve.json"

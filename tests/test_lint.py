@@ -583,6 +583,74 @@ def test_red016_exempts_collectives_and_honors_waiver(tmp_path):
                                             name="ops/fixture.py"))
 
 
+def test_red016_flags_redistribution_primitives_outside_fence(tmp_path):
+    """ISSUE 15 satellite: the fence covers every redistribution
+    primitive spelling, not just ppermute — an ad-hoc gather or
+    slice-shuffle is invisible to the planner's memory-bound contract
+    (docs/RESHARD.md)."""
+    src = (
+        "import jax\n"
+        "from jax import lax\n"
+        "from jax.lax import all_gather\n"
+        "def shuffle(x, r, k):\n"
+        "    g = all_gather(x, 'ranks', axis=0, tiled=True)\n"
+        "    y = jax.lax.psum_scatter(g, 'ranks', tiled=True)\n"
+        "    z = lax.dynamic_slice_in_dim(y, r, k, axis=0)\n"
+        "    return jax.lax.all_to_all(z, 'ranks', 0, 0)\n"
+    )
+    findings = _lint_src(tmp_path, src, name="ops/fixture.py")
+    # import binding + 4 call spellings
+    assert _rules(findings).count("RED016") == 5
+    hit = next(f for f in findings if f.rule == "RED016")
+    assert "reshard/primitives.py" in hit.message
+    # dynamic_update_slice stays OUT of the fence: staging assembly
+    # (utils/staging.py), homed by RED015, not cross-device movement
+    staging = ("import jax\n"
+               "def assemble(buf, chunk, off):\n"
+               "    return jax.lax.dynamic_update_slice(buf, chunk, "
+               "(off,))\n")
+    assert "RED016" not in _rules(_lint_src(tmp_path, staging,
+                                            name="ops/fixture2.py"))
+
+
+def test_red016_exempts_reshard_primitives_module(tmp_path):
+    """reshard/primitives.py is the second sanctioned home (ISSUE 15):
+    the one module where the planner's primitives are built."""
+    src = ("import jax\n"
+           "def gather(x):\n"
+           "    return jax.lax.all_gather(x, 'ranks', axis=0, "
+           "tiled=True)\n")
+    assert "RED016" not in _rules(_lint_src(
+        tmp_path, src, name="tpu_reductions/reshard/primitives.py"))
+    # ...but reshard/ siblings are NOT exempt — planner/oracle stay
+    # primitive-free by construction
+    findings = _lint_src(tmp_path, src,
+                         name="tpu_reductions/reshard/planner.py")
+    assert "RED016" in _rules(findings)
+
+
+def test_red016_new_spellings_flag_via_cli(tmp_path):
+    """Positive CLI fixture for the extended fence (the fixtures dict in
+    test_cli_emits_stable_json_rows is keyed by rule name, so the new
+    spellings get their own end-to-end row)."""
+    f = tmp_path / "ops" / "r16b.py"
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text("import jax\n"
+                 "def f(x, r):\n"
+                 "    return jax.lax.dynamic_slice_in_dim(x, r, 4, "
+                 "axis=0)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_reductions.lint", str(f),
+         "--format=json"],
+        capture_output=True, text=True,
+        cwd=str(Path(__file__).parents[1]))
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+    rows = json.loads(proc.stdout)
+    assert "RED016" in {o["rule"] for o in rows}
+    hit = next(o for o in rows if o["rule"] == "RED016")
+    assert "dynamic_slice_in_dim" in hit["message"]
+
+
 # ---------------------------------------------------------------- RED008
 
 

@@ -1,0 +1,97 @@
+"""ISSUE 15 satellite: the reshard curve under a relay death BETWEEN
+redistribution cells. The `reshard.cell` fault point wedges the second
+cell's plan execution while the test flips the fake relay dead — the
+watchdog exits 3 with the completed cell rows persisted in
+reshard_curve.json, and the re-invoked curve resumes those rows
+byte-identically (zero re-measures) instead of restarting at the first
+spec pair (docs/RESHARD.md; docs/RESILIENCE.md fault-point table)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_reductions.faults.relay import FakeRelay
+
+REPO = Path(__file__).resolve().parent.parent
+CURVE_ARGS = ["--platform=cpu", "--ranks=2,4", "--n=262144",
+              "--rows=256", "--quant-bits=0"]
+
+
+def _chaos_env(relay, marker, *, faults=None):
+    env = {**os.environ,
+           "TPU_REDUCTIONS_CHAOS_ARM": "1",
+           "TPU_REDUCTIONS_RELAY_MARKER": str(marker),
+           "TPU_REDUCTIONS_RELAY_PORTS": str(relay.port),
+           "TPU_REDUCTIONS_WATCHDOG_INTERVAL_S": "0.1",
+           "TPU_REDUCTIONS_WATCHDOG_GRACE": "2",
+           "TPU_REDUCTIONS_HEALTH_FILE": str(Path(marker).parent
+                                             / "health.json")}
+    env.pop("TPU_REDUCTIONS_FAULTS", None)
+    env.pop("TPU_REDUCTIONS_LEDGER", None)
+    if faults is not None:
+        env["TPU_REDUCTIONS_FAULTS"] = json.dumps(faults)
+    return env
+
+
+def _curve(out: Path, env):
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpu_reductions.bench.reshard_curve",
+         *CURVE_ARGS, f"--out={out}"],
+        env=env, cwd=str(REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _wait_for_rows(out: Path, n: int, timeout_s: float = 60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            rows = json.loads(out.read_text()).get("rows", [])
+            if len(rows) >= n:
+                return rows
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    pytest.fail(f"timed out waiting for {n} persisted row(s) in {out}")
+
+
+def test_chaos_reshard_curve_relay_death_midcurve_resumes_cells(tmp_path):
+    marker = tmp_path / "relay.marker"
+    marker.write_text("tunneled\n")
+    out = tmp_path / "reshard_curve.json"
+    with FakeRelay() as relay:
+        # cell 1 (row_to_col k=2) measures clean; cell 2 wedges just
+        # before its plan executes — the relay-death-between-cells shape
+        env = _chaos_env(relay, marker, faults={
+            "reshard.cell": {"after": 1, "action": "stall",
+                             "seconds": 120}})
+        proc = _curve(out, env)
+        _wait_for_rows(out, 1)          # first cell verified + persisted
+        relay.force("refuse")
+        rc = proc.wait(timeout=90)
+        stderr = proc.stderr.read()
+        assert rc == 3, f"expected watchdog exit 3, got {rc}: {stderr}"
+        interrupted = json.loads(out.read_text())
+        assert interrupted["complete"] is False
+        assert all(r["status"] == "PASSED" for r in interrupted["rows"])
+        n1 = len(interrupted["rows"])
+        assert 1 <= n1 < 10             # died mid-grid, not at the end
+
+        # window 2: relay back, no faults — the grid resumes mid-curve
+        relay.force("accept")
+        time.sleep(0.15)
+        proc2 = _curve(out, _chaos_env(relay, marker))
+        rc2 = proc2.wait(timeout=180)
+        stderr2 = proc2.stderr.read()
+        assert rc2 == 0, stderr2
+        assert "resumed from prior artifact" in stderr2
+        resumed = json.loads(out.read_text())
+    assert resumed["complete"] is True
+    assert len(resumed["rows"]) == 10   # 5 pairs x ranks {2,4}, exact
+    # the banked cells are reused byte-identically, then the grid runs on
+    assert resumed["rows"][:n1] == interrupted["rows"]
+    assert all(r["status"] == "PASSED" for r in resumed["rows"])

@@ -159,6 +159,12 @@ STEPS = [
      ["--platform=cpu", "--ranks=2", "--bits=8", "--n=4096",
       "--out=quant_curve.json"],
      "quant_curve.json"),
+    ("python -m tpu_reductions.bench.reshard_curve --platform=cpu "
+     "--out=examples/rank_scaling/reshard_curve.json",
+     "tpu_reductions.bench.reshard_curve",
+     ["--platform=cpu", "--ranks=2", "--n=16384", "--rows=64",
+      "--quant-bits=0", "--out=reshard_curve.json"],
+     "reshard_curve.json"),
     # the window scheduler's shell interface (run_scheduled_session):
     # one pick + one outcome record per loop iteration
     # (docs/SCHEDULER.md); rehearsed against the real registry's cpu

@@ -214,27 +214,26 @@ def run_curve(*, n: int, seed: int, ranks=DEFAULT_RANKS,
     grid loop is the reference's op fan-out (reduce.c:73) crossed with
     the node fan-out (mpi/submit_all.sh:3-4), plus the bits axis the
     reference never had."""
-    from tpu_reductions.bench.resume import Checkpoint
+    from tpu_reductions.bench.resume import (Checkpoint,
+                                             run_checkpointed_cells)
     logger = logger or BenchLogger(None, None)
     ck = Checkpoint(out, {"n": n, "seed": seed},
                     key_fn=lambda r: (r.get("method"), r.get("dtype"),
                                       r.get("bits"), r.get("ranks")))
     logger.log(QUANT_CURVE_HEADER)
-    rows = []
-    for method, dtype, b, k in curve_cells(ranks, bits):
-        key = (method, dtype, b, k)
-        row = ck.resume(key)
-        if row is None:
-            row = measure_cell(method, dtype, b, k, n, seed)
-            ck.add(row)
-        else:
-            ck.add(row)
-        logger.log(quant_curve_row(dtype, method, b, k,
+
+    def measure(key):
+        method, dtype, b, k = key
+        return measure_cell(method, dtype, b, k, n, seed)
+
+    def on_row(key, row):
+        _, dtype, b, k = key
+        logger.log(quant_curve_row(dtype, row["method"], b, k,
                                    row["wire_reduction"], row["max_err"],
                                    row["bound"]))
-        rows.append(row)
-    ck.finalize()
-    return rows
+
+    return run_checkpointed_cells(ck, curve_cells(ranks, bits), measure,
+                                  on_row)
 
 
 def quant_curve_markdown(data: dict) -> str:

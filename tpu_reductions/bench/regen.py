@@ -330,6 +330,25 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                     f"({qc_file})")
         except (OSError, ValueError, KeyError, TypeError) as e:
             log(f"regen: quant_curve.json unusable ({e}); skipped")
+    # the reshard engine's redistribution curve (ISSUE 15): committed
+    # next to the rank-scaling evidence like quant_curve; same
+    # out_dir-local override rule
+    rc_file = out / "reshard_curve.json"
+    if not rc_file.exists():
+        rc_file = out.parent / "rank_scaling" / "reshard_curve.json"
+    if rc_file.exists():
+        try:
+            from tpu_reductions.bench.reshard_curve import \
+                reshard_curve_markdown
+            rc = json.loads(rc_file.read_text())
+            md = reshard_curve_markdown(rc)
+            if md:
+                with open(paths["md"], "a") as f:
+                    f.write("\n" + md + "\n")
+                log(f"regen: appended redistribution-curve table "
+                    f"({rc_file})")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: reshard_curve.json unusable ({e}); skipped")
     # the compile observatory's per-surface cold/warm table (ISSUE 8):
     # chip_session's exit trap copies compile_ledger.json next to the
     # evidence; the compile axis ships with the numbers it explains

@@ -1,0 +1,319 @@
+"""The redistribution curve — reshard GB/s x ranks x spec pairs.
+
+The reshard engine's committed instrument (ISSUE 15; engine:
+tpu_reductions/reshard/, runbook: docs/RESHARD.md). For every
+(source, target) spec pair and rank count, the planner picks the
+cheapest primitive program under the memory bound, the executor runs
+it with per-primitive timing + instrumented buffer accounting, and the
+pure-numpy oracle verifies every rank's block element-wise — so each
+committed row is simultaneously a bandwidth point AND a verification
+that (a) the placement is right, (b) the measured peak memory honors
+the plan's declared factor, and (c) the planner's program beats the
+naive all-gather-then-slice wire where one exists. Quantized-wire rows
+(EQuARX per hop, PAPERS.md 2506.17615) carry the composed declared
+error bound and are verified against it.
+
+The reference published one table per (op, dtype) over node counts
+(mpi/results/INT_SUM.txt:2-4); this curve is the same fan-out shape
+over the workload the reference's MPI hid entirely — arrays moving
+BETWEEN reductions (reduce.c:30-36 kept them whole on every rank).
+
+Grid: 5 spec pairs x rank ladder (2..64 virtual), exact wire, plus
+quantized-wire rows for the wire-heavy pairs. Every cell persists the
+moment it lands and resumes under the shared contract
+(bench/resume.run_checkpointed_cells, keyed (pair, wire, ranks));
+`reshard.cell` is the chaos suite's fault point
+(tests/test_reshard_chaos.py).
+
+CLI:
+    python -m tpu_reductions.bench.reshard_curve [--platform=cpu] \
+        [--n=1048576 --rows=256 --ranks=2,4,8,16,32,64 --seed=0] \
+        [--mem-bound=F] [--quant-bits=8] --out=reshard_curve.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from tpu_reductions.utils.logging import BenchLogger
+
+DEFAULT_RANKS = (2, 4, 8, 16, 32, 64)
+DEFAULT_N = 1 << 20
+DEFAULT_ROWS = 256
+
+# the committed spec-pair menu: (name, src kind, dst kind) over a 2-D
+# payload — S0/S1 = sharded on dim 0/1, R = replicated, P = partial
+# per-rank addends. row_to_col / col_to_row are the pairs where the
+# planner's collective_permute beats the naive all-gather-then-slice
+# wire by a factor k (the acceptance margin the artifact commits).
+PAIRS = (
+    ("row_to_col", "S0", "S1"),
+    ("col_to_row", "S1", "S0"),
+    ("shard_to_replicated", "S0", "R"),
+    ("replicated_to_col", "R", "S1"),
+    ("partial_to_row", "P", "S0"),
+)
+# pairs that move wire and block-align, measured again quantized
+QUANT_PAIRS = ("row_to_col", "shard_to_replicated")
+
+
+def _spec(kind: str, k: int):
+    from tpu_reductions.reshard import ShardingSpec
+    if kind == "R":
+        return ShardingSpec.replicated(k, 2)
+    if kind == "P":
+        return ShardingSpec.replicated(k, 2, partial=True)
+    return ShardingSpec.sharded(k, 2, int(kind[1]))
+
+
+def curve_cells(ranks=DEFAULT_RANKS, quant_bits: Optional[int] = 8
+                ) -> List[tuple]:
+    """The (pair, wire, ranks) grid in artifact order — exact rows for
+    every pair first (the bandwidth story), then the quantized-wire
+    rows for the wire-heavy pairs (the accuracy-vs-bandwidth story),
+    rank ladder innermost like the reference's node fan-out
+    (mpi/submit_all.sh:3-4)."""
+    cells = []
+    for name, _, _ in PAIRS:
+        for k in ranks:
+            cells.append((name, "exact", k))
+    if quant_bits is not None:
+        for name in QUANT_PAIRS:
+            for k in ranks:
+                cells.append((name, f"q{quant_bits}", k))
+    return cells
+
+
+def measure_cell(pair: str, wire: str, k: int, n: int, rows: int,
+                 seed: int, mem_bound: Optional[float] = None) -> dict:
+    """One curve cell: plan, execute, oracle-verify, account. The
+    elementwise-oracle acceptance discipline of the single-chip bench
+    (reduction.cpp:232-239) applied to placements: a cell PASSES only
+    when every rank's block matches the numpy reference within the
+    declared bound AND the measured peak-memory factor honors the
+    plan's declared factor."""
+    import numpy as np
+
+    from tpu_reductions.faults.inject import fault_point
+    from tpu_reductions.reshard import (execute_plan, make_mesh,
+                                        naive_plan, plan_reshard,
+                                        reshard_error_bound,
+                                        verify_placement)
+    from tpu_reductions.utils import heartbeat
+
+    if n % rows or n % (k * k):
+        raise ValueError(f"--n={n} needs rows|n and k*k|n (k={k})")
+    shape = (rows, n // rows)
+    qb = int(wire[1:]) if wire.startswith("q") else None
+    kinds = {name: (s, d) for name, s, d in PAIRS}
+    src = _spec(kinds[pair][0], k)
+    dst = _spec(kinds[pair][1], k)
+    plan = plan_reshard(src, dst, shape, 4, mem_bound=mem_bound,
+                        quant_bits=qb)
+    naive = naive_plan(src, dst, shape, 4, quant_bits=qb)
+    fault_point("reshard.cell")
+    mesh = make_mesh(k)
+    # same draw per (pair, k) across wire modes: exact and quantized
+    # rows compare on identical data
+    rng = np.random.default_rng([seed, k])
+    if src.partial:
+        carried = rng.standard_normal((k,) + shape).astype(np.float32)
+    else:
+        carried = rng.standard_normal(shape).astype(np.float32)
+    m_abs = float(np.abs(carried).max())
+    # quantized crossings round against the block max; the partial
+    # pairs' f32 psum adds k half-ulps at the summed magnitude
+    bound = reshard_error_bound(plan.quant_steps, qb, m_abs)
+    if src.partial:
+        bound += float(k) * m_abs * 2.0 ** -22
+    # the cell's blocking device region (dispatch + per-step host
+    # materialization) is heartbeat-guarded inside execute_plan; the
+    # outer guard covers placement staging too (RED019)
+    with heartbeat.guard("reshard.cell"):
+        res = execute_plan(plan, carried, mesh)
+    verdict = verify_placement(carried, src, dst, res["shards"],
+                               atol=bound)
+    g_bytes = int(np.prod(shape)) * 4
+    wall_s = res["wall_s"]
+    mem_ok = res["measured_mem_factor"] <= plan.mem_factor + 1e-9
+    ok = bool(verdict["ok"]) and mem_ok
+    return {"pair": pair, "wire": wire, "ranks": k, "n": int(n),
+            "shape": list(shape),
+            "src": src.to_json(), "dst": dst.to_json(),
+            "program": [s.primitive for s in plan.steps],
+            "algorithms": [s.algorithm for s in plan.steps],
+            "plan_wire_bytes": plan.wire_bytes,
+            "naive_wire_bytes": (naive.wire_bytes if naive is not None
+                                 else None),
+            "mem_factor": round(plan.mem_factor, 6),
+            "measured_mem_factor": round(res["measured_mem_factor"], 6),
+            "gbps": (g_bytes / wall_s / 1e9 if wall_s > 0
+                     else float("inf")),
+            "wall_s": round(wall_s, 6),
+            "steps": res["steps"],
+            "max_err": verdict["max_err"], "bound": bound,
+            "status": "PASSED" if ok else "FAILED"}
+
+
+def run_curve(*, n: int, rows: int, seed: int, ranks=DEFAULT_RANKS,
+              quant_bits: Optional[int] = 8,
+              mem_bound: Optional[float] = None,
+              out: Optional[str] = None,
+              logger: Optional[BenchLogger] = None) -> List[dict]:
+    """The full grid under the shared per-cell persist/resume loop
+    (bench/resume.run_checkpointed_cells — the live-window discipline
+    every --out-writing instrument follows; an interrupted curve
+    resumes its persisted cells byte-identically,
+    tests/test_reshard_chaos.py).
+
+    No reference analog (TPU-native).
+    """
+    from tpu_reductions.bench.resume import (Checkpoint,
+                                             run_checkpointed_cells)
+    logger = logger or BenchLogger(None, None)
+    # meta key is dim0, not "rows": that name is the artifact's row list
+    ck = Checkpoint(out, {"n": n, "dim0": rows, "seed": seed,
+                          "mem_bound": mem_bound},
+                    key_fn=lambda r: (r.get("pair"), r.get("wire"),
+                                      r.get("ranks")))
+    if ck.path is not None and ck._prior:
+        print(f"reshard_curve: {len(ck._prior)} row(s) resumed from "
+              f"prior artifact {ck.path}", file=sys.stderr)
+
+    def measure(key):
+        pair, wire, k = key
+        return measure_cell(pair, wire, k, n, rows, seed, mem_bound)
+
+    def on_row(key, row):
+        beat = (f" naive={row['naive_wire_bytes']:.0f}B"
+                if row.get("naive_wire_bytes") is not None else "")
+        logger.log(f"reshard {row['pair']} {row['wire']} k={row['ranks']}"
+                   f" [{'+'.join(row['program']) or 'identity'}]"
+                   f" {row['gbps']:.3f} GB/s"
+                   f" wire={row['plan_wire_bytes']:.0f}B{beat}"
+                   f" mem={row['measured_mem_factor']:.3f}"
+                   f"/{row['mem_factor']:.3f} err={row['max_err']:.2e}"
+                   f" {row['status']}")
+
+    return run_checkpointed_cells(ck, curve_cells(ranks, quant_bits),
+                                  measure, on_row)
+
+
+def reshard_curve_markdown(data: dict) -> str:
+    """The report fold (bench/regen.py): one row per (pair, wire) at
+    the tallest measured rank rung — redistribution GB/s, the
+    plan-vs-naive wire margin, and the declared-vs-measured memory
+    factor, mirroring the reference's per-table node fan-out
+    (mpi/results/INT_SUM.txt:2-4) over the workload it never had."""
+    rows = [r for r in data.get("rows", []) if isinstance(r, dict)]
+    if not rows:
+        return ""
+    tall = {}
+    for r in rows:
+        key = (r["pair"], r["wire"])
+        if key not in tall or r["ranks"] > tall[key]["ranks"]:
+            tall[key] = r
+    ranks = sorted({r["ranks"] for r in rows})
+    n_fail = sum(1 for r in rows if r.get("status") != "PASSED")
+    lines = [
+        "### Redistribution curve (reshard engine)",
+        "",
+        f"{len(rows)} cells across ranks {ranks} at n={rows[0]['n']}"
+        + (f" — **{n_fail} FAILED**" if n_fail else
+           "; every cell oracle-verified within bound, every measured "
+           "peak-memory factor within its plan's declared factor"),
+        "",
+        "| pair | wire | ranks | program | GB/s | plan wire | "
+        "naive wire | mem (meas/decl) |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for (pair, wire), r in sorted(tall.items()):
+        naive = (f"{r['naive_wire_bytes']:.0f} B"
+                 if r.get("naive_wire_bytes") is not None else "-")
+        lines.append(
+            f"| {pair} | {wire} | {r['ranks']} "
+            f"| {'+'.join(r['program']) or 'identity'} "
+            f"| {r['gbps']:.3f} | {r['plan_wire_bytes']:.0f} B "
+            f"| {naive} "
+            f"| {r['measured_mem_factor']:.3f}/{r['mem_factor']:.3f} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    """CLI: the spec-pair x rank-count redistribution sweep, one
+    committed JSON artifact — the submit_all.sh fan-out
+    (mpi/submit_all.sh:3-4) applied to the reshard engine."""
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.bench.reshard_curve",
+        description="Redistribution GB/s x ranks x (source, target) "
+                    "spec pairs: planner programs executed, "
+                    "oracle-verified, memory-accounted",
+    )
+    p.add_argument("--n", type=int, default=DEFAULT_N,
+                   help="Global element count of the 2-D payload; must "
+                        "divide by --rows and by k*k for every rank "
+                        "count (the permute piece grid)")
+    p.add_argument("--rows", type=int, default=DEFAULT_ROWS,
+                   help="Dim-0 extent; must divide by every rank count")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ranks", type=str, default=None,
+                   help="Comma-separated rank ladder "
+                        f"(default {','.join(map(str, DEFAULT_RANKS))})")
+    p.add_argument("--quant-bits", type=int, default=8,
+                   choices=(0, 4, 8, 16),
+                   help="Bit width of the quantized-wire rows "
+                        "(0 disables them)")
+    p.add_argument("--mem-bound", type=float, default=None,
+                   help="Refuse plans whose declared peak-memory "
+                        "factor exceeds this (reshard/planner.py)")
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"))
+    p.add_argument("--out", type=str, default=None)
+    ns = p.parse_args(argv)
+    try:
+        ranks = (tuple(int(r) for r in ns.ranks.split(",") if r.strip())
+                 if ns.ranks else DEFAULT_RANKS)
+    except ValueError:
+        p.error("--ranks must be comma-separated ints")
+    if not ranks or any(k < 2 for k in ranks):
+        p.error(f"--ranks must all be >= 2, got {ns.ranks!r}")
+    if any(ns.n % (k * k) for k in ranks) or ns.n % ns.rows:
+        p.error(f"--n={ns.n} must divide by --rows={ns.rows} and by "
+                f"k*k for every rank count {ranks}")
+    if any(ns.rows % k for k in ranks) \
+            or any((ns.n // ns.rows) % k for k in ranks):
+        p.error(f"--rows={ns.rows} and --n/--rows={ns.n // ns.rows} "
+                f"must both divide by every rank count {ranks}")
+    from tpu_reductions.config import _apply_platform
+    # provision enough virtual CPU devices for the tallest rung
+    # (_apply_platform reads ns.num_devices, exactly like the sweep CLI)
+    ns.num_devices = max(ranks)
+    ns.mode = "vn"
+    _apply_platform(ns)
+    # flight recorder + watchdog BEFORE the first device touch
+    # (docs/OBSERVABILITY.md; RED011)
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("bench.reshard_curve",
+                argv=list(argv) if argv else sys.argv[1:])
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()
+    logger = BenchLogger(None, None, console=sys.stdout)
+    rows = run_curve(n=ns.n, rows=ns.rows, seed=ns.seed, ranks=ranks,
+                     quant_bits=ns.quant_bits or None,
+                     mem_bound=ns.mem_bound, out=ns.out, logger=logger)
+    if ns.out:
+        print(f"wrote {ns.out}")
+    bad = [r for r in rows if r["status"] != "PASSED"]
+    if bad:
+        for r in bad:
+            print(f"FAILED: {r['pair']} {r['wire']} k={r['ranks']}: "
+                  f"err {r['max_err']:.3e} bound {r['bound']:.3e} "
+                  f"mem {r['measured_mem_factor']}/{r['mem_factor']}",
+                  file=sys.stderr)
+    return 1 if bad or not rows else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

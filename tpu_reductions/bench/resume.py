@@ -188,6 +188,33 @@ class Checkpoint:
                     complete=complete)
 
 
+def run_checkpointed_cells(ck: "Checkpoint", cells, measure,
+                           on_row=None) -> List[dict]:
+    """The shared per-cell resume loop of the grid instruments
+    (bench/quant_curve.py, bench/reshard_curve.py — ISSUE 15 satellite:
+    one spelling of the boilerplate instead of two copies): for each
+    cell key, reuse the prior run's row when the Checkpoint accepts it,
+    else `measure(key)`; either way `add()` it so it lands in the new
+    artifact (resumed rows byte-identical — Checkpoint.resume's
+    contract), call `on_row(key, row)` for the caller's console line,
+    and `finalize()` once the grid completes. Returns the rows in
+    grid order.
+
+    No reference analog (TPU-native).
+    """
+    rows: List[dict] = []
+    for key in cells:
+        row = ck.resume(key)
+        if row is None:
+            row = measure(key)
+        ck.add(row)
+        if on_row is not None:
+            on_row(key, row)
+        rows.append(row)
+    ck.finalize()
+    return rows
+
+
 def load_cell(path: str | os.PathLike) -> dict:
     """One sweep-grid cell file as a dict; {} when absent/truncated (a
     pre-atomic interrupt) so the caller re-measures — the read half of

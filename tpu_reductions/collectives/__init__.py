@@ -38,7 +38,8 @@ from tpu_reductions.collectives.quant import (
     quant_support_error, quant_supported)
 from tpu_reductions.collectives.rings import (
     grid_factors, make_topology_all_reduce, naive_accumulate,
-    ring_perm, ring_rs_ag, ring_rs_ag_stateful, shard_map)
+    ring_all_to_all, ring_perm, ring_rs_ag, ring_rs_ag_stateful,
+    shard_map)
 
 __all__ = [
     "REGISTRY", "ROOTED_MODES", "WIRE_FACTORS", "Algorithm", "Selection",
@@ -56,5 +57,6 @@ __all__ = [
     "make_quant_sum_all_reduce", "quant_error_bound",
     "quant_ring_applies", "quant_support_error", "quant_supported",
     "grid_factors", "make_topology_all_reduce", "naive_accumulate",
-    "ring_perm", "ring_rs_ag", "ring_rs_ag_stateful", "shard_map",
+    "ring_all_to_all", "ring_perm", "ring_rs_ag", "ring_rs_ag_stateful",
+    "shard_map",
 ]

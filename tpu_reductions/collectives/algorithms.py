@@ -128,6 +128,34 @@ def _build_registry() -> Dict[str, Algorithm]:
             lambda k: 6 * (k - 1),
             note=f"{bits}-bit coarse keys + exact f64 pair resolve "
                  f"(EXACT)")
+
+    # the redistribution primitives (reshard/, Zhang et al. 2112.01075):
+    # payload convention is GLOBAL array bytes (not the per-rank local
+    # payload of the all-reduce family) — a reshard moves one logical
+    # array, and its plans sum wire over steps of the SAME global array
+    add("reshard_all_gather", lambda k: (k - 1) / k, lambda k: k - 1,
+        note="ring all-gather of the k local blocks (sharded -> "
+             "replicated)")
+    add("reshard_dynamic_slice", lambda k: 0.0, lambda k: 0,
+        note="local slice (replicated -> sharded); zero wire")
+    add("reshard_collective_permute", lambda k: (k - 1) / (k * k),
+        lambda k: k - 1,
+        note="ring all-to-all: k-1 rotation hops of 1/k**2 pieces "
+             "(sharded dim A -> sharded dim B); a factor k under the "
+             "naive all-gather-then-slice wire")
+    add("reshard_reduce_scatter", lambda k: (k - 1) / k, lambda k: k - 1,
+        note="psum_scatter of per-rank partial addends -> sharded sum")
+    # quantized wire variants (f32 payloads only; bits/8-bit carrier +
+    # one f32 scale per QUANT_BLOCK elements, same compression as the
+    # quantized SUM rings above)
+    for bits in QUANT_BITS:
+        c = (bits / 8 + 4 / QUANT_BLOCK) / 4
+        add(f"reshard_all_gather_q{bits}",
+            lambda k, _c=c: _c * (k - 1) / k, lambda k: k - 1,
+            note=f"{bits}-bit block-scaled all-gather wire")
+        add(f"reshard_collective_permute_q{bits}",
+            lambda k, _c=c: _c * (k - 1) / (k * k), lambda k: k - 1,
+            note=f"{bits}-bit block-scaled all-to-all wire")
     return reg
 
 

@@ -178,6 +178,54 @@ def naive_accumulate(axis: str, k: int, bufs: tuple, combine,
     return acc
 
 
+def ring_all_to_all(axis: str, k: int, x, *, split_axis: int,
+                    concat_axis: int, to_wire=None, from_wire=None):
+    """The redistribution all-to-all on the ring (the collective-permute
+    step of Zhang et al.'s reshard decomposition — PAPERS.md
+    2112.01075): the local block is split into k pieces along
+    `split_axis`; after k-1 rotation hops every rank holds the pieces
+    matching ITS index along `split_axis`, concatenated along
+    `concat_axis` in sender order. Globally: an array sharded on the
+    concat dim becomes the same array sharded on the split dim, each
+    rank sending k-1 pieces of 1/k² of the global payload
+    (reshard_collective_permute in collectives/algorithms.py — wire
+    (k-1)/k², a factor k under the naive all-gather's (k-1)/k).
+
+    `to_wire(piece) -> tuple` / `from_wire(tuple) -> piece` make the
+    hop payload pluggable (quantized wire, collectives/quant.py); the
+    rank's OWN piece never crosses the wire and is stored exactly, so
+    lossy wire forms touch only the k-1 received pieces.
+    """
+    if k == 1:
+        return x
+    pieces = jnp.stack(jnp.split(x, k, axis=split_axis))
+    r = jax.lax.axis_index(axis)
+    blk = x.shape[concat_axis]
+    out_shape = list(x.shape)
+    out_shape[split_axis] //= k
+    out_shape[concat_axis] *= k
+    buf = jnp.zeros(out_shape, x.dtype)
+    own = jax.lax.dynamic_index_in_dim(pieces, r, 0, keepdims=False)
+    buf = jax.lax.dynamic_update_slice_in_dim(buf, own, r * blk,
+                                              axis=concat_axis)
+    for t in range(1, k):
+        # hop t is a rotation by t: sender s ships the piece destined
+        # for rank (s+t)%k straight to it — k-1 hops total, each a full
+        # permutation, so every piece crosses the wire exactly once
+        send = jax.lax.dynamic_index_in_dim(pieces, (r + t) % k, 0,
+                                            keepdims=False)
+        wire = to_wire(send) if to_wire is not None else (send,)
+        rx = tuple(jax.lax.ppermute(w, axis,
+                                    perm=[(i, (i + t) % k)
+                                          for i in range(k)])
+                   for w in wire)
+        piece = from_wire(rx) if from_wire is not None else rx[0]
+        src = (r - t) % k
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, piece, src * blk,
+                                                  axis=concat_axis)
+    return buf
+
+
 def make_topology_all_reduce(method: str, mesh, axis: str = "ranks",
                              topology: str = "ring"):
     """Build the explicit-topology elementwise all-reduce for `method`

@@ -48,7 +48,12 @@ once per collective benchmark launch just before the warmup dispatch,
 bench/collective_driver.py — a scripted `stall` mid rank-scaling sweep
 rehearses a relay death between ladder rungs, and the re-invoked sweep
 must resume its persisted per-rank-count rows byte-identically,
-tests/test_chaos_e2e.py). docs/RESILIENCE.md keeps the list.
+tests/test_chaos_e2e.py), and `reshard.cell` (fired once per
+reshard-curve cell just before its plan executes,
+bench/reshard_curve.py — a scripted `stall` mid-curve rehearses a
+relay death between redistribution cells, and the re-invoked curve
+must resume its persisted cell rows byte-identically,
+tests/test_reshard_chaos.py). docs/RESILIENCE.md keeps the list.
 
 Counters are process-global and monotonic; `reset()` re-arms them for
 in-process tests (subprocesses start fresh by construction).

@@ -159,6 +159,14 @@ STREAM_EVENTS = ("stream.start", "stream.chunk", "stream.sync",
 COLLECTIVE_EVENTS = ("collective.select", "collective.launch",
                      "collective.done")
 
+# the reshard engine's typed events (tpu_reductions/reshard/ +
+# bench/reshard_curve.py; ISSUE 15 — docs/RESHARD.md): reshard.plan
+# records the chosen primitive program with its declared wire bytes and
+# peak-memory factor, reshard.step times one primitive to host
+# materialization, reshard.done closes the program — obs/timeline's
+# reshard_summary attributes redistribution wall-clock per primitive
+RESHARD_EVENTS = ("reshard.plan", "reshard.step", "reshard.done")
+
 # the compile observatory's typed events (obs/compile.py; ISSUE 8 —
 # docs/OBSERVABILITY.md "reading the compile table"): every XLA/Pallas
 # compile bracketed with its surface id, lower/compile split where the
@@ -205,7 +213,8 @@ SHELL_EVENTS = (
 REGISTERED_EVENTS = frozenset(CORE_EVENTS + SHELL_EVENTS + SCHED_EVENTS
                               + SERVE_EVENTS + STREAM_EVENTS
                               + COMPILE_EVENTS + COLLECTIVE_EVENTS
-                              + ROUTE_EVENTS + REPLICA_EVENTS)
+                              + ROUTE_EVENTS + REPLICA_EVENTS
+                              + RESHARD_EVENTS)
 
 
 def event_registered(name: str) -> bool:

@@ -70,8 +70,21 @@ STAGE_INGEST_SCOPE_DIRS = ("ops", "bench", "serve", "utils", "parallel")
 # RED016: cross-device wire patterns (jax.lax.ppermute rings) live in
 # the collective suite and nowhere else — an ad-hoc ring has no
 # registry entry, so its wire cost is invisible to the selector, the
-# curve and the busbw accounting (ISSUE 10; docs/COLLECTIVES.md)
+# curve and the busbw accounting (ISSUE 10; docs/COLLECTIVES.md).
+# ISSUE 15 extends the fence to every on-device REDISTRIBUTION spelling
+# (all_gather / psum_scatter / all_to_all / the dynamic-slice family):
+# those are the reshard primitives, whose one home outside
+# collectives/ is reshard/primitives.py (docs/RESHARD.md) — anywhere
+# else they bypass the planner's registry-priced cost + declared
+# peak-memory accounting exactly like an ad-hoc ring would.
 COLLECTIVES_SCOPE_DIR = "collectives"
+# (dynamic_update_slice stays OUT of the fence: it is the chunked
+# staging assembly spelling, already homed by RED015 in
+# utils/staging.py and not a cross-device redistribution)
+RESHARD_PRIMS_WHITELIST = ("reshard/primitives.py",)
+RESHARD_PRIM_NAMES = ("ppermute", "all_gather", "all_to_all",
+                      "psum_scatter", "dynamic_slice",
+                      "dynamic_slice_in_dim", "dynamic_index_in_dim")
 
 # RED006 applies to the measured packages only: every public surface in
 # ops/ and bench/ must carry its reference citation (PARITY.md).
@@ -701,14 +714,19 @@ def _red015(rel: str, ctx: _FileContext) -> List[RawFinding]:
 
 
 # --------------------------------------------------------------------------
-# RED016 — ad-hoc cross-device ring construction outside the collective
-# suite. `jax.lax.ppermute` IS the ring primitive: every hop pattern
-# built on it must live in tpu_reductions/collectives/ where the
-# algorithm registry (collectives/algorithms.py) declares its wire
-# factor and step count — a ring spelled anywhere else is invisible to
-# the selector, the accuracy-vs-bandwidth curve and the busbw
-# accounting, so its cost model silently drifts from the code
-# (ISSUE 10; docs/COLLECTIVES.md).
+# RED016 — ad-hoc cross-device ring construction OR redistribution
+# primitives outside the collective suite. `jax.lax.ppermute` IS the
+# ring primitive: every hop pattern built on it must live in
+# tpu_reductions/collectives/ where the algorithm registry
+# (collectives/algorithms.py) declares its wire factor and step count —
+# a ring spelled anywhere else is invisible to the selector, the
+# accuracy-vs-bandwidth curve and the busbw accounting, so its cost
+# model silently drifts from the code (ISSUE 10; docs/COLLECTIVES.md).
+# ISSUE 15 widens the fence to the redistribution spellings
+# (all_gather / all_to_all / psum_scatter / the on-device slice
+# family, RESHARD_PRIM_NAMES): their one home outside collectives/ is
+# reshard/primitives.py, where each call carries a registry label and
+# a declared peak-memory factor (docs/RESHARD.md).
 # --------------------------------------------------------------------------
 
 
@@ -716,25 +734,30 @@ def _red016(rel: str, ctx: _FileContext) -> List[RawFinding]:
     parts = rel.split("/")
     if COLLECTIVES_SCOPE_DIR in parts[:-1]:
         return []
-    msg = ("outside tpu_reductions/collectives/ — ring wire patterns "
-           "belong to the collective suite, where the algorithm "
-           "registry (collectives/algorithms.py) declares their wire "
-           "cost; build on make_topology_all_reduce / ring_rs_ag, or "
-           "waive with the reason the registry cannot express this "
-           "pattern")
+    if _suffix_match(rel, RESHARD_PRIMS_WHITELIST):
+        return []
+    msg = ("outside tpu_reductions/collectives/ and reshard/"
+           "primitives.py — ring wire patterns and redistribution "
+           "primitives belong there, where the algorithm registry "
+           "(collectives/algorithms.py) declares their wire cost and "
+           "the reshard planner its peak-memory factor; build on "
+           "make_topology_all_reduce / ring_rs_ag / reshard's "
+           "primitives, or waive with the reason the registry cannot "
+           "express this pattern")
     out = []
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.ImportFrom):
             mod = node.module or ""
             if mod in ("jax.lax", "jax._src.lax.parallel"):
                 for n in node.names:
-                    if n.name == "ppermute":
+                    if n.name in RESHARD_PRIM_NAMES:
                         out.append(RawFinding(
                             "RED016", node.lineno,
-                            f"import of ppermute {msg}"))
+                            f"import of {n.name} {msg}"))
         elif isinstance(node, ast.Call):
             chain = _attr_chain(node.func)
-            if chain.endswith(".ppermute") or chain == "ppermute":
+            if any(chain.endswith(f".{name}") or chain == name
+                   for name in RESHARD_PRIM_NAMES):
                 out.append(RawFinding(
                     "RED016", node.lineno, f"{chain}() {msg}"))
     return out

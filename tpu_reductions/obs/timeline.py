@@ -434,6 +434,41 @@ def collective_summary(events: List[dict]) -> Optional[dict]:
             "algorithms": [algos[a] for a in order]}
 
 
+def reshard_summary(events: List[dict]) -> Optional[dict]:
+    """Per-primitive redistribution attribution from the reshard.*
+    typed events (lint/grammar.py RESHARD_EVENTS; reshard/
+    primitives.execute_plan). The ISSUE-15 answer to "where did the
+    reshard minutes go": per primitive (all_gather / dynamic_slice /
+    collective_permute / reduce_scatter), how many steps ran and how
+    much wall-clock they took to host materialization, plus how many
+    whole programs executed. None when no reshard ran."""
+    plans = sum(1 for e in events if e["ev"] == "reshard.plan")
+    steps = [e for e in events if e["ev"] == "reshard.step"]
+    dones = [e for e in events if e["ev"] == "reshard.done"]
+    if not plans and not steps and not dones:
+        return None
+    prims: dict = {}
+    order: List[str] = []
+    total_s = 0.0
+    for e in steps:
+        p = e.get("primitive")
+        if not isinstance(p, str):
+            continue
+        if p not in prims:
+            prims[p] = {"primitive": p, "steps": 0, "wall_s": 0.0}
+            order.append(p)
+        prims[p]["steps"] += 1
+        d = e.get("wall_s")
+        if isinstance(d, (int, float)):
+            prims[p]["wall_s"] += float(d)
+            total_s += float(d)
+    for rec in prims.values():
+        rec["wall_s"] = round(rec["wall_s"], 6)
+    return {"plans": plans, "programs": len(dones),
+            "reshard_s": round(total_s, 6),
+            "primitives": [prims[p] for p in order]}
+
+
 def compile_summary(events: List[dict]) -> Optional[dict]:
     """Per-surface compile attribution from the compile observatory's
     typed events (compile.start/end, warm.* — lint/grammar.py
@@ -495,6 +530,9 @@ def summarize(path, events: List[dict], torn: int) -> dict:
     coll = collective_summary(events)
     if coll is not None:
         out["collective"] = coll
+    resh = reshard_summary(events)
+    if resh is not None:
+        out["reshard"] = resh
     comp = compile_summary(events)
     if comp is not None:
         out["compile"] = comp
@@ -718,6 +756,24 @@ def summary_markdown(summary: dict) -> str:
                      f"{coll['launches']} launch(es), "
                      f"{coll['collective_s']:.2f} s in collective "
                      "device phases")
+    resh = summary.get("reshard")
+    if resh:
+        # the reshard engine's record (ISSUE 15): per-primitive step
+        # counts and device-phase wall-clock — which redistribution
+        # move the window actually paid for
+        lines.append("")
+        lines.append("### reshard (per-primitive attribution)")
+        lines.append("")
+        lines.append("| primitive | steps | wall s |")
+        lines.append("|---|---|---|")
+        for rec in resh["primitives"]:
+            lines.append(f"| {rec['primitive']} | {rec['steps']} "
+                         f"| {rec['wall_s']:.3f} |")
+        lines.append("")
+        lines.append(f"{resh['plans']} plan(s), "
+                     f"{resh['programs']} program(s) executed, "
+                     f"{resh['reshard_s']:.2f} s in reshard device "
+                     "phases")
     comp = summary.get("compile")
     if comp:
         # the compile observatory's record (ISSUE 8): per-surface

@@ -1,0 +1,45 @@
+"""Reshard engine — portable array redistribution on the collectives
+registry (ISSUE 15; Zhang et al. 2112.01075, EQuARX 2506.17615):
+
+  spec.py        ShardingSpec — validated, byte-identical JSON round
+                 trip (specs live inside committed artifacts)
+  primitives.py  the four redistribution moves as shard_map programs
+                 (the ONE RED016-whitelisted home outside collectives/
+                 for on-device redistribution spellings) + the plan
+                 executor with instrumented buffer accounting
+  planner.py     cheapest primitive program under a peak-memory bound,
+                 priced by collectives/algorithms.algorithm_cost
+  oracle.py      pure-numpy reference every executed plan is verified
+                 against, element-wise per rank
+
+Instrument: bench/reshard_curve.py (committed artifact
+examples/rank_scaling/reshard_curve.json); runbook: docs/RESHARD.md.
+"""
+
+from tpu_reductions.reshard.oracle import (local_block, logical_global,
+                                           reshard_reference,
+                                           verify_placement)
+from tpu_reductions.reshard.planner import (Plan, PlanStep,
+                                            ReshardPlanError,
+                                            naive_plan, plan_reshard)
+from tpu_reductions.reshard.primitives import (PRIMITIVES, Primitive,
+                                               collect_shards,
+                                               declared_buffers,
+                                               declared_mem_factor,
+                                               execute_plan, make_mesh,
+                                               partition_spec,
+                                               place_spec,
+                                               quant_compression,
+                                               reshard_error_bound,
+                                               step_label)
+from tpu_reductions.reshard.spec import ShardingSpec, ShardingSpecError
+
+__all__ = [
+    "Plan", "PlanStep", "PRIMITIVES", "Primitive", "ReshardPlanError",
+    "ShardingSpec", "ShardingSpecError", "collect_shards",
+    "declared_buffers", "declared_mem_factor", "execute_plan",
+    "local_block", "logical_global", "make_mesh", "naive_plan",
+    "partition_spec", "place_spec", "plan_reshard",
+    "quant_compression", "reshard_error_bound", "reshard_reference",
+    "step_label", "verify_placement",
+]

@@ -1,0 +1,411 @@
+"""Redistribution primitives + the plan executor (ISSUE 15 tentpole (b)).
+
+The four primitive moves of Zhang et al.'s reshard decomposition
+(PAPERS.md 2112.01075 §3) as runnable shard_map programs over the
+collectives package's machinery — ppermute ring construction stays in
+collectives/rings.py (ring_all_to_all) per redlint RED016, and THIS
+file is the only place outside `collectives/` allowed to spell the
+on-device redistribution calls (all_gather / psum_scatter /
+dynamic-slice-on-device); the extended RED016 fence pins that.
+
+Each primitive declares, next to its implementation:
+  * its wire-cost label in the collectives registry
+    (collectives/algorithms.py `reshard_*` entries — the α-β cost the
+    planner prices), and
+  * its peak-memory factor — per-rank live bytes ÷ GLOBAL array bytes,
+    the paper's headline constraint — via `declared_buffers`, an
+    explicit enumeration of every buffer the builder allocates. The
+    executor instruments the REAL per-device shard sizes against this
+    declaration (`execute_plan` reports `measured_mem_factor`; the
+    property tests hold measured <= declared).
+
+Quantized wire (EQuARX, PAPERS.md 2506.17615): the wire-crossing
+primitives optionally ship block-scaled b-bit carriers
+(collectives/quant.block_encode) — each element crosses a lossy hop at
+most once per step, so a plan's composed error bound is
+steps_quantized * max|x| / levels(bits) (a 2x margin over the
+half-step rounding of each crossing; reshard/planner.plan_error_bound).
+
+The reference has no analog: MPI arrays lived whole on every rank
+(reduce.c:30-36); redistribution is the part the library hid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_reductions.collectives.quant import (QUANT_BLOCK, block_decode,
+                                              block_encode, levels)
+from tpu_reductions.collectives.rings import ring_all_to_all, shard_map
+from tpu_reductions.reshard.spec import ShardingSpec, ShardingSpecError
+
+
+@dataclasses.dataclass(frozen=True)
+class Primitive:
+    """One redistribution move: its registry label (quantized variants
+    append _q{bits}) and a one-line memory story (the full buffer
+    enumeration is `declared_buffers`). No reference analog
+    (TPU-native)."""
+
+    name: str
+    label: str
+    mem_note: str
+
+
+PRIMITIVES: Dict[str, Primitive] = {
+    "identity": Primitive(
+        "identity", "reshard_dynamic_slice",
+        "in only (nothing moves)"),
+    "all_gather": Primitive(
+        "all_gather", "reshard_all_gather",
+        "in 1/k + out 1 (quant: + encoded copies, (in+out)*(2+c))"),
+    "dynamic_slice": Primitive(
+        "dynamic_slice", "reshard_dynamic_slice",
+        "in + out slice; zero wire"),
+    "collective_permute": Primitive(
+        "collective_permute", "reshard_collective_permute",
+        "in + pieces stack + out (3/k) + two in-flight 1/k**2 pieces"),
+    "reduce_scatter": Primitive(
+        "reduce_scatter", "reshard_reduce_scatter",
+        "full addend 1 + out 1/k"),
+}
+
+
+def quant_compression(bits: int, itemsize: int) -> float:
+    """Wire bytes per payload byte of the block-scaled encoding: b-bit
+    carrier + one f32 scale per QUANT_BLOCK elements (the same constant
+    the registry's reshard_*_q{bits} factors derive from)."""
+    return (bits / 8 + 4 / QUANT_BLOCK) / itemsize
+
+
+def step_label(primitive: str, quant_bits: Optional[int]) -> str:
+    """Registry label of a primitive under the chosen wire form."""
+    base = PRIMITIVES[primitive].label
+    if quant_bits is None or primitive in ("identity", "dynamic_slice",
+                                           "reduce_scatter"):
+        return base
+    return f"{base}_q{quant_bits}"
+
+
+def declared_buffers(primitive: str, k: int, in_f: float, out_f: float,
+                     quant_bits: Optional[int] = None,
+                     itemsize: int = 4) -> Tuple[Tuple[str, float], ...]:
+    """The declared buffer enumeration of one step: (name, fraction of
+    GLOBAL array bytes) for every per-rank buffer the builder
+    allocates. The step's declared peak-memory factor is the sum; the
+    executor's instrumented accounting must never exceed it
+    (tests/test_reshard.py). Fractions follow the builders below
+    line-for-line — change an allocation THERE and this table (or the
+    property test screams)."""
+    c = (quant_compression(quant_bits, itemsize)
+         if quant_bits is not None else 0.0)
+    if primitive == "identity":
+        return (("in", in_f),)
+    if primitive == "dynamic_slice":
+        return (("in", in_f), ("out", out_f))
+    if primitive == "all_gather":
+        if quant_bits is None:
+            return (("in", in_f), ("out", out_f))
+        return (("in", in_f), ("flat", in_f),
+                ("enc_local", c * in_f), ("enc_gathered", c * out_f),
+                ("decoded", out_f), ("out", out_f))
+    if primitive == "collective_permute":
+        piece = in_f / k
+        base = [("in", in_f), ("pieces", in_f), ("out", out_f),
+                ("send_piece", piece), ("rx_piece", piece)]
+        if quant_bits is not None:
+            base += [("send_enc", c * piece), ("rx_enc", c * piece)]
+        return tuple(base)
+    if primitive == "reduce_scatter":
+        return (("in", in_f), ("out", out_f))
+    raise ShardingSpecError(f"unknown primitive {primitive!r}")
+
+
+def declared_mem_factor(primitive: str, k: int, in_f: float,
+                        out_f: float, quant_bits: Optional[int] = None,
+                        itemsize: int = 4) -> float:
+    """Sum of `declared_buffers` — the factor every emitted plan step
+    carries and the planner's --mem-bound filters on."""
+    return sum(f for _, f in declared_buffers(primitive, k, in_f, out_f,
+                                              quant_bits, itemsize))
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+
+def make_mesh(k: int, axis: str = "ranks") -> Mesh:
+    """A 1-D mesh over the first k local devices (the virtual-device
+    ladder of tests/conftest.py and the rank-scaling sweep)."""
+    devs = jax.devices()
+    if len(devs) < k:
+        raise ShardingSpecError(f"need {k} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:k]), (axis,))
+
+
+def partition_spec(spec: ShardingSpec, axis: str = "ranks") -> P:
+    """The jax PartitionSpec of a carried value under `spec`: a partial
+    value's leading stacked addend axis is sharded; otherwise the one
+    sharded dim carries the mesh axis."""
+    if spec.partial:
+        return P(axis, *([None] * spec.ndim))
+    d = spec.sharded_dim()
+    if d is None:
+        return P(*([None] * spec.ndim))
+    return P(*[axis if i == d else None for i in range(spec.ndim)])
+
+
+def place_spec(carried: np.ndarray, spec: ShardingSpec, mesh: Mesh,
+               axis: str = "ranks"):
+    """Place a host value per its spec (the reshard engine's ingest;
+    partial values are (k, *shape) addend stacks — reshard/spec.py)."""
+    x = np.asarray(carried)
+    if spec.partial:
+        if x.ndim != spec.ndim + 1 or x.shape[0] != spec.num_ranks:
+            raise ShardingSpecError(
+                f"partial value must be (k={spec.num_ranks}, *shape), "
+                f"got {x.shape}")
+    else:
+        spec.local_shape(x.shape)   # divisibility check
+    # redlint: disable=RED003 -- sharded per-device placement (1/k of the value per device), not single-device bulk staging
+    return jax.device_put(x, NamedSharding(mesh, partition_spec(spec,
+                                                                axis)))
+
+
+def collect_shards(y, mesh: Mesh, axis: str = "ranks") -> list:
+    """Per-rank numpy blocks of a device array, ordered by mesh
+    position — what oracle.verify_placement consumes."""
+    order = {d: i for i, d in enumerate(mesh.devices.reshape(-1))}
+    shards = [None] * len(order)
+    for s in y.addressable_shards:
+        shards[order[s.device]] = np.asarray(s.data)
+    return shards
+
+
+# ---------------------------------------------------------------------------
+# step builders (the RED016-fenced device spellings live HERE only)
+# ---------------------------------------------------------------------------
+
+
+def _quant_ok(count: int) -> bool:
+    return count % QUANT_BLOCK == 0
+
+
+def build_step(step, mesh: Mesh, global_shape: Tuple[int, ...],
+               dtype, axis: str = "ranks"):
+    """Compile one plan step into a jitted shard_map program. Returns
+    (fn, aux_buffers) where aux_buffers lists the modeled intermediate
+    allocations as (name, per-rank bytes) — the executor combines them
+    with the REAL in/out shard sizes for the instrumented accounting
+    (module docstring)."""
+    k = mesh.shape[axis]
+    itemsize = np.dtype(dtype).itemsize
+    g_bytes = int(np.prod(global_shape)) * itemsize
+    in_spec = partition_spec(step.src, axis)
+    out_spec = partition_spec(step.dst, axis)
+    qb = step.quant_bits
+    aux = []
+
+    if step.primitive == "identity":
+        def local(x):
+            return x
+        fn = local, in_spec, out_spec
+
+    elif step.primitive == "all_gather":
+        d = step.dims[0]
+        local_shape = step.src.local_shape(global_shape)
+        if qb is None:
+            def local(x):
+                return jax.lax.all_gather(x, axis, axis=d, tiled=True)
+        else:
+            n_local = int(np.prod(local_shape))
+            if not _quant_ok(n_local):
+                raise ShardingSpecError(
+                    f"quantized all-gather needs local count "
+                    f"{n_local} % {QUANT_BLOCK} == 0")
+            c = quant_compression(qb, itemsize)
+            aux += [("flat", n_local * itemsize),
+                    ("enc_local", int(c * n_local * itemsize)),
+                    ("enc_gathered", int(c * g_bytes)),
+                    ("decoded", g_bytes)]
+
+            def local(x, _d=d, _ls=local_shape, _qb=qb):
+                flat = x.reshape(-1)
+                carrier, scales = block_encode(flat, _qb)
+                gc = jax.lax.all_gather(carrier, axis, axis=0,
+                                        tiled=True)
+                gs = jax.lax.all_gather(scales, axis, axis=0,
+                                        tiled=True)
+                parts = block_decode(gc, gs, _qb).reshape((k,) + _ls)
+                return jnp.concatenate([parts[i] for i in range(k)],
+                                       axis=_d)
+        fn = local, in_spec, out_spec
+
+    elif step.primitive == "dynamic_slice":
+        d = step.dims[0]
+        size = global_shape[d] // step.dst.partitions(d)
+
+        def local(x, _d=d, _s=size):
+            r = jax.lax.axis_index(axis)
+            return jax.lax.dynamic_slice_in_dim(x, r * _s, _s, axis=_d)
+        fn = local, in_spec, out_spec
+
+    elif step.primitive == "collective_permute":
+        src_d, dst_d = step.dims
+        local_shape = step.src.local_shape(global_shape)
+        piece_shape = list(local_shape)
+        piece_shape[dst_d] //= k
+        piece_count = int(np.prod(piece_shape))
+        piece_bytes = piece_count * itemsize
+        aux += [("pieces", int(np.prod(local_shape)) * itemsize),
+                ("send_piece", piece_bytes), ("rx_piece", piece_bytes)]
+        to_wire = from_wire = None
+        if qb is not None:
+            if not _quant_ok(piece_count):
+                raise ShardingSpecError(
+                    f"quantized permute needs piece count "
+                    f"{piece_count} % {QUANT_BLOCK} == 0")
+            c = quant_compression(qb, itemsize)
+            aux += [("send_enc", int(c * piece_bytes)),
+                    ("rx_enc", int(c * piece_bytes))]
+            _ps = tuple(piece_shape)
+
+            def to_wire(p, _qb=qb):
+                return block_encode(p.reshape(-1), _qb)
+
+            def from_wire(rx, _qb=qb, _shape=_ps):
+                return block_decode(rx[0], rx[1], _qb).reshape(_shape)
+
+        def local(x, _sd=src_d, _dd=dst_d, _tw=to_wire, _fw=from_wire):
+            return ring_all_to_all(axis, k, x, split_axis=_dd,
+                                   concat_axis=_sd, to_wire=_tw,
+                                   from_wire=_fw)
+        fn = local, in_spec, out_spec
+
+    elif step.primitive == "reduce_scatter":
+        d = step.dims[0]
+
+        def local(x, _d=d):
+            # (1, *shape) addend -> shape, then scatter the sum
+            x = x.reshape(x.shape[1:])
+            return jax.lax.psum_scatter(x, axis,
+                                        scatter_dimension=_d,
+                                        tiled=True)
+        fn = local, in_spec, out_spec
+
+    else:
+        raise ShardingSpecError(f"unknown primitive {step.primitive!r}")
+
+    local_fn, in_s, out_s = fn
+    return jax.jit(shard_map(local_fn, mesh=mesh, in_specs=in_s,
+                             out_specs=out_s, check_vma=False)), aux
+
+
+# ---------------------------------------------------------------------------
+# executor
+# ---------------------------------------------------------------------------
+
+
+def execute_plan(plan, carried: np.ndarray, mesh: Mesh, *,
+                 axis: str = "ranks") -> dict:
+    """Run a planner program step by step with per-primitive timing and
+    instrumented buffer accounting; returns
+
+        {shards, wall_s, steps: [{primitive, algorithm, wall_s,
+         buffer_bytes, mem_factor}], measured_mem_factor}
+
+    Each step times to HOST MATERIALIZATION (jax.device_get) — never
+    block_until_ready, whose ack-only return this platform's timing
+    doctrine bans (CLAUDE.md) — and emits a `reshard.step` ledger event
+    so obs/timeline attributes per-primitive wall clock; the run is
+    bracketed by `reshard.plan`/`reshard.done`. Buffer accounting: the
+    REAL largest per-device shard bytes of the step's input and output
+    plus the builder's modeled intermediates (`build_step` aux), as a
+    fraction of global bytes — held against every step's declared
+    factor.
+
+    No reference analog (TPU-native)."""
+    from tpu_reductions.obs import ledger, trace
+    from tpu_reductions.utils import heartbeat
+    from tpu_reductions.utils.timing import Stopwatch
+
+    x_np = np.asarray(carried)
+    dtype = x_np.dtype
+    global_shape = (x_np.shape[1:] if plan.source.partial
+                    else x_np.shape)
+    g_bytes = int(np.prod(global_shape)) * dtype.itemsize
+
+    with trace.child():
+        ledger.emit("reshard.plan", src=plan.source.describe(),
+                    dst=plan.target.describe(),
+                    program=[s.primitive for s in plan.steps],
+                    wire_bytes=int(plan.wire_bytes),
+                    mem_factor=round(plan.mem_factor, 6),
+                    ranks=mesh.shape[axis])
+        x = place_spec(x_np, plan.source, mesh, axis)
+        step_rows = []
+        measured = _shard_fraction(x, g_bytes)
+        total = 0.0
+        for step in plan.steps:
+            fn, aux = build_step(step, mesh, global_shape, dtype, axis)
+            watch = Stopwatch()
+            watch.start()
+            # the step's one blocking device region: dispatch + host
+            # materialization, heartbeat-guarded so a mid-plan relay
+            # stall trips exit 4 instead of hanging (RED019)
+            with heartbeat.guard("reshard.step"):
+                y = fn(x)
+                jax.device_get(y)
+            wall_s = watch.stop()
+            total += wall_s
+            in_b = _max_shard_bytes(x)
+            out_b = _max_shard_bytes(y)
+            aux_b = sum(b for _, b in aux)
+            step_bytes = in_b + out_b + aux_b
+            step_frac = step_bytes / g_bytes
+            measured = max(measured, step_frac)
+            step_rows.append({"primitive": step.primitive,
+                              "algorithm": step.algorithm,
+                              "wall_s": round(wall_s, 6),
+                              "buffer_bytes": int(step_bytes),
+                              "mem_factor": round(step_frac, 6)})
+            ledger.emit("reshard.step", primitive=step.primitive,
+                        algorithm=step.algorithm,
+                        wall_s=round(wall_s, 6),
+                        mem_factor=round(step_frac, 6),
+                        ranks=mesh.shape[axis])
+            x = y
+        shards = collect_shards(x, mesh, axis)
+        ledger.emit("reshard.done", src=plan.source.describe(),
+                    dst=plan.target.describe(), steps=len(plan.steps),
+                    wall_s=round(total, 6),
+                    measured_mem_factor=round(measured, 6))
+    return {"shards": shards, "wall_s": total, "steps": step_rows,
+            "measured_mem_factor": measured}
+
+
+def _max_shard_bytes(y) -> int:
+    return max((s.data.nbytes for s in y.addressable_shards), default=0)
+
+
+def _shard_fraction(y, g_bytes: int) -> float:
+    return _max_shard_bytes(y) / g_bytes
+
+
+def reshard_error_bound(n_quant_steps: int, bits: Optional[int],
+                        max_abs: float) -> float:
+    """Composed declared bound of a plan's quantized crossings: each
+    element crosses each lossy step at most once, each crossing rounds
+    at most half a quantization step of a block whose max is <=
+    max|x| — declared with the suite's 2x margin
+    (collectives/quant.quant_error_bound's convention)."""
+    if not n_quant_steps or bits is None:
+        return 0.0
+    return n_quant_steps * float(max_abs) / levels(bits)

@@ -244,6 +244,22 @@ SESSION_TASKS: Tuple[Task, ...] = (
                             "--out=quant_curve.json"),
          artifacts=("examples/rank_scaling/quant_curve.json",),
          done_artifact="examples/rank_scaling/quant_curve.json"),
+    Task("reshard_curve", "redistribution curve", value=120.0,
+         budget_s=420,
+         # off-chip by design (ISSUE 15; docs/RESHARD.md): the planner's
+         # primitive programs run on the virtual CPU mesh up to 64 ranks
+         # (bench/reshard_curve.py) — safe with the relay dead, so it is
+         # flap-time filler like quant_curve; the committed artifact
+         # lives with the rank-scaling evidence and bench/regen folds
+         # reshard_curve_markdown into report.md from there
+         command=("python -m tpu_reductions.bench.reshard_curve "
+                  "--platform=cpu "
+                  "--out=examples/rank_scaling/reshard_curve.json"),
+         rehearsal_command=("python -m tpu_reductions.bench.reshard_curve "
+                            "--platform=cpu --ranks=2,4 --n=262144 "
+                            "--out=reshard_curve.json"),
+         artifacts=("examples/rank_scaling/reshard_curve.json",),
+         done_artifact="examples/rank_scaling/reshard_curve.json"),
     Task("serving_scale", "open-loop serving scale curve", value=110.0,
          budget_s=600,
          # off-chip by design (ISSUE 13; docs/SERVING.md scaling tier):
