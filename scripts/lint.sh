@@ -1,13 +1,17 @@
 #!/bin/bash
 # redlint convenience wrapper: the same invocation the tier-1 gate
 # (tests/test_lint_clean.py) enforces. Exit 0 = clean, 1 = findings.
-# Runs the whole-program flow layer (RED017-RED020) by default with the
-# fact cache armed at .lint_cache.json (untracked), so a warm re-run is
-# sub-second; --no-flow / --flow-cache= opt out (docs/LINT.md).
+# Runs the whole-program flow + concurrency layers (RED017-RED024) by
+# default with the fact cache armed at .lint_cache.json (untracked), so
+# a warm re-run is sub-second; --no-flow / --flow-cache= opt out
+# (docs/LINT.md).
 #
 #   bash scripts/lint.sh              # lint the gate surface
 #   bash scripts/lint.sh --format=json
-#   bash scripts/lint.sh --graph=dot  # the device-flow call graph
+#   bash scripts/lint.sh --graph=dot  # the flow/conc call graph
+#   bash scripts/lint.sh --changed-only  # per-file rules on git-dirty
+#                                     # files only; flow/conc still
+#                                     # whole-program (pre-commit loop)
 #   bash scripts/lint.sh path.py ...  # lint specific files instead
 set -euo pipefail
 cd "$(dirname "$0")/.."

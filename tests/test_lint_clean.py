@@ -2,16 +2,17 @@
 
 Every hard-won environment rule (CLAUDE.md) the linter encodes is only
 worth anything if the repo enforces it on itself: this test runs the
-full pass — per-file rules AND the whole-program flow layer
-(RED017-RED020, docs/LINT.md) — over the package, the session scripts
-and the repo-root entry points and asserts zero findings; pre-existing
-violations were either fixed or carry a reasoned inline waiver.
+full pass — per-file rules AND the whole-program flow + concurrency
+layers (RED017-RED024, docs/LINT.md) — over the package, the session
+scripts and the repo-root entry points and asserts zero findings, with
+the fact cache cold AND warm; pre-existing violations were either
+fixed or carry a reasoned inline waiver.
 """
 
 import time
 from pathlib import Path
 
-from tpu_reductions.lint.engine import lint_paths
+from tpu_reductions.lint.engine import iter_lintable, lint_paths
 
 REPO = Path(__file__).resolve().parents[1]
 TARGETS = [REPO / "tpu_reductions", REPO / "scripts",
@@ -27,6 +28,31 @@ def test_repo_clean_without_flow_too():
     # the per-file rules must not depend on the flow pass masking them
     findings = lint_paths(TARGETS, flow=False)
     assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_conc_layer_actually_ran(tmp_path):
+    """A repo-clean verdict is only meaningful if the concurrency
+    layer extracted real facts: the serving worker spawn must be a
+    thread root and the known module locks must be lock nodes —
+    checked on a cold build AND through a cache round trip (a cache
+    entry silently missing its conc facts would disable RED021-RED024
+    without failing anything else)."""
+    import json
+
+    from tpu_reductions.lint.flow.dataflow import (build_cached_project,
+                                                   export_graph)
+    py = [f for f in iter_lintable(TARGETS) if f.suffix == ".py"]
+    rels = {f: str(f).replace("\\", "/") for f in py}
+    cache = tmp_path / "cache.json"
+    for attempt in ("cold", "warm"):
+        project = build_cached_project(py, [Path(p) for p in TARGETS],
+                                       rels=rels, cache_path=cache)
+        out = json.loads(export_graph(project, "json"))
+        assert any(r.endswith("ServeEngine._run")
+                   for r in out["thread_roots"]), attempt
+        assert any(lk.endswith("ledger._state_lock")
+                   for lk in out["locks"]), attempt
+        assert out["spawn_edges"], attempt
 
 
 def test_warm_cached_flow_pass_is_fast(tmp_path):

@@ -470,7 +470,8 @@ def test_cli_no_flow_and_graph(tmp_path):
 
 
 def test_flow_rules_constant_matches_docs():
-    assert FLOW_RULES == ("RED017", "RED018", "RED019", "RED020")
+    assert FLOW_RULES == ("RED017", "RED018", "RED019", "RED020",
+                          "RED021", "RED022", "RED023", "RED024")
     docs = (REPO / "docs" / "LINT.md").read_text()
     for rule in FLOW_RULES:
         assert rule in docs

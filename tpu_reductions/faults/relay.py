@@ -74,8 +74,10 @@ class FakeRelay:
         """Bind (reserving the port for the relay's whole life, so a
         refuse phase can re-listen on the same port) and start the
         behavior thread; returns the port."""
+        # redlint: disable=RED021 -- precedes Thread.start: happens-before
         self._listener = self._bind()
         self.port = self._listener.getsockname()[1]
+        # redlint: disable=RED021 -- precedes Thread.start: happens-before
         self._phase_t0 = time.monotonic()
         self._thread = threading.Thread(target=self._serve,
                                         name="fake-relay", daemon=True)
@@ -92,6 +94,7 @@ class FakeRelay:
                 c.close()
             except OSError:
                 pass
+        # redlint: disable=RED021 -- reclaimed after _stop.set + join
         self._held.clear()
 
     def __enter__(self) -> "FakeRelay":

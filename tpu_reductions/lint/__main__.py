@@ -2,16 +2,20 @@
 
     python -m tpu_reductions.lint [paths...] [--format=text|json]
                                   [--no-flow] [--flow-cache=FILE]
-                                  [--graph=dot|json]
+                                  [--graph=dot|json] [--changed-only]
                                   [--fix-docstrings] [--fix-stale-waivers]
 
 Exit codes: 0 clean, 1 findings, 2 usage error (argparse). JSON output
 is a list of {rule, path, line, message} objects — one per violation,
 sorted by (path, line, rule) — for machine consumption (CI annotations,
-the test gate). The whole-program device-flow pass (RED017-RED020,
-lint/flow/) runs by default with a content-hash fact cache at
-.lint_cache.json; --graph prints the resolved call graph + facts
-instead of linting (the ROADMAP-4 seam inventory).
+the test gate). The whole-program device-flow + concurrency pass
+(RED017-RED024, lint/flow/ + lint/conc/) runs by default with a
+content-hash fact cache at .lint_cache.json; --graph prints the
+resolved call graph + facts (thread-root/lock nodes included) instead
+of linting (the ROADMAP-4 seam inventory). --changed-only restricts
+the per-file rules to `git diff`-touched files for fast pre-commit
+iteration while the whole-program pass still covers the full tree
+(docs/LINT.md).
 """
 
 from __future__ import annotations
@@ -39,18 +43,42 @@ def _print_graph(paths, fmt: str, cache: str | None) -> int:
     return 0
 
 
+def _changed_files():
+    """Resolved paths `git` reports as changed vs HEAD (tracked diffs
+    plus untracked non-ignored files); None when git is unavailable or
+    this is not a work tree (callers then lint everything)."""
+    import subprocess
+    from pathlib import Path
+    names = []
+    try:
+        for cmd in (["git", "diff", "--name-only", "HEAD"],
+                    ["git", "ls-files", "--others", "--exclude-standard"]):
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               check=True, timeout=30)
+            names += [ln for ln in r.stdout.splitlines() if ln.strip()]
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return {Path(n).resolve() for n in names}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tpu_reductions.lint",
         description="redlint: static checks for the repo's TPU safety & "
-                    "timing doctrine (rules RED001-RED020; docs/LINT.md)")
+                    "timing doctrine (rules RED001-RED024; docs/LINT.md)")
     p.add_argument("paths", nargs="*", default=None,
                    help="files or directories to lint (default: the "
                         "tpu_reductions package + scripts/)")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--no-flow", action="store_true",
-                   help="skip the whole-program device-flow pass "
-                        "(RED017-RED020; lint/flow/)")
+                   help="skip the whole-program device-flow and "
+                        "concurrency passes (RED017-RED024; lint/flow/ "
+                        "+ lint/conc/)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="run the per-file rules only on files git "
+                        "reports as changed vs HEAD (tracked diffs + "
+                        "untracked); the whole-program flow/conc pass "
+                        "still covers the full tree")
     p.add_argument("--flow-cache", default=".lint_cache.json",
                    metavar="FILE",
                    help="content-hash per-file fact cache for the flow "
@@ -73,6 +101,7 @@ def main(argv=None) -> int:
     paths = ns.paths or ["tpu_reductions", "scripts"]
     flow = not ns.no_flow
     cache = ns.flow_cache or None
+    restrict = _changed_files() if ns.changed_only else None
     try:
         if ns.graph:
             return _print_graph(paths, ns.graph, cache)
@@ -87,7 +116,8 @@ def main(argv=None) -> int:
             for path, line, rules in removed:
                 print(f"fixed: {path}:{line}: removed stale waiver "
                       f"({rules})", file=sys.stderr)
-        findings = lint_paths(paths, flow=flow, flow_cache=cache)
+        findings = lint_paths(paths, flow=flow, flow_cache=cache,
+                              restrict=restrict)
     except FileNotFoundError as e:
         p.error(str(e))
 

@@ -31,11 +31,13 @@ WAIVER_RE = re.compile(
 RULE_MALFORMED_WAIVER = "RED000"
 RULE_STALE_WAIVER = "RED009"
 
-# the interprocedural rules computed by lint/flow/ (docs/LINT.md).
-# Owned here (not in flow/) so the waiver machinery can reason about
-# them without importing the flow package: a waiver naming one of these
-# is only judged stale when the flow analysis actually ran.
-FLOW_RULES = ("RED017", "RED018", "RED019", "RED020")
+# the interprocedural rules computed by lint/flow/ + lint/conc/
+# (docs/LINT.md). Owned here (not in flow/) so the waiver machinery can
+# reason about them without importing the flow package: a waiver naming
+# one of these is only judged stale when the whole-program analysis
+# actually ran.
+FLOW_RULES = ("RED017", "RED018", "RED019", "RED020",
+              "RED021", "RED022", "RED023", "RED024")
 
 _SKIP_DIRS = {".git", "__pycache__", ".jax_cache", "node_modules", ".venv"}
 
@@ -143,7 +145,8 @@ def _parse_waivers(source: str, is_python: bool) -> List[_Waiver]:
 
 
 def _apply_waivers(raw: Iterable[RawFinding], waivers: List[_Waiver],
-                   path: str, flow_active: bool = False) -> List[Finding]:
+                   path: str, flow_active: bool = False,
+                   per_file_active: bool = True) -> List[Finding]:
     findings: List[Finding] = []
     for f in raw:
         suppressed = False
@@ -162,9 +165,15 @@ def _apply_waivers(raw: Iterable[RawFinding], waivers: List[_Waiver],
                 "waiver without a reason — write "
                 "'# redlint: disable=RED00X -- why this is safe'"))
         elif not w.used:
-            if not flow_active and set(w.rules) & flow_set:
-                # RED017-RED020 need the whole-program pass; a
+            rset = set(w.rules)
+            if not flow_active and rset & flow_set:
+                # RED017-RED024 need the whole-program pass; a
                 # single-file lint can't judge their waivers stale
+                continue
+            if not per_file_active and rset - flow_set:
+                # symmetric: under --changed-only the per-file rules
+                # were skipped for this file, so their waivers can't
+                # be judged stale either
                 continue
             findings.append(Finding(
                 RULE_STALE_WAIVER, path, w.line,
@@ -175,13 +184,17 @@ def _apply_waivers(raw: Iterable[RawFinding], waivers: List[_Waiver],
 
 def lint_file(path: Path, rel: str | None = None, *,
               extra_raw: Sequence[RawFinding] = (),
-              flow_active: bool = False) -> List[Finding]:
+              flow_active: bool = False,
+              per_file: bool = True) -> List[Finding]:
     """Lint one file (.py via the AST rules, .sh via the shell pass).
     `rel` overrides the path string used for whitelist suffix matching
     and reporting (defaults to the path as given). `extra_raw` carries
     this file's findings from the whole-program flow pass (lint_paths)
     so they share the per-file waiver machinery; `flow_active` tells the
-    staleness check whether RED017-RED020 waivers can be judged."""
+    staleness check whether RED017-RED024 waivers can be judged.
+    `per_file=False` (the --changed-only path for unchanged files)
+    skips the per-file AST/shell rules but still applies this file's
+    waivers to the whole-program findings in `extra_raw`."""
     rel = rel if rel is not None else str(path)
     rel_posix = rel.replace("\\", "/")
     try:
@@ -189,14 +202,17 @@ def lint_file(path: Path, rel: str | None = None, *,
     except (OSError, UnicodeDecodeError) as e:
         return [Finding("RED???", rel, 1, f"unreadable: {e}")]
     if path.suffix == ".py":
-        raw = list(check_python(rel_posix, source)) + list(extra_raw)
+        raw = (list(check_python(rel_posix, source)) if per_file else []) \
+            + list(extra_raw)
     elif path.suffix == ".sh":
-        raw = list(check_shell(rel_posix, source)) + list(extra_raw)
+        raw = (list(check_shell(rel_posix, source)) if per_file else []) \
+            + list(extra_raw)
     else:
         return []
     waivers = _parse_waivers(source, is_python=path.suffix == ".py")
     return sorted(_apply_waivers(raw, waivers, rel,
-                                 flow_active=flow_active),
+                                 flow_active=flow_active,
+                                 per_file_active=per_file),
                   key=lambda f: (f.line, f.rule))
 
 
@@ -218,13 +234,17 @@ def iter_lintable(paths: Sequence[str | Path]) -> List[Path]:
 
 
 def lint_paths(paths: Sequence[str | Path], *, flow: bool = True,
-               flow_cache: str | Path | None = None) -> List[Finding]:
+               flow_cache: str | Path | None = None,
+               restrict: set | None = None) -> List[Finding]:
     """Lint every .py/.sh file under `paths`; the package's public
     entry point (CLI: python -m tpu_reductions.lint). With `flow` on
-    (the default), the whole-program device-flow pass (lint/flow/)
-    runs over all the .py files together and its RED017-RED020
-    findings merge into the per-file waiver application; `flow_cache`
-    names the content-hash fact cache (.lint_cache.json)."""
+    (the default), the whole-program device-flow + concurrency pass
+    (lint/flow/, lint/conc/) runs over all the .py files together and
+    its RED017-RED024 findings merge into the per-file waiver
+    application; `flow_cache` names the content-hash fact cache
+    (.lint_cache.json). `restrict` (the --changed-only mode) limits
+    the per-file AST/shell rules to the given resolved paths while the
+    whole-program pass still covers everything."""
     files = iter_lintable(paths)
     flow_raw: Dict[str, List[RawFinding]] = {}
     if flow:
@@ -241,7 +261,9 @@ def lint_paths(paths: Sequence[str | Path], *, flow: bool = True,
     findings: List[Finding] = []
     for f in files:
         extra = flow_raw.get(str(f).replace("\\", "/"), [])
-        findings += lint_file(f, extra_raw=extra, flow_active=flow)
+        per_file = restrict is None or f.resolve() in restrict
+        findings += lint_file(f, extra_raw=extra, flow_active=flow,
+                              per_file=per_file)
     return sorted(findings, key=lambda x: (x.path, x.line, x.rule))
 
 

@@ -212,6 +212,27 @@ def _collect_calls(body_nodes: Sequence[ast.AST], bindings: _Bindings,
             for sub in ast.walk(stmt):
                 if isinstance(sub, (ast.Import, ast.ImportFrom)):
                     local.add_import(sub)
+    # local-instance resolution: `e = ServeEngine(cfg)` followed by
+    # `e.submit(req)` resolves through the constructor binding to
+    # `module.ServeEngine.submit`. Capitalized-last-component is the
+    # class heuristic (`out = run_bench()` never maps); a later
+    # reassignment to anything else conservatively unmaps the name.
+    instances: Dict[str, str] = {}
+    for stmt in body_nodes:
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Assign) or len(sub.targets) != 1 \
+                    or not isinstance(sub.targets[0], ast.Name):
+                continue
+            name = sub.targets[0].id
+            if isinstance(sub.value, ast.Call) and \
+                    not isinstance(sub.value.func, ast.Call):
+                chain = _attr_chain(sub.value.func)
+                if chain:
+                    t, _ = local.resolve_chain(chain)
+                    if t.rsplit(".", 1)[-1][:1].isupper():
+                        instances[name] = t
+                        continue
+            instances.pop(name, None)
     calls: List[CallSite] = []
     for stmt in body_nodes:
         for sub in ast.walk(stmt):
@@ -236,6 +257,12 @@ def _collect_calls(body_nodes: Sequence[ast.AST], bindings: _Bindings,
                 rest = chain[len("self."):]
                 target = f"{bindings.module}.{cls}.{rest}"
                 calls.append(CallSite(sub.lineno, chain, target, True))
+                continue
+            root = chain.split(".")[0] if chain else ""
+            if "." in chain and root in instances:
+                rest = chain.split(".", 1)[1]
+                calls.append(CallSite(
+                    sub.lineno, chain, f"{instances[root]}.{rest}", True))
                 continue
             target, resolved = local.resolve_chain(chain)
             calls.append(CallSite(sub.lineno, chain, target, resolved))
@@ -303,6 +330,9 @@ class Project:
         self.modules = modules
         # fqn ('module::qualname') -> (ModuleInfo, FunctionInfo)
         self.nodes: Dict[str, Tuple[ModuleInfo, FunctionInfo]] = {}
+        # module name -> conc.extract.ConcInfo, attached by
+        # dataflow.build_cached_project (empty when built uncached)
+        self.conc: Dict[str, object] = {}
         for mi in modules.values():
             for fi in mi.functions.values():
                 self.nodes[f"{mi.module}::{fi.qualname}"] = (mi, fi)

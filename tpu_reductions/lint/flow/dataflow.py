@@ -48,6 +48,8 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from tpu_reductions.lint import rules as _rules
+from tpu_reductions.lint.conc import analysis as C_analysis
+from tpu_reductions.lint.conc import extract as C
 from tpu_reductions.lint.flow import facts as F
 from tpu_reductions.lint.flow.callgraph import (MAIN_GUARD, ModuleInfo,
                                                 Project, extract_module,
@@ -55,8 +57,12 @@ from tpu_reductions.lint.flow.callgraph import (MAIN_GUARD, ModuleInfo,
 from tpu_reductions.lint.engine import FLOW_RULES  # noqa: F401 (re-export)
 from tpu_reductions.lint.rules import RawFinding, _suffix_match
 
-# cache schema: bumped together with FACTS_SCHEMA_VERSION it keys on
-CACHE_SCHEMA = 1
+# cache schema: bumped together with the fact-schema versions it keys
+# on; the version stamp ALSO carries a content fingerprint of the lint
+# package itself (schema_fingerprint) so ANY redlint upgrade — new
+# recognizer, new rule, changed propagation — invalidates cached facts
+# instead of silently reusing them (ISSUE 16 satellite).
+CACHE_SCHEMA = 2
 
 
 @dataclass
@@ -287,10 +293,14 @@ def _red020(project: Project, summaries: Dict[str, Summary]
     return out
 
 
-def run_flow_rules(project: Project) -> Dict[str, List[RawFinding]]:
+def run_flow_rules(project: Project,
+                   summaries: Optional[Dict[str, Summary]] = None
+                   ) -> Dict[str, List[RawFinding]]:
     """All four interprocedural rules over a seeded, linked project;
-    findings keyed by reporting path."""
-    summaries = compute_summaries(project)
+    findings keyed by reporting path. Pass `summaries` to reuse one
+    compute_summaries fixpoint across the flow and conc passes."""
+    if summaries is None:
+        summaries = compute_summaries(project)
     merged: Dict[str, List[RawFinding]] = {}
     for part in (_red017(project, summaries), _red018(project, summaries),
                  _red019(project, summaries), _red020(project, summaries)):
@@ -306,6 +316,36 @@ def _source_hash(source: str) -> str:
     return hashlib.sha256(source.encode("utf-8", "replace")).hexdigest()
 
 
+_FINGERPRINT: Optional[str] = None
+
+
+def schema_fingerprint() -> str:
+    """Content hash of the lint package's own sources (memoized per
+    process). Part of the cache version stamp: a redlint upgrade —
+    even one that forgot to bump a schema constant — busts the fact
+    cache, because stale facts from an older analyzer are worse than a
+    cold re-extraction (~1 s repo-wide)."""
+    global _FINGERPRINT
+    if _FINGERPRINT is None:
+        import tpu_reductions.lint as _pkg
+        root = Path(_pkg.__file__).resolve().parent
+        h = hashlib.sha256()
+        for f in sorted(root.rglob("*.py")):
+            h.update(f.relative_to(root).as_posix().encode())
+            h.update(b"\0")
+            try:
+                h.update(f.read_bytes())
+            except OSError:
+                pass
+        _FINGERPRINT = h.hexdigest()[:16]
+    return _FINGERPRINT
+
+
+def _cache_version() -> list:
+    return [CACHE_SCHEMA, F.FACTS_SCHEMA_VERSION,
+            C.CONC_SCHEMA_VERSION, schema_fingerprint()]
+
+
 def _load_cache(cache_path: Optional[Path]) -> dict:
     if cache_path is None:
         return {}
@@ -313,7 +353,7 @@ def _load_cache(cache_path: Optional[Path]) -> dict:
         data = json.loads(Path(cache_path).read_text())
     except (OSError, ValueError):
         return {}
-    if data.get("version") != [CACHE_SCHEMA, F.FACTS_SCHEMA_VERSION]:
+    if data.get("version") != _cache_version():
         return {}
     files = data.get("files")
     return files if isinstance(files, dict) else {}
@@ -325,7 +365,7 @@ def _store_cache(cache_path: Optional[Path], entries: dict) -> None:
     from tpu_reductions.utils.jsonio import atomic_json_dump
     try:
         atomic_json_dump(cache_path, {
-            "version": [CACHE_SCHEMA, F.FACTS_SCHEMA_VERSION],
+            "version": _cache_version(),
             "files": entries}, indent=None)
     except OSError:
         pass                              # read-only tree: cache is best-effort
@@ -340,6 +380,7 @@ def build_cached_project(files: Sequence[Path], roots: Sequence[Path],
     cached = _load_cache(cache_path)
     entries: dict = {}
     modules: Dict[str, ModuleInfo] = {}
+    conc: Dict[str, C.ConcInfo] = {}
     for f in files:
         if f.suffix != ".py":
             continue
@@ -351,19 +392,24 @@ def build_cached_project(files: Sequence[Path], roots: Sequence[Path],
             continue
         sha = _source_hash(src)
         mod = module_name_for(f, roots)
+        is_pkg = f.name == "__init__.py"
         hit = cached.get(key)
         if hit and hit.get("sha") == sha and hit.get("module") == mod \
-                and hit.get("rel") == rel:
+                and hit.get("rel") == rel and "conc" in hit:
             mi = ModuleInfo.from_dict(hit["info"])
+            ci = C.ConcInfo.from_dict(hit["conc"])
         else:
-            mi = extract_module(src, mod, rel,
-                                is_pkg=f.name == "__init__.py")
+            mi = extract_module(src, mod, rel, is_pkg=is_pkg)
             F.seed_module(mi)
+            ci = C.extract_conc(src, mod, rel, is_pkg=is_pkg)
         entries[key] = {"sha": sha, "module": mod, "rel": rel,
-                        "info": mi.to_dict()}
+                        "info": mi.to_dict(), "conc": ci.to_dict()}
         modules[mod] = mi
+        conc[mod] = ci
     _store_cache(cache_path, entries)
-    return Project(modules)
+    project = Project(modules)
+    project.conc = conc
+    return project
 
 
 def analyze_flow(files: Sequence[Path], roots: Sequence[Path],
@@ -371,10 +417,18 @@ def analyze_flow(files: Sequence[Path], roots: Sequence[Path],
                  cache_path: Optional[Path] = None
                  ) -> Dict[str, List[RawFinding]]:
     """The engine's flow entry: extract (cached), link, propagate, and
-    return RED017-RED020 raw findings keyed by reporting path."""
+    return RED017-RED024 raw findings keyed by reporting path (the
+    device-flow rules and the concurrency rules share one
+    compute_summaries fixpoint)."""
     project = build_cached_project(files, roots, rels=rels,
                                    cache_path=cache_path)
-    return run_flow_rules(project)
+    summaries = compute_summaries(project)
+    merged = run_flow_rules(project, summaries=summaries)
+    conc_raw = C_analysis.run_conc_rules(project, project.conc,
+                                         summaries=summaries)
+    for rel, lst in conc_raw.items():
+        merged.setdefault(rel, []).extend(lst)
+    return merged
 
 
 # ---------------------------------------------------------------- graph export
@@ -385,6 +439,19 @@ def export_graph(project: Project, fmt: str = "json") -> str:
     consumes: every function node with its facts and resolved edges
     (unresolved call sites included, marked as such)."""
     summaries = compute_summaries(project)
+    conc = getattr(project, "conc", {})
+    locks = sorted({lk for ci in conc.values() for lk in ci.locks})
+    spawn_edges = []
+    for module in sorted(conc):
+        for qual in sorted(conc[module].functions):
+            for sp in conc[module].functions[qual].spawns:
+                callee = project.resolve_target(sp["target"]) \
+                    if sp["target"] else None
+                spawn_edges.append({
+                    "from": f"{module}::{qual}", "to": callee,
+                    "kind": sp["kind"], "line": sp["line"],
+                    "daemon": sp["daemon"]})
+    thread_roots = sorted({e["to"] for e in spawn_edges if e["to"]})
     if fmt == "json":
         nodes = []
         for fqn in sorted(project.nodes):
@@ -412,19 +479,27 @@ def export_graph(project: Project, fmt: str = "json") -> str:
                     unresolved += 1
         return json.dumps({"modules": len(project.modules),
                            "functions": nodes, "edges": edges,
-                           "dynamic_unresolved_calls": unresolved},
+                           "dynamic_unresolved_calls": unresolved,
+                           "locks": locks,
+                           "thread_roots": thread_roots,
+                           "spawn_edges": spawn_edges},
                           indent=1)
     if fmt == "dot":
         lines = ["digraph redlint_flow {", "  rankdir=LR;",
                  "  node [shape=box, fontsize=9];"]
+        root_set = set(thread_roots)
         for fqn in sorted(project.nodes):
             mi, fi = project.nodes[fqn]
             facts = ",".join(sorted(fi.facts)) or "-"
             color = "red" if F.TOUCHES_DEVICE in fi.facts else (
                 "green" if F.GATES in fi.facts else "black")
+            shape = ', peripheries=2' if fqn in root_set else ''
             lines.append(
                 f'  "{fqn}" [label="{mi.module}.{fi.qualname}\\n'
-                f'[{facts}]", color={color}];')
+                f'[{facts}]", color={color}{shape}];')
+        for lk in locks:
+            lines.append(f'  "{lk}" [label="{lk}", shape=ellipse, '
+                         'color=blue, fontsize=9];')
         seen = set()
         for fqn in sorted(project.nodes):
             for cs in project.nodes[fqn][1].calls:
@@ -433,6 +508,12 @@ def export_graph(project: Project, fmt: str = "json") -> str:
                 if callee and (fqn, callee) not in seen:
                     seen.add((fqn, callee))
                     lines.append(f'  "{fqn}" -> "{callee}";')
+        for e in spawn_edges:
+            if e["to"] and (e["from"], e["to"], "spawn") not in seen:
+                seen.add((e["from"], e["to"], "spawn"))
+                lines.append(f'  "{e["from"]}" -> "{e["to"]}" '
+                             '[style=dashed, color=blue, '
+                             f'label="{e["kind"]}"];')
         lines.append("}")
         return "\n".join(lines)
     raise ValueError(f"unknown graph format: {fmt!r}")

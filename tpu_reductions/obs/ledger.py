@@ -53,6 +53,7 @@ import atexit
 import json
 import os
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -75,6 +76,13 @@ _fd: Optional[int] = None
 _path: Optional[str] = None
 _max_bytes: Optional[int] = None
 _session_open = False
+# Serializes every mutation of the module state above (arm/disarm/
+# rotation/session open), which races between the process main thread
+# and emitters on the serving/replica threads (redlint RED021). The
+# emit hot path stays lock-free: it READS _fd once and issues one
+# line-atomic O_APPEND os.write — a concurrent rotation at worst files
+# that line under `<path>.1` (the ENV_MAX_BYTES contract above).
+_state_lock = threading.Lock()
 
 
 def disabled() -> bool:
@@ -106,44 +114,48 @@ def arm(path: Optional[str | os.PathLike] = None) -> Optional[str]:
     """Open (create) the ledger for appending; returns the path or None
     when the recorder stays off. Idempotent for the same path; arming a
     different path closes the previous fd."""
-    global _fd, _path
+    global _fd, _path, _max_bytes
     if disabled():
         return None
     path = resolved_path(path)
     if path is None:
         return None
-    if _fd is not None and _path == path:
-        return path
-    try:
-        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-    except OSError as e:
-        _warn(f"cannot open ledger {path!r}: {e}")
-        return None
-    if _fd is not None:
+    with _state_lock:
+        if _fd is not None and _path == path:
+            return path
         try:
-            os.close(_fd)
-        except OSError:
-            pass
-    _fd, _path = fd, path
-    global _max_bytes
-    try:
-        _max_bytes = int(os.environ.get(ENV_MAX_BYTES, ""))
-        if _max_bytes <= 0:
+            fd = os.open(path,
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        except OSError as e:
+            _warn(f"cannot open ledger {path!r}: {e}")
+            return None
+        if _fd is not None:
+            try:
+                os.close(_fd)
+            except OSError:
+                pass
+        _fd, _path = fd, path
+        try:
+            _max_bytes = int(os.environ.get(ENV_MAX_BYTES, ""))
+            if _max_bytes <= 0:
+                _max_bytes = None
+        except ValueError:
             _max_bytes = None
-    except ValueError:
-        _max_bytes = None
     return path
 
 
 def disarm() -> None:
     """Close the ledger (tests; subprocesses end via session.end)."""
     global _fd, _path, _session_open, _max_bytes
-    if _fd is not None:
-        try:
-            os.close(_fd)
-        except OSError:
-            pass
-    _fd, _path, _session_open, _max_bytes = None, None, False, None
+    with _state_lock:
+        if _fd is not None:
+            try:
+                os.close(_fd)
+            except OSError:
+                pass
+        _fd, _path, _session_open, _max_bytes = None, None, False, None
+    # trace.reset acquires the trace lock — deliberately OUTSIDE
+    # _state_lock so the two module locks never nest (redlint RED022)
     try:
         # a disarmed recorder sheds its trace identity too (tests
         # re-arm fresh sessions; a stale root would chain them)
@@ -243,32 +255,35 @@ def _maybe_rotate(incoming: int) -> None:
     does not already contain; a failed rename just keeps appending to
     the oversized file (hygiene is best-effort, durability is not)."""
     global _fd
-    if _fd is None or _path is None or _max_bytes is None:
-        return
-    try:
-        if os.fstat(_fd).st_size + incoming <= _max_bytes:
+    with _state_lock:
+        if _fd is None or _path is None or _max_bytes is None:
             return
-        os.replace(_path, _path + ".1")
-        fd = os.open(_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
-                     0o644)
-    except OSError:
-        return
-    try:
-        os.close(_fd)
-    except OSError:
-        pass
-    _fd = fd
+        try:
+            if os.fstat(_fd).st_size + incoming <= _max_bytes:
+                return
+            os.replace(_path, _path + ".1")
+            fd = os.open(_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                         0o644)
+        except OSError:
+            return
+        try:
+            os.close(_fd)
+        except OSError:
+            pass
+        _fd = fd
 
 
 _bad_names: set = set()
 
 
 def _warn_once_bad_name(ev: str) -> None:
-    if ev not in _bad_names:
+    with _state_lock:
+        if ev in _bad_names:
+            return
         _bad_names.add(ev)
-        print(f"obs.ledger: dropped event with non-grammar name {ev!r} "
-              "(lint/grammar.py EVENT_NAME_RE)", file=sys.stderr,
-              flush=True)
+    print(f"obs.ledger: dropped event with non-grammar name {ev!r} "
+          "(lint/grammar.py EVENT_NAME_RE)", file=sys.stderr,
+          flush=True)
 
 
 def arm_session(prog: str, argv=None, **fields) -> Optional[str]:
@@ -292,8 +307,10 @@ def arm_session(prog: str, argv=None, **fields) -> Optional[str]:
         pass
     emit("session.start", prog=prog,
          argv=list(argv) if argv is not None else None, **fields)
-    if not _session_open:
+    with _state_lock:
+        register = not _session_open
         _session_open = True
+    if register:
         atexit.register(_end_session)
     return path
 

@@ -183,10 +183,22 @@ class ServeEngine:
         self._stopping = False
         self._stopped = False
         self._ids = itertools.count()
+        # stats counters are bumped from submitter threads (admission)
+        # AND the worker loop; every write funnels through _bump under
+        # this lock (redlint RED021). _exec_lock serializes the lazy
+        # BatchExecutor construction for the same reason — construction
+        # is jax-free (serve/executor.py header), so holding the lock
+        # never wraps a device sync (RED023).
+        self._stats_lock = threading.Lock()
+        self._exec_lock = threading.Lock()
         self.stats: Dict[str, float] = {
             "submitted": 0, "ok": 0, "error": 0, "rejected": 0,
             "expired": 0, "shed": 0, "batches": 0, "batched_requests": 0,
             "preempted": 0, "sharded": 0}
+
+    def _bump(self, key: str, delta: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] = self.stats.get(key, 0) + delta
 
     # -- lifecycle ----------------------------------------------------
 
@@ -251,7 +263,7 @@ class ServeEngine:
         queue bound (with priority preemption)."""
         rid = f"r{next(self._ids):06d}"
         pending = PendingResponse(rid)
-        self.stats["submitted"] += 1
+        self._bump("submitted")
         reason = self._admission_reason(request)
         if reason is not None:
             return self._resolve_at_admission(request, rid, pending,
@@ -309,7 +321,7 @@ class ServeEngine:
                               reason: str) -> PendingResponse:
         """Terminal verdict before the queue: resolve the slot now
         (never entered the queue, so no latency split to report)."""
-        self.stats[status] = self.stats.get(status, 0) + 1
+        self._bump(status)
         resp = ReduceResponse(rid, status, request.method,
                               request.dtype, request.n, error=reason)
         ledger.emit("serve.respond", req=rid, status=status,
@@ -346,7 +358,7 @@ class ServeEngine:
             if victim is None:
                 return f"queue full (depth {len(self._queue)})"
             self._queue.remove(victim)
-            self.stats["preempted"] += 1
+            self._bump("preempted")
             self._respond(victim, "shed",
                           error=(f"priority-preempted: displaced by "
                                  f"priority {request.priority} arrival"))
@@ -400,10 +412,14 @@ class ServeEngine:
                     "supports_f64": False}        # keep serving 32-bit
 
     def _ensure_executor(self):
-        if self._executor is None:
-            from tpu_reductions.serve.executor import BatchExecutor
-            self._executor = BatchExecutor()
-        return self._executor
+        # reached from both submitter threads (capability probes at
+        # admission) and the worker loop — without the lock two racing
+        # first calls build two executors with separate jit caches
+        with self._exec_lock:
+            if self._executor is None:
+                from tpu_reductions.serve.executor import BatchExecutor
+                self._executor = BatchExecutor()
+            return self._executor
 
     # -- responses ----------------------------------------------------
 
@@ -413,7 +429,7 @@ class ServeEngine:
         now = time.monotonic()
         latency = now - adm.t_enqueue
         queue_s = (adm.t_launch - adm.t_enqueue) if adm.t_launch else None
-        self.stats[status] = self.stats.get(status, 0) + 1
+        self._bump(status)
         r = adm.request
         resp = ReduceResponse(adm.request_id, status, r.method, r.dtype,
                               r.n, result=result,
@@ -560,8 +576,8 @@ class ServeEngine:
             return
         dt = time.monotonic() - t0
         self._cost_model.observe(batch.key, dt)
-        self.stats["batches"] += 1
-        self.stats["batched_requests"] += len(live)
+        self._bump("batches")
+        self._bump("batched_requests", len(live))
         ok_count = sum(1 for r in results if r["ok"])
         ledger.emit("serve.verify", batch=batch.batch_id,
                     ok=ok_count, failed=len(live) - ok_count,
@@ -652,9 +668,9 @@ class ServeEngine:
             return
         dt = time.monotonic() - t0
         self._cost_model.observe((r.method, r.dtype, r.n), dt)
-        self.stats["batches"] += 1
-        self.stats["batched_requests"] += 1
-        self.stats["sharded"] += 1
+        self._bump("batches")
+        self._bump("batched_requests")
+        self._bump("sharded")
         ledger.emit("serve.verify", batch=f"p-{adm.request_id}",
                     ok=int(res["ok"]), failed=int(not res["ok"]),
                     exec_s=round(dt, 6),
@@ -708,8 +724,8 @@ class ServeEngine:
             return
         dt = time.monotonic() - t0
         self._cost_model.observe((r.method, r.dtype, r.n), dt)
-        self.stats["batches"] += 1
-        self.stats["batched_requests"] += 1
+        self._bump("batches")
+        self._bump("batched_requests")
         ledger.emit("serve.verify", batch=f"s-{adm.request_id}",
                     ok=int(res["ok"]), failed=int(not res["ok"]),
                     exec_s=round(dt, 6),
