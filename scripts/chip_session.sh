@@ -469,6 +469,15 @@ fallback_static_session() {
             examples/tpu_run/serving_scale.json -- \
         bash scripts/run_serving_scale.sh
 
+    # off-chip by design as well: the elastic autoscaler curve drives
+    # in-process fleets + the local chaos relay on virtual devices,
+    # flap-time filler exactly as the scheduler prices it
+    # (docs/SERVING.md elastic fleet)
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py serving_elastic
+    step "elastic autoscaler curve" 600 \
+            examples/tpu_run/serving_elastic.json -- \
+        bash scripts/run_serving_elastic.sh
+
     # 3 h: the long tail (hazard cells last), and the watcher re-arms
     # on abort — a flagship that wedges slow-but-alive must not pin the
     # watcher past the round

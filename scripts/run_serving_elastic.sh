@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Refresh the committed ELASTIC serving curve (ISSUE 17;
+# docs/SERVING.md "elastic fleet") — off-chip by construction, safe
+# with the relay dead: the loadgen's --elastic mode drives the
+# autoscaler control loop (serve/autoscale.py) against the seeded
+# diurnal open-loop arrival plan at 64/256/1024 clients on
+# --platform=cpu with 8 virtual devices, the per-launch tunnel RTT
+# modeled through a local chaos relay in `slow` mode, then runs the
+# drain-vs-kill contract pair on the same seeded burst: the planned
+# drain hands warm bucket keys to survivors, moves sharded partials
+# via an oracle-verified redistribution program under the declared
+# peak-memory bound, and sheds ZERO requests where the SIGKILL
+# control row sheds in-flight ones. Then the curve is folded into the
+# flagship report next to the scaling curve (bench/regen.py).
+#
+# Usage: bash scripts/run_serving_elastic.sh [out.json] [experiment_dir]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exp="${2:-examples/tpu_run}"
+out="${1:-$exp/serving_elastic.json}"
+
+python -m tpu_reductions.serve.loadgen --platform=cpu --devices=8 \
+    --elastic --plan=diurnal --scale-clients=64,256,1024 --seed=0 \
+    --out="$out"
+
+if [ -d "$exp" ]; then
+    python -m tpu_reductions.bench.regen "$exp"
+fi

@@ -1,0 +1,537 @@
+"""Elastic-fleet coverage (ISSUE 17; tpu_reductions/serve/autoscale.py
++ the router's draining vocabulary): the drain-vs-kill contract on the
+same seeded workload (planned drain sheds ZERO requests where a
+SIGKILL sheds in-flight ones), the free draining re-route (a
+max_retries=0 fleet still drains losslessly), `_pick` skipping
+draining replicas, the autoscaler's hysteresis (no oscillation in the
+up/down gap, cooldown spacing, min/max clamps, p99-breach trigger),
+the oracle-verified partial handoff on the 8-device virtual CPU
+platform (tests/conftest.py), the seeded diurnal arrival plan's
+determinism, and the timeline's elastic-fleet attribution."""
+
+import threading
+import time
+import random
+import zlib
+
+import numpy as np
+import pytest
+
+from tpu_reductions.obs.timeline import autoscale_summary
+from tpu_reductions.ops import oracle
+from tpu_reductions.serve.autoscale import (Autoscaler, drain_replica,
+                                            _reshard_partials)
+from tpu_reductions.serve.engine import ServeEngine
+from tpu_reductions.serve.loadgen import (DIURNAL_EPOCHS,
+                                          diurnal_epoch_counts,
+                                          elastic_markdown,
+                                          open_arrivals, plan_workload)
+from tpu_reductions.serve.request import ReduceRequest, ReduceResponse
+from tpu_reductions.serve.router import (LocalReplica, ReplicaRouter,
+                                         replica_draining,
+                                         replica_failure)
+
+
+class FakeExecutor:
+    """Deterministic device stand-in (same as tests/test_serve_scale):
+    resolves with the payload's real oracle value, no jax."""
+
+    def __init__(self, delay_s=0.0, hold=None):
+        self.delay_s = delay_s
+        self.hold = hold              # threading.Event: block until set
+        self.launches = []
+
+    def capabilities(self):
+        return {"backend": "cpu", "supports_f64": True,
+                "device_count": 1}
+
+    def run_batch(self, method, dtype, n, seeds):
+        self.launches.append((method, dtype, n, tuple(seeds)))
+        if self.hold is not None:
+            assert self.hold.wait(timeout=30)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        out = []
+        from tpu_reductions.utils.rng import host_data
+        for s in seeds:
+            host = oracle.host_reduce(host_data(n, dtype, seed=s),
+                                      method)
+            v = float(np.asarray(host, dtype=np.float64))
+            out.append({"result": v, "ok": True, "host": v,
+                        "diff": 0.0})
+        return out
+
+
+def _affine_n(idx, n_alive, method="SUM", dtype="int32", start=64):
+    """Smallest n >= start whose jit-bucket key hashes to alive-list
+    index `idx` — the router's own crc32 spelling."""
+    n = start
+    while zlib.crc32(f"{method}:{dtype}:{n}".encode()) % n_alive != idx:
+        n += 1
+    return n
+
+
+def _pair(hold=None, max_retries=2):
+    """(router, victim, survivor, victim_ex, survivor_ex): a 2-replica
+    fleet whose victim executor optionally blocks on `hold` — the
+    in-flight-work shape both halves of the drain-vs-kill contract
+    start from."""
+    ex_s, ex_v = FakeExecutor(), FakeExecutor(hold=hold)
+    surv = LocalReplica("survivor", ServeEngine(executor=ex_s,
+                                                coalesce_window_s=0.0))
+    victim = LocalReplica("victim", ServeEngine(executor=ex_v,
+                                                coalesce_window_s=0.0))
+    router = ReplicaRouter([surv, victim],
+                           max_retries=max_retries).start()
+    return router, victim, surv, ex_v, ex_s
+
+
+# ------------------------------------------- the draining vocabulary
+
+
+def test_replica_draining_mark_distinct_from_dead():
+    """`replica-draining` is its OWN terminal vocabulary: the draining
+    predicate matches it, the failure predicate does NOT (a drain is
+    planned, not a fault), and replica-dead stays a failure."""
+    def resp(status, error=None):
+        return ReduceResponse("r0", status, "SUM", "int", 64,
+                              error=error)
+
+    draining = resp("rejected", "replica-draining: admission closed "
+                                "for planned scale-down")
+    assert replica_draining(draining)
+    assert not replica_failure(draining)
+    dead = resp("error", "replica-dead: child exited")
+    assert replica_failure(dead)
+    assert not replica_draining(dead)
+    assert not replica_draining(resp("ok"))
+
+
+def test_pick_skips_draining_replica():
+    """Once a replica drains, `_pick` stops hashing new
+    bucket-affinity keys to it — recurrences of a key that used to
+    land there re-hash among the survivors."""
+    router, victim, surv, ex_v, ex_s = _pair()
+    try:
+        n = _affine_n(1, 2)          # alive=[survivor, victim] -> victim
+        assert router.submit(ReduceRequest(
+            method="SUM", dtype="int32", n=n)).result(30).status == "ok"
+        assert len(ex_v.launches) == 1
+        victim.drain_begin()
+        assert router.submit(ReduceRequest(
+            method="SUM", dtype="int32", n=n)).result(30).status == "ok"
+        assert len(ex_v.launches) == 1       # victim saw nothing new
+        assert len(ex_s.launches) == 1
+    finally:
+        router.stop()
+
+
+def test_drain_reroute_is_free_at_max_retries_zero():
+    """The free re-route: a request that reaches a draining replica
+    (the drain-began-after-pick race) re-routes WITHOUT burning a
+    max_retries attempt — a max_retries=0 fleet still loses nothing
+    to a planned drain."""
+    router, victim, surv, ex_v, ex_s = _pair(max_retries=0)
+    try:
+        victim._engine.begin_drain()
+        # the router cannot see the drain (the race window): _pick
+        # still selects the victim, whose engine then rejects
+        victim.draining = lambda: False
+        n = _affine_n(1, 2)
+        resp = router.submit(ReduceRequest(
+            method="SUM", dtype="int32", n=n)).result(30)
+        assert resp.status == "ok", resp.error
+        assert router.stats["drain_rerouted"] == 1
+        assert router.stats["rerouted"] == 0
+        assert len(ex_s.launches) == 1
+    finally:
+        router.stop()
+
+
+def test_all_draining_fleet_terminates_not_loops():
+    """`tried` keeps the draining victim, so a fleet that is ALL
+    draining resolves to the no-replica-alive terminal instead of
+    re-routing forever."""
+    router, victim, surv, ex_v, ex_s = _pair(max_retries=0)
+    try:
+        for rep in (victim, surv):
+            rep._engine.begin_drain()
+            rep.draining = lambda: False     # hide both drains
+        resp = router.submit(ReduceRequest(
+            method="SUM", dtype="int32", n=64)).result(30)
+        assert resp.status == "error"
+        assert "no-replica-alive" in (resp.error or "")
+    finally:
+        router.stop()
+
+
+# ------------------------------------------- the drain-vs-kill contract
+
+
+def test_drain_sheds_zero_and_hands_off_warm_keys():
+    """The planned half of the contract: drain mid-burst -> every
+    in-flight and queued request finishes on the victim (shed == 0,
+    expired == 0), the warm bucket key lands prewarmed on the
+    survivor affinity will hash it to, and the victim leaves the
+    routing table only after."""
+    hold = threading.Event()
+    router, victim, surv, ex_v, ex_s = _pair(hold=hold)
+    try:
+        n = _affine_n(1, 2)
+        first = router.submit(ReduceRequest(method="SUM",
+                                            dtype="int32", n=n))
+        time.sleep(0.1)              # worker takes it, blocks on hold
+        rest = [router.submit(ReduceRequest(method="SUM",
+                                            dtype="int32", n=n,
+                                            seed=i))
+                for i in range(1, 5)]
+
+        evidence = {}
+        fx = FakeExecutor()          # device_count=1: no mesh to move
+
+        def _drain():
+            evidence.update(drain_replica(router, victim,
+                                          executor=fx))
+
+        t = threading.Thread(target=_drain)
+        t.start()
+        time.sleep(0.2)
+        assert t.is_alive()          # waiting on the in-flight work
+        hold.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+
+        assert first.result(30).status == "ok"
+        assert all(p.result(30).status == "ok" for p in rest)
+        assert evidence["drained"] is True
+        assert evidence["victim_stats"]["shed"] == 0
+        assert evidence["victim_stats"]["expired"] == 0
+        assert evidence["reshard"] is None        # single-device
+        key = ("SUM", "int32", n)
+        assert {"key": ["SUM", "int32", n], "target": "survivor"} \
+            in evidence["handoff"]
+        assert key in surv._engine.warm_bucket_keys()
+        assert [r.replica_id for r in router.replicas] == ["survivor"]
+        assert router.stats["rerouted"] == 0
+    finally:
+        hold.set()
+        router.stop()
+
+
+def test_kill_sheds_inflight_where_drain_does_not():
+    """The control half: the SAME workload shape, but the victim is
+    killed instead of drained — its queued requests shed (the loss a
+    planned drain avoids), and only the router's retry budget saves
+    them."""
+    hold = threading.Event()
+    router, victim, surv, ex_v, ex_s = _pair(hold=hold)
+    try:
+        n = _affine_n(1, 2)
+        first = router.submit(ReduceRequest(method="SUM",
+                                            dtype="int32", n=n))
+        time.sleep(0.1)              # worker takes it, blocks on hold
+        rest = [router.submit(ReduceRequest(method="SUM",
+                                            dtype="int32", n=n,
+                                            seed=i))
+                for i in range(1, 5)]
+        assert victim.queued_depth() > 0
+
+        t = threading.Thread(target=victim.kill)
+        t.start()
+        time.sleep(0.1)
+        shed = victim.stats()["shed"]
+        assert shed > 0              # the in-flight loss drain avoids
+        hold.set()
+        t.join(timeout=30)
+        # the retry budget re-routes the shed requests to the survivor
+        assert all(p.result(30).status == "ok" for p in [first] + rest)
+        assert router.stats["rerouted"] >= shed
+    finally:
+        hold.set()
+        router.stop()
+
+
+def test_drain_step_fault_turns_drain_into_kill(monkeypatch):
+    """The `drain.step` fault point (faults/inject.py): a scripted
+    raise after quiesce aborts the drain mid-protocol — no handoff,
+    no reshard, the degenerate kill-like exit the chaos suite
+    contrasts with a clean drain."""
+    from tpu_reductions.faults import inject
+    monkeypatch.setenv("TPU_REDUCTIONS_FAULTS",
+                       '{"drain.step": {"action": "raise"}}')
+    inject.reset()
+    router, victim, surv, ex_v, ex_s = _pair()
+    try:
+        with pytest.raises(inject.InjectedFault):
+            drain_replica(router, victim, executor=FakeExecutor())
+        # the drain never reached the handoff or the routing-table exit
+        assert [r.replica_id for r in router.replicas] \
+            == ["survivor", "victim"]
+    finally:
+        inject.reset()
+        router.stop()
+
+
+# ------------------------------------------- the partial-state handoff
+
+
+def test_reshard_partials_oracle_verified_under_mem_bound():
+    """The drain's state handoff on the real 8-device virtual mesh:
+    the planner-emitted partial->row-sharded program executes through
+    executor.run_reshard, verifies element-wise against the numpy
+    oracle, and its measured peak-memory factor stays <= the declared
+    bound."""
+    from tpu_reductions.serve.executor import BatchExecutor
+    res = _reshard_partials("victim", executor=BatchExecutor(),
+                            mem_bound=2.0, seed=3)
+    assert res is not None
+    assert res["ok"] is True
+    assert res["ranks"] == 8
+    assert res["program"]            # a real redistribution ran
+    assert res["mem_ok"] is True
+    assert res["measured_mem_factor"] <= res["mem_factor"] + 1e-9
+    assert res["max_err"] <= res["bound"]
+
+
+# ------------------------------------------- the autoscaler control loop
+
+
+class _FakeRep:
+    def __init__(self, rid, fleet):
+        self.replica_id = rid
+        self._fleet = fleet
+
+    def start(self):
+        return self
+
+    def alive(self):
+        return True
+
+    def draining(self):
+        return False
+
+    def queued_depth(self):
+        return self._fleet.queued
+
+    def slo_p99(self, slo):
+        return self._fleet.p99
+
+    def warm_bucket_keys(self):
+        return []
+
+    def prewarm(self, method, dtype, n, **kw):
+        pass
+
+    def drain_begin(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def stats(self):
+        return {}
+
+
+class _FakeFleet:
+    """Router stand-in with dial-a-load signals: `outstanding` and
+    `queued` are per-replica, `p99` feeds every replica's tracker —
+    the oscillation test drives tick() against exact scenarios."""
+
+    def __init__(self, n):
+        self._reps = [_FakeRep(f"f{i}", self) for i in range(n)]
+        self.outstanding = 0
+        self.queued = 0
+        self.p99 = None
+
+    @property
+    def replicas(self):
+        return list(self._reps)
+
+    def load_snapshot(self):
+        return {"outstanding": {r.replica_id: self.outstanding
+                                for r in self._reps},
+                "stats": {},
+                "replicas": [{"replica": r.replica_id, "alive": True,
+                              "draining": False} for r in self._reps]}
+
+    def add_replica(self, rep):
+        self._reps.append(rep)
+
+    def remove_replica(self, rid):
+        self._reps = [r for r in self._reps if r.replica_id != rid]
+
+    def affinity_target(self, method, dtype, n, exclude=()):
+        alive = [r for r in self._reps if r.replica_id not in exclude]
+        return alive[0] if alive else None
+
+
+def _scaler(fleet, t, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("down_ticks", 3)
+    return Autoscaler(fleet, lambda i: _FakeRep(f"s{i}", fleet),
+                      executor=FakeExecutor(), clock=lambda: t[0],
+                      **kw)
+
+
+def test_autoscaler_scales_up_under_load_with_cooldown():
+    fleet, t = _FakeFleet(1), [0.0]
+    auto = _scaler(fleet, t)
+    fleet.outstanding = 10           # load 10 > up_load 4
+    assert auto.tick()["action"] == "up"
+    assert len(fleet.replicas) == 2
+    t[0] = 1.0                       # inside the cooldown
+    assert auto.tick()["action"] == "hold"
+    assert len(fleet.replicas) == 2
+    t[0] = 11.0                      # cooldown over, still loaded
+    assert auto.tick()["action"] == "up"
+    assert len(fleet.replicas) == 3
+    t[0] = 22.0                      # at max: clamp
+    assert auto.tick()["action"] == "hold"
+    assert len(fleet.replicas) == 3
+
+
+def test_autoscaler_hysteresis_holds_in_the_gap():
+    """Load between down_load and up_load is the hysteresis gap: the
+    fleet NEVER oscillates there, however long it sits."""
+    fleet, t = _FakeFleet(2), [100.0]
+    auto = _scaler(fleet, t)
+    fleet.outstanding = 1            # per-replica load 2: in the gap
+    for i in range(20):
+        t[0] += 10.0                 # every tick past the cooldown
+        assert auto.tick()["action"] == "hold"
+    assert len(fleet.replicas) == 2
+    assert auto.drains == []
+
+
+def test_autoscaler_scales_down_after_consecutive_calm_ticks():
+    fleet, t = _FakeFleet(2), [100.0]
+    auto = _scaler(fleet, t)
+    fleet.outstanding = 0            # calm
+    assert auto.tick()["action"] == "hold"      # calm 1
+    assert auto.tick()["action"] == "hold"      # calm 2
+    rec = auto.tick()                           # calm 3 -> drain
+    assert rec["action"] == "down"
+    assert len(fleet.replicas) == 1
+    assert len(auto.drains) == 1
+    assert auto.drains[0]["victim_stats"] == {}
+    # at the min floor, calm ticks never drain below
+    t[0] = 200.0
+    for _ in range(5):
+        assert auto.tick()["action"] == "hold"
+    assert len(fleet.replicas) == 1
+
+
+def test_autoscaler_interrupted_calm_run_resets_the_counter():
+    fleet, t = _FakeFleet(2), [100.0]
+    auto = _scaler(fleet, t)
+    fleet.outstanding = 0
+    auto.tick()
+    auto.tick()                      # calm 2
+    fleet.outstanding = 1            # back in the gap: calm resets
+    auto.tick()
+    fleet.outstanding = 0
+    auto.tick()
+    auto.tick()                      # calm 2 again — not 3
+    assert len(fleet.replicas) == 2
+    assert auto.tick()["action"] == "down"
+    assert len(fleet.replicas) == 1
+
+
+def test_autoscaler_p99_breach_triggers_scale_up_at_zero_load():
+    fleet, t = _FakeFleet(1), [0.0]
+    auto = _scaler(fleet, t, slo_classes={"std": 0.2})
+    fleet.p99 = 0.5                  # observed tail over the deadline
+    rec = auto.tick()
+    assert rec["p99_breach"] is True
+    assert rec["action"] == "up"
+    assert len(fleet.replicas) == 2
+
+
+def test_autoscaler_validates_bounds():
+    fleet = _FakeFleet(1)
+    with pytest.raises(ValueError):
+        Autoscaler(fleet, lambda i: _FakeRep(f"s{i}", fleet),
+                   min_replicas=4, max_replicas=2)
+
+
+# ------------------------------------------- the diurnal arrival plan
+
+
+def test_diurnal_plan_is_seed_deterministic():
+    """Same seed -> identical offsets AND requests; different seed ->
+    a different plan (the elastic curve's replay contract)."""
+    kw = dict(count=100, methods=("SUM", "MIN"), dtype="int32",
+              n_choices=(4096, 8192), rate_rps=50.0,
+              process="diurnal", slo="std")
+    a = plan_workload(7, **kw)
+    b = plan_workload(7, **kw)
+    assert [off for off, _ in a] == [off for off, _ in b]
+    assert [(r.method, r.n, r.seed, r.slo) for _, r in a] \
+        == [(r.method, r.n, r.seed, r.slo) for _, r in b]
+    c = plan_workload(8, **kw)
+    assert [off for off, _ in a] != [off for off, _ in c]
+
+
+def test_diurnal_offsets_monotone_and_fully_allocated():
+    rng = random.Random(3)
+    offs = open_arrivals(rng, count=250, rate_rps=100.0,
+                         process="diurnal")
+    assert len(offs) == 250
+    assert offs == sorted(offs)
+    assert all(o >= 0 for o in offs)
+    assert sum(diurnal_epoch_counts(250)) == 250
+    assert abs(sum(f for _, f, _, _ in DIURNAL_EPOCHS) - 1.0) < 1e-9
+
+
+# ------------------------------------------- artifact + attribution
+
+
+def test_elastic_markdown_contract_line():
+    art = {"plan": "diurnal", "slo_s": 5.0, "autoscale_min": 1,
+           "autoscale_max": 8, "cooldown_s": 0.75, "seed": 0,
+           "platform": "cpu",
+           "rows": [
+               {"key": "elastic@64@diurnal", "clients": 64,
+                "rps": 8.0, "p99_ms": 90.0, "p99_in_slo": True,
+                "replicas_min": 1, "replicas_max": 3, "scale_ups": 2,
+                "scale_downs": 2, "ok": 64, "by_status": {"ok": 64}},
+               {"key": "drain", "victim_shed": 0,
+                "reshard": {"program": ["reduce_scatter"], "ok": True,
+                            "measured_mem_factor": 1.125,
+                            "mem_factor": 1.125}},
+               {"key": "kill", "victim_shed": 3}]}
+    md = elastic_markdown(art)
+    assert "| 64 | 8.0 | 90.0 | yes | 1..3 | 2 | 2 | 64 | - |" in md
+    assert "planned drain shed 0 requests" in md
+    assert "SIGKILL shed 3" in md
+    assert "oracle-verified=True" in md
+
+
+def test_timeline_autoscale_summary():
+    events = [
+        {"t": 0.0, "ev": "autoscale.tick", "replicas": 1,
+         "load_per_replica": 5.0, "action": "up"},
+        {"t": 0.1, "ev": "autoscale.up", "replica": "s1",
+         "prewarmed": 4},
+        {"t": 0.2, "ev": "autoscale.tick", "replicas": 2,
+         "load_per_replica": 0.5, "action": "hold"},
+        {"t": 0.3, "ev": "autoscale.down", "replica": "s1"},
+        {"t": 0.3, "ev": "drain.reshard", "replica": "s1",
+         "program": "reduce_scatter", "wall_s": 0.01,
+         "measured_mem_factor": 1.125},
+        {"t": 0.4, "ev": "drain.done", "replica": "s1",
+         "waited_s": 0.05, "keys": 4, "shed": 0, "expired": 0,
+         "reshard_ok": True},
+    ]
+    s = autoscale_summary(events)
+    assert s["ticks"] == 2 and s["ups"] == 1 and s["downs"] == 1
+    assert s["prewarmed"] == 4
+    assert s["replicas_min"] == 1 and s["replicas_max"] == 2
+    assert s["load_max"] == 5.0
+    d = s["drains"][0]
+    assert d["shed"] == 0 and d["reshard_ok"] is True
+    assert d["program"] == "reduce_scatter"
+    assert d["measured_mem_factor"] == 1.125
+    assert autoscale_summary([{"t": 0, "ev": "serve.start"}]) is None

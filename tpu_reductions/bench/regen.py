@@ -291,6 +291,21 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                 "(serving_scale.json)")
         except (OSError, ValueError, KeyError, TypeError) as e:
             log(f"regen: serving_scale.json unusable ({e}); skipped")
+    # the elastic autoscaler curve (ISSUE 17): replica count tracking
+    # the diurnal load plan + the drain-vs-kill contract row,
+    # committed by serve/loadgen.py --elastic
+    # (scripts/run_serving_elastic.sh)
+    el_file = out / "serving_elastic.json"
+    if el_file.exists():
+        try:
+            from tpu_reductions.serve.loadgen import elastic_markdown
+            el = json.loads(el_file.read_text())
+            with open(paths["md"], "a") as f:
+                f.write("\n" + elastic_markdown(el) + "\n")
+            log("regen: appended elastic-fleet table "
+                "(serving_elastic.json)")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: serving_elastic.json unusable ({e}); skipped")
     # the streaming pipeline's committed probes (ISSUE 7 evidence,
     # ISSUE 8 relocation: the ONE copy lives in the experiment dir —
     # the PR-6 serving_curve dedup rule applied to stream artifacts)

@@ -92,6 +92,15 @@ def _env_bytes(name: str) -> Optional[int]:
         return None
 
 
+def _env_float(name: str) -> Optional[float]:
+    import os
+    try:
+        v = float(os.environ[name])
+        return v if v >= 0 else None
+    except (KeyError, ValueError):
+        return None
+
+
 def stage_chunk_bytes(override: Optional[int] = None) -> int:
     """The effective per-message host->device chunk bound: explicit
     argument (the --chunk-bytes flag), else the
@@ -128,6 +137,49 @@ def shard_threshold_bytes(override: Optional[int] = None) -> int:
         return int(override)
     return _env_bytes("TPU_REDUCTIONS_SHARD_THRESHOLD_BYTES") \
         or DEFAULT_SHARD_THRESHOLD_BYTES
+
+
+# ---------------------------------------------------------------------------
+# Elastic serving fleet bounds (serve/autoscale.py; docs/SERVING.md
+# "elastic fleet"). Same knob discipline as the staging bounds above:
+# explicit argument > env override > default, ONE home for all three
+# (docs/RESILIENCE.md env-knob table).
+# ---------------------------------------------------------------------------
+
+DEFAULT_AUTOSCALE_MIN = 1
+DEFAULT_AUTOSCALE_MAX = 8
+DEFAULT_AUTOSCALE_COOLDOWN_S = 5.0
+
+
+def autoscale_min(override: Optional[int] = None) -> int:
+    """Floor on the elastic fleet's replica count: explicit argument,
+    else TPU_REDUCTIONS_AUTOSCALE_MIN, else 1 (the autoscaler never
+    drains the last replica below this)."""
+    if override is not None and override > 0:
+        return int(override)
+    return _env_bytes("TPU_REDUCTIONS_AUTOSCALE_MIN") \
+        or DEFAULT_AUTOSCALE_MIN
+
+
+def autoscale_max(override: Optional[int] = None) -> int:
+    """Ceiling on the elastic fleet's replica count: explicit argument,
+    else TPU_REDUCTIONS_AUTOSCALE_MAX, else 8 (a burst can never spawn
+    replicas past this, however far p99 drifts)."""
+    if override is not None and override > 0:
+        return int(override)
+    return _env_bytes("TPU_REDUCTIONS_AUTOSCALE_MAX") \
+        or DEFAULT_AUTOSCALE_MAX
+
+
+def autoscale_cooldown_s(override: Optional[float] = None) -> float:
+    """Minimum seconds between scaling actions: explicit argument, else
+    TPU_REDUCTIONS_AUTOSCALE_COOLDOWN_S, else 5 s — one half of the
+    oscillation damping (the other is the consecutive-calm-tick
+    hysteresis; serve/autoscale.Autoscaler)."""
+    if override is not None and override >= 0:
+        return float(override)
+    env = _env_float("TPU_REDUCTIONS_AUTOSCALE_COOLDOWN_S")
+    return env if env is not None else DEFAULT_AUTOSCALE_COOLDOWN_S
 
 # Kernel ids: the reference kept only kernel 6 live and emptied 0-5
 # (reduction_kernel.cu:278-289). We map 6 -> single-pass fold-accumulator

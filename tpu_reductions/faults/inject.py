@@ -53,7 +53,13 @@ reshard-curve cell just before its plan executes,
 bench/reshard_curve.py — a scripted `stall` mid-curve rehearses a
 relay death between redistribution cells, and the re-invoked curve
 must resume its persisted cell rows byte-identically,
-tests/test_reshard_chaos.py). docs/RESILIENCE.md keeps the list.
+tests/test_reshard_chaos.py), and `drain.step` (fired once per
+planned replica drain after the wait-for-quiesce and before the
+warm-key handoff, serve/autoscale.drain_replica — a scripted `raise`
+there is the "drain interrupted mid-protocol" case the drain-vs-kill
+contract contrasts: the victim dies like a SIGKILL instead of
+finishing the handoff, tests/test_serve_elastic.py).
+docs/RESILIENCE.md keeps the list.
 
 Counters are process-global and monotonic; `reset()` re-arms them for
 in-process tests (subprocesses start fresh by construction).

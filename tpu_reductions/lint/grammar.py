@@ -167,6 +167,18 @@ COLLECTIVE_EVENTS = ("collective.select", "collective.launch",
 # reshard_summary attributes redistribution wall-clock per primitive
 RESHARD_EVENTS = ("reshard.plan", "reshard.step", "reshard.done")
 
+# the elastic fleet's typed events (serve/autoscale.py; ISSUE 17 —
+# docs/SERVING.md "elastic fleet"): autoscale.tick records one
+# control-loop observation (load, p99, action), autoscale.up/down the
+# scaling actions; drain.begin -> wait -> handoff -> reshard -> done
+# is the planned scale-down protocol — drain.reshard carries the
+# redistribution program's oracle verdict + measured peak-memory
+# factor. Consumer: obs/timeline.py's autoscale_summary
+# (replica-count-vs-load attribution)
+AUTOSCALE_EVENTS = ("autoscale.tick", "autoscale.up", "autoscale.down")
+DRAIN_EVENTS = ("drain.begin", "drain.wait", "drain.handoff",
+                "drain.reshard", "drain.done")
+
 # the compile observatory's typed events (obs/compile.py; ISSUE 8 —
 # docs/OBSERVABILITY.md "reading the compile table"): every XLA/Pallas
 # compile bracketed with its surface id, lower/compile split where the
@@ -214,7 +226,8 @@ REGISTERED_EVENTS = frozenset(CORE_EVENTS + SHELL_EVENTS + SCHED_EVENTS
                               + SERVE_EVENTS + STREAM_EVENTS
                               + COMPILE_EVENTS + COLLECTIVE_EVENTS
                               + ROUTE_EVENTS + REPLICA_EVENTS
-                              + RESHARD_EVENTS)
+                              + RESHARD_EVENTS + AUTOSCALE_EVENTS
+                              + DRAIN_EVENTS)
 
 
 def event_registered(name: str) -> bool:

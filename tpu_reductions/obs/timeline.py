@@ -469,6 +469,51 @@ def reshard_summary(events: List[dict]) -> Optional[dict]:
             "primitives": [prims[p] for p in order]}
 
 
+def autoscale_summary(events: List[dict]) -> Optional[dict]:
+    """Replica-count-vs-load attribution from the elastic fleet's
+    typed events (lint/grammar.py AUTOSCALE_EVENTS/DRAIN_EVENTS;
+    serve/autoscale.py — ISSUE 17). Per tick: how many replicas were
+    active against what per-replica load; per action: scale-ups with
+    their prewarm counts and scale-downs with the drain protocol's
+    evidence (wait wall-clock, handed-off keys, shed count, the
+    oracle verdict on the redistribution program). None when no
+    autoscaler ran."""
+    ticks = [e for e in events if e["ev"] == "autoscale.tick"]
+    ups = [e for e in events if e["ev"] == "autoscale.up"]
+    downs = [e for e in events if e["ev"] == "autoscale.down"]
+    dones = [e for e in events if e["ev"] == "drain.done"]
+    if not ticks and not ups and not downs and not dones:
+        return None
+    counts = [e["replicas"] for e in ticks
+              if isinstance(e.get("replicas"), int)]
+    loads = [float(e["load_per_replica"]) for e in ticks
+             if isinstance(e.get("load_per_replica"), (int, float))]
+    resh_by_replica = {e.get("replica"): e for e in events
+                       if e["ev"] == "drain.reshard"}
+    drains = []
+    for e in dones:
+        rec = {"replica": e.get("replica"),
+               "waited_s": e.get("waited_s"),
+               "keys": e.get("keys"),
+               "shed": e.get("shed"), "expired": e.get("expired"),
+               "reshard_ok": e.get("reshard_ok")}
+        resh = resh_by_replica.get(e.get("replica"))
+        if resh is not None:
+            rec["program"] = resh.get("program")
+            rec["reshard_s"] = resh.get("wall_s")
+            rec["measured_mem_factor"] = resh.get("measured_mem_factor")
+        drains.append(rec)
+    out = {"ticks": len(ticks), "ups": len(ups), "downs": len(downs),
+           "prewarmed": sum(int(e.get("prewarmed", 0)) for e in ups),
+           "drains": drains}
+    if counts:
+        out["replicas_min"] = min(counts)
+        out["replicas_max"] = max(counts)
+    if loads:
+        out["load_max"] = round(max(loads), 4)
+    return out
+
+
 def compile_summary(events: List[dict]) -> Optional[dict]:
     """Per-surface compile attribution from the compile observatory's
     typed events (compile.start/end, warm.* — lint/grammar.py
@@ -533,6 +578,9 @@ def summarize(path, events: List[dict], torn: int) -> dict:
     resh = reshard_summary(events)
     if resh is not None:
         out["reshard"] = resh
+    auto = autoscale_summary(events)
+    if auto is not None:
+        out["autoscale"] = auto
     comp = compile_summary(events)
     if comp is not None:
         out["compile"] = comp
@@ -774,6 +822,43 @@ def summary_markdown(summary: dict) -> str:
                      f"{resh['programs']} program(s) executed, "
                      f"{resh['reshard_s']:.2f} s in reshard device "
                      "phases")
+    auto = summary.get("autoscale")
+    if auto:
+        # the elastic fleet's record (ISSUE 17): replica count vs
+        # load across the window + per-drain protocol evidence — the
+        # committed proof that planned scale-down sheds nothing
+        lines.append("")
+        lines.append("### elastic fleet (replica count vs load)")
+        lines.append("")
+        span = (f"replicas {auto['replicas_min']}.."
+                f"{auto['replicas_max']}"
+                if auto.get("replicas_max") is not None else "replicas ?")
+        lines.append(
+            f"{auto['ticks']} control tick(s), {span}, "
+            f"{auto['ups']} scale-up(s) "
+            f"({auto['prewarmed']} key(s) prewarmed), "
+            f"{auto['downs']} planned drain(s)"
+            + (f"; peak load/replica {auto['load_max']}"
+               if auto.get("load_max") is not None else ""))
+        if auto["drains"]:
+            lines.append("")
+            lines.append("| drained replica | waited s | keys handed "
+                         "| shed | expired | reshard |")
+            lines.append("|---|---|---|---|---|---|")
+            for d in auto["drains"]:
+                waited = d.get("waited_s")
+                resh_cell = "-"
+                if d.get("program"):
+                    ok = "ok" if d.get("reshard_ok") else "FAILED"
+                    resh_cell = (f"{d['program']} ({ok}, mem x"
+                                 f"{d.get('measured_mem_factor')})")
+                elif d.get("reshard_ok"):
+                    resh_cell = "ok"
+                lines.append(
+                    f"| {d['replica']} "
+                    f"| {waited if waited is not None else '-'} "
+                    f"| {d.get('keys', '-')} | {d.get('shed', '-')} "
+                    f"| {d.get('expired', '-')} | {resh_cell} |")
     comp = summary.get("compile")
     if comp:
         # the compile observatory's record (ISSUE 8): per-surface

@@ -277,6 +277,25 @@ SESSION_TASKS: Tuple[Task, ...] = (
                             "--out=serving_scale.json"),
          artifacts=("examples/tpu_run/serving_scale.json",),
          done_artifact="examples/tpu_run/serving_scale.json"),
+    Task("serving_elastic", "elastic autoscaler curve", value=105.0,
+         budget_s=600,
+         # off-chip by design (ISSUE 17; docs/SERVING.md elastic
+         # fleet): the diurnal open-loop plan drives in-process
+         # engines behind the autoscaler on --platform=cpu with the
+         # tunnel RTT modeled through a local slow relay, and the
+         # drain's redistribution program runs on the virtual CPU
+         # mesh — safe with the relay dead, flap-time filler like
+         # serving_scale; the ONE committed artifact lives in the
+         # experiment dir and bench/regen folds elastic_markdown into
+         # report.md from there
+         command="bash scripts/run_serving_elastic.sh",
+         rehearsal_command=("python -m tpu_reductions.serve.loadgen "
+                            "--platform=cpu --devices=8 --elastic "
+                            "--scale-clients=64 --elastic-seconds=4 "
+                            "--n=8192 "
+                            "--out=serving_elastic.json"),
+         artifacts=("examples/tpu_run/serving_elastic.json",),
+         done_artifact="examples/tpu_run/serving_elastic.json"),
     Task("flagship", "flagship experiment", value=300.0, budget_s=10800,
          command="bash scripts/run_tpu_experiment.sh examples/tpu_run",
          artifacts=("examples/tpu_run",),

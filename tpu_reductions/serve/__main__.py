@@ -35,6 +35,37 @@ import time
 from tpu_reductions.config import _apply_platform
 
 
+def _control_response(engine, spec: dict) -> dict:
+    """The {"op": ...} control plane a ProcessReplica parent drives
+    for planned scale-down (serve/router.ProcessReplica._control;
+    docs/SERVING.md "elastic fleet"): drain closes admission,
+    drain_status reports the drain-protocol observables, prewarm
+    warms a handed-off bucket key. Unknown ops (or a front end
+    without the protocol, e.g. the router CLI) report instead of
+    raising — the parent treats an error as the kill case."""
+    op = spec.get("op")
+    try:
+        if op == "drain":
+            engine.begin_drain()
+            return {"op": op, "ok": True}
+        if op == "drain_status":
+            return {"op": op, "ok": True,
+                    "draining": bool(getattr(engine, "draining", False)),
+                    "queued": engine.queued_depth(),
+                    "warm_keys": [list(k)
+                                  for k in engine.warm_bucket_keys()],
+                    "stats": {k: v for k, v in engine.stats.items()}}
+        if op == "prewarm":
+            engine.prewarm(spec["method"],
+                           spec.get("type", spec.get("dtype", "int")),
+                           int(spec["n"]),
+                           up_to_batch=int(spec.get("up_to_batch", 1)))
+            return {"op": op, "ok": True}
+        return {"op": op, "error": f"unknown control op: {op!r}"}
+    except (AttributeError, KeyError, TypeError, ValueError) as e:
+        return {"op": op, "error": f"{type(e).__name__}: {e}"}
+
+
 def _make_handler(engine, request_timeout_s: float):
     from tpu_reductions.serve.request import ReduceRequest
 
@@ -46,6 +77,12 @@ def _make_handler(engine, request_timeout_s: float):
                     continue
                 try:
                     spec = json.loads(raw)
+                    if isinstance(spec, dict) and "op" in spec:
+                        resp = _control_response(engine, spec)
+                        self.wfile.write(
+                            (json.dumps(resp) + "\n").encode())
+                        self.wfile.flush()
+                        continue
                     req = ReduceRequest(
                         method=spec["method"],
                         dtype=spec.get("type", spec.get("dtype", "int")),

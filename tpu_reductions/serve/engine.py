@@ -182,6 +182,7 @@ class ServeEngine:
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
         self._stopped = False
+        self._draining = False
         self._ids = itertools.count()
         # stats counters are bumped from submitter threads (admission)
         # AND the worker loop; every write funnels through _bump under
@@ -195,6 +196,10 @@ class ServeEngine:
             "submitted": 0, "ok": 0, "error": 0, "rejected": 0,
             "expired": 0, "shed": 0, "batches": 0, "batched_requests": 0,
             "preempted": 0, "sharded": 0}
+        # jit-bucket keys this engine has warmed or launched — the warm
+        # state a planned drain hands to the surviving replicas
+        # (serve/autoscale.drain_replica; docs/SERVING.md elastic fleet)
+        self._warm_keys: set = set()
 
     def _bump(self, key: str, delta: int = 1) -> None:
         with self._stats_lock:
@@ -237,6 +242,44 @@ class ServeEngine:
         ledger.emit("serve.stop", **{k: int(v)
                                      for k, v in self.stats.items()})
 
+    def begin_drain(self) -> None:
+        """Enter the draining admission mode (docs/SERVING.md elastic
+        fleet): every NEW submit resolves `rejected` with the
+        `replica-draining` mark — which the router re-routes for free
+        (serve/router.replica_draining) — while queued and in-flight
+        work keeps serving to completion. Distinct from stop(): the
+        worker stays up, nothing sheds. The drain protocol
+        (serve/autoscale.drain_replica) calls stop() only once the
+        queue and the router's outstanding count hit zero, so a
+        planned drain sheds ZERO requests where a kill sheds the
+        queue."""
+        with self._cond:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def queued_depth(self) -> int:
+        """Current admission-queue depth — one of the autoscaler's
+        control signals (serve/autoscale.py) and the drain protocol's
+        emptiness probe."""
+        with self._cond:
+            return len(self._queue)
+
+    def slo_p99(self, slo: str) -> Optional[float]:
+        """Rolling p99 of an SLO class (the _SLOTracker the p99-aware
+        shed consults), exported as an autoscaler control signal."""
+        return self._slo.p99(slo)
+
+    def warm_bucket_keys(self) -> List[tuple]:
+        """The (method, dtype, n) jit-bucket keys this engine has
+        warmed or served — the cache state a planned drain prewarms
+        onto survivors so retiring the replica does not re-cold-start
+        its traffic (serve/autoscale.drain_replica)."""
+        with self._stats_lock:
+            return sorted(self._warm_keys)
+
     def prewarm(self, method: str, dtype: str, n: int,
                 up_to_batch: int = 1) -> None:
         """Compile-cache warming through the sanctioned executor path:
@@ -245,6 +288,8 @@ class ServeEngine:
         inside a measured or deadline-bound window (the .jax_cache
         doctrine, serving-shaped; ROADMAP item 5's cold-start story).
         Call before start() or while the engine is idle."""
+        with self._stats_lock:
+            self._warm_keys.add((method, dtype, n))
         k = 1
         while True:
             self._ensure_executor().run_batch(method, dtype, n,
@@ -383,6 +428,14 @@ class ServeEngine:
     def _admission_reason(self, request: ReduceRequest) -> Optional[str]:
         if self._stopping or self._stopped:
             return "engine-stopped"
+        if self._draining:
+            # the planned scale-down vocabulary, distinct from
+            # engine-stopped BY DESIGN: the router re-routes this
+            # without burning a max_retries attempt
+            # (serve/router.replica_draining) because the replica is
+            # healthy — admission is closed by policy, not failure
+            return ("replica-draining: admission closed for planned "
+                    "scale-down (in-flight work finishing)")
         if request.slo is not None \
                 and request.slo not in self._slo_classes:
             return (f"unknown slo class {request.slo!r} (configured: "
@@ -547,6 +600,8 @@ class ServeEngine:
         if not live:
             return
         method, dtype, n = batch.key
+        with self._stats_lock:
+            self._warm_keys.add(batch.key)
         est = self._cost_model.estimate(batch.key)
         ledger.emit("serve.launch", batch=batch.batch_id, size=len(live),
                     method=method, dtype=dtype, n=n,

@@ -411,3 +411,21 @@ class BatchExecutor:
             "per_device_chunks": plan.num_chunks,
             "chunk_bytes": plan.chunk_bytes,
         }
+
+    def run_reshard(self, plan, carried: np.ndarray) -> Dict:
+        """Execute ONE planner-emitted redistribution program
+        (reshard/planner.plan_reshard) on the local mesh — the drain
+        protocol's device seam (serve/autoscale.drain_replica): the
+        autoscaler plans and oracle-verifies jax-free, and every
+        device touch funnels through here so the rest of serve/ stays
+        inside the RED014 fence. Returns execute_plan's result dict
+        ({'shards', 'wall_s', 'steps', 'measured_mem_factor'})."""
+        from tpu_reductions.reshard.primitives import (execute_plan,
+                                                       make_mesh)
+        from tpu_reductions.utils.retry import retry_device_call
+
+        fault_point("serve.batch")
+
+        mesh = make_mesh(plan.source.num_ranks)
+        return retry_device_call(
+            lambda: execute_plan(plan, carried, mesh), phase="serve")

@@ -31,6 +31,16 @@ one row per mode / per (series, clients, process) cell, persisted the
 moment each lands; `bench/regen.py` folds them into report.md via
 `curve_markdown` / `scale_markdown`.
 
+Elastic (ISSUE 17, `serving_elastic.json`, `--elastic`): an
+autoscaled LocalReplica fleet (serve/autoscale.py) tracks the seeded
+`--plan=diurnal` arrival shape (ramp/burst/ebb/peak/tail composed
+from the same poisson/bursty primitives) per client count — the
+committed row carries the replica-count-vs-load trajectory and the
+p99-inside-SLO verdict — then the drain-vs-kill pair retires a
+replica mid-burst both ways on one seeded workload (planned drain:
+zero victim shed, warm keys handed off, partials resharded under the
+declared peak-memory bound; SIGKILL control: in-flight losses).
+
 CLI:
     python -m tpu_reductions.serve.loadgen --platform=cpu --clients=8 \
         [--requests=32 --n=65536 --methods=SUM,MIN,MAX --type=int] \
@@ -38,6 +48,9 @@ CLI:
     python -m tpu_reductions.serve.loadgen --platform=cpu --scale \
         [--scale-clients=64,256,1024 --replicas=4 --seed=0] \
         --out=examples/tpu_run/serving_scale.json
+    python -m tpu_reductions.serve.loadgen --platform=cpu --elastic \
+        [--plan=diurnal --scale-clients=64,256,1024 --slo-s=5] \
+        --out=examples/tpu_run/serving_elastic.json
 """
 
 from __future__ import annotations
@@ -155,6 +168,33 @@ def _distill(rows: List[dict], wall: float) -> dict:
 # Open loop (ISSUE 13): seeded arrival processes + callback completion
 # --------------------------------------------------------------------------
 
+# the seeded time-varying arrival plan (ISSUE 17; --plan=diurnal):
+# ramp + burst epochs composed from the poisson/bursty processes —
+# (name, fraction of count, rate factor vs the base rate, process).
+# The elastic curve drives THIS shape so the autoscaler has real
+# scale-up (burst, peak) and scale-down (ebb, tail) signals to track.
+DIURNAL_EPOCHS = (
+    ("ramp", 0.20, 0.25, "poisson"),
+    ("burst", 0.20, 2.00, "bursty"),
+    ("ebb", 0.20, 0.25, "poisson"),
+    ("peak", 0.20, 1.50, "bursty"),
+    ("tail", 0.20, 0.25, "poisson"),
+)
+# total plan duration in units of count/base_rate: sum(frac / factor)
+# over the epochs — the elastic mode sizes base_rate from this so a
+# cell spans --elastic-seconds of wall clock
+DIURNAL_TIME_FACTOR = sum(f / r for _, f, r, _ in DIURNAL_EPOCHS)
+
+
+def diurnal_epoch_counts(count: int) -> List[int]:
+    """Per-epoch arrival counts for a `count`-arrival diurnal plan:
+    floor(frac * count) each, remainder into the last epoch — so the
+    composition is exact and deterministic for any count."""
+    counts = [int(frac * count) for _, frac, _, _ in DIURNAL_EPOCHS]
+    counts[-1] += count - sum(counts)
+    return counts
+
+
 def open_arrivals(rng: random.Random, *, count: int, rate_rps: float,
                   process: str = "poisson",
                   burst: int = 32) -> List[float]:
@@ -165,7 +205,12 @@ def open_arrivals(rng: random.Random, *, count: int, rate_rps: float,
         default);
       * bursty  — Poisson BURST epochs, `burst` back-to-back arrivals
         each (same long-run rate, pathological short-run concurrency —
-        the coalescing window's stress shape).
+        the coalescing window's stress shape);
+      * diurnal — the DIURNAL_EPOCHS composition (ramp -> burst ->
+        ebb -> peak -> tail), each epoch its own poisson/bursty
+        process at `rate_rps` x the epoch's factor, time offsets
+        accumulated across epochs — deterministic per rng state like
+        the primitives it composes.
     """
     if count <= 0 or rate_rps <= 0:
         raise ValueError("count and rate_rps must be positive")
@@ -179,21 +224,32 @@ def open_arrivals(rng: random.Random, *, count: int, rate_rps: float,
         while len(offsets) < count:
             t += rng.expovariate(rate_rps / burst)
             offsets.extend([t] * min(burst, count - len(offsets)))
+    elif process == "diurnal":
+        for (_, _, factor, proc), k in zip(DIURNAL_EPOCHS,
+                                           diurnal_epoch_counts(count)):
+            if k <= 0:
+                continue
+            sub = open_arrivals(rng, count=k,
+                                rate_rps=rate_rps * factor,
+                                process=proc, burst=burst)
+            offsets.extend(t + o for o in sub)
+            t = offsets[-1]
     else:
         raise ValueError(f"unknown arrival process {process!r} "
-                         "(poisson|bursty)")
+                         "(poisson|bursty|diurnal)")
     return offsets
 
 
 def plan_workload(seed: int, *, count: int, methods: Sequence[str],
                   dtype: str, n_choices: Sequence[int],
                   rate_rps: float, process: str = "poisson",
-                  burst: int = 32,
-                  deadline_s: Optional[float] = None) -> List[Tuple]:
+                  burst: int = 32, deadline_s: Optional[float] = None,
+                  slo: Optional[str] = None) -> List[Tuple]:
     """The seeded open-loop plan: `count` (offset_s, ReduceRequest)
     pairs, fully determined by `seed` (same seed -> identical offsets
     AND request specs — tests/test_loadgen pins this), so every series
-    of a scaling run replays the SAME workload."""
+    of a scaling run replays the SAME workload. `slo` stamps every
+    request with that SLO class (the elastic mode's p99 contract)."""
     from tpu_reductions.serve.request import ReduceRequest
     rng = random.Random(seed)
     offsets = open_arrivals(rng, count=count, rate_rps=rate_rps,
@@ -203,7 +259,8 @@ def plan_workload(seed: int, *, count: int, methods: Sequence[str],
         plan.append((off, ReduceRequest(
             method=rng.choice(list(methods)), dtype=dtype,
             n=rng.choice(list(n_choices)),
-            seed=rng.randrange(1 << 30), deadline_s=deadline_s)))
+            seed=rng.randrange(1 << 30), deadline_s=deadline_s,
+            slo=slo)))
     return plan
 
 
@@ -523,6 +580,355 @@ def _sharded_evidence(ledger_path: Optional[str]) -> dict:
     return out
 
 
+def elastic_markdown(artifact: dict) -> str:
+    """The report.md section for the elastic fleet (bench/regen.py
+    folds it after the scaling curve): replica trajectory per cell +
+    the drain-vs-kill contract line."""
+    lines = ["## elastic serving fleet (autoscaler tracking the "
+             "diurnal plan)", ""]
+    meta = ", ".join(f"{k}={artifact[k]}"
+                     for k in ("plan", "slo_s", "autoscale_min",
+                               "autoscale_max", "cooldown_s", "seed",
+                               "platform")
+                     if artifact.get(k) is not None)
+    if meta:
+        lines += [f"config: {meta}", ""]
+    rows = [r for r in artifact.get("rows", []) if isinstance(r, dict)]
+    cells = [r for r in rows if str(r.get("key", "")).startswith(
+        "elastic@")]
+    if cells:
+        lines.append("| clients | req/s | p99 ms | in SLO | replicas "
+                     "min..max | ups | downs | ok | other |")
+        lines.append("|---|---|---|---|---|---|---|---|---|")
+        for r in sorted(cells, key=lambda r: r.get("clients", 0)):
+            other = ", ".join(
+                f"{k}:{v}" for k, v in sorted(r.get("by_status",
+                                                    {}).items())
+                if k != "ok") or "-"
+            lines.append(
+                f"| {r.get('clients', '-')} | {r.get('rps', '-')} "
+                f"| {r.get('p99_ms', '-')} "
+                f"| {'yes' if r.get('p99_in_slo') else 'NO'} "
+                f"| {r.get('replicas_min', '-')}.."
+                f"{r.get('replicas_max', '-')} "
+                f"| {r.get('scale_ups', '-')} "
+                f"| {r.get('scale_downs', '-')} "
+                f"| {r.get('ok', '-')} | {other} |")
+    dr = next((r for r in rows if r.get("key") == "drain"), None)
+    kl = next((r for r in rows if r.get("key") == "kill"), None)
+    if dr and kl:
+        rs = dr.get("reshard") or {}
+        lines += ["", "drain-vs-kill on the same seeded mid-burst "
+                      "workload: planned drain shed "
+                      f"{dr.get('victim_shed')} requests (redistribution "
+                      f"program {rs.get('program')} oracle-verified="
+                      f"{rs.get('ok')}, measured peak-memory factor "
+                      f"{rs.get('measured_mem_factor')} <= declared "
+                      f"{rs.get('mem_factor')}); SIGKILL shed "
+                      f"{kl.get('victim_shed')} in-flight requests the "
+                      "router had to re-route"]
+    return "\n".join(lines)
+
+
+def _compress_trajectory(history: List[dict],
+                         keep_every: int = 10) -> List[dict]:
+    """The committed replica-count-vs-load trajectory: every tick that
+    acted (or changed the replica count) plus every `keep_every`-th
+    hold tick — bounded, but the scale-up/down story stays intact."""
+    if not history:
+        return []
+    t0 = history[0].get("t", 0.0)
+    out = []
+    last_n = None
+    for i, rec in enumerate(history):
+        act = rec.get("action") != "hold"
+        changed = rec.get("replicas") != last_n
+        if act or changed or i % keep_every == 0 \
+                or i == len(history) - 1:
+            out.append({"t": round(rec.get("t", t0) - t0, 3),
+                        "replicas": rec.get("replicas"),
+                        "load": rec.get("load_per_replica"),
+                        "queued": rec.get("queued"),
+                        "action": rec.get("action")})
+        last_n = rec.get("replicas")
+    return out
+
+
+def _run_elastic(ns, methods: List[str]) -> int:
+    """`--elastic`: the ISSUE 17 elastic-fleet curve. Per client
+    count, an autoscaled LocalReplica fleet (serve/autoscale.py)
+    tracks the seeded --plan arrival shape — replica count must
+    follow load while p99 stays inside the declared SLO — then the
+    drain-vs-kill pair retires a replica mid-burst both ways on one
+    seeded workload: the planned drain's victim sheds ZERO requests
+    (warm keys handed off, partials resharded under the declared
+    peak-memory bound, oracle-verified), the SIGKILL control's victim
+    sheds its queue."""
+    from tpu_reductions.bench.resume import Checkpoint
+    from tpu_reductions.serve.autoscale import Autoscaler, drain_replica
+    from tpu_reductions.serve.engine import ServeEngine
+    from tpu_reductions.serve.executor import BatchExecutor
+    from tpu_reductions.serve.router import LocalReplica, local_router
+    from tpu_reductions import config as cfg
+
+    n_choices = (max(1024, ns.n // 2), ns.n, ns.n * 2)
+    counts = sorted({int(c) for c in ns.scale_clients.split(",")
+                     if c.strip()})
+    amin = cfg.autoscale_min(ns.autoscale_min)
+    amax = cfg.autoscale_max(ns.autoscale_max)
+    # flag > env > the CELL-scale default: an 8-second plan needs a
+    # sub-second cooldown, not config.py's live-fleet 5 s
+    cooldown = (ns.autoscale_cooldown_s
+                if ns.autoscale_cooldown_s is not None
+                else cfg._env_float("TPU_REDUCTIONS_AUTOSCALE_COOLDOWN_S"))
+    if cooldown is None:
+        cooldown = 0.75
+    meta = {"instrument": "serving_elastic", "plan": ns.plan,
+            "dtype": DTYPE_ALIASES[ns.dtype],
+            "methods": ",".join(methods),
+            "n_choices": list(n_choices), "seed": ns.seed,
+            "slo_s": ns.slo_s, "autoscale_min": amin,
+            "autoscale_max": amax, "cooldown_s": cooldown,
+            "elastic_seconds": ns.elastic_seconds,
+            "launch_latency_ms": ns.launch_latency_ms,
+            "platform": ns.platform or "default"}
+    ck = Checkpoint(ns.out, meta, key_fn=lambda r: r.get("key"))
+
+    relay = None
+    if ns.launch_latency_ms > 0:
+        from tpu_reductions.faults.relay import FakeRelay
+        from tpu_reductions.faults.schedule import Phase
+        relay = FakeRelay([Phase("slow",
+                                 delay_s=ns.launch_latency_ms / 1e3)])
+        relay.start()
+
+    def _transport():
+        if relay is None:
+            return None
+        from tpu_reductions.serve.transport import RelayTransport
+        return RelayTransport(ports=(relay.port,), assume_tunneled=True,
+                              drain=True)
+
+    executor = BatchExecutor()
+    slo_classes = {"std": ns.slo_s}
+    dk_relay = None
+
+    def _engine_kwargs(clients):
+        return dict(max_batch=ns.max_batch, coalesce_window_s=0.0,
+                    device_window_s=ns.device_window_ms / 1e3,
+                    max_queue=max(2048, 2 * clients),
+                    slo_classes=dict(slo_classes))
+
+    def _prewarm(replicas):
+        for rep in replicas:
+            for m in methods:
+                for n in n_choices:
+                    rep.prewarm(m, ns.dtype, n)
+
+    def _epoch_table(plan):
+        bounds, i = [], 0
+        for (name, _, factor, proc), k in zip(
+                DIURNAL_EPOCHS, diurnal_epoch_counts(len(plan))):
+            if k <= 0:
+                continue
+            bounds.append({"epoch": name, "t0": round(plan[i][0], 3),
+                           "arrivals": k, "rate_factor": factor,
+                           "process": proc})
+            i += k
+        return bounds
+
+    try:
+        # -- the autoscaled cells: replica count tracks the plan ------
+        for clients in counts:
+            key = f"elastic@{clients}@{ns.plan}"
+            prior = ck.resume(key,
+                              reusable=lambda r: bool(r.get("requests")))
+            if prior is not None:
+                print(f"elastic {key}: resumed from prior artifact",
+                      file=sys.stderr)
+                ck.add(prior)
+                continue
+            base_rate = (clients * DIURNAL_TIME_FACTOR
+                         / max(ns.elastic_seconds, 0.5)
+                         if ns.plan == "diurnal"
+                         else clients / max(ns.elastic_seconds, 0.5))
+            plan_seed = ns.seed * 1_000_003 + clients * 31 + 7
+            plan = plan_workload(
+                plan_seed, count=clients, methods=methods,
+                dtype=ns.dtype, n_choices=n_choices,
+                rate_rps=base_rate, process=ns.plan, burst=ns.burst,
+                slo="std")
+            ekw = _engine_kwargs(clients)
+            router = local_router(
+                amin, engine_kwargs=dict(
+                    transports=[_transport() for _ in range(amin)],
+                    **ekw)).start()
+            _prewarm(router.replicas)
+            spawned = []
+
+            def spawn(i, _ekw=ekw, _spawned=spawned):
+                rep = LocalReplica(
+                    f"replica-e{i}",
+                    ServeEngine(transport=_transport(), **_ekw))
+                _spawned.append(rep)
+                return rep
+
+            scaler = Autoscaler(
+                router, spawn, min_replicas=amin, max_replicas=amax,
+                cooldown_s=cooldown, slo_classes=dict(slo_classes),
+                executor=executor, down_ticks=ns.down_ticks
+            ).start(interval_s=ns.tick_s)
+            row = run_open_load(router.submit, plan, timeout_s=900)
+            # let the loop observe the post-plan calm so the ebb-side
+            # story (scale-down back toward min) lands in-trajectory
+            settle = time.monotonic() + max(
+                4 * (cooldown + ns.down_ticks * ns.tick_s), 1.0)
+            while time.monotonic() < settle:
+                snap = router.load_snapshot()
+                if sum(1 for r in snap["replicas"]
+                       if r["alive"] and not r["draining"]) <= amin:
+                    break
+                time.sleep(ns.tick_s)
+            scaler.stop()
+            router.stop()
+            hist = scaler.history
+            ups = sum(1 for r in hist if r["action"] == "up")
+            downs = sum(1 for r in hist if r["action"] == "down")
+            p99_in_slo = (row.get("p99_ms") is not None
+                          and row["p99_ms"] / 1e3 <= ns.slo_s)
+            ck.add({"key": key, "clients": clients, "plan": ns.plan,
+                    **row, "p99_in_slo": bool(p99_in_slo),
+                    "slo_s": ns.slo_s,
+                    "replicas_min": min(r["replicas"] for r in hist),
+                    "replicas_max": max(r["replicas"] for r in hist),
+                    "scale_ups": ups, "scale_downs": downs,
+                    "ticks": len(hist),
+                    "epochs": _epoch_table(plan),
+                    "trajectory": _compress_trajectory(hist),
+                    "drains": [d["reshard"] for d in scaler.drains
+                               if d.get("reshard")]})
+            print(f"elastic {key}: rps={row.get('rps')} "
+                  f"p99_ms={row.get('p99_ms')} ups={ups} downs={downs}",
+                  file=sys.stderr)
+
+        # -- drain-vs-kill: one seeded mid-burst workload, two exits --
+        dk_clients = counts[len(counts) // 2] if counts else 64
+        dk_seed = ns.seed * 1_000_003 + dk_clients * 31 + 13
+        # the pair runs behind a deliberately slow relay (>= 25 ms per
+        # launch): a burst then genuinely QUEUES behind the in-flight
+        # batch, so the SIGKILL's victim dies with work on its queue —
+        # the loss the planned drain exists to avoid
+        dk_latency_ms = max(ns.launch_latency_ms, 25.0)
+        if dk_latency_ms > 0:
+            from tpu_reductions.faults.relay import FakeRelay
+            from tpu_reductions.faults.schedule import Phase
+            dk_relay = FakeRelay([Phase("slow",
+                                        delay_s=dk_latency_ms / 1e3)])
+            dk_relay.start()
+
+        def _dk_transport():
+            if dk_relay is None:
+                return None
+            from tpu_reductions.serve.transport import RelayTransport
+            return RelayTransport(ports=(dk_relay.port,),
+                                  assume_tunneled=True, drain=True)
+
+        for mode in ("drain", "kill"):
+            prior = ck.resume(
+                mode, reusable=lambda r: r.get("victim_shed") is not None)
+            if prior is not None:
+                ck.add(prior)
+                continue
+            plan = plan_workload(
+                dk_seed, count=dk_clients, methods=methods,
+                dtype=ns.dtype, n_choices=n_choices,
+                rate_rps=4.0 * dk_clients, process="bursty",
+                burst=ns.burst, slo="std")
+            router = local_router(
+                3, engine_kwargs=dict(
+                    transports=[_dk_transport() for _ in range(3)],
+                    **_engine_kwargs(dk_clients))).start()
+            _prewarm(router.replicas)
+            victim = router.replicas[-1]
+            # trigger at the END of a burst run (a maximal run of
+            # equal offsets past the 1/3 mark): the whole burst has
+            # dispatched, the worker is inside a slow launch, and the
+            # victim's share of the burst sits QUEUED — the contract's
+            # hard case for both exits
+            offsets = [off for off, _ in plan]
+            s = len(offsets) // 3
+            while s + 1 < len(offsets) \
+                    and offsets[s + 1] != offsets[s]:
+                s += 1
+            trig = s
+            while trig + 1 < len(offsets) \
+                    and offsets[trig + 1] == offsets[s]:
+                trig += 1
+            fired = threading.Event()
+            evidence: dict = {}
+
+            def act(_router=router, _victim=victim, _mode=mode,
+                    _evidence=evidence, _fired=fired):
+                _fired.wait(timeout=60)
+                if _mode == "drain":
+                    _evidence.update(drain_replica(
+                        _router, _victim, executor=executor))
+                else:
+                    # catch the victim with work ON ITS QUEUE — the
+                    # work SIGKILL sheds and a planned drain serves:
+                    # behind the slow relay the worker is inside a
+                    # 25 ms+ launch round while later burst arrivals
+                    # queue behind it
+                    deadline = time.monotonic() + 30.0
+                    while time.monotonic() < deadline \
+                            and _victim.queued_depth() <= 0:
+                        time.sleep(0.001)
+                    _victim.kill()
+                    _evidence["victim_stats"] = _victim.stats()
+
+            actor = threading.Thread(target=act, daemon=True)
+            actor.start()
+            dispatched = [0]
+
+            def submit(req, _router=router, _d=dispatched,
+                       _fired=fired, _trig=trig):
+                _d[0] += 1
+                if _d[0] == _trig + 1:
+                    _fired.set()
+                return _router.submit(req)
+
+            row = run_open_load(submit, plan, timeout_s=900)
+            actor.join(timeout=120)
+            # kill's shed counter lands when the engine stops; read
+            # the victim's terminals AFTER the actor finished
+            stats = evidence.get("victim_stats") or {}
+            router.stop()
+            ck.add({"key": mode, "clients": dk_clients,
+                    "process": "bursty", **row,
+                    "victim": victim.replica_id,
+                    "victim_shed": int(stats.get("shed", 0)),
+                    "victim_expired": int(stats.get("expired", 0)),
+                    "reshard": evidence.get("reshard"),
+                    "handoff_keys": len(evidence.get("handoff") or []),
+                    "drain_rerouted":
+                        router.stats.get("drain_rerouted", 0),
+                    "rerouted": router.stats.get("rerouted", 0)})
+            print(f"elastic {mode}: victim_shed={stats.get('shed', 0)} "
+                  f"ok={row.get('ok')}", file=sys.stderr)
+    finally:
+        if relay is not None:
+            relay.stop()
+        if dk_relay is not None:
+            dk_relay.stop()
+    if ns.out:
+        ck.finalize()
+    artifact = {**meta, "rows": ck.rows}
+    print(elastic_markdown(artifact))
+    if ns.out:
+        print(f"wrote {ns.out}")
+    return 0
+
+
 def _tcp_submit(addr: str):
     """A submit() against the TCP front end: one connection per client
     thread (thread-local), one JSON line per request/response."""
@@ -622,6 +1028,39 @@ def main(argv=None) -> int:
                         "512 MiB shard threshold)")
     p.add_argument("--skip-sharded", action="store_true",
                    help="omit the sharded row from --scale")
+    p.add_argument("--elastic", action="store_true",
+                   help="ISSUE 17 mode: autoscaled fleet tracking the "
+                        "--plan arrival shape per --scale-clients "
+                        "count, plus the drain-vs-kill contract pair; "
+                        "writes serving_elastic.json-shaped artifact "
+                        "to --out")
+    p.add_argument("--plan", default="diurnal",
+                   choices=("diurnal", "poisson", "bursty"),
+                   help="arrival plan for the --elastic cells (the "
+                        "seeded ramp/burst/ebb/peak/tail composition "
+                        "by default)")
+    p.add_argument("--elastic-seconds", type=float, default=8.0,
+                   help="target wall-clock span of one elastic cell's "
+                        "plan (the base arrival rate derives from it)")
+    p.add_argument("--slo-s", type=float, default=5.0,
+                   help="declared SLO deadline (class 'std') the "
+                        "elastic cells must hold p99 inside")
+    p.add_argument("--tick-s", type=float, default=0.05,
+                   help="autoscaler control-loop interval (--elastic)")
+    p.add_argument("--down-ticks", type=int, default=3,
+                   help="consecutive calm ticks before a scale-down "
+                        "(the hysteresis depth; serve/autoscale.py)")
+    p.add_argument("--autoscale-min", type=int, default=None,
+                   help="fleet floor (default: "
+                        "TPU_REDUCTIONS_AUTOSCALE_MIN or 1)")
+    p.add_argument("--autoscale-max", type=int, default=None,
+                   help="fleet ceiling (default: "
+                        "TPU_REDUCTIONS_AUTOSCALE_MAX or 8)")
+    p.add_argument("--autoscale-cooldown-s", type=float, default=None,
+                   help="post-action cooldown (default: "
+                        "TPU_REDUCTIONS_AUTOSCALE_COOLDOWN_S or 0.75 "
+                        "— cell-scale; config.py's 5 s default suits "
+                        "live fleets)")
     p.add_argument("--devices", dest="num_devices", type=int,
                    default=None,
                    help="virtual CPU device count (--platform=cpu; "
@@ -648,6 +1087,11 @@ def main(argv=None) -> int:
             p.error("--scale drives in-process engines/routers; "
                     "--connect is the single-engine TCP mode")
         return _run_scale(ns, methods)
+    if ns.elastic:
+        if ns.connect:
+            p.error("--elastic drives in-process autoscaled fleets; "
+                    "--connect is the single-engine TCP mode")
+        return _run_elastic(ns, methods)
 
     meta = {"dtype": DTYPE_ALIASES[ns.dtype], "n": ns.n,
             "methods": ",".join(methods), "clients": ns.clients,

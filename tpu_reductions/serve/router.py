@@ -55,6 +55,13 @@ from tpu_reductions.serve.request import (PendingResponse, ReduceRequest,
 _REPLICA_FAILURE_MARKS = ("replica-dead", "replica-timeout",
                           "relay dead", "relay-dead", "engine-stopped")
 
+# the planned scale-down terminal (docs/SERVING.md elastic fleet),
+# deliberately NOT in the failure vocabulary: a draining replica is
+# healthy, its admission is closed by policy, so landing on one
+# re-routes WITHOUT burning a max_retries attempt (the retry budget
+# exists for failures, and a planned drain is not one)
+_REPLICA_DRAINING_MARK = "replica-draining"
+
 
 def replica_failure(resp: ReduceResponse) -> bool:
     """Whether this terminal response blames the replica rather than
@@ -63,6 +70,23 @@ def replica_failure(resp: ReduceResponse) -> bool:
     if resp.status not in ("error", "shed", "rejected"):
         return False
     return any(m in (resp.error or "") for m in _REPLICA_FAILURE_MARKS)
+
+
+def replica_draining(resp: ReduceResponse) -> bool:
+    """Whether this terminal response is a draining replica declining
+    NEW work (serve/engine.begin_drain's rejection mark) — distinct
+    from replica_failure: the router re-submits without consuming a
+    retry attempt, so max_retries=0 fleets still drain losslessly."""
+    if resp.status not in ("error", "shed", "rejected"):
+        return False
+    return _REPLICA_DRAINING_MARK in (resp.error or "")
+
+
+def _is_draining(replica) -> bool:
+    """Duck-typed draining probe: replicas without the drain protocol
+    (any pre-elastic replica shape) never report draining."""
+    probe = getattr(replica, "draining", None)
+    return bool(probe()) if callable(probe) else False
 
 
 class LocalReplica:
@@ -92,6 +116,30 @@ class LocalReplica:
         """Delegate to the engine's jit-bucket warmer (the loadgen's
         measure-serving-not-compilation discipline)."""
         self._engine.prewarm(method, dtype, n, up_to_batch=up_to_batch)
+
+    # -- drain protocol (serve/autoscale.drain_replica) ---------------
+
+    def drain_begin(self) -> None:
+        """Close admission for planned scale-down; in-flight and queued
+        work keeps serving (serve/engine.begin_drain)."""
+        self._engine.begin_drain()
+
+    def draining(self) -> bool:
+        return bool(self._engine.draining)
+
+    def queued_depth(self) -> int:
+        return self._engine.queued_depth()
+
+    def warm_bucket_keys(self) -> list:
+        return self._engine.warm_bucket_keys()
+
+    def slo_p99(self, slo: str):
+        return self._engine.slo_p99(slo)
+
+    def stats(self) -> dict:
+        """Engine terminal counters (the drain-vs-kill evidence:
+        a drained victim retires with shed == 0)."""
+        return dict(self._engine.stats)
 
     def stop(self) -> None:
         self._engine.stop(drain=True)
@@ -263,6 +311,51 @@ class ProcessReplica:
         ledger.emit("replica.down", replica=self.replica_id,
                     reason=reason[:120])
 
+    # -- drain protocol (serve/autoscale.drain_replica) ---------------
+
+    def _control(self, spec: dict) -> dict:
+        """One {"op": ...} control round-trip on a dedicated short
+        connection (serve/__main__ handles ops before request parsing);
+        failures report instead of raising — a dead child mid-drain is
+        the kill case, not a crash."""
+        import json
+        try:
+            with socket.create_connection(("127.0.0.1", self._port),
+                                          timeout=10.0) as conn:
+                conn.sendall((json.dumps(spec) + "\n").encode())
+                raw = conn.makefile("rb").readline()
+            return json.loads(raw) if raw else {"error": "no response"}
+        except (OSError, ValueError, ConnectionError) as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def drain_begin(self) -> None:
+        resp = self._control({"op": "drain"})
+        with self._lock:
+            self._draining_flag = not resp.get("error")
+
+    def draining(self) -> bool:
+        with self._lock:
+            return bool(getattr(self, "_draining_flag", False))
+
+    def queued_depth(self) -> int:
+        return int(self._control({"op": "drain_status"}
+                                 ).get("queued") or 0)
+
+    def warm_bucket_keys(self) -> list:
+        keys = self._control({"op": "drain_status"}).get("warm_keys")
+        return [tuple(k) for k in keys] if keys else []
+
+    def slo_p99(self, slo: str):
+        return None      # per-class tails stay in the child process
+
+    def stats(self) -> dict:
+        return self._control({"op": "drain_status"}).get("stats") or {}
+
+    def prewarm(self, method: str, dtype: str, n: int, *,
+                up_to_batch: int = 1) -> None:
+        self._control({"op": "prewarm", "method": method, "type": dtype,
+                       "n": int(n), "up_to_batch": int(up_to_batch)})
+
     def stop(self) -> None:
         for _ in self._threads:
             self._jobs.put(None)
@@ -312,8 +405,8 @@ class ReplicaRouter:
         self._lock = threading.Lock()
         self._ids = itertools.count()
         self.stats: Dict[str, int] = {
-            "routed": 0, "rerouted": 0, "affinity": 0, "balanced": 0,
-            "no_replica": 0}
+            "routed": 0, "rerouted": 0, "drain_rerouted": 0,
+            "affinity": 0, "balanced": 0, "no_replica": 0}
 
     # -- lifecycle ----------------------------------------------------
 
@@ -335,6 +428,54 @@ class ReplicaRouter:
     def replicas(self) -> List:
         return list(self._replicas)
 
+    # -- elastic fleet (serve/autoscale.py; docs/SERVING.md) ----------
+
+    def add_replica(self, replica) -> None:
+        """Scale-up seam: start the replica and admit it to routing —
+        affinity hashes immediately include it (the autoscaler prewarms
+        the hot keys first so recurrences don't pay a cold compile)."""
+        replica.start()
+        with self._lock:
+            self._replicas.append(replica)
+            self._outstanding.setdefault(replica.replica_id, 0)
+
+    def remove_replica(self, replica_id: str) -> None:
+        """Scale-down seam: forget a replica AFTER its drain completed
+        (serve/autoscale.drain_replica) — late `_on_result` callbacks
+        from the removed replica tolerate the missing outstanding row."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas
+                              if r.replica_id != replica_id]
+            self._outstanding.pop(replica_id, None)
+
+    def load_snapshot(self) -> dict:
+        """The autoscaler's per-tick observable: per-replica
+        outstanding + alive/draining flags + routing stats — the same
+        signals route.* ledger events carry, read in-process."""
+        with self._lock:
+            outstanding = dict(self._outstanding)
+            stats = dict(self.stats)
+            replicas = [{"replica": r.replica_id, "alive": r.alive(),
+                         "draining": _is_draining(r)}
+                        for r in self._replicas]
+        return {"outstanding": outstanding, "stats": stats,
+                "replicas": replicas}
+
+    def affinity_target(self, method: str, dtype: str, n: int,
+                        exclude: tuple = ()):
+        """The replica a warm bucket key would hash to once `exclude`
+        (the drain victim) is gone — the handoff placement oracle: the
+        drain prewarms each key exactly where future affinity routing
+        will land it (same crc32 hash as `_pick`)."""
+        with self._lock:
+            alive = [r for r in self._replicas
+                     if r.replica_id not in exclude and r.alive()
+                     and not _is_draining(r)]
+        if not alive:
+            return None
+        key = f"{method}:{dtype}:{n}"
+        return alive[zlib.crc32(key.encode()) % len(alive)]
+
     # -- routing ------------------------------------------------------
 
     def submit(self, request: ReduceRequest) -> PendingResponse:
@@ -355,7 +496,8 @@ class ReplicaRouter:
         least-outstanding."""
         with self._lock:
             alive = [r for r in self._replicas
-                     if r.replica_id not in tried and r.alive()]
+                     if r.replica_id not in tried and r.alive()
+                     and not _is_draining(r)]
             if not alive:
                 return None, None
             if request.nbytes <= self._affinity_bytes:
@@ -392,8 +534,24 @@ class ReplicaRouter:
     def _on_result(self, routed: _Routed, replica,
                    resp: ReduceResponse) -> None:
         with self._lock:
-            self._outstanding[replica.replica_id] = max(
-                0, self._outstanding[replica.replica_id] - 1)
+            if replica.replica_id in self._outstanding:
+                self._outstanding[replica.replica_id] = max(
+                    0, self._outstanding[replica.replica_id] - 1)
+        if replica_draining(resp):
+            # planned scale-down is not a failure: re-route WITHOUT
+            # consuming a max_retries attempt (ISSUE 17 satellite 1 —
+            # a max_retries=0 fleet still drains losslessly); `tried`
+            # keeps the victim so an all-draining fleet terminates at
+            # the no-replica-alive error instead of looping
+            routed.attempts -= 1
+            self.stats["drain_rerouted"] += 1
+            ledger.emit("route.reroute", req=routed.router_id,
+                        replica=replica.replica_id,
+                        attempt=routed.attempts,
+                        reason=(resp.error or "")[:120],
+                        **trace.request_fields(routed.router_id))
+            self._dispatch(routed)
+            return
         if replica_failure(resp) \
                 and routed.attempts <= self._max_retries:
             self.stats["rerouted"] += 1
