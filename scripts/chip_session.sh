@@ -478,6 +478,16 @@ fallback_static_session() {
             examples/tpu_run/serving_elastic.json -- \
         bash scripts/run_serving_elastic.sh
 
+    # off-chip by design: the crash-recovery instrument kills and
+    # restarts a journaled router subprocess + the in-process
+    # kill-replica/drain contrast pair on cpu, flap-time filler
+    # exactly as the scheduler prices it (docs/SERVING.md
+    # crash-consistent control plane)
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py serving_recovery
+    step "crash-recovery instrument" 420 \
+            examples/tpu_run/serving_recovery.json -- \
+        bash scripts/run_serving_recovery.sh
+
     # 3 h: the long tail (hazard cells last), and the watcher re-arms
     # on abort — a flagship that wedges slow-but-alive must not pin the
     # watcher past the round

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Refresh the committed crash-recovery instrument (ISSUE 18;
+# docs/SERVING.md "crash-consistent control plane") — off-chip by
+# construction, safe with the relay dead: the loadgen's --recovery
+# mode runs three disruptions on ONE seeded idem-keyed workload on
+# --platform=cpu. kill_router spawns a REAL `serve.router --journal`
+# subprocess over process-per-replica children, kills the controller
+# via the scripted router.crash os._exit mid-burst, restarts it
+# against the same fleet journal (replicas re-adopted, not
+# respawned), and the TCP clients retry with their original
+# idempotency keys — the ledger-joined claim is ZERO duplicate device
+# executions and MTTR in fractions of a second. kill_replica and
+# drain run the in-process contrast pair. Then the table is folded
+# into the flagship report next to the elastic curve (bench/regen.py).
+#
+# Usage: bash scripts/run_serving_recovery.sh [out.json] [experiment_dir]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exp="${2:-examples/tpu_run}"
+out="${1:-$exp/serving_recovery.json}"
+
+python -m tpu_reductions.serve.loadgen --platform=cpu \
+    --recovery --recovery-requests=48 --crash-after=16 --seed=0 \
+    --out="$out"
+
+if [ -d "$exp" ]; then
+    python -m tpu_reductions.bench.regen "$exp"
+fi

@@ -281,6 +281,46 @@ def test_red010_accepts_jsonio_routes_and_non_artifact_text(tmp_path):
                             name="utils/jsonio.py")) == []
 
 
+def test_red010_serve_fence_flags_any_write_mode_open(tmp_path):
+    # the ISSUE-18 control-plane extension: inside serve/, ANY
+    # write-mode open / write_text / write_bytes is fenced — the fleet
+    # journal and port files must survive a SIGKILL mid-write
+    src = (
+        "from pathlib import Path\n"
+        "def persist(state, path):\n"
+        '    with open(path, "w") as f:\n'
+        "        f.write(str(state))\n"
+        '    Path(path).write_text("port: 8082\\n")\n'
+        '    Path(path).write_bytes(b"x")\n'
+    )
+    findings = _lint_src(tmp_path, src,
+                         name="tpu_reductions/serve/journal.py")
+    assert _rules(findings) == ["RED010", "RED010", "RED010"]
+
+
+def test_red010_serve_fence_accepts_reads_and_jsonio(tmp_path):
+    src = (
+        "from tpu_reductions.utils.jsonio import atomic_json_dump\n"
+        "from tpu_reductions.utils.jsonio import atomic_text_dump\n"
+        "def persist(state, path):\n"
+        "    atomic_json_dump(path, state)\n"
+        '    atomic_text_dump(path, "8082\\n")\n'
+        "    with open(path) as f:\n"          # read-mode: fine
+        "        return f.read()\n"
+    )
+    assert _rules(_lint_src(
+        tmp_path, src,
+        name="tpu_reductions/serve/router.py")) == []
+    # outside serve/ the plain-text write stays legal (the tree-wide
+    # rule only fences JSON-artifact spellings)
+    plain = (
+        "def note(path):\n"
+        '    with open(path, "w") as f:\n'
+        '        f.write("notes\\n")\n'
+    )
+    assert _rules(_lint_src(tmp_path, plain)) == []
+
+
 # ---------------------------------------------------------------- RED011
 
 

@@ -1,0 +1,524 @@
+"""Crash-consistent control plane coverage (ISSUE 18;
+serve/journal.py + serve/router.adopt_fleet + the engine's
+exactly-once dedup cache): the fleet journal's atomic round-trip and
+meta contract, write-ahead ordering (the record lands on disk BEFORE
+the action it describes), the dedup cache's exactly-once property
+under interleaved retries and its bounded-eviction at-least-once
+fallback (an evicted key re-executes, never hangs), the settlement
+vocabulary (transport/lifecycle failures stay retryable), adoption's
+stale/dead verdicts, the autoscaler's mid-cooldown export/restore,
+the timeline's crash-recovery attribution, the ledger-joined
+exactly-once audit, and the chaos e2e: a REAL journaled router
+subprocess killed mid-burst by the scripted `router.crash` os._exit,
+restarted against the same journal — replica children re-ADOPTED (not
+respawned), retried keys answered with ZERO duplicate device
+executions, zero orphans after teardown."""
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_reductions.obs.timeline import recovery_summary, summarize, \
+    summary_markdown
+from tpu_reductions.ops import oracle
+from tpu_reductions.serve.autoscale import Autoscaler
+from tpu_reductions.serve.engine import ServeEngine
+from tpu_reductions.serve.journal import (FleetJournal, JOURNAL_META,
+                                          REPLICA_STATES)
+from tpu_reductions.serve.loadgen import (_recovery_client,
+                                          _recovery_evidence,
+                                          _stamp_idem, plan_workload,
+                                          recovery_markdown)
+from tpu_reductions.serve.request import ReduceRequest
+from tpu_reductions.serve.router import adopt_fleet
+
+
+class FakeExecutor:
+    """Deterministic device stand-in (same as tests/test_serve_scale):
+    resolves with the payload's real oracle value, no jax."""
+
+    def __init__(self, hold=None):
+        self.hold = hold              # threading.Event: block until set
+        self.launches = []
+
+    def capabilities(self):
+        return {"backend": "cpu", "supports_f64": True,
+                "device_count": 1}
+
+    def run_batch(self, method, dtype, n, seeds):
+        self.launches.append((method, dtype, n, tuple(seeds)))
+        if self.hold is not None:
+            assert self.hold.wait(timeout=30)
+        out = []
+        from tpu_reductions.utils.rng import host_data
+        for s in seeds:
+            host = oracle.host_reduce(host_data(n, dtype, seed=s),
+                                      method)
+            v = float(np.asarray(host, dtype=np.float64))
+            out.append({"result": v, "ok": True, "host": v,
+                        "diff": 0.0})
+        return out
+
+
+def _engine(**kw):
+    kw.setdefault("executor", FakeExecutor())
+    kw.setdefault("coalesce_window_s", 0.0)
+    return ServeEngine(**kw)
+
+
+def _req(key, seed, method="SUM", n=64):
+    return ReduceRequest(method=method, dtype="int32", n=n, seed=seed,
+                         idem_key=key)
+
+
+# ------------------------------------------------------ fleet journal
+
+
+def test_journal_round_trip_and_meta_contract(tmp_path):
+    """A journal reloads byte-faithfully (replicas + placements +
+    autoscaler state) under the meta contract; a foreign/mismatched
+    meta is refused — an empty fleet record, never someone else's."""
+    path = str(tmp_path / "fleet_journal.json")
+    j = FleetJournal(path)
+    j.record_replica("replica-0", state="up", port=4242, pid=777,
+                     platform="cpu")
+    j.record_replica("replica-1", state="starting")
+    j.record_placement("SUM", "int32", 4096)
+    j.record_placement("SUM", "int32", 4096)   # deduped
+    j.record_autoscaler({"last_action_wall": 123.0, "calm": 2,
+                         "next_idx": 3})
+    j2 = FleetJournal(path)
+    assert j2.replicas() == j.replicas()
+    assert j2.placements() == [("SUM", "int32", 4096)]
+    assert j2.autoscaler_state()["calm"] == 2
+    # meta contract: a version bump makes it some other instrument's
+    # file — replay refuses rather than adopting a fleet it does not
+    # describe
+    data = json.loads(open(path).read())
+    data["version"] = JOURNAL_META["version"] + 1
+    from tpu_reductions.utils.jsonio import atomic_json_dump
+    atomic_json_dump(path, data)
+    j3 = FleetJournal(path)
+    assert j3.replicas() == {}
+    assert j3.placements() == []
+    assert j3.autoscaler_state() is None
+
+
+def test_journal_write_ahead_and_field_preservation(tmp_path):
+    """Every record is on disk the moment the call returns (the
+    write-AHEAD half of the contract: the journal never claims less
+    than reality), and a later transition keeps previously-journaled
+    fields it does not restate — a drain does not forget the port the
+    adoption probe needs."""
+    path = str(tmp_path / "j.json")
+    j = FleetJournal(path)
+    j.record_replica("replica-0", state="starting")
+    on_disk = json.loads(open(path).read())
+    assert on_disk["replicas"]["replica-0"]["state"] == "starting"
+    j.record_replica("replica-0", state="up", port=5151, pid=999)
+    j.record_replica("replica-0", state="draining")
+    entry = json.loads(open(path).read())["replicas"]["replica-0"]
+    assert entry == {"state": "draining", "port": 5151, "pid": 999}
+    j.forget_replica("replica-0")
+    assert json.loads(open(path).read())["replicas"] == {}
+    with pytest.raises(ValueError):
+        j.record_replica("replica-0", state="exploded")
+    assert "exploded" not in REPLICA_STATES
+
+
+def test_journal_in_memory_without_path(tmp_path):
+    """path=None keeps the whole record in memory — the in-process
+    test fleets' shape: same call sites, zero disk writes."""
+    j = FleetJournal(None)
+    j.record_replica("replica-0", state="up", port=1, pid=2)
+    j.record_placement("MIN", "float32", 128)
+    assert j.replicas()["replica-0"]["port"] == 1
+    assert j.placements() == [("MIN", "float32", 128)]
+    assert list(tmp_path.iterdir()) == []
+
+
+# ------------------------------------------- exactly-once dedup cache
+
+
+def test_dedup_exactly_once_under_interleaved_retries():
+    """The property: any interleaving of settled-then-retried keys
+    settles each key to exactly ONE response value and exactly ONE
+    device execution — every duplicate of a settled key answers from
+    the cache without a launch."""
+    ex = FakeExecutor()
+    eng = _engine(executor=ex).start()
+    try:
+        rng = random.Random(11)
+        keys = [f"k{i}" for i in range(8)]
+        seeds = {k: 1000 + i for i, k in enumerate(keys)}
+        schedule = [k for k in keys for _ in range(3)]
+        rng.shuffle(schedule)
+        responses = {k: [] for k in keys}
+        for k in schedule:
+            r = eng.submit(_req(k, seeds[k])).result(timeout=30)
+            assert r.status == "ok", (r.status, r.error)
+            responses[k].append(r)
+        for k in keys:
+            assert len({r.result for r in responses[k]}) == 1
+        launched = [s for (_m, _d, _n, ss) in ex.launches for s in ss]
+        for k in keys:
+            assert launched.count(seeds[k]) == 1
+        assert eng.stats["dedup_hits"] == len(schedule) - len(keys)
+    finally:
+        eng.stop()
+
+
+def test_dedup_concurrent_duplicates_first_settle_wins():
+    """Duplicates racing BEFORE settlement both execute (the cache
+    only answers settled keys) but agree on the value; once settled,
+    the cached response is pinned — a later duplicate returns the
+    first settler's response without a new launch."""
+    hold = threading.Event()
+    ex = FakeExecutor(hold=hold)
+    eng = _engine(executor=ex).start()
+    try:
+        p1 = eng.submit(_req("race", 42))
+        p2 = eng.submit(_req("race", 42))
+        hold.set()
+        r1, r2 = p1.result(timeout=30), p2.result(timeout=30)
+        assert r1.status == r2.status == "ok"
+        assert r1.result == r2.result
+        n_launches = len(ex.launches)
+        r3 = eng.submit(_req("race", 42)).result(timeout=30)
+        assert r3.status == "ok" and r3.result == r1.result
+        assert len(ex.launches) == n_launches   # answered from cache
+        assert eng.stats["dedup_hits"] == 1
+    finally:
+        eng.stop()
+
+
+def test_dedup_bounded_eviction_at_least_once_never_hangs():
+    """The documented at-least-once fallback: past the LRU bound an
+    evicted key re-executes (one more launch, a correct response,
+    never a hang); a still-cached key keeps answering without one."""
+    ex = FakeExecutor()
+    eng = _engine(executor=ex, dedup_cache_size=2).start()
+    try:
+        for i, k in enumerate(("a", "b", "c")):
+            assert eng.submit(_req(k, 100 + i)) \
+                .result(timeout=30).status == "ok"
+        n_launches = len(ex.launches)
+        # "c" is hot: cached, no launch
+        assert eng.submit(_req("c", 102)).result(timeout=30) \
+            .status == "ok"
+        assert len(ex.launches) == n_launches
+        # "a" was LRU-evicted by "c": re-executes, still resolves
+        r = eng.submit(_req("a", 100)).result(timeout=30)
+        assert r.status == "ok"
+        assert len(ex.launches) == n_launches + 1
+    finally:
+        eng.stop()
+
+
+def test_dedup_settlement_vocabulary():
+    """What caches: ok always; an executed-and-failed error yes; a
+    transport/lifecycle failure never (a cached one would poison every
+    later retry of the key)."""
+    settled = ServeEngine._dedup_settled
+    assert settled("ok", None)
+    assert settled("error", "verification failed: diff=1.0")
+    assert not settled("error", "relay dead on every probe port")
+    assert not settled("error", "replica-dead: relay-dead")
+    assert not settled("error", "engine-stopped")
+    assert not settled("error", "replica-draining")
+    assert not settled("rejected", "queue full (depth 64)")
+    assert not settled("expired", None)
+    assert not settled("shed", None)
+
+
+# ------------------------------------------------------- adoption
+
+
+def test_adopt_fleet_stale_and_dead_verdicts(tmp_path):
+    """The recovery probe's non-live verdicts: a write-ahead
+    "starting" entry with no port is STALE (nothing to probe), a
+    journaled pid that no longer exists is GONE — both are forgotten
+    from the journal, neither is adopted."""
+    path = str(tmp_path / "j.json")
+    j = FleetJournal(path)
+    j.record_replica("replica-0", state="starting")
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait(timeout=30)
+    # a bound-then-closed socket yields a port nothing listens on
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    j.record_replica("replica-1", state="up", port=dead_port,
+                     pid=proc.pid)
+    adopted, reaped = adopt_fleet(j, reap_grace_s=0.2)
+    assert adopted == []
+    assert reaped == ["replica-1"]
+    assert j.replicas() == {}
+    assert FleetJournal(path).replicas() == {}
+
+
+# ----------------------------------------- autoscaler cooldown resume
+
+
+def test_autoscaler_cooldown_survives_restore():
+    """export_state carries the cooldown anchor across processes as a
+    WALL clock; restore converts the elapsed share back onto the
+    successor's clock — a restart mid-cooldown stays cooling instead
+    of re-firing the predecessor's decision."""
+    class StubRouter:
+        replicas = []
+        journal = None
+
+    clock = [100.0]
+    a1 = Autoscaler(StubRouter(), spawn=lambda i: None,
+                    cooldown_s=60.0, clock=lambda: clock[0])
+    a1._last_action_t = clock[0]
+    a1._last_action = "up"
+    a1._calm = 2
+    a1._next_idx = 5
+    state = a1.export_state()
+    assert state["cooldown_s"] == 60.0 and state["calm"] == 2
+    a2 = Autoscaler(StubRouter(), spawn=lambda i: None,
+                    cooldown_s=60.0, clock=lambda: clock[0])
+    a2.restore_state(state)
+    # the anchor restored onto a2's clock: elapsed ~0, so the full
+    # cooldown remains
+    assert a2._last_action_t is not None
+    assert clock[0] - a2._last_action_t < 5.0
+    assert a2._calm == 2 and a2._next_idx >= 5
+    # empty state is a no-op (a journal with no autoscaler record)
+    a3 = Autoscaler(StubRouter(), spawn=lambda i: None,
+                    cooldown_s=60.0)
+    a3.restore_state(None)
+    assert a3._last_action_t is None
+
+
+# -------------------------------------------- timeline / ledger joins
+
+
+def test_timeline_recovery_summary_and_markdown():
+    events = [
+        {"ev": "session.start", "prog": "serve.router"},
+        {"ev": "journal.record", "kind": "replica-up",
+         "name": "replica-0", "replicas": 1},
+        {"ev": "journal.record", "kind": "placement", "replicas": 1},
+        {"ev": "journal.replay", "path": "j.json", "replicas": 2,
+         "placements": 1, "autoscaler": True},
+        {"ev": "adopt.begin", "candidates": 3},
+        {"ev": "adopt.replica", "replica": "replica-0",
+         "verdict": "adopted", "port": 1, "pid": 2},
+        {"ev": "adopt.replica", "replica": "replica-1",
+         "verdict": "adopted", "port": 3, "pid": 4},
+        {"ev": "adopt.replica", "replica": "replica-2",
+         "verdict": "gone", "port": 5, "pid": 6},
+        {"ev": "adopt.done", "adopted": 2, "reaped": 1,
+         "wall_s": 0.42},
+        {"ev": "serve.dedup", "req": "r000001", "idem": "k0",
+         "orig": "r000000", "status": "ok"},
+    ]
+    for i, e in enumerate(events):       # the ledger's line shape
+        e.setdefault("t", 100.0 + 0.01 * i)
+        e.setdefault("pid", 1)
+    rec = recovery_summary(events)
+    assert rec["recoveries"] == 1
+    assert rec["adopted"] == 2 and rec["reaped"] == 1
+    assert rec["verdicts"] == {"adopted": 2, "gone": 1}
+    assert rec["journal_records"] == 2
+    assert rec["journal_replays"] == 1
+    assert rec["dedup_hits"] == 1
+    assert rec["mttr_max_s"] == 0.42
+    assert recovery_summary([{"ev": "serve.coalesce"}]) is None
+    summary = summarize("x.jsonl", events, torn=0)
+    md = summary_markdown(summary)
+    assert "crash recovery" in md
+    assert "0.42" in md
+
+
+def test_recovery_evidence_joins_on_idem_keys(tmp_path):
+    """The exactly-once audit counts coalesce-stamped idempotency
+    keys (request ids are per-engine and collide across replicas) —
+    per-key launches beyond the first are the duplicates; rotation
+    sidecars are read oldest-first; other prefixes are invisible."""
+    path = str(tmp_path / "ledger.jsonl")
+    with open(path + ".1", "w") as f:     # rotated older half
+        f.write(json.dumps({"ev": "serve.coalesce", "batch": 0,
+                            "idems": ["kr-0", "kr-1"]}) + "\n")
+    rows = [
+        {"ev": "serve.coalesce", "batch": 1, "idems": ["kr-1", "x-9"]},
+        {"ev": "serve.dedup", "idem": "kr-2"},
+        {"ev": "serve.dedup", "idem": "x-2"},
+        {"ev": "adopt.done", "adopted": 2, "reaped": 0,
+         "wall_s": 0.3},
+        "not json\n",
+    ]
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(r if isinstance(r, str) else json.dumps(r) + "\n")
+    ev = _recovery_evidence(path, "kr-")
+    assert ev["executed_keys"] == 2
+    assert ev["duplicates"] == 1          # kr-1 launched twice
+    assert ev["dedup_hits"] == 1          # x-2 filtered out
+    assert ev["adopted"] == 2 and ev["adopt_wall_s"] == 0.3
+    empty = _recovery_evidence(str(tmp_path / "missing.jsonl"), "kr-")
+    assert empty == {"duplicates": 0, "dedup_hits": 0,
+                     "executed_keys": 0}
+
+
+def test_recovery_markdown_orders_scenarios_and_flags_duplicates():
+    art = {"dtype": "int", "methods": ["SUM"], "requests": 8,
+           "crash_after": 3, "seed": 0, "platform": "cpu",
+           "rows": [
+               {"key": "drain", "requests": 8, "ok": 8,
+                "shed": 0, "duplicates": 0, "dedup_hits": 0,
+                "mttr_s": 0.0},
+               {"key": "kill_router", "requests": 8, "ok": 8,
+                "shed": 0, "duplicates": 0, "dedup_hits": 2,
+                "mttr_s": 0.5, "adopted": 2, "reaped": 0,
+                "adopt_wall_s": 0.4},
+               {"key": "kill_replica", "requests": 8, "ok": 8,
+                "shed": 1, "duplicates": 0, "dedup_hits": 0,
+                "mttr_s": 0.0},
+           ]}
+    md = recovery_markdown(art)
+    lines = [ln for ln in md.splitlines() if ln.startswith("| kill")
+             or ln.startswith("| drain")]
+    assert lines[0].startswith("| kill_router")
+    assert lines[-1].startswith("| drain")
+    assert "crash-consistent" in md
+
+
+# ------------------------------------------------------- chaos e2e
+
+
+def _spawn_router(jpath, port_file, env):
+    if os.path.exists(port_file):
+        os.unlink(port_file)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_reductions.serve.router",
+         "--replicas", "2", "--platform", "cpu",
+         "--journal", jpath, "--port-file", port_file,
+         "--max-seconds", "300"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"router died during spawn "
+                               f"(exit {proc.returncode})")
+        if os.path.exists(port_file):
+            return proc
+        time.sleep(0.05)
+    proc.kill()
+    raise TimeoutError("router never published its port")
+
+
+def _pid_dead(pid):
+    try:
+        os.kill(pid, 0)
+        return False
+    except (ProcessLookupError, PermissionError):
+        return True
+
+
+def test_router_crash_recovery_e2e(tmp_path):
+    """The tentpole's chaos proof end-to-end: a REAL journaled router
+    over two process replicas dies by the scripted `router.crash`
+    os._exit mid-burst; the clients retry broken requests with their
+    original idempotency keys; a restart against the same journal
+    RE-ADOPTS both still-live children (same pids — never respawned);
+    every request lands exactly one terminal ok; the ledger-joined
+    audit counts ZERO duplicate device executions; teardown leaves
+    zero orphaned children."""
+    jpath = str(tmp_path / "fleet_journal.json")
+    port_file = str(tmp_path / "router.port")
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    plan = _stamp_idem(
+        plan_workload(7, count=12, methods=["SUM", "MIN"], dtype="int",
+                      n_choices=[4096], rate_rps=200.0), "e2e-")
+    base_env = {k: v for k, v in os.environ.items()
+                if not k.startswith("TPU_REDUCTIONS_")}
+    base_env["TPU_REDUCTIONS_LEDGER"] = ledger_path
+    crash_env = dict(base_env)
+    crash_env["TPU_REDUCTIONS_FAULTS"] = json.dumps(
+        {"router.crash": {"after": 4, "action": "exit", "code": 86}})
+
+    proc = _spawn_router(jpath, port_file, crash_env)
+    procs = [proc]
+    try:
+        rows = []
+        client = threading.Thread(
+            target=lambda: rows.extend(
+                _recovery_client(port_file, plan, clients=3,
+                                 retry_window_s=180.0)),
+            daemon=True)
+        client.start()
+        # the 5th routed submit fires the os._exit — no drain, no
+        # atexit, children orphaned alive with work in flight
+        assert proc.wait(timeout=120) == 86
+        pids = [int(e["pid"]) for e in
+                json.loads(open(jpath).read())["replicas"].values()
+                if e.get("state") == "up"]
+        assert len(pids) == 2
+        assert all(not _pid_dead(p) for p in pids)   # orphans live on
+
+        proc2 = _spawn_router(jpath, port_file, base_env)
+        procs.append(proc2)
+        client.join(timeout=180)
+        assert not client.is_alive()
+        assert len(rows) == len(plan)
+        assert all(r["status"] == "ok" for r in rows), \
+            [(r["key"], r["status"], r.get("error")) for r in rows
+             if r["status"] != "ok"]
+        assert any(r["attempts"] > 1 for r in rows)   # retries happened
+
+        # the successor ADOPTED the orphans: same pids, still alive
+        pids_after = [int(e["pid"]) for e in
+                      json.loads(open(jpath).read())
+                      ["replicas"].values() if e.get("state") == "up"]
+        assert sorted(pids_after) == sorted(pids)
+
+        ev = _recovery_evidence(ledger_path, "e2e-")
+        assert ev["executed_keys"] == len(plan)
+        assert ev["duplicates"] == 0
+        assert ev["adopted"] == 2 and ev["reaped"] == 0
+
+        proc2.send_signal(signal.SIGINT)
+        assert proc2.wait(timeout=60) == 0
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline \
+                and not all(_pid_dead(p) for p in pids):
+            time.sleep(0.1)
+        assert all(_pid_dead(p) for p in pids)   # zero orphans
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.send_signal(signal.SIGINT)
+        for pr in procs:
+            if pr.poll() is None:
+                try:
+                    pr.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pr.kill()
+        # best-effort INT-first sweep of any child the journal still
+        # records (a mid-test failure must not leak serve processes
+        # into the rest of the suite)
+        try:
+            entries = json.loads(open(jpath).read())["replicas"]
+        except (OSError, ValueError, KeyError):
+            entries = {}
+        for e in entries.values():
+            pid = e.get("pid")
+            if pid and not _pid_dead(int(pid)):
+                try:
+                    os.kill(int(pid), signal.SIGINT)
+                except (ProcessLookupError, PermissionError):
+                    pass

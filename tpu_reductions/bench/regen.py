@@ -306,6 +306,22 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                 "(serving_elastic.json)")
         except (OSError, ValueError, KeyError, TypeError) as e:
             log(f"regen: serving_elastic.json unusable ({e}); skipped")
+    # the crash-recovery instrument (ISSUE 18): MTTR / shed /
+    # ledger-verified duplicate device executions for kill-router vs
+    # kill-replica vs drain on one seeded idem-keyed workload,
+    # committed by serve/loadgen.py --recovery
+    # (scripts/run_serving_recovery.sh)
+    rc_file = out / "serving_recovery.json"
+    if rc_file.exists():
+        try:
+            from tpu_reductions.serve.loadgen import recovery_markdown
+            rc = json.loads(rc_file.read_text())
+            with open(paths["md"], "a") as f:
+                f.write("\n" + recovery_markdown(rc) + "\n")
+            log("regen: appended crash-recovery table "
+                "(serving_recovery.json)")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: serving_recovery.json unusable ({e}); skipped")
     # the streaming pipeline's committed probes (ISSUE 7 evidence,
     # ISSUE 8 relocation: the ONE copy lives in the experiment dir —
     # the PR-6 serving_curve dedup rule applied to stream artifacts)

@@ -181,6 +181,38 @@ def autoscale_cooldown_s(override: Optional[float] = None) -> float:
     env = _env_float("TPU_REDUCTIONS_AUTOSCALE_COOLDOWN_S")
     return env if env is not None else DEFAULT_AUTOSCALE_COOLDOWN_S
 
+
+# ---------------------------------------------------------------------------
+# Crash-consistent control plane knobs (serve/journal.py, serve/engine.py
+# dedup cache; docs/SERVING.md "crash-consistent control plane"). Same
+# discipline: explicit argument > env override > default.
+# ---------------------------------------------------------------------------
+
+DEFAULT_DEDUP_CACHE_SIZE = 1024
+
+
+def fleet_journal_path(override: Optional[str] = None) -> Optional[str]:
+    """Where the fleet journal persists: explicit argument (the
+    router's --journal flag), else TPU_REDUCTIONS_FLEET_JOURNAL, else
+    None (journaling off — an in-process test fleet does not need a
+    file). All writes route through utils/jsonio (RED010)."""
+    if override:
+        return str(override)
+    import os
+    return os.environ.get("TPU_REDUCTIONS_FLEET_JOURNAL") or None
+
+
+def dedup_cache_size(override: Optional[int] = None) -> int:
+    """Bound on each engine's settled-response dedup cache (entries):
+    explicit argument, else TPU_REDUCTIONS_DEDUP_CACHE_SIZE, else 1024.
+    Eviction is LRU; an evicted idempotency key degrades to the
+    documented at-least-once fallback (retry re-executes) — never a
+    hang (docs/SERVING.md)."""
+    if override is not None and override > 0:
+        return int(override)
+    return _env_bytes("TPU_REDUCTIONS_DEDUP_CACHE_SIZE") \
+        or DEFAULT_DEDUP_CACHE_SIZE
+
 # Kernel ids: the reference kept only kernel 6 live and emptied 0-5
 # (reduction_kernel.cu:278-289). We map 6 -> single-pass fold-accumulator
 # Pallas kernel, 7 -> two-pass partials Pallas kernel, 8-10 ->

@@ -58,7 +58,13 @@ planned replica drain after the wait-for-quiesce and before the
 warm-key handoff, serve/autoscale.drain_replica — a scripted `raise`
 there is the "drain interrupted mid-protocol" case the drain-vs-kill
 contract contrasts: the victim dies like a SIGKILL instead of
-finishing the handoff, tests/test_serve_elastic.py).
+finishing the handoff, tests/test_serve_elastic.py), and
+`router.crash` (fired on every ReplicaRouter.submit before routing —
+a scripted `exit` after N hits is the deterministic SIGKILL-class
+controller death mid-burst: os._exit, no drain, children orphaned
+alive with the fleet journal as their only record; the recovery
+suite restarts the router against that journal,
+tests/test_serve_recovery.py).
 docs/RESILIENCE.md keeps the list.
 
 Counters are process-global and monotonic; `reset()` re-arms them for

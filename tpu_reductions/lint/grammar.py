@@ -127,7 +127,7 @@ SCHED_EVENTS = ("sched.plan", "sched.pick", "sched.skip", "sched.done",
 SERVE_EVENTS = ("serve.start", "serve.enqueue", "serve.coalesce",
                 "serve.launch", "serve.verify", "serve.respond",
                 "serve.shed", "serve.stop", "serve.stream",
-                "serve.shard")
+                "serve.shard", "serve.dedup")
 
 # the replica router's typed events (serve/router.py; ISSUE 13 —
 # docs/SERVING.md "scaling tier"): route.start/stop bracket the router
@@ -175,9 +175,22 @@ RESHARD_EVENTS = ("reshard.plan", "reshard.step", "reshard.done")
 # redistribution program's oracle verdict + measured peak-memory
 # factor. Consumer: obs/timeline.py's autoscale_summary
 # (replica-count-vs-load attribution)
-AUTOSCALE_EVENTS = ("autoscale.tick", "autoscale.up", "autoscale.down")
+AUTOSCALE_EVENTS = ("autoscale.tick", "autoscale.up", "autoscale.down",
+                    "autoscale.resume")
 DRAIN_EVENTS = ("drain.begin", "drain.wait", "drain.handoff",
                 "drain.reshard", "drain.done")
+
+# the crash-consistent control plane's typed events (serve/journal.py
+# + serve/router.adopt_fleet; ISSUE 18 — docs/SERVING.md
+# "crash-consistent control plane"): journal.open/replay bracket a
+# journal attach (replay = a prior controller's state was loaded),
+# journal.record is one write-ahead fleet transition; adopt.begin ->
+# adopt.replica (verdict adopted/reaped-*/stale/gone per child) ->
+# adopt.done is the recovery protocol — adopt.done's wall_s is the
+# controller-MTTR evidence; serve.dedup (SERVE_EVENTS) is the
+# exactly-once cache hit. Consumer: obs/timeline.py's recovery_summary
+JOURNAL_EVENTS = ("journal.open", "journal.replay", "journal.record")
+ADOPT_EVENTS = ("adopt.begin", "adopt.replica", "adopt.done")
 
 # the compile observatory's typed events (obs/compile.py; ISSUE 8 —
 # docs/OBSERVABILITY.md "reading the compile table"): every XLA/Pallas
@@ -227,7 +240,8 @@ REGISTERED_EVENTS = frozenset(CORE_EVENTS + SHELL_EVENTS + SCHED_EVENTS
                               + COMPILE_EVENTS + COLLECTIVE_EVENTS
                               + ROUTE_EVENTS + REPLICA_EVENTS
                               + RESHARD_EVENTS + AUTOSCALE_EVENTS
-                              + DRAIN_EVENTS)
+                              + DRAIN_EVENTS + JOURNAL_EVENTS
+                              + ADOPT_EVENTS)
 
 
 def event_registered(name: str) -> bool:

@@ -485,15 +485,64 @@ def _red007(rel: str, ctx: _FileContext) -> List[RawFinding]:
 # temp+rename helpers (utils/jsonio.atomic_json_dump /
 # bench/resume.store_cell). json.dumps to stdout/log lines is fine —
 # only file-writing spellings are flagged.
+#
+# serve/ control-plane extension (ISSUE 18): inside
+# tpu_reductions/serve/ the fence widens to ANY write-mode open() and
+# any .write_text/.write_bytes call — the fleet journal, port files,
+# and every other control-plane state file are exactly the artifacts a
+# SIGKILL-class controller death must leave replayable
+# (serve/journal.py persists via atomic_json_dump; port files via
+# atomic_text_dump).
 # --------------------------------------------------------------------------
+
+_SERVE_STATE_DIR = "tpu_reductions/serve/"
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    """Whether this is an `open(...)` call with a literal w/a/x/+
+    mode (positional arg 1 or mode= keyword). Unknown/dynamic modes
+    stay unflagged: the rule fences spellings, not possibilities."""
+    if _attr_chain(node.func) != "open":
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None or not isinstance(mode, ast.Constant) \
+            or not isinstance(mode.value, str):
+        return False
+    return any(c in mode.value for c in "wax+")
+
 
 def _red010(rel: str, ctx: _FileContext) -> List[RawFinding]:
     if _suffix_match(rel, JSONIO_WHITELIST):
         return []
+    in_serve = rel.startswith(_SERVE_STATE_DIR) \
+        or _SERVE_STATE_DIR in rel
     out = []
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
+        if in_serve:
+            if _open_write_mode(node):
+                out.append(RawFinding(
+                    "RED010", node.lineno,
+                    "write-mode open() in serve/ — control-plane "
+                    "state must survive a SIGKILL-class controller "
+                    "death mid-write; persist via utils.jsonio."
+                    "atomic_json_dump / atomic_text_dump"))
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("write_text", "write_bytes"):
+                out.append(RawFinding(
+                    "RED010", node.lineno,
+                    f"{node.func.attr}() in serve/ — control-plane "
+                    "state must survive a SIGKILL-class controller "
+                    "death mid-write; persist via utils.jsonio."
+                    "atomic_json_dump / atomic_text_dump"))
+                continue
         chain = _attr_chain(node.func)
         if chain == "json.dump" or chain.endswith(".json.dump"):
             out.append(RawFinding(

@@ -514,6 +514,51 @@ def autoscale_summary(events: List[dict]) -> Optional[dict]:
     return out
 
 
+def recovery_summary(events: List[dict]) -> Optional[dict]:
+    """Crash-recovery attribution from the control plane's typed
+    events (lint/grammar.py JOURNAL_EVENTS/ADOPT_EVENTS + serve.dedup;
+    serve/journal.py, serve/router.adopt_fleet — ISSUE 18). Per
+    recovery: the adopt.begin -> adopt.done wall clock IS the MTTR
+    evidence, with the per-child verdicts (adopted vs INT-first
+    reaped vs already gone) and the exactly-once record (dedup cache
+    hits that answered retried keys without re-touching the device).
+    None when no journal was in play."""
+    begins = [e for e in events if e["ev"] == "adopt.begin"]
+    dones = [e for e in events if e["ev"] == "adopt.done"]
+    reps = [e for e in events if e["ev"] == "adopt.replica"]
+    journal_records = sum(1 for e in events
+                          if e["ev"] == "journal.record")
+    replays = [e for e in events if e["ev"] == "journal.replay"]
+    dedup_hits = sum(1 for e in events if e["ev"] == "serve.dedup")
+    if not begins and not dones and not journal_records \
+            and not replays and not dedup_hits:
+        return None
+    verdicts: dict = {}
+    for e in reps:
+        v = e.get("verdict")
+        if isinstance(v, str):
+            verdicts[v] = verdicts.get(v, 0) + 1
+    recoveries = []
+    for e in dones:
+        recoveries.append({"adopted": e.get("adopted"),
+                           "reaped": e.get("reaped"),
+                           "mttr_s": e.get("wall_s")})
+    out = {"recoveries": len(dones),
+           "adopted": sum(int(e.get("adopted", 0)) for e in dones),
+           "reaped": sum(int(e.get("reaped", 0)) for e in dones),
+           "verdicts": verdicts,
+           "journal_records": journal_records,
+           "journal_replays": len(replays),
+           "dedup_hits": dedup_hits}
+    mttrs = [r["mttr_s"] for r in recoveries
+             if isinstance(r["mttr_s"], (int, float))]
+    if mttrs:
+        out["mttr_max_s"] = round(max(float(m) for m in mttrs), 6)
+    if recoveries:
+        out["per_recovery"] = recoveries
+    return out
+
+
 def compile_summary(events: List[dict]) -> Optional[dict]:
     """Per-surface compile attribution from the compile observatory's
     typed events (compile.start/end, warm.* — lint/grammar.py
@@ -581,6 +626,9 @@ def summarize(path, events: List[dict], torn: int) -> dict:
     auto = autoscale_summary(events)
     if auto is not None:
         out["autoscale"] = auto
+    rec = recovery_summary(events)
+    if rec is not None:
+        out["recovery"] = rec
     comp = compile_summary(events)
     if comp is not None:
         out["compile"] = comp
@@ -859,6 +907,36 @@ def summary_markdown(summary: dict) -> str:
                     f"| {waited if waited is not None else '-'} "
                     f"| {d.get('keys', '-')} | {d.get('shed', '-')} "
                     f"| {d.get('expired', '-')} | {resh_cell} |")
+    rec = summary.get("recovery")
+    if rec:
+        # the crash-consistent control plane's record (ISSUE 18):
+        # per-recovery MTTR from the adopt.begin -> adopt.done wall
+        # clock, the per-child adoption verdicts, and the dedup-cache
+        # hits that made router retries exactly-once
+        lines.append("")
+        lines.append("### crash recovery (journal / adoption / dedup)")
+        lines.append("")
+        verdicts = ", ".join(f"{k}: {v}" for k, v
+                             in sorted(rec["verdicts"].items())) or "-"
+        lines.append(
+            f"{rec['recoveries']} recovery(ies), "
+            f"{rec['adopted']} replica(s) adopted, "
+            f"{rec['reaped']} reaped ({verdicts}); "
+            f"{rec['journal_records']} journal record(s), "
+            f"{rec['journal_replays']} replay(s), "
+            f"{rec['dedup_hits']} dedup hit(s)"
+            + (f"; MTTR <= {rec['mttr_max_s']:.3f} s"
+               if rec.get("mttr_max_s") is not None else ""))
+        if rec.get("per_recovery"):
+            lines.append("")
+            lines.append("| recovery | adopted | reaped | MTTR s |")
+            lines.append("|---|---|---|---|")
+            for i, r in enumerate(rec["per_recovery"]):
+                mttr = r.get("mttr_s")
+                lines.append(
+                    f"| {i} | {r.get('adopted', '-')} "
+                    f"| {r.get('reaped', '-')} "
+                    f"| {f'{mttr:.3f}' if isinstance(mttr, (int, float)) else '-'} |")
     comp = summary.get("compile")
     if comp:
         # the compile observatory's record (ISSUE 8): per-surface

@@ -296,6 +296,26 @@ SESSION_TASKS: Tuple[Task, ...] = (
                             "--out=serving_elastic.json"),
          artifacts=("examples/tpu_run/serving_elastic.json",),
          done_artifact="examples/tpu_run/serving_elastic.json"),
+    Task("serving_recovery", "crash-recovery instrument", value=100.0,
+         budget_s=420,
+         # off-chip by design (ISSUE 18; docs/SERVING.md
+         # crash-consistent control plane): a REAL journaled router
+         # subprocess over ProcessReplica children dies via the
+         # scripted router.crash os._exit and restarts against its
+         # journal, then the in-process kill-replica / drain contrast
+         # pair runs on the same seeded idem-keyed workload — all on
+         # --platform=cpu, safe with the relay dead, flap-time filler
+         # like the other serving curves; the ONE committed artifact
+         # lives in the experiment dir and bench/regen folds
+         # recovery_markdown into report.md from there
+         command="bash scripts/run_serving_recovery.sh",
+         rehearsal_command=("python -m tpu_reductions.serve.loadgen "
+                            "--platform=cpu --recovery "
+                            "--recovery-requests=24 --crash-after=8 "
+                            "--n=8192 "
+                            "--out=serving_recovery.json"),
+         artifacts=("examples/tpu_run/serving_recovery.json",),
+         done_artifact="examples/tpu_run/serving_recovery.json"),
     Task("flagship", "flagship experiment", value=300.0, budget_s=10800,
          command="bash scripts/run_tpu_experiment.sh examples/tpu_run",
          artifacts=("examples/tpu_run",),

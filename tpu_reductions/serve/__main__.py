@@ -45,6 +45,11 @@ def _control_response(engine, spec: dict) -> dict:
     raising — the parent treats an error as the kill case."""
     op = spec.get("op")
     try:
+        if op == "ping":
+            # the adoption liveness probe (serve/router.adopt_fleet):
+            # a pid can outlive a wedged engine, so recovery trusts
+            # only a served control round-trip
+            return {"op": op, "ok": True}
         if op == "drain":
             engine.begin_drain()
             return {"op": op, "ok": True}
@@ -92,7 +97,8 @@ def _make_handler(engine, request_timeout_s: float):
                         value=float(spec.get("value", 1.0)),
                         tenant=spec.get("tenant", "default"),
                         priority=int(spec.get("priority", 1)),
-                        slo=spec.get("slo"))
+                        slo=spec.get("slo"),
+                        idem_key=spec.get("idem_key"))
                 except (KeyError, TypeError, ValueError) as e:
                     resp = {"status": "rejected",
                             "error": f"malformed request: {e}"}

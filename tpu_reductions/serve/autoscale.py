@@ -73,6 +73,13 @@ def drain_replica(router, victim, *, executor=None,
     vid = victim.replica_id
     ledger.emit("drain.begin", replica=vid,
                 mem_bound=round(float(mem_bound), 6))
+    # write-ahead (serve/journal.py): the journal shows "draining"
+    # before admission closes, so a controller crash mid-drain leaves
+    # recovery a record of the phase — a draining-but-alive child is
+    # adopted like any other and the drain re-decided
+    journal = getattr(router, "journal", None)
+    if journal is not None:
+        journal.record_replica(vid, state="draining")
     victim.drain_begin()
 
     # -- 2. let in-flight + queued work finish ------------------------
@@ -211,7 +218,7 @@ class Autoscaler:
                  slo_classes: Optional[Dict[str, float]] = None,
                  executor=None, up_load: float = 4.0,
                  down_load: float = 1.0, down_ticks: int = 3,
-                 mem_bound: float = 2.0,
+                 mem_bound: float = 2.0, journal=None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self._router = router
         self._spawn = spawn
@@ -229,13 +236,60 @@ class Autoscaler:
         self._down_ticks = int(down_ticks)
         self._mem_bound = float(mem_bound)
         self._clock = clock
+        # the fleet journal, when the fleet has one: every tick's
+        # control state (cooldown anchor, calm counter, last decision)
+        # is journaled write-ahead so a restarted controller resumes
+        # the POLICY mid-cooldown instead of cold-starting it
+        # (serve/journal.py; router.journal is the usual source)
+        self._journal = journal if journal is not None \
+            else getattr(router, "journal", None)
         self._last_action_t: Optional[float] = None
+        self._last_action: Optional[str] = None
         self._calm = 0
         self._next_idx = len(router.replicas)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.history: List[dict] = []
         self.drains: List[dict] = []
+
+    # -- crash-consistent control state (serve/journal.py) ------------
+
+    def export_state(self) -> dict:
+        """The journal-shaped control-loop state. The cooldown anchor
+        crosses processes as a WALL clock (`time.time()`): the dead
+        controller's monotonic clock means nothing to the successor,
+        but wall-clock elapsed-since-last-action does."""
+        if self._last_action_t is None:
+            last_wall = None
+        else:
+            last_wall = time.time() - (self._clock()
+                                       - self._last_action_t)
+        return {"last_action_wall": last_wall,
+                "last_action": self._last_action,
+                "cooldown_s": self._cooldown_s,
+                "calm": self._calm, "next_idx": self._next_idx}
+
+    def restore_state(self, state: Optional[dict]) -> None:
+        """Resume a journaled control loop mid-cooldown: the remaining
+        cooldown carries over (converted back onto this process's
+        clock), as do the calm-tick counter and the replica-name
+        counter — the successor never re-fires a decision the
+        predecessor's hysteresis had already damped."""
+        if not state:
+            return
+        last_wall = state.get("last_action_wall")
+        if last_wall is not None:
+            elapsed = max(0.0, time.time() - float(last_wall))
+            self._last_action_t = self._clock() - elapsed
+        self._last_action = state.get("last_action")
+        self._calm = int(state.get("calm") or 0)
+        self._next_idx = max(self._next_idx,
+                             int(state.get("next_idx") or 0))
+        ledger.emit("autoscale.resume",
+                    cooling=(self._last_action_t is not None
+                             and self._clock() - self._last_action_t
+                             < self._cooldown_s),
+                    calm_ticks=self._calm, next_idx=self._next_idx)
 
     # -- signals ------------------------------------------------------
 
@@ -287,22 +341,35 @@ class Autoscaler:
         self._calm = self._calm + 1 if calm else 0
         action = "hold"
         if want_up and n < self._max and not cooling:
+            # write-ahead: the decision (and the cooldown it starts)
+            # is on disk before the spawn, so a crash mid-action
+            # resumes cooling instead of immediately re-deciding
+            self._last_action_t = now
+            self._last_action = "up"
+            self._calm = 0
+            self._journal_state()
             self._scale_up(sig)
             action = "up"
-            self._last_action_t = now
-            self._calm = 0
         elif (self._calm >= self._down_ticks and n > self._min
                 and not cooling):
+            self._last_action_t = now
+            self._last_action = "down"
+            self._calm = 0
+            self._journal_state()
             self._scale_down(sig)
             action = "down"
-            self._last_action_t = now
-            self._calm = 0
+        else:
+            self._journal_state()
         record = dict(sig, action=action, cooling=cooling,
                       calm_ticks=self._calm, t=round(now, 4))
         record.pop("active")
         ledger.emit("autoscale.tick", **record)
         self.history.append(record)
         return record
+
+    def _journal_state(self) -> None:
+        if self._journal is not None:
+            self._journal.record_autoscaler(self.export_state())
 
     def _scale_up(self, sig: dict) -> None:
         replica = self._spawn(self._next_idx)

@@ -41,7 +41,7 @@ import itertools
 import sys
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional
 
@@ -137,6 +137,7 @@ class ServeEngine:
                  slo_classes: Optional[Dict[str, float]] = None,
                  slo_min_samples: int = 8,
                  quant_slack_factor: float = 2.0,
+                 dedup_cache_size: Optional[int] = None,
                  executor=None, transport=None,
                  cost_model: Optional[CostModel] = None) -> None:
         if max_queue <= 0 or max_batch <= 0:
@@ -195,7 +196,20 @@ class ServeEngine:
         self.stats: Dict[str, float] = {
             "submitted": 0, "ok": 0, "error": 0, "rejected": 0,
             "expired": 0, "shed": 0, "batches": 0, "batched_requests": 0,
-            "preempted": 0, "sharded": 0}
+            "preempted": 0, "sharded": 0, "dedup_hits": 0}
+        # exactly-once settlement (docs/SERVING.md "crash-consistent
+        # control plane"): bounded LRU of settled terminal responses
+        # keyed on the client-supplied idempotency key. A duplicate of
+        # a settled key — a router re-route after a timeout, a client
+        # retry across a controller crash — returns the cached response
+        # WITHOUT re-touching the device. Only settled outcomes cache
+        # (ok, and errors that are not transport/lifecycle failures);
+        # rejected/shed/expired stay retryable by design. Eviction at
+        # the bound degrades the evicted key to at-least-once (retry
+        # re-executes) — documented fallback, never a hang.
+        self._dedup_max = config.dedup_cache_size(dedup_cache_size)
+        self._dedup: "OrderedDict[str, ReduceResponse]" = OrderedDict()
+        self._dedup_lock = threading.Lock()
         # jit-bucket keys this engine has warmed or launched — the warm
         # state a planned drain hands to the surviving replicas
         # (serve/autoscale.drain_replica; docs/SERVING.md elastic fleet)
@@ -309,6 +323,22 @@ class ServeEngine:
         rid = f"r{next(self._ids):06d}"
         pending = PendingResponse(rid)
         self._bump("submitted")
+        # exactly-once short-circuit BEFORE admission: a settled
+        # idempotency key answers from the dedup cache even on a
+        # draining or stopping engine — the work already happened;
+        # re-running it (or bouncing the retry) would break the
+        # one-terminal-status-per-key contract
+        if request.idem_key is not None:
+            cached = self._dedup_get(request.idem_key)
+            if cached is not None:
+                self._bump("dedup_hits")
+                ledger.emit("serve.dedup", req=rid,
+                            idem=request.idem_key,
+                            orig=cached.request_id,
+                            status=cached.status,
+                            **trace.request_fields(rid))
+                pending.resolve(cached)
+                return pending
         reason = self._admission_reason(request)
         if reason is not None:
             return self._resolve_at_admission(request, rid, pending,
@@ -358,8 +388,54 @@ class ServeEngine:
                     dtype=request.dtype, n=request.n, depth=depth,
                     streamed=adm.streamed, tenant=request.tenant,
                     priority=request.priority,
+                    # the idem key on the enqueue row is what makes
+                    # "zero duplicate device executions" LEDGER-
+                    # verifiable: loadgen --recovery joins enqueue
+                    # rows to coalesce/launch rows per key
+                    **({"idem": request.idem_key}
+                       if request.idem_key else {}),
                     **trace.request_fields(rid))
         return pending
+
+    # -- exactly-once dedup cache -------------------------------------
+
+    def _dedup_get(self,
+                   idem_key: str) -> Optional[ReduceResponse]:
+        """Cached terminal response for a settled key (LRU touch), or
+        None — the miss path costs one dict lookup under a lock."""
+        with self._dedup_lock:
+            resp = self._dedup.get(idem_key)
+            if resp is not None:
+                self._dedup.move_to_end(idem_key)
+            return resp
+
+    @staticmethod
+    def _dedup_settled(status: str, error: Optional[str]) -> bool:
+        """Whether an outcome is a SETTLEMENT worth caching. ok always
+        is; an error is only when the device genuinely executed and
+        failed (verification mismatch, contained batch crash) — a
+        transport/lifecycle failure (dead relay, stopping engine,
+        draining replica) must stay retryable, or a cached failure
+        would poison every later retry of the key."""
+        if status == "ok":
+            return True
+        if status != "error":
+            return False
+        e = error or ""
+        return not any(mark in e for mark in (
+            "relay dead", "relay-dead", "engine-stopped",
+            "replica-draining"))
+
+    def _dedup_put(self, idem_key: str, resp: ReduceResponse) -> None:
+        """Record a settlement (first settle wins — a racing duplicate
+        never clobbers what a client may already hold) and evict LRU
+        past the bound (config.dedup_cache_size)."""
+        with self._dedup_lock:
+            if idem_key in self._dedup:
+                return
+            self._dedup[idem_key] = resp
+            while len(self._dedup) > self._dedup_max:
+                self._dedup.popitem(last=False)
 
     def _resolve_at_admission(self, request: ReduceRequest, rid: str,
                               pending: PendingResponse, status: str,
@@ -502,6 +578,10 @@ class ServeEngine:
             # shedding consults (only ok latencies: a shed/rejected
             # request's instant resolution says nothing about service)
             self._slo.observe(r.slo, latency)
+        # exactly-once: record the settlement BEFORE resolving, so a
+        # duplicate racing the resolution finds the cache populated
+        if r.idem_key is not None and self._dedup_settled(status, error):
+            self._dedup_put(r.idem_key, resp)
         ledger.emit("serve.respond", **fields)
         adm.pending.resolve(resp)
 
@@ -576,10 +656,17 @@ class ServeEngine:
         launch, defer = plan_round(batches, cost_model=self._cost_model,
                                    device_window_s=self._device_window_s)
         for b in launch:
+            # request ids are per-engine (r000000 collides across
+            # replicas), so the exactly-once audit joins on the
+            # client-supplied idempotency keys stamped HERE — the
+            # launch-membership event IS the device-execution record
+            # (serve/loadgen._recovery_evidence)
+            idems = [a.request.idem_key for a in b.admitted]
             ledger.emit("serve.coalesce", batch=b.batch_id,
                         method=b.key[0], dtype=b.key[1], n=b.key[2],
                         size=b.size,
-                        reqs=[a.request_id for a in b.admitted])
+                        reqs=[a.request_id for a in b.admitted],
+                        **({"idems": idems} if any(idems) else {}))
         if defer:
             # deferred batches keep their place ahead of new arrivals
             with self._cond:

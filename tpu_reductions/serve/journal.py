@@ -1,0 +1,185 @@
+"""Write-ahead fleet journal: the control plane's crash consistency.
+
+The serving fleet's orchestration state — which ProcessReplica
+children exist (name, port, pid, platform, relay port, lifecycle
+state), which jit-bucket placements have been prewarmed where, and
+where the autoscaler's control loop stands (cooldown clock, calm-tick
+counter, last decision) — used to live only in router/autoscaler
+memory, so a controller death orphaned live children (with
+possibly-nonempty device queues: the machine-wedge hazard of
+CLAUDE.md) and cold-started the scaling policy. The journal gives the
+control plane the same crash-consistency contract the bench artifacts
+have had since bench/resume.py: every fleet transition is persisted
+atomically (utils/jsonio — RED010's fsync'd temp+rename discipline)
+BEFORE the action it describes, under a Checkpoint-style meta
+contract, so a restarted `python -m tpu_reductions.serve.router
+--journal=PATH` can re-adopt still-live children, reap the rest
+INT-first, and resume the autoscaler mid-cooldown
+(docs/SERVING.md "crash-consistent control plane").
+
+Write-ahead ordering: `record_replica(name, state="starting")` lands
+on disk before the Popen; "up" (with port+pid) lands the moment the
+port file resolves; drain phases land before each phase acts. A crash
+between journal and action therefore leaves a conservative record —
+the recovering router probes a "starting" entry and reaps it if it
+never came up, instead of discovering an unrecorded orphan.
+
+jax-free by construction (RED014): the journal must be writable and
+replayable with the relay dead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu_reductions.obs import ledger
+from tpu_reductions.utils.jsonio import atomic_json_dump
+
+# the meta contract (bench/resume doctrine): a journal whose meta does
+# not round-trip identically is some other instrument's file — refuse
+# to replay it rather than adopt a fleet it does not describe
+JOURNAL_META = {"instrument": "fleet_journal", "version": 1}
+
+# replica lifecycle vocabulary — every journaled replica is in exactly
+# one of these states:
+#   starting   journaled ahead of the spawn; no port/pid yet
+#   up         serving (port + pid recorded)
+#   draining   planned scale-down in progress (admission closed)
+#   down       removed from the fleet (kept as tombstone for one
+#              journal generation so recovery can explain it)
+REPLICA_STATES = ("starting", "up", "draining", "down")
+
+
+class FleetJournal:
+    """Atomically-persisted fleet state (module docstring). With
+    `path=None` the journal is a pure in-memory record — the
+    in-process test fleets keep the same call sites without touching
+    disk. Thread-safe: the router's submit threads, the autoscaler
+    loop, and drain workers all record through one lock."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = os.fspath(path) if path else None
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, dict] = {}
+        self._placements: List[list] = []
+        self._autoscaler: Optional[dict] = None
+        replayed = self._load()
+        if self.path:
+            ledger.emit("journal.open", path=self.path,
+                        replayed=replayed,
+                        replicas=len(self._replicas))
+
+    # -- load / persist ------------------------------------------------
+
+    def _load(self) -> bool:
+        """Replay an existing journal file (meta contract permitting).
+        A truncated/foreign file is ignored — an empty fleet record is
+        the conservative recovery posture; atomic writes make real
+        truncation unreachable, so this guards foreign files."""
+        if not self.path or not os.path.exists(self.path):
+            return False
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if not isinstance(data, dict):
+            return False
+        if any(data.get(k) != v for k, v in JOURNAL_META.items()):
+            return False
+        reps = data.get("replicas")
+        self._replicas = {str(k): dict(v) for k, v in reps.items()} \
+            if isinstance(reps, dict) else {}
+        self._placements = [list(p) for p in data.get("placements", [])
+                            if isinstance(p, (list, tuple))]
+        auto = data.get("autoscaler")
+        self._autoscaler = dict(auto) if isinstance(auto, dict) else None
+        ledger.emit("journal.replay", path=self.path,
+                    replicas=len(self._replicas),
+                    placements=len(self._placements),
+                    autoscaler=self._autoscaler is not None)
+        return True
+
+    def _persist_locked(self, kind: str, name: Optional[str]) -> None:
+        if not self.path:
+            return
+        atomic_json_dump(self.path, {
+            **JOURNAL_META,
+            "wall": time.time(),
+            "replicas": self._replicas,
+            "placements": self._placements,
+            "autoscaler": self._autoscaler,
+        })
+        ledger.emit("journal.record", kind=kind,
+                    **({"name": name} if name else {}),
+                    replicas=len(self._replicas))
+
+    # -- replica transitions (write-ahead: call BEFORE acting) ---------
+
+    def record_replica(self, name: str, *, state: str,
+                       port: Optional[int] = None,
+                       pid: Optional[int] = None,
+                       platform: Optional[str] = None,
+                       relay_port: Optional[int] = None) -> None:
+        """Journal one replica transition. Fields given as None keep
+        their previously-journaled value (a drain transition does not
+        forget the port the adoption probe needs)."""
+        if state not in REPLICA_STATES:
+            raise ValueError(f"state must be one of {REPLICA_STATES}, "
+                             f"got {state!r}")
+        with self._lock:
+            entry = dict(self._replicas.get(name) or {})
+            entry["state"] = state
+            for key, val in (("port", port), ("pid", pid),
+                             ("platform", platform),
+                             ("relay_port", relay_port)):
+                if val is not None:
+                    entry[key] = val
+            self._replicas[name] = entry
+            self._persist_locked(f"replica-{state}", name)
+
+    def forget_replica(self, name: str) -> None:
+        """Drop a tombstone entirely (after a recovery has explained
+        it, or when a spawn failed before the child ever existed)."""
+        with self._lock:
+            if self._replicas.pop(name, None) is not None:
+                self._persist_locked("replica-forget", name)
+
+    # -- placements / autoscaler ---------------------------------------
+
+    def record_placement(self, method: str, dtype: str, n: int) -> None:
+        """Journal one prewarmed jit-bucket placement — what recovery
+        re-prewarms onto the adopted fleet so the survivors' compile
+        caches match the pre-crash fleet's."""
+        key = [method, dtype, int(n)]
+        with self._lock:
+            if key in self._placements:
+                return
+            self._placements.append(key)
+            self._persist_locked("placement", None)
+
+    def record_autoscaler(self, state: Optional[dict]) -> None:
+        """Journal the autoscaler's exported control-loop state
+        (serve/autoscale.Autoscaler.export_state: wall-clock cooldown
+        anchor, calm-tick counter, last decision, name counter)."""
+        with self._lock:
+            self._autoscaler = dict(state) if state else None
+            self._persist_locked("autoscaler", None)
+
+    # -- recovery-side accessors ---------------------------------------
+
+    def replicas(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._replicas.items()}
+
+    def placements(self) -> List[tuple]:
+        with self._lock:
+            return [tuple(p) for p in self._placements]
+
+    def autoscaler_state(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._autoscaler) if self._autoscaler else None

@@ -929,6 +929,399 @@ def _run_elastic(ns, methods: List[str]) -> int:
     return 0
 
 
+def recovery_markdown(artifact: dict) -> str:
+    """The report.md section for the crash-recovery instrument
+    (bench/regen.py folds it after the elastic fleet): per disruption
+    scenario on ONE seeded idem-keyed workload, the MTTR / shed /
+    duplicate-execution record the ISSUE 18 acceptance reads."""
+    lines = ["## crash-consistent control plane (kill-router vs "
+             "kill-replica vs drain)", ""]
+    meta = ", ".join(f"{k}={artifact[k]}"
+                     for k in ("dtype", "methods", "requests",
+                               "crash_after", "seed", "platform")
+                     if artifact.get(k) is not None)
+    if meta:
+        lines += [f"config: {meta}", ""]
+    rows = [r for r in artifact.get("rows", []) if isinstance(r, dict)]
+    if rows:
+        lines.append("| scenario | requests | ok | shed | duplicate "
+                     "device execs | dedup hits | MTTR s | adopted "
+                     "| reaped | other |")
+        lines.append("|---|---|---|---|---|---|---|---|---|---|")
+        order = {"kill_router": 0, "kill_replica": 1, "drain": 2}
+        for r in sorted(rows, key=lambda r: order.get(r.get("key"), 9)):
+            other = ", ".join(
+                f"{k}:{v}" for k, v in sorted(r.get("by_status",
+                                                    {}).items())
+                if k != "ok") or "-"
+            mttr = r.get("mttr_s")
+            lines.append(
+                f"| {r.get('key', '-')} | {r.get('requests', '-')} "
+                f"| {r.get('ok', '-')} | {r.get('shed', '-')} "
+                f"| {r.get('duplicates', '-')} "
+                f"| {r.get('dedup_hits', '-')} "
+                f"| {f'{mttr:.3f}' if isinstance(mttr, (int, float)) else '-'} "
+                f"| {r.get('adopted', '-')} | {r.get('reaped', '-')} "
+                f"| {other} |")
+    kr = next((r for r in rows if r.get("key") == "kill_router"), None)
+    if kr:
+        lines += ["", "controller SIGKILL mid-burst: the restarted "
+                      "router re-adopted "
+                      f"{kr.get('adopted')} journaled replica(s) in "
+                      f"{kr.get('adopt_wall_s')} s, every retried "
+                      "request carried its idempotency key, and the "
+                      "ledger shows "
+                      f"{kr.get('duplicates')} duplicate device "
+                      f"execution(s) ({kr.get('dedup_hits')} retried "
+                      "key(s) answered from the dedup cache without "
+                      "re-touching the device)"]
+    return "\n".join(lines)
+
+
+def _stamp_idem(plan: List[Tuple], prefix: str) -> List[Tuple]:
+    """Stamp every planned request with a client-supplied idempotency
+    key (the exactly-once contract's join key): scenario-prefixed so
+    one shared ledger separates the three scenarios' executions."""
+    import dataclasses
+    return [(off, dataclasses.replace(req, idem_key=f"{prefix}{i}"))
+            for i, (off, req) in enumerate(plan)]
+
+
+def _recovery_evidence(ledger_path: Optional[str], prefix: str) -> dict:
+    """The ledger-verified exactly-once record for one scenario's key
+    prefix: serve.coalesce launch-membership rows carry the
+    idempotency keys of every request they put on the device (request
+    ids are per-engine and collide across replicas, so the audit
+    counts keys, never rids) — per-key launches beyond the first are
+    the duplicate device executions, serve.dedup rows are the retries
+    the cache answered WITHOUT a launch, and adopt.done is the
+    adoption/MTTR record when a recovery ran."""
+    out: dict = {"duplicates": 0, "dedup_hits": 0, "executed_keys": 0}
+    if not ledger_path or not os.path.exists(ledger_path):
+        return out
+    execs: Dict[str, int] = {}
+    paths = [p for p in (ledger_path + ".1", ledger_path)
+             if os.path.exists(p)]      # rotation-aware, oldest first
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    name = ev.get("ev")
+                    if name == "serve.coalesce":
+                        for idem in ev.get("idems") or []:
+                            if isinstance(idem, str) \
+                                    and idem.startswith(prefix):
+                                execs[idem] = execs.get(idem, 0) + 1
+                    elif name == "serve.dedup":
+                        idem = ev.get("idem")
+                        if isinstance(idem, str) \
+                                and idem.startswith(prefix):
+                            out["dedup_hits"] += 1
+                    elif name == "adopt.done":
+                        out["adopted"] = ev.get("adopted")
+                        out["reaped"] = ev.get("reaped")
+                        out["adopt_wall_s"] = ev.get("wall_s")
+        except OSError:
+            continue
+    out["executed_keys"] = len(execs)
+    out["duplicates"] = sum(max(0, c - 1) for c in execs.values())
+    return out
+
+
+def _recovery_client(port_file: str, plan: List[Tuple], *,
+                     clients: int = 4,
+                     retry_window_s: float = 90.0) -> List[dict]:
+    """The kill-router scenario's TCP clients: `clients` threads split
+    the idem-keyed plan; a broken connection (the controller died
+    mid-burst) re-reads --port-file and RETRIES the same spec with the
+    SAME idempotency key against whichever router is listening —
+    at-least-once transport under the engine-side exactly-once cache.
+    Returns one record per request: key, terminal status, attempts,
+    and the completion wall clock (monotonic)."""
+    rows: List[dict] = []
+    lock = threading.Lock()
+
+    def _port() -> Optional[int]:
+        try:
+            with open(port_file) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _one(req) -> dict:
+        spec = {"method": req.method, "type": req.dtype, "n": req.n,
+                "seed": req.seed, "idem_key": req.idem_key}
+        deadline = time.monotonic() + retry_window_s
+        attempts = 0
+        err = "no attempt"
+        while time.monotonic() < deadline:
+            port = _port()
+            if port is None:
+                time.sleep(0.05)
+                continue
+            attempts += 1
+            try:
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=30) as sock:
+                    sock.sendall((json.dumps(spec) + "\n").encode())
+                    raw = sock.makefile("r").readline()
+                if not raw:
+                    raise ConnectionError("connection closed mid-request")
+                d = json.loads(raw)
+            except (OSError, ValueError) as e:
+                err = f"{type(e).__name__}: {e}"
+                time.sleep(0.05)
+                continue
+            return {"key": req.idem_key, "status": d.get("status"),
+                    "attempts": attempts, "t_done": time.monotonic(),
+                    "latency_s": d.get("latency_s")}
+        return {"key": req.idem_key, "status": "client-error",
+                "attempts": attempts, "t_done": time.monotonic(),
+                "error": err}
+
+    def _worker(slice_):
+        for _, req in slice_:
+            rec = _one(req)
+            with lock:
+                rows.append(rec)
+
+    threads = [threading.Thread(target=_worker, args=(plan[c::clients],),
+                                daemon=True)
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return rows
+
+
+def _run_recovery(ns, methods: List[str]) -> int:
+    """`--recovery`: the ISSUE 18 crash-recovery instrument. Three
+    disruptions on ONE seeded idem-keyed workload shape:
+
+      * kill_router — a REAL `serve.router --journal` subprocess over
+        ProcessReplica children dies via the scripted `router.crash`
+        os._exit mid-burst; the driver restarts it against the same
+        journal; TCP clients retry broken requests with their original
+        idempotency keys. The committed claim: zero duplicate device
+        executions (ledger-joined), replicas re-adopted not respawned,
+        MTTR in seconds.
+      * kill_replica — SIGKILL-equivalent on one in-process replica
+        mid-burst: the router re-routes carrying the keys, but a
+        victim that already executed and shed its response re-executes
+        on a survivor (separate dedup cache) — the honest at-least-once
+        contrast the journal/dedup pair exists to beat.
+      * drain — the planned exit (ISSUE 17): zero shed, zero
+        duplicates, on the same workload.
+    """
+    import subprocess
+
+    from tpu_reductions.bench.resume import Checkpoint
+    from tpu_reductions.obs import ledger
+    from tpu_reductions.serve.autoscale import drain_replica
+    from tpu_reductions.serve.executor import BatchExecutor
+    from tpu_reductions.serve.router import local_router
+
+    meta = {"instrument": "serving_recovery",
+            "dtype": DTYPE_ALIASES[ns.dtype],
+            "methods": ",".join(methods), "n": ns.n,
+            "requests": ns.recovery_requests,
+            "crash_after": ns.crash_after, "seed": ns.seed,
+            "platform": ns.platform or "default"}
+    ck = Checkpoint(ns.out, meta, key_fn=lambda r: r.get("key"))
+    ledger_path = None
+    if ns.out:
+        ledger_path = ledger.arm(ns.out + ".ledger.jsonl")
+    n_choices = (max(1024, ns.n // 2), ns.n)
+
+    def _plan(prefix: str):
+        # same seed for every scenario: the three rows contrast the
+        # EXIT, not the workload
+        plan = plan_workload(
+            ns.seed * 1_000_003 + 17, count=ns.recovery_requests,
+            methods=methods, dtype=ns.dtype, n_choices=n_choices,
+            rate_rps=8.0 * ns.recovery_requests, process="bursty",
+            burst=ns.burst)
+        return _stamp_idem(plan, prefix)
+
+    def _reusable(r):
+        return r.get("duplicates") is not None
+
+    # -- kill_router: real subprocess controller, journaled fleet -----
+    prior = ck.resume("kill_router", reusable=_reusable)
+    if prior is not None:
+        ck.add(prior)
+    else:
+        import shutil
+        import tempfile
+        workdir = tempfile.mkdtemp(prefix="recovery-")
+        jpath = os.path.join(workdir, "fleet_journal.json")
+        port_file = os.path.join(workdir, "port")
+        env = dict(os.environ)
+        if ledger_path:
+            env["TPU_REDUCTIONS_LEDGER"] = ledger_path
+        argv = [sys.executable, "-m", "tpu_reductions.serve.router",
+                "--replicas", "2", "--journal", jpath,
+                "--port-file", port_file, "--max-seconds", "300"]
+        if ns.platform:
+            argv += ["--platform", ns.platform]
+        # the scripted controller death: os._exit on the
+        # (crash_after+1)-th routed submit — no drain, no atexit,
+        # children orphaned with the journal as their only record
+        crash_env = dict(env)
+        crash_env["TPU_REDUCTIONS_FAULTS"] = json.dumps(
+            {"router.crash": {"after": ns.crash_after,
+                              "action": "exit", "code": 86}})
+        plan = _plan("kr-")
+        procs: List = []
+        t_death = [None]
+
+        def _spawn(e):
+            if os.path.exists(port_file):
+                os.unlink(port_file)
+            proc = subprocess.Popen(argv, env=e,
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL)
+            procs.append(proc)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if os.path.exists(port_file):
+                    return proc
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.05)
+            raise RuntimeError("router subprocess never published "
+                               f"its port (exit {proc.poll()})")
+
+        rows: List[dict] = []
+        try:
+            proc1 = _spawn(crash_env)
+            client = threading.Thread(
+                target=lambda: rows.extend(
+                    _recovery_client(port_file, plan)), daemon=True)
+            client.start()
+            # the driver IS the supervisor here: watch for the scripted
+            # death, restart against the same journal (fault disarmed)
+            while client.is_alive():
+                if t_death[0] is None and proc1.poll() is not None:
+                    t_death[0] = time.monotonic()
+                    _spawn(env)
+                client.join(timeout=0.05)
+            client.join()
+            mttr = None
+            if t_death[0] is not None:
+                after = [r["t_done"] for r in rows
+                         if r.get("status") == "ok"
+                         and r["t_done"] > t_death[0]]
+                if after:
+                    mttr = round(min(after) - t_death[0], 6)
+            lat = sorted(r["latency_s"] for r in rows
+                         if r.get("status") == "ok"
+                         and isinstance(r.get("latency_s"),
+                                        (int, float)))
+            by_status: Dict[str, int] = {}
+            for r in rows:
+                s = r.get("status") or "?"
+                by_status[s] = by_status.get(s, 0) + 1
+            row = {"key": "kill_router", "requests": len(rows),
+                   "ok": by_status.get("ok", 0),
+                   "by_status": by_status,
+                   "retried": sum(1 for r in rows
+                                  if r.get("attempts", 1) > 1),
+                   "router_exit": 86, "shed": 0, "mttr_s": mttr}
+            if lat:
+                row["p50_ms"] = round(percentile(lat, 0.50) * 1e3, 3)
+                row["p99_ms"] = round(percentile(lat, 0.99) * 1e3, 3)
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.send_signal(2)     # SIGINT: drain, never wedge
+            for proc in procs:
+                if proc.poll() is None:
+                    try:
+                        proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+            shutil.rmtree(workdir, ignore_errors=True)
+        row.update(_recovery_evidence(ledger_path, "kr-"))
+        ck.add(row)
+        print(f"recovery kill_router: ok={row.get('ok')} "
+              f"duplicates={row.get('duplicates')} "
+              f"mttr_s={row.get('mttr_s')}", file=sys.stderr)
+
+    # -- kill_replica / drain: in-process contrast pair ---------------
+    executor = BatchExecutor()
+    for mode, prefix in (("kill_replica", "krep-"), ("drain", "dr-")):
+        prior = ck.resume(mode, reusable=_reusable)
+        if prior is not None:
+            ck.add(prior)
+            continue
+        plan = _plan(prefix)
+        router = local_router(3, engine_kwargs=dict(
+            max_batch=ns.max_batch, coalesce_window_s=0.0,
+            max_queue=max(2048, 2 * len(plan)))).start()
+        victim = router.replicas[-1]
+        trig = max(1, len(plan) // 3)
+        fired = threading.Event()
+        t_disrupt = [None]
+
+        def act(_mode=mode, _victim=victim, _fired=fired,
+                _t=t_disrupt):
+            _fired.wait(timeout=60)
+            _t[0] = time.monotonic()
+            if _mode == "drain":
+                drain_replica(router, _victim, executor=executor)
+            else:
+                _victim.kill()
+
+        actor = threading.Thread(target=act, daemon=True)
+        actor.start()
+        dispatched = [0]
+
+        def submit(req, _router=router, _d=dispatched, _fired=fired,
+                   _trig=trig):
+            _d[0] += 1
+            if _d[0] == _trig + 1:
+                _fired.set()
+            return _router.submit(req)
+
+        row = run_open_load(submit, plan, timeout_s=300)
+        actor.join(timeout=60)
+        stats = victim.stats()
+        router.stop()
+        out_row = {"key": mode, **row,
+                   "victim": victim.replica_id,
+                   "shed": int(stats.get("shed", 0)),
+                   "rerouted": router.stats.get("rerouted", 0),
+                   "drain_rerouted":
+                       router.stats.get("drain_rerouted", 0)}
+        if t_disrupt[0] is not None:
+            out_row["mttr_s"] = 0.0     # in-process re-route: no gap
+        evidence = _recovery_evidence(ledger_path, prefix)
+        for k in ("adopted", "reaped", "adopt_wall_s"):
+            # the adoption record belongs to kill_router alone — the
+            # shared ledger's adopt.done is not prefix-scoped
+            evidence.pop(k, None)
+        out_row.update(evidence)
+        ck.add(out_row)
+        print(f"recovery {mode}: ok={row.get('ok')} "
+              f"shed={out_row['shed']} "
+              f"duplicates={out_row.get('duplicates')}",
+              file=sys.stderr)
+
+    if ns.out:
+        ck.finalize()
+    artifact = {**meta, "rows": ck.rows}
+    print(recovery_markdown(artifact))
+    if ns.out:
+        print(f"wrote {ns.out}")
+    return 0
+
+
 def _tcp_submit(addr: str):
     """A submit() against the TCP front end: one connection per client
     thread (thread-local), one JSON line per request/response."""
@@ -944,7 +1337,11 @@ def _tcp_submit(addr: str):
             local.rfile = local.sock.makefile("r")
         line = json.dumps({"method": req.method, "type": req.dtype,
                            "n": req.n, "seed": req.seed,
-                           "deadline_s": req.deadline_s}) + "\n"
+                           "deadline_s": req.deadline_s,
+                           # retries carry the key: the engine-side
+                           # dedup cache makes the retry exactly-once
+                           **({"idem_key": req.idem_key}
+                              if req.idem_key else {})}) + "\n"
         local.sock.sendall(line.encode())
         raw = local.rfile.readline()
         if not raw:
@@ -1061,6 +1458,19 @@ def main(argv=None) -> int:
                         "TPU_REDUCTIONS_AUTOSCALE_COOLDOWN_S or 0.75 "
                         "— cell-scale; config.py's 5 s default suits "
                         "live fleets)")
+    p.add_argument("--recovery", action="store_true",
+                   help="ISSUE 18 mode: kill-router / kill-replica / "
+                        "drain on one seeded idem-keyed workload — "
+                        "MTTR, shed count, and ledger-verified "
+                        "duplicate device executions per scenario; "
+                        "writes serving_recovery.json-shaped artifact "
+                        "to --out (docs/SERVING.md crash-consistent "
+                        "control plane)")
+    p.add_argument("--recovery-requests", type=int, default=48,
+                   help="requests per --recovery scenario")
+    p.add_argument("--crash-after", type=int, default=16,
+                   help="routed submits before the scripted "
+                        "router.crash os._exit (--recovery)")
     p.add_argument("--devices", dest="num_devices", type=int,
                    default=None,
                    help="virtual CPU device count (--platform=cpu; "
@@ -1092,6 +1502,12 @@ def main(argv=None) -> int:
             p.error("--elastic drives in-process autoscaled fleets; "
                     "--connect is the single-engine TCP mode")
         return _run_elastic(ns, methods)
+    if ns.recovery:
+        if ns.connect:
+            p.error("--recovery drives its own router subprocess and "
+                    "in-process fleets; --connect is the single-engine "
+                    "TCP mode")
+        return _run_recovery(ns, methods)
 
     meta = {"dtype": DTYPE_ALIASES[ns.dtype], "n": ns.n,
             "methods": ",".join(methods), "clients": ns.clients,

@@ -61,6 +61,17 @@ class ReduceRequest:
     slo: Optional[str] = None            # SLO class name — resolved to
     #                                      a deadline by the engine's
     #                                      slo_classes table
+    idem_key: Optional[str] = None       # client-supplied idempotency
+    #                                      key: retries/re-routes that
+    #                                      carry the same key settle to
+    #                                      ONE terminal response — a
+    #                                      duplicate of a settled key
+    #                                      returns the cached response
+    #                                      without re-touching the
+    #                                      device (exactly-once;
+    #                                      docs/SERVING.md
+    #                                      "crash-consistent control
+    #                                      plane")
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
@@ -83,6 +94,10 @@ class ReduceRequest:
         if self.slo is not None and (not isinstance(self.slo, str)
                                      or not self.slo):
             raise ValueError("slo must be a non-empty string (or None)")
+        if self.idem_key is not None and (
+                not isinstance(self.idem_key, str) or not self.idem_key):
+            raise ValueError("idem_key must be a non-empty string "
+                             "(or None)")
 
     @property
     def nbytes(self) -> int:

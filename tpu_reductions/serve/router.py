@@ -36,6 +36,7 @@ import dataclasses
 import itertools
 import os
 import queue
+import signal
 import socket
 import subprocess
 import sys
@@ -44,7 +45,9 @@ import time
 import zlib
 from typing import Dict, List, Optional, Sequence
 
+from tpu_reductions.faults.inject import fault_point
 from tpu_reductions.obs import ledger, trace
+from tpu_reductions.serve.journal import FleetJournal
 from tpu_reductions.serve.request import (PendingResponse, ReduceRequest,
                                           ReduceResponse)
 
@@ -166,6 +169,7 @@ class ProcessReplica:
                  relay_port: Optional[int] = None, workers: int = 4,
                  request_timeout_s: float = 600.0,
                  spawn_timeout_s: float = 90.0,
+                 reap_grace_s: float = 5.0,
                  extra_args: Sequence[str] = ()) -> None:
         self.replica_id = replica_id
         self._platform = platform
@@ -173,18 +177,59 @@ class ProcessReplica:
         self._workers = workers
         self._request_timeout_s = request_timeout_s
         self._spawn_timeout_s = spawn_timeout_s
+        self._reap_grace_s = reap_grace_s
         self._extra_args = list(extra_args)
         self._proc: Optional[subprocess.Popen] = None
+        self._pid: Optional[int] = None    # adopted orphans: no Popen
         self._port: Optional[int] = None
         self._jobs: "queue.Queue" = queue.Queue()
         self._threads: List[threading.Thread] = []
         self._down_emitted = False
         self._lock = threading.Lock()
 
+    @classmethod
+    def adopt(cls, replica_id: str, *, port: int, pid: int,
+              platform: str = "cpu",
+              relay_port: Optional[int] = None, workers: int = 4,
+              request_timeout_s: float = 600.0,
+              reap_grace_s: float = 5.0) -> "ProcessReplica":
+        """Re-attach to a still-running child a DEAD controller left
+        behind (the fleet journal's port+pid record): no Popen handle —
+        the orphan was reparented to init when the old router died —
+        so liveness falls back to signal-0 probes and reaping to raw
+        os.kill escalation. `start()` on an adopted replica only
+        spins up the worker pool; the process already runs."""
+        rep = cls(replica_id, platform=platform, relay_port=relay_port,
+                  workers=workers, request_timeout_s=request_timeout_s,
+                  reap_grace_s=reap_grace_s)
+        rep._pid = int(pid)
+        rep._port = int(port)
+        return rep
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc is not None else self._pid
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._port
+
+    @property
+    def adopted(self) -> bool:
+        return self._proc is None and self._pid is not None
+
     def start(self) -> "ProcessReplica":
+        if self.adopted:
+            # the child already runs; only the router-side worker pool
+            # needs (re)building
+            self._start_workers()
+            ledger.emit("replica.up", replica=self.replica_id,
+                        kind="adopted", port=self._port, pid=self._pid)
+            return self
+        import shutil
         import tempfile
-        port_file = os.path.join(tempfile.mkdtemp(prefix="replica-"),
-                                 "port")
+        port_dir = tempfile.mkdtemp(prefix="replica-")
+        port_file = os.path.join(port_dir, "port")
         cmd = [sys.executable, "-m", "tpu_reductions.serve",
                "--port", "0", "--port-file", port_file]
         if self._platform:
@@ -196,34 +241,58 @@ class ProcessReplica:
                                       stderr=subprocess.DEVNULL)
         ledger.emit("replica.spawn", replica=self.replica_id,
                     pid=self._proc.pid)
-        deadline = time.monotonic() + self._spawn_timeout_s
-        while time.monotonic() < deadline:
-            if self._proc.poll() is not None:
-                raise RuntimeError(
-                    f"replica {self.replica_id} died during spawn "
-                    f"(exit {self._proc.returncode})")
-            try:
-                with open(port_file) as f:
-                    self._port = int(f.read().strip())
-                break
-            except (OSError, ValueError):
-                time.sleep(0.05)
-        if self._port is None:
-            self._proc.kill()
-            raise TimeoutError(
-                f"replica {self.replica_id} never published its port "
-                f"within {self._spawn_timeout_s}s")
+        try:
+            deadline = time.monotonic() + self._spawn_timeout_s
+            while time.monotonic() < deadline:
+                if self._proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica {self.replica_id} died during spawn "
+                        f"(exit {self._proc.returncode})")
+                try:
+                    with open(port_file) as f:
+                        self._port = int(f.read().strip())
+                    break
+                except (OSError, ValueError):
+                    time.sleep(0.05)
+            if self._port is None:
+                self._proc.kill()
+                raise TimeoutError(
+                    f"replica {self.replica_id} never published its "
+                    f"port within {self._spawn_timeout_s}s")
+        finally:
+            # the port is read (or the spawn failed): the tempdir has
+            # served its purpose — one leaked dir per spawn otherwise
+            shutil.rmtree(port_dir, ignore_errors=True)
+        self._start_workers()
+        ledger.emit("replica.up", replica=self.replica_id,
+                    kind="process", port=self._port)
+        return self
+
+    def _start_workers(self) -> None:
         for i in range(self._workers):
             t = threading.Thread(target=self._worker, daemon=True,
                                  name=f"{self.replica_id}-w{i}")
             t.start()
             self._threads.append(t)
-        ledger.emit("replica.up", replica=self.replica_id,
-                    kind="process", port=self._port)
-        return self
 
     def alive(self) -> bool:
-        return self._proc is not None and self._proc.poll() is None
+        if self._proc is not None:
+            return self._proc.poll() is None
+        if self._pid is None:
+            return False
+        # adopted orphan: no waitable handle — signal-0 probes the pid
+        # (reparented to init, still signalable by us)
+        try:
+            os.kill(self._pid, 0)
+            return True
+        except (ProcessLookupError, PermissionError):
+            return False
+
+    def ping(self) -> bool:
+        """Liveness probe over the existing TCP wire (the adoption
+        check): a pid can be alive while the engine inside is wedged —
+        only a control round-trip proves the replica SERVES."""
+        return self._control({"op": "ping"}).get("ok") is True
 
     def submit(self, request: ReduceRequest) -> PendingResponse:
         pending = PendingResponse(f"{self.replica_id}-pending")
@@ -262,7 +331,8 @@ class ProcessReplica:
                         "value": request.value,
                         "tenant": request.tenant,
                         "priority": request.priority,
-                        "slo": request.slo}
+                        "slo": request.slo,
+                        "idem_key": request.idem_key}
                 conn.sendall((json.dumps(spec) + "\n").encode())
                 raw = rfile.readline()
                 if not raw:
@@ -359,20 +429,48 @@ class ProcessReplica:
     def stop(self) -> None:
         for _ in self._threads:
             self._jobs.put(None)
-        if self._proc is not None and self._proc.poll() is None:
-            self._proc.terminate()
+        self.reap()
+
+    def reap(self) -> Optional[str]:
+        """INT-first teardown with bounded grace before escalation:
+        SIGINT lets the child's KeyboardInterrupt path drain its
+        engine (a SIGKILL to a child with a nonempty device queue is
+        the machine-wedge hazard — CLAUDE.md), SIGTERM after
+        `reap_grace_s`, SIGKILL only as the last resort another grace
+        later. Returns the signal that ended it (or None if it was
+        already gone) — the adoption probe's reap evidence."""
+        if not self.alive():
+            return None
+        for sig_name, sig_no in (("int", signal.SIGINT),
+                                 ("term", signal.SIGTERM),
+                                 ("kill", signal.SIGKILL)):
             try:
-                self._proc.wait(timeout=10.0)
-            except subprocess.TimeoutExpired:
-                self._proc.kill()
+                if self._proc is not None:
+                    self._proc.send_signal(sig_no)
+                else:
+                    os.kill(self._pid, sig_no)
+            except (ProcessLookupError, PermissionError, OSError):
+                return None
+            deadline = time.monotonic() + self._reap_grace_s
+            while time.monotonic() < deadline:
+                if not self.alive():
+                    return sig_name
+                time.sleep(0.05)
+        return "kill"
 
     def kill(self) -> None:
         """Chaos seam: SIGKILL the child mid-traffic. In-flight
         round-trips fail to replica-dead errors and the router
         re-routes them."""
         self._mark_down("killed")
-        if self._proc is not None and self._proc.poll() is None:
-            self._proc.kill()
+        if self._proc is not None:
+            if self._proc.poll() is None:
+                self._proc.kill()
+        elif self._pid is not None:
+            try:
+                os.kill(self._pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
 
 
 @dataclasses.dataclass
@@ -394,12 +492,19 @@ class ReplicaRouter:
 
     def __init__(self, replicas: Sequence, *,
                  affinity_bytes: int = 1 << 20,
-                 max_retries: int = 2) -> None:
+                 max_retries: int = 2,
+                 journal: Optional[FleetJournal] = None) -> None:
         if not replicas:
             raise ValueError("need at least one replica")
         self._replicas = list(replicas)
         self._affinity_bytes = affinity_bytes
         self._max_retries = max_retries
+        # the write-ahead fleet journal (serve/journal.py): with no
+        # path it is a pure in-memory record, so every fleet mutation
+        # below journals unconditionally — crash consistency is not an
+        # opt-in code path that only the CLI exercises
+        self._journal = journal if journal is not None \
+            else FleetJournal(None)
         self._outstanding: Dict[str, int] = {
             r.replica_id: 0 for r in self._replicas}
         self._lock = threading.Lock()
@@ -408,11 +513,35 @@ class ReplicaRouter:
             "routed": 0, "rerouted": 0, "drain_rerouted": 0,
             "affinity": 0, "balanced": 0, "no_replica": 0}
 
+    @property
+    def journal(self) -> FleetJournal:
+        return self._journal
+
+    def _journal_replica(self, replica, state: str) -> None:
+        """Journal one replica transition, with whatever identity the
+        replica shape exposes (ProcessReplica: port+pid; LocalReplica:
+        name only — an in-process replica dies with the controller, so
+        there is nothing to re-adopt and the record is for the
+        narrative)."""
+        self._journal.record_replica(
+            replica.replica_id, state=state,
+            port=getattr(replica, "port", None),
+            pid=getattr(replica, "pid", None),
+            platform=getattr(replica, "_platform", None),
+            relay_port=getattr(replica, "_relay_port", None))
+
     # -- lifecycle ----------------------------------------------------
 
     def start(self) -> "ReplicaRouter":
         for r in self._replicas:
+            already_up = bool(getattr(r, "adopted", False))
+            if not already_up:
+                # write-ahead: the journal knows about the child
+                # BEFORE it exists, so a crash mid-spawn leaves a
+                # "starting" record recovery probes and reaps
+                self._journal_replica(r, "starting")
             r.start()
+            self._journal_replica(r, "up")
         ledger.emit("route.start", replicas=len(self._replicas),
                     affinity_bytes=self._affinity_bytes,
                     max_retries=self._max_retries)
@@ -420,7 +549,9 @@ class ReplicaRouter:
 
     def stop(self) -> None:
         for r in self._replicas:
+            self._journal_replica(r, "down")
             r.stop()
+            self._journal.forget_replica(r.replica_id)
         ledger.emit("route.stop", **{k: int(v)
                                      for k, v in self.stats.items()})
 
@@ -433,20 +564,27 @@ class ReplicaRouter:
     def add_replica(self, replica) -> None:
         """Scale-up seam: start the replica and admit it to routing —
         affinity hashes immediately include it (the autoscaler prewarms
-        the hot keys first so recurrences don't pay a cold compile)."""
+        the hot keys first so recurrences don't pay a cold compile).
+        Journals write-ahead: "starting" before the spawn, "up" once
+        the port/pid exist."""
+        if not getattr(replica, "adopted", False):
+            self._journal_replica(replica, "starting")
         replica.start()
         with self._lock:
             self._replicas.append(replica)
             self._outstanding.setdefault(replica.replica_id, 0)
+        self._journal_replica(replica, "up")
 
     def remove_replica(self, replica_id: str) -> None:
         """Scale-down seam: forget a replica AFTER its drain completed
         (serve/autoscale.drain_replica) — late `_on_result` callbacks
         from the removed replica tolerate the missing outstanding row."""
+        self._journal.record_replica(replica_id, state="down")
         with self._lock:
             self._replicas = [r for r in self._replicas
                               if r.replica_id != replica_id]
             self._outstanding.pop(replica_id, None)
+        self._journal.forget_replica(replica_id)
 
     def load_snapshot(self) -> dict:
         """The autoscaler's per-tick observable: per-replica
@@ -482,6 +620,12 @@ class ReplicaRouter:
         """Route one request; always returns a PendingResponse that
         WILL resolve (the replicas' no-hang contract plus the
         no-alive-replica terminal error here)."""
+        # chaos seam (faults/inject.py): a scripted `exit` here is the
+        # deterministic SIGKILL-class controller death mid-burst the
+        # recovery suite restarts from — os._exit, no atexit, no
+        # drain; the children orphan alive with the journal as the
+        # only record of them
+        fault_point("router.crash")
         rid = f"g{next(self._ids):06d}"
         pending = PendingResponse(rid)
         routed = _Routed(request=request, router_id=rid,
@@ -521,6 +665,12 @@ class ReplicaRouter:
         routed.tried += (replica.replica_id,)
         self.stats["routed"] += 1
         self.stats[policy] += 1
+        if policy == "affinity":
+            # journal the bucket placement (deduped inside): recovery
+            # re-prewarms exactly the keys traffic has made hot, onto
+            # the replicas the post-adoption hash will route them to
+            r = routed.request
+            self._journal.record_placement(r.method, r.dtype, r.n)
         with self._lock:
             self._outstanding[replica.replica_id] += 1
         ledger.emit("route.request", req=routed.router_id,
@@ -577,6 +727,72 @@ class ReplicaRouter:
         routed.pending.resolve(out)
 
 
+def adopt_fleet(journal: FleetJournal, *,
+                request_timeout_s: float = 600.0,
+                reap_grace_s: float = 5.0):
+    """Recover a dead controller's fleet from its journal
+    (docs/SERVING.md "crash-consistent control plane"): probe every
+    journaled replica over the existing TCP wire and split the fleet
+    into (adopted, reaped) — still-serving children come back as
+    `ProcessReplica.adopt` handles ready for a new router; everything
+    else (never came up, pid gone, wedged engine) is reaped INT-first
+    with bounded grace (never SIGKILL-first: a child mid-device-queue
+    is the machine-wedge hazard) and forgotten from the journal.
+    `adopt.done`'s wall_s IS the controller-MTTR evidence the recovery
+    artifact commits."""
+    entries = journal.replicas()
+    t0 = time.monotonic()
+    ledger.emit("adopt.begin", candidates=len(entries))
+    adopted: List[ProcessReplica] = []
+    reaped: List[str] = []
+    for name in sorted(entries):
+        entry = entries[name]
+        port, pid = entry.get("port"), entry.get("pid")
+        if port is None or pid is None or entry.get("state") == "down":
+            # never came up (write-ahead "starting" with no port) or
+            # already retired: nothing to probe, nothing to adopt
+            verdict = "stale"
+            journal.forget_replica(name)
+        else:
+            rep = ProcessReplica.adopt(
+                name, port=int(port), pid=int(pid),
+                platform=entry.get("platform") or "cpu",
+                relay_port=entry.get("relay_port"),
+                request_timeout_s=request_timeout_s,
+                reap_grace_s=reap_grace_s)
+            if rep.alive() and rep.ping():
+                verdict = "adopted"
+                adopted.append(rep)
+            else:
+                sig = rep.reap()
+                verdict = f"reaped-{sig}" if sig else "gone"
+                reaped.append(name)
+                journal.forget_replica(name)
+        ledger.emit("adopt.replica", replica=name, verdict=verdict,
+                    port=port, pid=pid)
+    ledger.emit("adopt.done", adopted=len(adopted), reaped=len(reaped),
+                wall_s=round(time.monotonic() - t0, 6))
+    return adopted, reaped
+
+
+def reprewarm_placements(router: ReplicaRouter) -> int:
+    """Re-prewarm every journaled bucket-affinity placement onto the
+    replica the CURRENT alive set hashes it to — the recovery twin of
+    the drain handoff: the adopted fleet's compile caches end up where
+    post-recovery affinity routing will actually land the keys."""
+    warmed = 0
+    for method, dtype, n in router.journal.placements():
+        target = router.affinity_target(method, dtype, int(n))
+        if target is None:
+            continue
+        try:
+            target.prewarm(method, dtype, int(n))
+            warmed += 1
+        except (OSError, ValueError, RuntimeError):
+            continue
+    return warmed
+
+
 def local_router(n_replicas: int, *, engine_kwargs: Optional[dict] = None,
                  affinity_bytes: int = 1 << 20,
                  max_retries: int = 2) -> ReplicaRouter:
@@ -625,22 +841,63 @@ def main(argv=None) -> int:
     p.add_argument("--relay-port", type=int, default=None,
                    help="every replica gates launches on this relay "
                         "port (chaos rehearsals: faults/relay.py)")
+    p.add_argument("--journal", default=None,
+                   help="fleet journal path (default: "
+                        "TPU_REDUCTIONS_FLEET_JOURNAL env, else "
+                        "journaling off). A restart against a journal "
+                        "a dead controller left behind re-adopts its "
+                        "still-live replica children, reaps the rest "
+                        "INT-first, resumes the autoscaler "
+                        "mid-cooldown, and re-prewarms journaled "
+                        "placements (docs/SERVING.md crash-consistent "
+                        "control plane)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the elastic autoscaler over the fleet "
+                        "(serve/autoscale.py), its control state "
+                        "journaled per tick and resumed on restart")
     ns = p.parse_args(argv)
     _apply_platform(ns)
 
     from tpu_reductions.obs.ledger import arm_session
     arm_session("serve.router", argv=list(argv) if argv
                 else sys.argv[1:])
+    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    maybe_arm_for_tpu()   # the autoscaler's drain path touches devices
 
     if ns.replicas <= 0:
         p.error("--replicas must be positive")
-    replicas = [ProcessReplica(f"replica-{i}", platform=ns.platform,
-                               relay_port=ns.relay_port,
-                               request_timeout_s=ns.request_timeout_s)
-                for i in range(ns.replicas)]
+
+    from tpu_reductions.config import fleet_journal_path
+    journal = FleetJournal(fleet_journal_path(ns.journal))
+    adopted, _ = adopt_fleet(
+        journal, request_timeout_s=ns.request_timeout_s) \
+        if journal.replicas() else ([], [])
+
+    def spawn(i: int) -> ProcessReplica:
+        return ProcessReplica(f"replica-{i}", platform=ns.platform,
+                              relay_port=ns.relay_port,
+                              request_timeout_s=ns.request_timeout_s)
+
+    taken = {r.replica_id for r in adopted}
+    replicas: List = list(adopted)
+    i = 0
+    while len(replicas) < ns.replicas:
+        if f"replica-{i}" not in taken:
+            replicas.append(spawn(i))
+        i += 1
     router = ReplicaRouter(replicas,
                            affinity_bytes=ns.affinity_bytes,
-                           max_retries=ns.max_retries).start()
+                           max_retries=ns.max_retries,
+                           journal=journal).start()
+    if adopted:
+        reprewarm_placements(router)
+
+    autoscaler = None
+    if ns.autoscale:
+        from tpu_reductions.serve.autoscale import Autoscaler
+        autoscaler = Autoscaler(router, spawn, journal=journal)
+        autoscaler.restore_state(journal.autoscaler_state())
+        autoscaler.start()
 
     import socketserver
 
@@ -665,6 +922,8 @@ def main(argv=None) -> int:
         pass
     finally:
         server.shutdown()
+        if autoscaler is not None:
+            autoscaler.stop()
         router.stop()
     return 0
 
