@@ -1,0 +1,153 @@
+"""Chaos coverage for the execution core's one deterministic seam
+(ISSUE 19; docs/RESILIENCE.md `exec.launch`): a scripted death between
+the exec.plan record and the launch — the relay dying mid-plan — kills
+a REAL rewired entry point (bench/spot), the re-invocation resumes its
+persisted rows through exec/core, and the ledger join across BOTH runs
+proves zero duplicate launches: the interrupted plan re-plans, the
+already-persisted row never re-enters the core at all."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from tpu_reductions.faults import inject
+from tpu_reductions.faults.inject import InjectedFault, fault_point
+
+REPO = Path(__file__).resolve().parent.parent
+SPOT_ARGS = ["--platform=cpu", "--type=int", "--methods=SUM,MIN,MAX",
+             "--n=16384", "--iterations=8", "--chainreps=2"]
+
+
+def _env(*, faults=None, ledger=None):
+    env = {**os.environ}
+    for k in ("TPU_REDUCTIONS_FAULTS", "TPU_REDUCTIONS_LEDGER",
+              "TPU_REDUCTIONS_CHAOS_ARM"):
+        env.pop(k, None)
+    if faults is not None:
+        env["TPU_REDUCTIONS_FAULTS"] = json.dumps(faults)
+    if ledger is not None:
+        env["TPU_REDUCTIONS_LEDGER"] = str(ledger)
+    return env
+
+
+def _spot(out, env, methods=None):
+    args = list(SPOT_ARGS)
+    if methods is not None:
+        args = [a for a in args if not a.startswith("--methods=")]
+        args.append(f"--methods={methods}")
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_reductions.bench.spot",
+         *args, f"--out={out}"],
+        env=env, cwd=str(REPO), capture_output=True, text=True,
+        timeout=300)
+
+
+def _events(led: Path):
+    return [json.loads(line) for line in
+            led.read_text().splitlines() if line.strip()]
+
+
+def test_exec_launch_fault_point_fires_in_core(monkeypatch):
+    """The seam is wired: a scripted raise at exec.launch surfaces
+    from run(plan) AFTER the exec.plan record, before any builder
+    work (the builder never runs)."""
+    import pytest
+
+    from tpu_reductions.exec import core as exec_core
+    from tpu_reductions.exec.plan import launch_plan
+    monkeypatch.setenv("TPU_REDUCTIONS_FAULTS",
+                       json.dumps({"exec.launch": {"action": "raise"}}))
+    inject.reset()
+    ran = {"builder": False}
+
+    def builder(ctx):
+        ran["builder"] = True
+        return 1
+
+    with pytest.raises(InjectedFault):
+        exec_core.run(launch_plan("unit/fault", "bench", builder))
+    assert ran["builder"] is False
+    monkeypatch.delenv("TPU_REDUCTIONS_FAULTS")
+    inject.reset()
+    assert fault_point("exec.launch") is None
+
+
+def test_death_mid_plan_resumes_with_zero_duplicate_launches(tmp_path):
+    """The full pipeline. A spot method is a TREE of plans — the
+    spot-level bench plan nests the chained trips' own chain plans
+    (surface k6) — so the death point is calibrated, not guessed: a
+    clean SUM-only run counts the exec.plan records one method emits
+    (= the exec.launch fault-point hits), then the 3-method run dies
+    exactly at MIN's spot-level seam — after SUM's row persisted,
+    after MIN's plan was recorded, before MIN's launch. Run 2 resumes:
+    SUM's row is reused WITHOUT re-entering the core, MIN and MAX
+    measure fresh. The exec.* join across both runs is the
+    zero-duplicate-launch audit: the interrupted plan shows
+    plans=2/launches=1/done=1, the resumed row plans=1/launches=1."""
+    out = tmp_path / "spot.json"
+    led = tmp_path / "ledger.jsonl"
+
+    # calibrate: how many plans does one clean SUM spot run?
+    cal = _spot(tmp_path / "cal.json",
+                _env(ledger=tmp_path / "cal.jsonl"), methods="SUM")
+    assert cal.returncode == 0, cal.stderr
+    hits_per_method = sum(1 for e in _events(tmp_path / "cal.jsonl")
+                          if e["ev"] == "exec.plan")
+    assert hits_per_method >= 1
+
+    # run 1: die between MIN's exec.plan record and its launch
+    faults = {"exec.launch": {"after": hits_per_method,
+                              "action": "exit", "code": 3}}
+    p1 = _spot(out, _env(faults=faults, ledger=led))
+    assert p1.returncode == 3, p1.stderr
+    doc1 = json.loads(out.read_text())
+    assert doc1["complete"] is False
+    assert [r["method"] for r in doc1["rows"]] == ["SUM"]
+
+    # run 2: no faults — resume through the same core
+    p2 = _spot(out, _env(ledger=led))
+    assert p2.returncode == 0, p2.stderr
+    assert "resumed from prior artifact" in p2.stderr
+    doc2 = json.loads(out.read_text())
+    assert doc2["complete"] is True
+    assert [r["method"] for r in doc2["rows"]] == ["SUM", "MIN", "MAX"]
+    # the reused row is byte-identical to the one run 1 persisted
+    assert doc2["rows"][0] == doc1["rows"][0]
+
+    # the ledger join across both runs (docs/EXECUTOR.md audit)
+    evs = _events(led)
+    fires = [e for e in evs if e["ev"] == "fault.fire"]
+    assert fires and fires[0]["point"] == "exec.launch"
+
+    def count(ev, surface):
+        return sum(1 for e in evs
+                   if e["ev"] == ev and e.get("surface") == surface)
+
+    # SUM: persisted in run 1, RESUMED in run 2 — one plan ever
+    assert (count("exec.plan", "spot/sum"),
+            count("exec.launch", "spot/sum"),
+            count("exec.done", "spot/sum")) == (1, 1, 1)
+    # MIN: planned twice (run 1's record died at the seam), launched
+    # exactly once — the zero-duplicate-launch contract
+    assert (count("exec.plan", "spot/min"),
+            count("exec.launch", "spot/min"),
+            count("exec.done", "spot/min")) == (2, 1, 1)
+    assert (count("exec.plan", "spot/max"),
+            count("exec.launch", "spot/max"),
+            count("exec.done", "spot/max")) == (1, 1, 1)
+    # every completed launch (spot-level AND nested chain plans)
+    # closed ok: the death fell between plan and launch, never inside
+    assert all(e["ok"] for e in evs if e["ev"] == "exec.done")
+
+    # the timeline's exec section sees the same join per surface
+    from tpu_reductions.obs.timeline import exec_summary
+    s = exec_summary(evs)
+    by = {r["surface"]: r for r in s["surfaces"]}
+    assert by["spot/min"]["plans"] == 2
+    assert by["spot/min"]["done"] == 1
+    assert by["spot/sum"]["plans"] == by["spot/sum"]["done"] == 1
+    assert s["failures"] == 0
+    # plans exceed launches by exactly the one interrupted record
+    assert s["plans"] == s["launches"] + 1 == s["done"] + 1

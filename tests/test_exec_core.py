@@ -1,0 +1,323 @@
+"""Execution-core unit coverage (tpu_reductions/exec/ — ISSUE 19):
+LaunchPlan validation, run(plan) contract semantics (ledger join,
+failure surfacing, retry classification, heartbeat wrapping), the
+LaunchContext builder surface, compile-seam dedupe, the timeline's
+exec section, and a ledger-join parity check over a REAL rewired path
+(bench/spot on --platform=cpu)."""
+
+import dataclasses
+import json
+from pathlib import Path
+
+import pytest
+
+from tpu_reductions.exec import core as exec_core
+from tpu_reductions.exec.plan import (LaunchPlan, ResilienceContract,
+                                      device_task, launch_plan)
+from tpu_reductions.lint.grammar import EVENT_ROW_RE
+from tpu_reductions.obs import ledger
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    """Unarmed ledger + empty compile-seam dedupe on both sides of
+    every test (both are process-global)."""
+    monkeypatch.delenv("TPU_REDUCTIONS_LEDGER", raising=False)
+    monkeypatch.delenv("TPU_REDUCTIONS_OBS_DISABLE", raising=False)
+    ledger.disarm()
+    exec_core.reset_observed()
+    yield
+    ledger.disarm()
+    exec_core.reset_observed()
+
+
+def _lines(path):
+    return [json.loads(line) for line in
+            Path(path).read_text().splitlines() if line.strip()]
+
+
+def _arm(tmp_path, monkeypatch):
+    led = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER", str(led))
+    ledger.arm(led)
+    return led
+
+
+# ------------------------------------------------------------- the plan
+
+def test_plan_rejects_unknown_kind_timing_and_missing_builder():
+    with pytest.raises(ValueError, match="kind"):
+        LaunchPlan(surface="s", kind="warp", builder=lambda ctx: 0)
+    with pytest.raises(ValueError, match="timing"):
+        LaunchPlan(surface="s", kind="bench", builder=lambda ctx: 0,
+                   timing="sync")  # the banned doctrine stays banned
+    with pytest.raises(ValueError, match="builder"):
+        LaunchPlan(surface="s", kind="bench")
+
+
+def test_launch_plan_geometry_is_sorted_and_frozen():
+    plan = launch_plan("s", "chain", lambda ctx: 0,
+                       n=8, dtype="int", method="SUM")
+    assert plan.geometry == (("dtype", "int"), ("method", "SUM"),
+                             ("n", 8))
+    assert plan.geometry_dict() == {"dtype": "int", "method": "SUM",
+                                    "n": 8}
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        plan.surface = "other"
+
+
+def test_device_task_is_the_retried_whole_task_shape():
+    plan = device_task("spot/sum", lambda: 41 + 1, method="SUM")
+    assert plan.kind == "bench"
+    assert plan.contract.retry is True
+    # the wrapped fn ignores the ctx it is handed
+    assert plan.builder(object()) == 42
+
+
+def test_contract_retry_log_is_identity_not_plan_semantics():
+    a = ResilienceContract(retry=True, retry_log=print)
+    b = ResilienceContract(retry=True, retry_log=None)
+    assert a == b
+
+
+# ------------------------------------------------------------ run(plan)
+
+def test_run_returns_result_and_emits_the_plan_launch_done_join(
+        tmp_path, monkeypatch):
+    led = _arm(tmp_path, monkeypatch)
+    plan = launch_plan("unit/ok", "bench", lambda ctx: "payload",
+                       timing="steps", heartbeat_phase="unit",
+                       staging_bound=123, n=4)
+    assert exec_core.run(plan) == "payload"
+    rows = [r for r in _lines(led)           # the guard's hb.phase
+            if r["ev"].startswith("exec.")]  # marks interleave freely
+    assert [r["ev"] for r in rows] == ["exec.plan", "exec.launch",
+                                       "exec.done"]
+    p, l, d = rows
+    assert (p["surface"], p["kind"], p["timing"]) == ("unit/ok",
+                                                      "bench", "steps")
+    assert p["phase"] == "unit" and p["retry"] is False
+    assert p["staging_bound"] == 123 and p["drain"] is False
+    assert p["n"] == 4                       # geometry stamped flat
+    assert (l["surface"], l["kind"]) == ("unit/ok", "bench")
+    assert d["ok"] is True and d["wall_s"] >= 0.0
+    for raw in led.read_text().splitlines():  # grammar-typed rows
+        assert EVENT_ROW_RE.match(raw), raw
+
+
+def test_run_failure_emits_ok_false_with_error_name_and_reraises(
+        tmp_path, monkeypatch):
+    led = _arm(tmp_path, monkeypatch)
+
+    def boom(ctx):
+        raise KeyError("missing rung")
+
+    with pytest.raises(KeyError):
+        exec_core.run(launch_plan("unit/boom", "bench", boom))
+    done = [r for r in _lines(led) if r["ev"] == "exec.done"]
+    assert len(done) == 1
+    assert done[0]["ok"] is False and done[0]["error"] == "KeyError"
+
+
+def test_run_retry_contract_survives_one_transient_flap(
+        tmp_path, monkeypatch):
+    """contract.retry=True routes the builder through the bounded
+    flap retry (utils/retry.py): with the relay probing alive, one
+    failure backs off and retries instead of surfacing."""
+    from tpu_reductions.utils import retry as retry_mod
+    monkeypatch.setattr(retry_mod, "tunneled_environment", lambda: True)
+    monkeypatch.setattr(retry_mod, "relay_alive", lambda: True)
+    monkeypatch.setenv("TPU_REDUCTIONS_DEVICE_RETRIES", "1")
+    led = _arm(tmp_path, monkeypatch)
+
+    calls = {"n": 0}
+
+    def flaky(ctx):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("flap")
+        return "recovered"
+
+    plan = launch_plan("unit/flaky", "bench", flaky, retry=True)
+    assert exec_core.run(plan) == "recovered"
+    assert calls["n"] == 2
+    rows = _lines(led)
+    assert any(r["ev"] == "retry.attempt" for r in rows)
+    done = [r for r in rows if r["ev"] == "exec.done"]
+    assert done[-1]["ok"] is True
+
+
+def test_run_retry_contract_reraises_on_dead_relay(tmp_path,
+                                                   monkeypatch):
+    from tpu_reductions.utils import retry as retry_mod
+    monkeypatch.setattr(retry_mod, "tunneled_environment", lambda: True)
+    monkeypatch.setattr(retry_mod, "relay_alive", lambda: False)
+    led = _arm(tmp_path, monkeypatch)
+
+    def dies(ctx):
+        raise RuntimeError("relay gone")
+
+    with pytest.raises(RuntimeError):
+        exec_core.run(launch_plan("unit/dead", "bench", dies,
+                                  retry=True))
+    rows = _lines(led)
+    fatal = [r for r in rows if r["ev"] == "retry.fatal"]
+    assert fatal and fatal[0]["reason"] == "relay-dead"
+    assert [r for r in rows if r["ev"] == "exec.done"][-1]["ok"] is False
+
+
+def test_phase_none_contract_means_builder_scopes_its_own_guards():
+    """heartbeat_phase=None + retry=False is the bare path: the builder
+    is trusted to scope its own regions through the ctx surface."""
+    seen = {}
+
+    def builder(ctx):
+        assert ctx.plan.surface == "unit/ctx"
+        ctx.tick()                      # forward-progress mark
+        with ctx.guard("unit.region"):  # self-scoped guarded region
+            seen["guarded"] = True
+        return 7
+
+    plan = launch_plan("unit/ctx", "reshard", builder, timing="steps",
+                       heartbeat_phase=None)
+    assert exec_core.run(plan) == 7
+    assert seen["guarded"]
+
+
+def test_ctx_call_is_a_retried_unit_with_the_plan_phase(monkeypatch):
+    from tpu_reductions.utils import retry as retry_mod
+    monkeypatch.setattr(retry_mod, "tunneled_environment", lambda: True)
+    monkeypatch.setattr(retry_mod, "relay_alive", lambda: True)
+    monkeypatch.setenv("TPU_REDUCTIONS_DEVICE_RETRIES", "1")
+
+    calls = {"n": 0}
+
+    def unit():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("flap")
+        return "ok"
+
+    plan = launch_plan("unit/steps", "collective",
+                       lambda ctx: ctx.call(unit), timing="steps",
+                       heartbeat_phase=None)
+    assert exec_core.run(plan) == "ok"
+    assert calls["n"] == 2
+
+
+# ------------------------------------------------- compile-seam dedupe
+
+def test_observe_compile_key_dedupes_process_wide(tmp_path,
+                                                  monkeypatch):
+    led = _arm(tmp_path, monkeypatch)
+    for _ in range(3):
+        with exec_core.observe_compile("unit/seam",
+                                       key=("SUM", "int", 16)) as obs:
+            pass
+    starts = [r for r in _lines(led) if r["ev"] == "compile.start"]
+    assert len(starts) == 1
+    assert starts[0]["surface"] == "unit/seam"
+    # a fresh key observes again; reset_observed clears the set
+    with exec_core.observe_compile("unit/seam2", key="k2"):
+        pass
+    exec_core.reset_observed()
+    with exec_core.observe_compile("unit/seam2", key="k2"):
+        pass
+    starts2 = [r for r in _lines(led)
+               if r["ev"] == "compile.start"
+               and r["surface"] == "unit/seam2"]
+    assert len(starts2) == 2
+
+
+def test_ctx_observe_compile_defaults_to_the_plan_surface(tmp_path,
+                                                          monkeypatch):
+    led = _arm(tmp_path, monkeypatch)
+
+    def builder(ctx):
+        with ctx.observe_compile():
+            return 1
+
+    exec_core.run(launch_plan("unit/plansurf", "serve", builder,
+                              timing="serve"))
+    starts = [r for r in _lines(led) if r["ev"] == "compile.start"]
+    assert starts and starts[0]["surface"] == "unit/plansurf"
+
+
+# ------------------------------------------- timeline exec attribution
+
+def test_timeline_exec_summary_joins_plans_launches_and_selects():
+    from tpu_reductions.obs.timeline import exec_summary
+    events = [
+        {"ev": "exec.plan", "surface": "spot/sum", "kind": "bench"},
+        {"ev": "exec.launch", "surface": "spot/sum", "kind": "bench"},
+        {"ev": "exec.done", "surface": "spot/sum", "kind": "bench",
+         "ok": True, "wall_s": 0.25},
+        {"ev": "exec.plan", "surface": "spot/min", "kind": "bench"},
+        {"ev": "exec.done", "surface": "spot/min", "kind": "bench",
+         "ok": False, "error": "RuntimeError", "wall_s": 0.5},
+        {"ev": "exec.select", "axis": "kernel", "choice": "k10",
+         "static": "k6", "flipped": True, "reason": "HBM regime"},
+    ]
+    s = exec_summary(events)
+    assert s["plans"] == 2 and s["launches"] == 1 and s["done"] == 2
+    assert s["failures"] == 1 and s["exec_s"] == 0.75
+    by = {r["surface"]: r for r in s["surfaces"]}
+    assert by["spot/sum"]["done"] == 1 and by["spot/sum"]["failed"] == 0
+    assert by["spot/min"]["failed"] == 1
+    sel = s["selects"][0]
+    assert sel["flipped"] is True and sel["static_choice"] == "k6"
+    assert exec_summary([{"ev": "session.start"}]) is None
+
+
+def test_summary_markdown_renders_the_exec_section():
+    from tpu_reductions.obs.timeline import summary_markdown
+    summary = {"path": "l.jsonl", "sessions": [],
+               "exec": {"plans": 1, "launches": 1, "done": 1,
+                        "failures": 0, "exec_s": 0.1,
+                        "surfaces": [{"surface": "spot/sum",
+                                      "kind": "bench", "plans": 1,
+                                      "done": 1, "failed": 0,
+                                      "wall_s": 0.1}],
+                        "selects": [{"axis": "wire", "choice": "q8",
+                                     "static_choice": "exact",
+                                     "flipped": True,
+                                     "reason": "tight slack"}]}}
+    md = summary_markdown(summary)
+    assert "execution core" in md
+    assert "| spot/sum | bench | 1 | 1 | 0 |" in md
+    assert "| wire | q8 | exact | YES |" in md
+
+
+# ----------------------------------- a real rewired path, ledger-joined
+
+def test_spot_path_runs_through_the_core_with_a_clean_join(
+        tmp_path, monkeypatch):
+    """bench/spot's device work enters through exec.core.run: every
+    method draws exactly one exec.plan with a matching exec.launch and
+    exec.done ok=True — the join the chaos suite audits, here on the
+    happy path (cpu platform from tests/conftest.py)."""
+    led = _arm(tmp_path, monkeypatch)
+    from tpu_reductions.bench.spot import run_spots
+    from tpu_reductions.config import ReduceConfig
+    base = ReduceConfig(method="SUM", dtype="int", n=1 << 12,
+                        kernel=6, threads=256, max_blocks=8,
+                        iterations=8, warmup=1, timing="chained",
+                        chain_reps=2, stat="median", log_file=None)
+    rows = run_spots(base, ["SUM", "MIN"])
+    assert [r["status"] for r in rows] == ["PASSED", "PASSED"]
+    evs = _lines(led)
+    for m in ("sum", "min"):
+        surf = f"spot/{m}"
+        plans = [e for e in evs
+                 if e["ev"] == "exec.plan" and e["surface"] == surf]
+        launches = [e for e in evs
+                    if e["ev"] == "exec.launch"
+                    and e["surface"] == surf]
+        dones = [e for e in evs
+                 if e["ev"] == "exec.done" and e["surface"] == surf]
+        assert len(plans) == len(launches) == len(dones) == 1
+        assert plans[0]["kind"] == "bench"
+        assert plans[0]["method"] == m.upper()     # geometry stamped
+        assert dones[0]["ok"] is True

@@ -1,0 +1,143 @@
+"""Cost-oracle properties (tpu_reductions/exec/cost.py — ISSUE 19):
+monotone regime flips on each axis, the empty-evidence degradation to
+the static picks, the exec.select audit row, the report fold, and the
+drift gate over the committed decision artifact
+(examples/tpu_run/exec_decisions.json)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpu_reductions.exec.cost import (CostOracle, decisions_markdown,
+                                      emit_select)
+
+REPO = Path(__file__).resolve().parent.parent
+ARTIFACT = REPO / "examples" / "tpu_run" / "exec_decisions.json"
+
+
+@pytest.fixture()
+def oracle():
+    """The oracle over the repo's own committed evidence — exactly
+    what the CLIs see when run from the checkout root."""
+    return CostOracle(root=str(REPO))
+
+
+@pytest.fixture()
+def empty_oracle(tmp_path):
+    return CostOracle(root=str(tmp_path))
+
+
+# ------------------------------------------------- empty-evidence floor
+
+def test_empty_evidence_degrades_every_axis_to_the_static_pick(
+        empty_oracle):
+    k = empty_oracle.pick_kernel("SUM", "int", 1 << 28)
+    assert (k.choice, k.static_choice, k.flipped) == ("k6", "k6", False)
+    assert k.evidence == ()
+    t = empty_oracle.pick_topology(64, 3 * 64)
+    assert (t.choice, t.flipped) == ("ring", False)
+    assert t.evidence == ()
+    w = empty_oracle.pick_wire("SUM", "float32", 8, 1 << 24, None)
+    assert (w.choice, w.flipped) == ("exact", False)
+
+
+# ----------------------------------------------------- monotone regimes
+
+def test_kernel_pick_is_monotone_in_n_and_flips_at_the_residency_bound(
+        oracle):
+    choices = [oracle.pick_kernel("SUM", "int", 1 << e).choice
+               for e in range(20, 29)]
+    assert choices[0] == "k6" and choices[-1] == "k10"
+    # one crossover, never back: every k10 is after every k6
+    assert choices == sorted(choices, key=lambda c: c == "k10")
+
+
+def test_kernel_flip_reason_names_the_regime(oracle):
+    small = oracle.pick_kernel("SUM", "int", 1 << 22)
+    big = oracle.pick_kernel("SUM", "int", 1 << 28)
+    assert "<=" in small.reason and not small.flipped
+    assert big.flipped and "deep-DMA overlap" in big.reason
+    assert big.evidence            # the artifacts the pick consulted
+    assert all(s is not None for _, s in big.candidates)
+
+
+def test_topology_pick_is_monotone_in_k(oracle):
+    choices = [oracle.pick_topology(k, 3 * k).choice
+               for k in (2, 4, 16, 64)]
+    assert choices[0] == "ring" and choices[-1] == "torus2d"
+    flipped = [c != "ring" for c in choices]
+    assert flipped == sorted(flipped)   # once off ring, never back
+
+
+def test_wire_pick_is_monotone_in_slack(oracle):
+    choices = [oracle.pick_wire("SUM", "float32", 8, 1 << 24, s).choice
+               for s in (10.0, 1.0, 0.01, 0.001)]
+    assert choices[0] == "exact" and choices[-1] == "q8"
+    quant = [c != "exact" for c in choices]
+    assert quant == sorted(quant)       # shrinking slack: exact -> q8
+
+
+def test_wire_pick_never_quantizes_unsupported_combos(oracle):
+    d = oracle.pick_wire("MIN", "float32", 8, 1 << 24, 1e-6)
+    assert d.choice == "exact" and "not quantizable" in d.reason
+    d = oracle.pick_wire("SUM", "double", 8, 1 << 24, 1e-6)
+    assert d.choice == "exact"
+
+
+# -------------------------------------------------------- the audit row
+
+def test_decision_row_shape_and_select_event(tmp_path, monkeypatch,
+                                             oracle):
+    from tpu_reductions.obs import ledger
+    led = tmp_path / "l.jsonl"
+    monkeypatch.setenv("TPU_REDUCTIONS_LEDGER", str(led))
+    ledger.arm(led)
+    try:
+        d = oracle.pick_kernel("SUM", "int", 1 << 28)
+        emit_select(d, method="SUM", dtype="int", n=1 << 28)
+    finally:
+        ledger.disarm()
+    row = d.row()
+    assert row["axis"] == "kernel" and row["flipped"] is True
+    assert row["static"] == "k6"
+    assert {c["name"] for c in row["candidates"]} == {"k6", "k10"}
+    ev = json.loads(led.read_text().splitlines()[-1])
+    assert ev["ev"] == "exec.select"
+    assert ev["choice"] == row["choice"] and ev["n"] == 1 << 28
+
+
+def test_decisions_markdown_counts_flips_and_skips_empty():
+    assert decisions_markdown({"rows": []}) == ""
+    doc = {"rows": [
+        {"axis": "kernel", "choice": "k10", "static": "k6",
+         "flipped": True, "reason": "HBM", "geometry": {"n": 1}},
+        {"axis": "wire", "choice": "exact", "static": "exact",
+         "flipped": False, "reason": "no deadline", "geometry": {}},
+    ]}
+    md = decisions_markdown(doc)
+    assert "| kernel | n=1 | k10 | k6 | YES | HBM |" in md
+    assert "2 decision(s), 1 regime flip(s)" in md
+
+
+# ----------------------------------------------------------- drift gate
+
+def test_committed_decision_artifact_matches_the_oracle(oracle):
+    """The committed exec_decisions.json IS the oracle's output over
+    the committed evidence: a selector or evidence change that moves
+    any pick must show up as an artifact diff in review, never as a
+    silent behavior change (regenerate with `python -m
+    tpu_reductions.exec --explain --platform=cpu
+    --out=examples/tpu_run/exec_decisions.json`)."""
+    from tpu_reductions.exec.__main__ import decision_rows
+    doc = json.loads(ARTIFACT.read_text())
+    assert doc["complete"] is True
+    assert doc["rows"] == decision_rows(oracle)
+
+
+def test_committed_artifact_shows_a_flip_on_every_axis():
+    """ISSUE 19 acceptance: the cost oracle demonstrably flips at
+    least 3 picks with regime, visible in the committed artifact."""
+    doc = json.loads(ARTIFACT.read_text())
+    flipped_axes = {r["axis"] for r in doc["rows"] if r["flipped"]}
+    assert flipped_axes == {"kernel", "topology", "wire"}

@@ -691,6 +691,81 @@ def test_red016_new_spellings_flag_via_cli(tmp_path):
     assert "dynamic_slice_in_dim" in hit["message"]
 
 
+# ---------------------------------------------------------------- RED025
+
+
+def test_red025_acceptance_probe_raw_guard_in_chain(tmp_path):
+    """ISSUE 19 acceptance probe: a raw heartbeat guard reintroduced in
+    ops/chain.py — the exact bespoke wiring the one-core refactor
+    removed — fires RED025."""
+    src = (
+        "from tpu_reductions.utils import heartbeat\n"
+        "def trip(fn, x):\n"
+        "    with heartbeat.guard('chained'):\n"
+        "        return fn(x)\n"
+    )
+    findings = _lint_src(tmp_path, src, name="ops/chain.py")
+    assert _rules(findings).count("RED025") == 1
+    hit = next(f for f in findings if f.rule == "RED025")
+    assert "exec/core.py" in hit.message and "LaunchPlan" in hit.message
+
+
+def test_red025_flags_bare_imports_and_retry_and_spans(tmp_path):
+    # bound aliases hide the attr chain, so the import binding is
+    # flagged alongside each call spelling
+    imported = (
+        "from tpu_reductions.utils.heartbeat import guard\n"
+        "from tpu_reductions.utils.retry import retry_device_call\n"
+        "def run(fn):\n"
+        "    with guard('device'):\n"
+        "        return retry_device_call(fn)\n"
+    )
+    findings = _lint_src(tmp_path, imported, name="bench/fixture.py")
+    assert _rules(findings).count("RED025") == 4  # 2 imports + 2 calls
+    spans = (
+        "from tpu_reductions.obs import compile as obs_compile\n"
+        "def lower(fn, x):\n"
+        "    with obs_compile.compile_span('k6'):\n"
+        "        return fn(x)\n"
+        "def probe(fn, x):\n"
+        "    obs_compile.probe_lower_compile(fn, x, surface='k6')\n"
+    )
+    findings = _lint_src(tmp_path, spans, name="serve/fixture.py")
+    assert _rules(findings).count("RED025") == 2
+
+
+def test_red025_exempts_core_ctx_surface_and_honors_waiver(tmp_path):
+    src = (
+        "from tpu_reductions.utils import heartbeat\n"
+        "from tpu_reductions.utils.retry import retry_device_call\n"
+        "def run(plan):\n"
+        "    with heartbeat.guard('device'):\n"
+        "        return retry_device_call(plan.builder)\n"
+    )
+    # the core and the three primitive homes it composes
+    for home in ("tpu_reductions/exec/core.py", "utils/heartbeat.py",
+                 "utils/retry.py", "obs/compile.py"):
+        assert "RED025" not in _rules(
+            _lint_src(tmp_path, src, name=home)), home
+    # the builder-side LaunchContext surface IS the sanctioned
+    # narrow-scope spelling — deliberately unmatched
+    ctx_src = (
+        "def builder(ctx):\n"
+        "    with ctx.guard('reshard.step'):\n"
+        "        return ctx.call(lambda: 1)\n"
+    )
+    assert "RED025" not in _rules(_lint_src(tmp_path, ctx_src,
+                                            name="ops/fixture.py"))
+    waived = (
+        "from tpu_reductions.utils import heartbeat\n"
+        "def probe():\n"
+        "    with heartbeat.guard('serve'):  # redlint: disable=RED025 -- raw TCP probe, no launch to plan\n"
+        "        return 1\n"
+    )
+    assert "RED025" not in _rules(_lint_src(tmp_path, waived,
+                                            name="serve/fixture.py"))
+
+
 # ---------------------------------------------------------------- RED008
 
 

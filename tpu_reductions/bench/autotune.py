@@ -168,7 +168,8 @@ def autotune(base: ReduceConfig,
     cfgs = candidate_configs(base, grid, comparator=comparator)
     if base.timing == "chained":
         from tpu_reductions.bench.driver import crash_result, run_benchmark
-        from tpu_reductions.utils.retry import retry_device_call
+        from tpu_reductions.exec import core as exec_core
+        from tpu_reductions.exec.plan import device_task
         results = []
         for cfg in cfgs:
             prior = resume(cfg) if resume is not None else None
@@ -181,9 +182,12 @@ def autotune(base: ReduceConfig,
                 results.append(prior)
                 continue
             try:
-                res = retry_device_call(
+                res = exec_core.run(device_task(
+                    f"autotune/k{cfg.kernel}",
                     lambda: run_benchmark(cfg, logger=logger),
-                    log=logger.log)
+                    retry_log=logger.log, method=cfg.method,
+                    dtype=cfg.dtype, n=cfg.n, threads=cfg.threads,
+                    max_blocks=cfg.max_blocks))
             except Exception as e:
                 # one candidate that cannot even compile (e.g. a Mosaic
                 # lowering gap on the real chip for a kernel the
@@ -298,7 +302,7 @@ def main(argv=None) -> int:
     from tpu_reductions.obs.ledger import arm_session
     arm_session("bench.autotune",
                 argv=list(argv) if argv else sys.argv[1:])
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
     maybe_arm_for_tpu()  # a race hung on a dead relay loses its ranking
     logger = BenchLogger(None, None, console=sys.stderr)
 

@@ -371,16 +371,26 @@ def _run_collective_benchmark(cfg: CollectiveConfig,
                     rows=len(results))
         _hop_span.close()
 
-    # warm-up collective (reduce.c:61-64). Guarded: this is the first
-    # blocking dispatch of the run — the timed path below guards itself
+    # warm-up collective (reduce.c:61-64). One LaunchPlan whose
+    # contract carries the guard phase: this is the first blocking
+    # dispatch of the run — the timed path below plans its own trips
     # inside time_chained, but a relay that stalls DURING warm-up would
     # otherwise hang with live ports, invisible to the port-probe
     # watchdog (redlint RED019).
-    from tpu_reductions.utils import heartbeat
-    with heartbeat.guard("collective.warmup"):
+    from tpu_reductions.exec import core as exec_core
+    from tpu_reductions.exec.plan import launch_plan
+
+    def warmup(ctx):
+        out = None
         for _ in range(max(cfg.warmup, 1)):
             out = jax.block_until_ready(run(x_dev))
-            heartbeat.tick()
+            ctx.tick()
+        return out
+
+    out = exec_core.run(launch_plan(
+        f"collective/{algorithm}", "collective", warmup,
+        timing="chained", heartbeat_phase="collective.warmup",
+        method=method, dtype=dtype, ranks=k, n=int(cfg.n)))
 
     # host oracle (the check reduce.c never had)
     expect = None
@@ -619,7 +629,7 @@ def main(argv=None) -> int:
     arm_session("bench.collective_driver", argv=args)
     # a collective hung on a mid-run relay death reports nothing; exit
     # promptly instead (utils/watchdog.py; no-op off-TPU)
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
     maybe_arm_for_tpu()
     try:
         if cfg.num_processes and cfg.num_processes > 1:

@@ -319,7 +319,7 @@ class _PendingResult:
         status = QAStatus.PASSED
         # post-fetch this materialization pays real execution + tunnel
         # latency; guard it so a stall here draws exit 4, not a hang
-        with heartbeat.guard("fetch"):
+        with heartbeat.guard("fetch"):  # redlint: disable=RED025 -- runs INSIDE the callers' device_task LaunchPlans; this narrow guard labels the one post-fetch blocking edge the plan-level phase cannot distinguish
             dev_val = float(np.asarray(jax.device_get(self.result),
                                        dtype=np.float64))
         host_val = float("nan")
@@ -521,7 +521,7 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
     # H2D + pad, untimed; compile-phase guard: the first staging call
     # builds its insert/pad executables (big payloads additionally tick
     # per chunk inside utils/staging.py)
-    with heartbeat.guard(heartbeat.PHASE_COMPILE):
+    with heartbeat.guard(heartbeat.PHASE_COMPILE):  # redlint: disable=RED025 -- inside the callers' device_task plans; re-labels the untimed staging edge compile-tolerant, narrower than the plan's phase
         x_dev = jax.block_until_ready(stage_fn(x_np))
     # flight-recorder: staging completion, untimed region (chunked big
     # payloads additionally emit per-chunk from utils/staging.py)
@@ -567,7 +567,7 @@ def _run_benchmark_inner(cfg: ReduceConfig, logger: BenchLogger,
         # untimed — the verification value. First use of the UNchained
         # executable, so this dispatch can legitimately block on a
         # compile: label the guard accordingly (utils/heartbeat.py)
-        with heartbeat.guard(heartbeat.PHASE_COMPILE):
+        with heartbeat.guard(heartbeat.PHASE_COMPILE):  # redlint: disable=RED025 -- inside the callers' device_task plans; first UNchained dispatch may legitimately block on a compile, so the narrow compile-tolerant label is the point
             result = reduce_fn(x_dev)
     else:
         result, sw = time_fn(reduce_fn, x_dev, iterations=cfg.iterations,
@@ -604,7 +604,7 @@ def main(argv=None) -> int:
     arm_session(name, argv=list(argv) if argv else sys.argv[1:])
     # a run that hangs on a mid-benchmark relay death reports nothing;
     # exit promptly instead (utils/watchdog.py; no-op off-TPU)
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
     maybe_arm_for_tpu()
     logger = _make_logger(cfg)
 

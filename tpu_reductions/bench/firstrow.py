@@ -104,7 +104,7 @@ def main(argv=None) -> int:
     from tpu_reductions.obs.ledger import arm_session
     arm_session("bench.firstrow",
                 argv=list(argv) if argv else sys.argv[1:], t0=_T0)
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
     maybe_arm_for_tpu()   # a relay death mid-row must exit 3, not hang
     _mark(marks, f"jax ready (backend={jax.default_backend()}, "
                  f"{len(jax.devices())} device(s))")
@@ -162,11 +162,14 @@ def main(argv=None) -> int:
         _mark(marks, f"int row resumed from interrupted {ns.out}: "
                      f"{row['gbps']} GB/s [{row['status']}]")
     else:
-        from tpu_reductions.utils.retry import retry_device_call
+        from tpu_reductions.exec import core as exec_core
+        from tpu_reductions.exec.plan import device_task
         try:
-            res = retry_device_call(
+            res = exec_core.run(device_task(
+                "firstrow",
                 lambda: run_benchmark(cfg, logger=logger),
-                log=logger.log)
+                retry_log=logger.log, method=cfg.method,
+                dtype=cfg.dtype, n=cfg.n))
         except Exception as e:   # contained: a crash must still leave a
             res = crash_result(cfg, e, logger)   # status row + timeline
         row = res.to_dict()

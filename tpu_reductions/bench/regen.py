@@ -396,6 +396,22 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                     "(compile_ledger.json)")
         except (OSError, ValueError, KeyError, TypeError) as e:
             log(f"regen: compile_ledger.json unusable ({e}); skipped")
+    # the execution core's decision audit (ISSUE 19): the committed
+    # cost-oracle grid (exec_decisions.json) vs the static defaults —
+    # each regime flip ships with the numbers it steers
+    xd_file = out / "exec_decisions.json"
+    if xd_file.exists():
+        try:
+            from tpu_reductions.exec.cost import decisions_markdown
+            xd = json.loads(xd_file.read_text())
+            md = decisions_markdown(xd)
+            if md:
+                with open(paths["md"], "a") as f:
+                    f.write("\n" + md + "\n")
+                log("regen: appended exec-decision audit "
+                    "(exec_decisions.json)")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: exec_decisions.json unusable ({e}); skipped")
     # the cross-round headline trajectory (ISSUE 12 satellite): the
     # committed BENCH_rNN.json round metrics collated into one table
     # so regressions across windows are visible in one place

@@ -74,7 +74,8 @@ def run_smoke(n: int = 1 << 20, logger: Optional[BenchLogger] = None,
     No reference analog (TPU-native).
     """
     from tpu_reductions.bench.driver import run_benchmark
-    from tpu_reductions.utils.retry import retry_device_call
+    from tpu_reductions.exec import core as exec_core
+    from tpu_reductions.exec.plan import device_task
 
     logger = logger or BenchLogger(None, None)
     rows: List[dict] = []
@@ -96,10 +97,11 @@ def run_smoke(n: int = 1 << 20, logger: Optional[BenchLogger] = None,
         cfg = ReduceConfig(**kw)
         t0 = time.perf_counter()
         try:
-            res = retry_device_call(
+            res = exec_core.run(device_task(
+                surface,
                 # redlint: disable=RED018 -- the window records per-surface compile seconds (host-real even on the broken-sync tunnel); throughput claims come from the chained slopes inside run_benchmark
                 lambda: run_benchmark(cfg, logger=logger),
-                log=logger.log)
+                retry_log=logger.log, method=method, dtype=dtype))
             row = {"name": name, "surface": surface,
                    "status": res.status.name,
                    "ok": res.status.name in ("PASSED", "WAIVED"),
@@ -142,7 +144,7 @@ def main(argv=None) -> int:
     # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md)
     from tpu_reductions.obs.ledger import arm_session
     arm_session("bench.smoke", argv=list(argv) if argv else sys.argv[1:])
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
     maybe_arm_for_tpu()   # a smoke hung on a dead relay reports nothing
     logger = BenchLogger(None, None, console=sys.stderr)
 

@@ -65,7 +65,8 @@ def run_spots(base: ReduceConfig, methods: List[str],
     import dataclasses
 
     from tpu_reductions.bench.driver import crash_result, run_benchmark
-    from tpu_reductions.utils.retry import retry_device_call
+    from tpu_reductions.exec import core as exec_core
+    from tpu_reductions.exec.plan import device_task
 
     logger = logger or BenchLogger(None, None)
     rows = []
@@ -80,9 +81,11 @@ def run_spots(base: ReduceConfig, methods: List[str],
             continue
         cfg = dataclasses.replace(base, method=method)
         try:
-            res = retry_device_call(
+            res = exec_core.run(device_task(
+                f"spot/{method.lower()}",
                 lambda: run_benchmark(cfg, logger=logger),
-                log=logger.log)
+                retry_log=logger.log, method=method, dtype=cfg.dtype,
+                n=cfg.n))
         except Exception as e:
             res = crash_result(cfg, e, logger)
         row = _row(cfg, res)
@@ -151,7 +154,7 @@ def main(argv=None) -> int:
     # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md)
     from tpu_reductions.obs.ledger import arm_session
     arm_session("bench.spot", argv=list(argv) if argv else sys.argv[1:])
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
     maybe_arm_for_tpu()   # a spot hung on a dead relay reports nothing
     logger = BenchLogger(None, None, console=sys.stderr)
 

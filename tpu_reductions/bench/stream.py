@@ -78,7 +78,7 @@ def run_serial_baseline(flat: np.ndarray, method: str, *,
                       chunk_bytes=chunk_bytes)
     flat = np.ravel(flat)
     t0 = time.monotonic()
-    with heartbeat.guard("stream"):
+    with heartbeat.guard("stream"):  # redlint: disable=RED025 -- the serial NON-overlapped baseline instrument: its guard edges bracket exactly the measured stage+sync sequence the overlap comparison is against, not a launch plan
         r.restore(None)
         staged = []
         for i in range(r.plan.num_chunks):
@@ -328,7 +328,7 @@ def main(argv=None) -> int:
     # touch (docs/OBSERVABILITY.md; RED011 doctrine)
     from tpu_reductions.obs.ledger import arm_session
     arm_session("bench.stream", argv=list(argv) if argv else sys.argv[1:])
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
     maybe_arm_for_tpu()
 
     def log(msg):

@@ -155,16 +155,20 @@ def _run_cells(cfgs, logger, on_result, log_row=None):
     examples/tpu_run/RECOVERY.md). Shared by run_shmoo and sweep_all;
     regime-SENSITIVE legacy disciplines must keep their shared batch."""
     from tpu_reductions.bench.driver import crash_result, run_benchmark
-    from tpu_reductions.utils.retry import retry_device_call
+    from tpu_reductions.exec import core as exec_core
+    from tpu_reductions.exec.plan import device_task
     results = []
     for sub in cfgs:
         try:
             # a transient relay flap (relay back before the watchdog
             # grace) retries the cell; a dead relay re-raises straight
-            # into the crash containment (utils/retry.py)
-            res = retry_device_call(
+            # into the crash containment (utils/retry.py via the
+            # plan's retry contract)
+            res = exec_core.run(device_task(
+                "sweep-cell",
                 lambda: run_benchmark(sub, logger=logger),
-                log=logger.log)
+                retry_log=logger.log, method=sub.method,
+                dtype=sub.dtype, n=sub.n))
         except Exception as e:
             res = crash_result(sub, e, logger)
         if log_row is not None:
@@ -438,7 +442,7 @@ def main(argv=None) -> int:
     # relay must exit 3 with its completed rank rows persisted
     from tpu_reductions.obs.ledger import arm_session
     arm_session("bench.sweep", argv=list(argv) if argv else sys.argv[1:])
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
     maybe_arm_for_tpu()
     logger = BenchLogger(None, None, console=sys.stderr)
     rows = sweep_collective(rank_counts=rank_counts, methods=methods,

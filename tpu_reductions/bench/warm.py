@@ -187,7 +187,7 @@ def run_warm(n: int = 1 << 20, skip: Optional[set] = None,
             continue
         try:
             fn, args = build(n)
-            obs_compile.probe_lower_compile(fn, *args, surface=surface)
+            obs_compile.probe_lower_compile(fn, *args, surface=surface)  # redlint: disable=RED025 -- warm IS the compile observatory's AOT probe pass: lower+compile only, no device launch to plan
             row = {"surface": surface, "error": None,
                    **(obs_compile.last_observation() or {})}
         except Exception as e:   # the report IS the product
@@ -250,7 +250,7 @@ def main(argv=None) -> int:
     # flight recorder + watchdog, armed together (docs/OBSERVABILITY.md)
     ledger.arm_session("bench.warm",
                        argv=list(argv) if argv else sys.argv[1:])
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
     maybe_arm_for_tpu()   # AOT compiles still cross the tunnel on-chip
 
     # resume (the Checkpoint contract, observatory spelling): a prior

@@ -1,0 +1,24 @@
+"""One execution core (ISSUE 19; docs/EXECUTOR.md).
+
+Three parts, one doctrine:
+
+* `plan.py`  — the frozen `LaunchPlan` IR: surface id, builder,
+  geometry, timing mode, and the resilience contract (heartbeat phase,
+  retry class, staging bound, drain obligation). Planners PRODUCE
+  plans; nothing but `core.run` consumes them.
+* `core.py`  — THE one executor. It alone owns the heartbeat guards,
+  `utils/retry.py` classification, `obs/compile.compile_span`
+  bracketing and the `exec.plan/launch/done` ledger events; redlint
+  RED025 fences those spellings here.
+* `cost.py`  — the runtime cost oracle: kernel / topology / wire picks
+  promoted from the evidence the repo already persists (autotune
+  artifacts, `compile_ledger.json`, sched duration priors, the
+  calibration rate model), every decision a typed `exec.select` event.
+
+`python -m tpu_reductions.exec --explain` dumps the decision table
+(committed rehearsal artifact: `examples/tpu_run/exec_decisions.json`).
+"""
+
+from tpu_reductions.exec.plan import LaunchPlan, ResilienceContract
+
+__all__ = ["LaunchPlan", "ResilienceContract"]

@@ -1,0 +1,117 @@
+"""Decision-table CLI: `python -m tpu_reductions.exec --explain`.
+
+Runs the cost oracle (exec/cost.py) over a fixed grid of
+(op, dtype, n, devices, slack) cells — one block per decision axis,
+spanning each axis's regime crossover — and dumps every Decision as a
+JSON row plus an `exec.select` ledger event (when a flight recorder is
+armed, TPU_REDUCTIONS_LEDGER). The committed rehearsal artifact lives
+at `examples/tpu_run/exec_decisions.json` and tier-1 gates on it
+(tests/test_exec_cost.py), so a selector change that moves a pick is
+visible in review as an artifact diff, never a silent behavior change.
+
+The grid is DETERMINISTIC — no timestamps, no environment probing —
+because the drift gate compares it byte-for-byte. jax is never
+imported on this path (`--platform` is accepted for CLI-family parity
+and recorded in the artifact).
+
+No reference analog (the reference hardcodes its one kernel —
+reduction_kernel.cu:278-289).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpu_reductions.exec.cost import CostOracle, Decision, emit_select
+
+# the grid: each block walks ONE regime axis across its crossover
+# (payload for the kernel pick, device count for the topology pick,
+# deadline slack for the wire pick) with everything else pinned
+_KERNEL_CELLS = [("SUM", "int", 1 << 22), ("SUM", "int", 1 << 24),
+                 ("SUM", "int", 1 << 25), ("SUM", "int", 1 << 28),
+                 ("MAX", "double", 1 << 23), ("MAX", "double", 1 << 26)]
+# per-rank length 3k keeps ring supported (divisible by k) while the
+# odd multiplier rules bidir out at k=2 — the crossover is pure
+# ring -> torus2d; the trailing big-payload cell shows the bandwidth
+# regime flipping the same k to the doubled-duty bidir wire
+_TOPOLOGY_CELLS = [(2, 3 * 2), (4, 3 * 4), (16, 3 * 16), (64, 3 * 64),
+                   (16, 3 << 20)]
+_WIRE_CELLS = [("SUM", "float32", 8, 1 << 24, None),
+               ("SUM", "float32", 8, 1 << 24, 1.0),
+               ("SUM", "float32", 8, 1 << 24, 0.005),
+               ("SUM", "bfloat16", 8, 1 << 24, 0.005),
+               ("MIN", "float32", 8, 1 << 24, 0.005)]
+
+
+def decision_rows(oracle: CostOracle) -> list:
+    """The full grid, evaluated — the artifact's `rows` list."""
+    rows = []
+
+    def add(decision: Decision, **geometry):
+        rows.append({**decision.row(), "geometry": geometry})
+        emit_select(decision, **geometry)
+
+    for method, dtype, n in _KERNEL_CELLS:
+        add(oracle.pick_kernel(method, dtype, n),
+            method=method, dtype=dtype, n=n)
+    for k, per_rank in _TOPOLOGY_CELLS:
+        add(oracle.pick_topology(k, per_rank),
+            devices=k, per_rank_len=per_rank)
+    for method, dtype, k, payload, slack in _WIRE_CELLS:
+        add(oracle.pick_wire(method, dtype, k, payload, slack),
+            method=method, dtype=dtype, devices=k,
+            payload_bytes=payload, slack_s=slack)
+    return rows
+
+
+def _table(rows: list) -> str:
+    """The human spelling of the artifact (stdout)."""
+    out = ["axis      choice    geometry                                "
+           "reason",
+           "-" * 78]
+    for r in rows:
+        geo = " ".join(f"{k}={v}" for k, v in r["geometry"].items())
+        out.append(f"{r['axis']:<9} {r['choice']:<9} {geo:<39} "
+                   f"{r['reason']}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_reductions.exec",
+        description="cost-oracle decision table (docs/EXECUTOR.md)")
+    p.add_argument("--explain", action="store_true",
+                   help="print the decision table (the only mode)")
+    p.add_argument("--out", default=None,
+                   help="write the JSON artifact here "
+                        "(examples/tpu_run/exec_decisions.json is the "
+                        "committed rehearsal)")
+    p.add_argument("--platform", default=None,
+                   help="accepted for CLI-family parity; the oracle "
+                        "never touches a device")
+    p.add_argument("--evidence-root", default=None,
+                   help="artifact root (default: cwd / "
+                        "TPU_REDUCTIONS_EVIDENCE_ROOT)")
+    ns = p.parse_args(argv)
+
+    oracle = CostOracle(root=ns.evidence_root)
+    rows = decision_rows(oracle)
+    print(_table(rows))
+    flips = sorted({r["axis"] for i, r in enumerate(rows)
+                    for j, s in enumerate(rows)
+                    if r["axis"] == s["axis"]
+                    and r["choice"] != s["choice"]})
+    print(f"\n{len(rows)} decisions; regime flips on axes: "
+          f"{', '.join(flips) if flips else 'NONE (evidence missing?)'}")
+    if ns.out:
+        from tpu_reductions.utils.jsonio import atomic_json_dump
+        doc = {"kind": "exec-decisions", "version": 1, "complete": True,
+               "platform": ns.platform or "none", "rows": rows}
+        atomic_json_dump(ns.out, doc)
+        print(f"wrote {ns.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

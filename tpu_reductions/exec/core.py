@@ -1,0 +1,163 @@
+"""THE one executor — every device launch enters through `run(plan)`.
+
+This module is the single sanctioned home (redlint RED025) of the
+resilience + telemetry wiring the five legacy paths used to re-spell
+for themselves: the heartbeat guard (`utils/heartbeat.py` — a stalled
+relay draws watchdog exit 4, never a hang), the bounded-backoff flap
+retry with its dead-relay classification (`utils/retry.py`), the
+compile observatory bracketing (`obs/compile.compile_span` — every
+trace+compile lands in the ledger with its .jax_cache cold/warm
+verdict), and the typed `exec.plan` / `exec.launch` / `exec.done`
+flight-recorder events (lint/grammar.py EXEC_EVENTS). The watchdog
+gate is re-exported here too (`maybe_arm_for_tpu`), so entry points
+import their RED011 pre-JAX gate from the executor and the whole
+contract lives behind one door.
+
+Producers never touch those spellings: a plan's builder receives a
+`LaunchContext` whose `call` / `guard` / `tick` / `observe_compile`
+methods ARE the wiring, scoped to the plan's contract. Moving a raw
+guard back into a producer is a RED025 finding (docs/LINT.md).
+
+`fault_point("exec.launch")` fires between the plan record and the
+launch — the one deterministic seam where the chaos suite kills a
+relay "mid-plan" and the resume pipeline must re-enter through here
+with no duplicate launches (tests/test_exec_chaos.py; the ledger join
+is exec.plan rows vs exec.done rows per surface).
+
+No reference analog (TPU-native; the reference's launches are inline
+and unguarded — reduction.cpp:319-374).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Optional
+
+from tpu_reductions.faults.inject import fault_point
+# the RED011 pre-JAX gate, re-exported: entry-point mains import it
+# from HERE (the executor owns gating end to end; utils/watchdog.py
+# stays the implementation)
+from tpu_reductions.utils.watchdog import maybe_arm_for_tpu  # noqa: F401
+
+from tpu_reductions.exec.plan import LaunchPlan
+
+# compile seams already observed this process, by caller-chosen key
+# (the serve bucket discipline: one span per (method, dtype, n, kb)
+# key, steady-state launches pay one set lookup)
+_observed_keys: set = set()
+
+
+def reset_observed() -> None:
+    """Forget the once-per-key compile-seam dedupe (in-process tests)."""
+    _observed_keys.clear()
+
+
+@contextlib.contextmanager
+def observe_compile(surface: str, *, key=None, **fields):
+    """Bracket one compile seam in a compile observatory span
+    (obs/compile.py). `key`, when given, dedupes process-wide: only the
+    first entry per key observes; later entries are passthrough. The
+    producers' per-wrapper / per-reducer first-call gates pass key=None
+    and gate themselves — the span spelling still lives only here."""
+    if key is not None:
+        if key in _observed_keys:
+            yield None
+            return
+        _observed_keys.add(key)
+    from tpu_reductions.obs.compile import compile_span
+    with compile_span(surface, **fields) as obs:
+        yield obs
+
+
+class LaunchContext:
+    """The builder's only handle to the guarded/retried wiring.
+
+    Handed to `plan.builder(ctx)` by `run`; every method delegates to
+    the RED025-fenced spellings owned by this module, scoped to the
+    plan's resilience contract."""
+
+    def __init__(self, plan: LaunchPlan) -> None:
+        self.plan = plan
+
+    def tick(self) -> None:
+        """One forward-progress mark (utils/heartbeat.tick)."""
+        from tpu_reductions.utils import heartbeat
+        heartbeat.tick()
+
+    def guard(self, phase: Optional[str] = None):
+        """A phase-scoped heartbeat guard context — the per-step /
+        per-region liveness boundary for builders whose contract sets
+        heartbeat_phase=None and scope their own regions."""
+        from tpu_reductions.utils import heartbeat
+        return heartbeat.guard(phase
+                               or self.plan.contract.heartbeat_phase
+                               or "device")
+
+    def call(self, fn: Callable, *, phase: Optional[str] = None):
+        """One retried, flap-classified, heartbeat-guarded device unit
+        (utils/retry.py — transient flaps back off and retry, dead
+        relays re-raise into watchdog territory)."""
+        from tpu_reductions.utils.retry import retry_device_call
+        return retry_device_call(
+            fn, phase=(phase or self.plan.contract.heartbeat_phase
+                       or "device"),
+            log=self.plan.contract.retry_log)
+
+    def observe_compile(self, surface: Optional[str] = None, *,
+                        key=None, **fields):
+        """Bracket this plan's compile seam (module observe_compile);
+        defaults to the plan's own surface id."""
+        return observe_compile(surface or self.plan.surface, key=key,
+                               **fields)
+
+
+def run(plan: LaunchPlan):
+    """Execute one LaunchPlan under its resilience contract.
+
+    Emits `exec.plan` (the record: surface, kind, timing, contract,
+    geometry), fires the `exec.launch` fault point, emits `exec.launch`,
+    invokes the builder under the contract's guard/retry wrapping, and
+    closes with `exec.done` (ok + dispatch-side wall clock — an
+    ATTRIBUTION number for the timeline, never a throughput claim; the
+    honest timing doctrine lives inside the builders, docs/TIMING.md).
+    The whole launch shares one child trace context, so every event a
+    builder emits nests under the plan in the span tree."""
+    from tpu_reductions.obs import ledger, trace
+
+    c = plan.contract
+    with trace.child():
+        ledger.emit("exec.plan", surface=plan.surface, kind=plan.kind,
+                    timing=plan.timing, phase=c.heartbeat_phase,
+                    retry=bool(c.retry),
+                    staging_bound=c.staging_bound,
+                    drain=bool(c.drain), **plan.geometry_dict())
+        # the chaos seam: a scripted death HERE is "the relay died
+        # between the plan record and its launch" (docs/RESILIENCE.md)
+        fault_point("exec.launch")
+        ctx = LaunchContext(plan)
+        ledger.emit("exec.launch", surface=plan.surface, kind=plan.kind)
+        t0 = time.perf_counter()
+        try:
+            if c.retry:
+                from tpu_reductions.utils.retry import retry_device_call
+                result = retry_device_call(
+                    lambda: plan.builder(ctx),
+                    phase=c.heartbeat_phase or "device",
+                    log=c.retry_log)
+            elif c.heartbeat_phase is not None:
+                from tpu_reductions.utils import heartbeat
+                with heartbeat.guard(c.heartbeat_phase):
+                    result = plan.builder(ctx)
+            else:
+                result = plan.builder(ctx)
+        except BaseException as e:
+            ledger.emit("exec.done", surface=plan.surface,
+                        kind=plan.kind, ok=False,
+                        error=type(e).__name__,
+                        wall_s=round(time.perf_counter() - t0, 6))
+            raise
+        ledger.emit("exec.done", surface=plan.surface, kind=plan.kind,
+                    ok=True,
+                    wall_s=round(time.perf_counter() - t0, 6))
+    return result

@@ -1,0 +1,398 @@
+"""Runtime cost oracle — kernel / topology / wire picks promoted from
+the evidence the repo already persists (ISSUE 19; docs/EXECUTOR.md).
+
+Today's picks are per-CLI-flag: `--kernel` defaults to 6,
+`--topology` to the ring family, the serving engine's quantized-wire
+call rides its own slack formula. This module makes the pick a
+DECISION — a frozen value carrying the candidate table, the predicted
+cost per candidate, and the artifact paths the prediction came from —
+and emits it as a typed `exec.select` ledger event so every pick is
+auditable in the timeline (obs/timeline exec section).
+
+Evidence sources (all committed, all optional — a missing artifact
+degrades the pick to today's static choice, never an error):
+
+  * `tune_fine.json`           — the autotune race's ranked kernel
+                                 rows: measured GB/s per (kernel,
+                                 threads, max_blocks) in the
+                                 VMEM-resident regime.
+  * `examples/tpu_run/stream_probe.json`
+                               — the kernel-10 deep-DMA streaming
+                                 probe: sustained GB/s and the
+                                 overlap_efficiency multiplier vs the
+                                 serial baseline.
+  * `examples/tpu_run/compile_ledger.json`
+                               — per-surface cold/warm verdicts: a
+                                 candidate whose surface was never
+                                 lowered pays its cold compile seconds
+                                 up front (obs/compile.CompileModel).
+  * `examples/rank_scaling/scaling_shape.json`
+                               — the measured rank-scaling sweep: peak
+                                 observed GB/s anchors the β term the
+                                 α-β topology pricer uses
+                                 (collectives/algorithms.py; Zhang et
+                                 al.'s plan-against-cost-model framing,
+                                 PAPERS.md 2112.01075).
+  * `examples/rank_scaling/quant_curve.json`
+                               — measured wire_reduction per bits for
+                                 the EQuARX-style quantized ring
+                                 (PAPERS.md 2506.17615): prices the
+                                 approximate-wire candidate.
+
+The three axes and their regime flips (acceptance: each flip visible
+in the committed `examples/tpu_run/exec_decisions.json`):
+
+  * kernel   k6 (single-pass fold-accumulator) in the VMEM-resident
+             regime -> k10 (deep-DMA streaming accumulator) past the
+             residency bound, where overlap buys the HBM roof.
+  * topology ring family at tiny device counts -> torus2d past the
+             device-count crossover where the per-hop α dominates.
+  * wire     exact ring -> quantized wire when deadline slack tightens
+             against the predicted exact time (the serving engine's
+             formula, unchanged — serve/engine._quant_wire delegates
+             here so the decision is ledger-auditable).
+
+Purely offline: reads JSON artifacts, touches no device; jax-bearing
+modules (collectives.algorithms) import lazily inside the pricing
+paths only.
+
+No reference analog (the reference hardcodes kernel 6 —
+reduction_kernel.cu:278-289).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+# byte widths per declared dtype name; bfloat16 streams at 2 B/element
+# (CLAUDE.md reduction semantics)
+_ITEMSIZE = {"int": 4, "int32": 4, "float": 4, "float32": 4,
+             "bfloat16": 2, "double": 8, "float64": 8}
+
+# VMEM residency bound + HBM roof for the measured device (v5e row of
+# ops/chain._TPU_RATE_MODEL — kept numerically identical; chain.py is
+# jax-bearing so the two constants are mirrored, not imported)
+_RESIDENT_BYTES = 112 << 20
+_VMEM_RATE = 3.5e12
+_HBM_RATE = 819e9
+
+# statically quantizable SUM dtypes — serve/engine._QUANT_SUM_DTYPES,
+# mirrored (the executor re-checks quant_supported at launch, so this
+# table degrades the CHOICE, never correctness)
+_QUANT_SUM_DTYPES = ("float32", "bfloat16")
+
+# default evidence roots, relative to the repo checkout the instruments
+# run from (every CLI runs at the repo root; override for tests via
+# the env knob or CostOracle(root=...))
+_EVIDENCE = {
+    "autotune": "tune_fine.json",
+    "stream": os.path.join("examples", "tpu_run", "stream_probe.json"),
+    "compile": os.path.join("examples", "tpu_run",
+                            "compile_ledger.json"),
+    "scaling": os.path.join("examples", "rank_scaling",
+                            "scaling_shape.json"),
+    "quant": os.path.join("examples", "rank_scaling",
+                          "quant_curve.json"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One audited pick: the choice, what the empty-evidence static
+    default would have been, every candidate with its predicted cost,
+    and the artifact paths the prediction consulted (empty tuple =
+    fallback — the oracle had nothing to learn from)."""
+
+    axis: str                                   # kernel|topology|wire
+    choice: str
+    static_choice: str
+    candidates: Tuple[Tuple[str, Optional[float]], ...]
+    evidence: Tuple[str, ...]
+    reason: str
+
+    @property
+    def flipped(self) -> bool:
+        return self.choice != self.static_choice
+
+    def row(self) -> Dict[str, Any]:
+        """The stable JSON spelling (exec_decisions.json rows and the
+        exec.select event payload share it)."""
+        return {
+            "axis": self.axis,
+            "choice": self.choice,
+            "static": self.static_choice,
+            "flipped": self.flipped,
+            "candidates": [
+                {"name": n,
+                 "predicted_s": (round(s, 9) if s is not None else None)}
+                for n, s in self.candidates],
+            "evidence": list(self.evidence),
+            "reason": self.reason,
+        }
+
+
+def emit_select(decision: Decision, **geometry) -> None:
+    """Stamp one pick into the flight recorder as a typed
+    `exec.select` event (lint/grammar.py EXEC_EVENTS) — the audit row
+    the timeline's exec section renders."""
+    from tpu_reductions.obs import ledger
+    ledger.emit("exec.select", **decision.row(), **geometry)
+
+
+class CostOracle:
+    """Evidence-backed pick per axis. Artifacts load lazily and cache;
+    a missing or unreadable artifact simply drops out of the evidence
+    tuple and the affected pick degrades toward the static default."""
+
+    def __init__(self, root: Optional[str] = None) -> None:
+        self.root = (root
+                     or os.environ.get("TPU_REDUCTIONS_EVIDENCE_ROOT")
+                     or ".")
+        self._cache: Dict[str, Any] = {}
+
+    # -- evidence loading ------------------------------------------------
+
+    def _load(self, key: str):
+        """One artifact, parsed and cached; None when absent/bad."""
+        if key not in self._cache:
+            path = os.path.join(self.root, _EVIDENCE[key])
+            try:
+                with open(path) as f:
+                    self._cache[key] = json.load(f)
+            except (OSError, ValueError):
+                self._cache[key] = None
+        return self._cache[key]
+
+    def _path(self, key: str) -> str:
+        return _EVIDENCE[key]
+
+    def kernel_rates(self) -> Optional[Dict[int, float]]:
+        """Best measured GB/s per kernel id from the autotune race's
+        ranked rows (VMEM-resident regime — the race geometry is
+        n=2^24)."""
+        doc = self._load("autotune")
+        if not doc or not doc.get("ranked"):
+            return None
+        rates: Dict[int, float] = {}
+        for row in doc["ranked"]:
+            if row.get("status") != "PASSED":
+                continue
+            kid = int(row["kernel"])
+            rates[kid] = max(rates.get(kid, 0.0), float(row["gbps"]))
+        return rates or None
+
+    def stream_overlap(self) -> Optional[float]:
+        """The committed k10 probe's overlap_efficiency (streamed
+        fetch+fold wall clock vs the serial baseline) — the multiplier
+        deep DMA buys over a non-overlapped pass in the HBM regime."""
+        doc = self._load("stream")
+        if not doc:
+            return None
+        for row in reversed(doc.get("rows") or []):
+            if row.get("final") and row.get("status") == "PASSED":
+                eff = row.get("overlap_efficiency")
+                return float(eff) if eff else None
+        return None
+
+    def compile_penalty(self, surface: str) -> float:
+        """Cold compile seconds a candidate pays if its surface was
+        never observed warm (compile observatory ledger); 0.0 when the
+        surface is cache-banked or the ledger is absent."""
+        doc = self._load("compile")
+        if not doc:
+            return 0.0
+        cold_s, warm = 0.0, False
+        for row in doc.get("surfaces") or []:
+            if row.get("surface") != surface:
+                continue
+            if row.get("verdict") == "warm":
+                warm = True
+            elif row.get("verdict") == "cold":
+                cold_s = max(cold_s, float(row.get("compile_s") or 0.0))
+        return 0.0 if warm else cold_s
+
+    def measured_beta(self) -> Optional[float]:
+        """β (seconds per wire byte) anchored on the peak GB/s the
+        committed rank-scaling sweep actually measured — the learned
+        replacement for the α-β pricer's 100 GB/s-class default."""
+        doc = self._load("scaling")
+        if not doc or not doc.get("series"):
+            return None
+        peak = max((pt[1] for pts in doc["series"].values()
+                    for pt in pts), default=0.0)
+        return (1.0 / (peak * 1e9)) if peak > 0 else None
+
+    def wire_reduction(self, bits: int) -> Optional[float]:
+        """Median measured wire-byte reduction factor for the
+        quantized SUM ring at `bits` (quant_curve.json)."""
+        doc = self._load("quant")
+        if not doc:
+            return None
+        vals = sorted(float(r["wire_reduction"])
+                      for r in doc.get("rows") or []
+                      if r.get("method") == "SUM"
+                      and int(r.get("bits", 0)) == bits
+                      and r.get("status") == "PASSED")
+        return vals[len(vals) // 2] if vals else None
+
+    # -- the three axes --------------------------------------------------
+
+    def pick_kernel(self, method: str, dtype: str, n: int) -> Decision:
+        """k6 vs k10 by payload regime. Static default: kernel 6, the
+        per-CLI-flag default (config.KERNEL_SINGLE_PASS). With the
+        autotune + stream evidence in hand: under the VMEM residency
+        bound the single-pass fold at the measured race rate wins;
+        past it both candidates stream from HBM and k10's deep-DMA
+        overlap multiplier (the committed probe's overlap_efficiency)
+        takes the roof. Monotone in n by construction: the only
+        crossover is the residency bound."""
+        payload = n * _ITEMSIZE.get(dtype, 4)
+        rates = self.kernel_rates()
+        overlap = self.stream_overlap()
+        if rates is None or overlap is None:
+            return Decision(
+                axis="kernel", choice="k6", static_choice="k6",
+                candidates=(("k6", None), ("k10", None)), evidence=(),
+                reason="no autotune/stream evidence; static kernel 6")
+        k6_rate = rates.get(6, 0.0) * 1e9 or _VMEM_RATE
+        resident = payload <= _RESIDENT_BYTES
+        # in the HBM regime k6 re-reads the carry at the raw roof; k10
+        # overlaps fetch with fold and sustains overlap x the roof
+        k6_s = payload / (k6_rate if resident else _HBM_RATE)
+        k10_s = (payload / (_HBM_RATE * max(overlap, 1e-9))
+                 + self.compile_penalty("k10@4"))
+        evidence = [self._path("autotune"), self._path("stream")]
+        if self._load("compile"):
+            evidence.append(self._path("compile"))
+        choice = "k6" if (resident or k6_s <= k10_s) else "k10"
+        return Decision(
+            axis="kernel", choice=choice, static_choice="k6",
+            candidates=(("k6", k6_s), ("k10", k10_s)),
+            evidence=tuple(evidence),
+            reason=(f"payload {payload} B "
+                    f"{'<=' if resident else '>'} VMEM residency bound "
+                    f"{_RESIDENT_BYTES} B"
+                    + ("" if resident else
+                       f"; deep-DMA overlap x{overlap:.2f}")))
+
+    def pick_topology(self, k: int, per_rank_len: int,
+                      elem_bytes: int = 4) -> Decision:
+        """Ring family vs 2D torus by device count, priced by the α-β
+        model (collectives/algorithms.algorithm_cost) with β anchored
+        on the measured rank-scaling sweep when committed. Static
+        default: ring (select_algorithm's family when no --topology
+        flag). Monotone in k at fixed payload: ring's 2(k-1) hops grow
+        linearly, torus2d's grow with sqrt(k) — one crossover, never
+        back."""
+        beta = self.measured_beta()
+        if beta is None:
+            return Decision(
+                axis="topology", choice="ring", static_choice="ring",
+                candidates=(("ring", None), ("torus2d", None)),
+                evidence=(),
+                reason="no rank-scaling evidence; static ring family")
+        from tpu_reductions.collectives.algorithms import (
+            _TOPOLOGY_LABELS, algorithm_cost, topology_supported)
+        payload = per_rank_len * elem_bytes
+        cands = []
+        # naive is the correctness degrade (rings dispatch), not a race
+        # candidate — its wire bytes scale with k, so racing it only
+        # wins model-artifact ties at k=2
+        for topo in ("ring", "bidir", "torus2d"):
+            if not topology_supported(topo, k, per_rank_len):
+                continue
+            cands.append((topo, algorithm_cost(
+                _TOPOLOGY_LABELS[topo], k, payload,
+                20e-6, beta)))
+        if not cands:
+            cands = [("naive", algorithm_cost(
+                _TOPOLOGY_LABELS["naive"], k, payload, 20e-6, beta))]
+        choice = min(cands, key=lambda c: c[1])[0]
+        return Decision(
+            axis="topology", choice=choice, static_choice="ring",
+            candidates=tuple(cands),
+            evidence=(self._path("scaling"),),
+            reason=(f"alpha-beta pick at k={k}, "
+                    f"{payload} B/rank, learned beta="
+                    f"{beta:.3e} s/B"))
+
+    def pick_wire(self, method: str, dtype: str, k: int,
+                  payload_bytes: int, slack_s: Optional[float], *,
+                  est_s: Optional[float] = None, bits: int = 8,
+                  slack_factor: float = 2.0) -> Decision:
+        """Exact vs quantized wire by deadline slack — EXACTLY the
+        serving engine's formula (serve/engine._quant_wire: quantize
+        when slack < slack_factor x the cost model's estimate and the
+        (method, dtype) is statically quantizable), promoted into an
+        audited decision. `est_s` is the caller's own estimate (the
+        engine's cost model); when absent the exact wire is priced by
+        the α-β model. Monotone in slack: shrinking slack can only
+        move exact -> quantized."""
+        supported = (method.upper() == "SUM"
+                     and dtype in _QUANT_SUM_DTYPES)
+        quant_label = f"q{bits}"
+        if est_s is None:
+            from tpu_reductions.collectives.algorithms import (
+                algorithm_cost)
+            est_s = algorithm_cost("ring_rs_ag", k, payload_bytes,
+                                   20e-6, self.measured_beta()
+                                   or 1 / 100e9)
+        reduction = self.wire_reduction(bits)
+        evidence = ((self._path("quant"),) if reduction else ())
+        quant_s = (est_s / reduction) if reduction else None
+        if not supported or slack_s is None:
+            return Decision(
+                axis="wire", choice="exact", static_choice="exact",
+                candidates=(("exact", est_s), (quant_label, quant_s)),
+                evidence=evidence,
+                reason=("no deadline" if supported else
+                        f"{method}/{dtype} not quantizable"))
+        tight = slack_s < slack_factor * max(est_s, 1e-6)
+        return Decision(
+            axis="wire", choice=(quant_label if tight else "exact"),
+            static_choice="exact",
+            candidates=(("exact", est_s), (quant_label, quant_s)),
+            evidence=evidence,
+            reason=(f"slack {slack_s:.4f}s "
+                    f"{'<' if tight else '>='} {slack_factor:g} x "
+                    f"est {est_s:.4f}s"))
+
+
+def decisions_markdown(doc: dict) -> str:
+    """report.md section for a committed exec_decisions.json (ISSUE 19;
+    bench/regen.py folds it): every kernel/topology/wire pick the cost
+    oracle makes over the committed (op, dtype, n, devices, slack)
+    grid, against the static baseline it replaces — regime flips ship
+    with the numbers they steer. Empty string when there are no rows
+    (regen then skips the section)."""
+    rows = doc.get("rows") or []
+    if not rows:
+        return ""
+    lines = ["## execution-core decision audit (learned cost oracle)",
+             "",
+             "Cost-oracle picks over the committed decision grid vs "
+             "the static defaults (`python -m tpu_reductions.exec "
+             "--explain`; docs/EXECUTOR.md). A YES row is a regime "
+             "flip: persisted evidence moved the pick off the static "
+             "choice.",
+             "",
+             "| axis | geometry | chosen | static | flipped | why |",
+             "|---|---|---|---|---|---|"]
+    flips = 0
+    for r in rows:
+        geom = r.get("geometry") or {}
+        gtxt = " ".join(f"{k}={v}" for k, v in geom.items()) or "-"
+        flipped = bool(r.get("flipped",
+                             r.get("choice") != r.get("static")))
+        flips += flipped
+        lines.append(f"| {r.get('axis')} | {gtxt} | {r.get('choice')} "
+                     f"| {r.get('static')} "
+                     f"| {'YES' if flipped else 'no'} "
+                     f"| {r.get('reason') or '-'} |")
+    lines.append("")
+    lines.append(f"{len(rows)} decision(s), {flips} regime flip(s) vs "
+                 "the static baseline.")
+    return "\n".join(lines)

@@ -1,0 +1,139 @@
+"""LaunchPlan — the frozen IR every device launch is described in.
+
+A plan is a VALUE: what executable to run (`builder`), which compile
+surface it belongs to (`surface`, the observatory id), what timing
+doctrine governs it (`timing`), and the resilience contract the one
+executor (`exec/core.py`) must honor around it — heartbeat phase,
+retry class, staging bound, drain obligation. Producers (ops/chain,
+ops/stream, serve/executor, the collective driver, reshard) build
+plans; `core.run(plan)` is the only consumer. Nothing in a plan
+touches jax: constructing one is free and import-light, so jax-free
+planners (the scheduler, the autoscaler) can mint plans too.
+
+The builder receives a `core.LaunchContext` — its ONLY handle to the
+guarded/retried/compile-observed wiring (RED025): `ctx.call(fn)` for a
+retried device unit, `ctx.guard(phase)` for a guarded region,
+`ctx.tick()` for a forward-progress mark, `ctx.observe_compile(...)`
+for a compile seam. Raw `heartbeat.guard` / `retry_device_call` /
+`compile_span` spellings outside `exec/core.py` are lint findings.
+
+No reference analog (TPU-native; the reference launches kernels
+inline — reduction.cpp:319-374 — with no resilience contract at all).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+# the timing doctrines a plan can declare (docs/TIMING.md,
+# docs/STREAMING.md, docs/SERVING.md): chained slopes, streamed
+# chunk folds, serving launches, stepwise primitive programs
+TIMING_MODES = ("chained", "stream", "serve", "steps")
+
+# plan kinds — one per legacy device-touching path (ISSUE 19)
+PLAN_KINDS = ("chain", "stream", "serve", "collective", "reshard",
+              "bench")
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceContract:
+    """What the executor owes the plan (and the relay owes us nothing).
+
+    heartbeat_phase  phase label for the guard around the whole builder
+                     (None = the builder scopes its own guards through
+                     `ctx.guard` / `ctx.call` — e.g. per-step programs)
+    retry            wrap the WHOLE builder in the bounded-backoff flap
+                     retry (utils/retry.py classification: transient
+                     flaps retry, dead relays re-raise into watchdog
+                     territory)
+    staging_bound    max host->device message bytes this plan may stage
+                     (None = config.stage_chunk_bytes; informational —
+                     utils/staging.py enforces the bound mechanically)
+    drain            the plan must leave no in-flight device work on
+                     exit (a torn-down queue wedges the remote chip,
+                     CLAUDE.md) — declared by plans whose result is
+                     consumed asynchronously (serve drains)
+    """
+
+    heartbeat_phase: Optional[str] = "device"
+    retry: bool = False
+    staging_bound: Optional[int] = None
+    drain: bool = False
+    # retry-attempt narration sink (a BenchLogger.log usually); carried
+    # on the contract so retried plans keep the instruments' live
+    # "retrying after flap" lines — identity, not plan semantics
+    retry_log: Optional[Callable] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class LaunchPlan:
+    """One device launch, described — not performed.
+
+    surface    compile-observatory id (obs/compile.py) the launch's
+               executable belongs to; `exec.*` events carry it so the
+               timeline can attribute wall clock per surface
+    kind       which path produced it (PLAN_KINDS)
+    timing     governing timing doctrine (TIMING_MODES)
+    builder    `builder(ctx) -> result`; the device work itself
+    contract   the resilience contract (ResilienceContract)
+    geometry   hashable (key, value) pairs describing the launch shape
+               (op, dtype, n, ranks, ...) — stamped onto the exec.plan
+               event, never interpreted by the executor
+    """
+
+    surface: str
+    kind: str
+    builder: Callable = dataclasses.field(repr=False, compare=False,
+                                          default=None)
+    timing: str = "chained"
+    contract: ResilienceContract = dataclasses.field(
+        default_factory=ResilienceContract)
+    geometry: Tuple[Tuple[str, Any], ...] = ()
+
+    def __post_init__(self):
+        if self.kind not in PLAN_KINDS:
+            raise ValueError(f"unknown plan kind {self.kind!r}; one of "
+                             f"{PLAN_KINDS}")
+        if self.timing not in TIMING_MODES:
+            raise ValueError(f"unknown timing mode {self.timing!r}; "
+                             f"one of {TIMING_MODES}")
+        if self.builder is None:
+            raise ValueError("a LaunchPlan needs a builder")
+
+    def geometry_dict(self) -> Dict[str, Any]:
+        return dict(self.geometry)
+
+
+def launch_plan(surface: str, kind: str, builder: Callable, *,
+                timing: str = "chained",
+                heartbeat_phase: Optional[str] = "device",
+                retry: bool = False,
+                staging_bound: Optional[int] = None,
+                drain: bool = False,
+                retry_log: Optional[Callable] = None,
+                **geometry) -> LaunchPlan:
+    """Keyword-friendly plan constructor — geometry kwargs become the
+    frozen (key, value) tuple, sorted for a stable event row."""
+    return LaunchPlan(
+        surface=surface, kind=kind, builder=builder, timing=timing,
+        contract=ResilienceContract(heartbeat_phase=heartbeat_phase,
+                                    retry=retry,
+                                    staging_bound=staging_bound,
+                                    drain=drain, retry_log=retry_log),
+        geometry=tuple(sorted(geometry.items())))
+
+
+def device_task(surface: str, fn: Callable, *, kind: str = "bench",
+                timing: str = "chained",
+                heartbeat_phase: Optional[str] = "device",
+                retry_log: Optional[Callable] = None,
+                **geometry) -> LaunchPlan:
+    """The whole-task plan shape the bench instruments use (spot,
+    smoke, autotune, sweep, firstrow): one retried, flap-classified
+    unit wrapping `fn()` — the LaunchPlan spelling of the old bare
+    `retry_device_call(fn)` sites."""
+    return launch_plan(surface, kind, lambda ctx: fn(), timing=timing,
+                       heartbeat_phase=heartbeat_phase, retry=True,
+                       retry_log=retry_log, **geometry)

@@ -64,7 +64,13 @@ a scripted `exit` after N hits is the deterministic SIGKILL-class
 controller death mid-burst: os._exit, no drain, children orphaned
 alive with the fleet journal as their only record; the recovery
 suite restarts the router against that journal,
-tests/test_serve_recovery.py).
+tests/test_serve_recovery.py), and `exec.launch` (fired in
+exec/core.run between the exec.plan event and the exec.launch event —
+i.e. after the plan is declared but before ANY device work — so a
+scripted `exit` there is the deterministic relay-death-mid-plan: the
+re-invoked entry point must re-enter through exec/core and the ledger
+join of exec.plan/exec.launch/exec.done rows must show zero duplicate
+launches, tests/test_exec_chaos.py).
 docs/RESILIENCE.md keeps the list.
 
 Counters are process-global and monotonic; `reset()` re-arms them for

@@ -14,9 +14,12 @@ Each fact names one side of the repo's device-safety doctrine
   ``run_preflight``, ``gate_verdict`` (utils/watchdog.py,
   utils/preflight.py);
 * GUARDS — heartbeat liveness (``heartbeat.tick``/``heartbeat.guard``,
-  utils/heartbeat.py);
+  utils/heartbeat.py; the execution core's ``exec_core.run`` — every
+  LaunchPlan executes under its declared heartbeat phase — and the
+  builder-side ``ctx.tick``/``ctx.guard`` surface, exec/core.py);
 * RETRIES — bounded-backoff flap retries (``retry_device_call``,
-  utils/retry.py);
+  utils/retry.py; ``exec_core.run`` with a retry contract and the
+  builder-side ``ctx.call``, exec/core.py);
 * STAGES — bounded host->device transfer (utils/staging.py,
   ops/stream.py surfaces);
 * DRAINS — ``device_get`` (the exit-drain marker RED007 keys on);
@@ -49,7 +52,7 @@ INGESTS = "INGESTS"
 WALLCLOCK = "WALLCLOCK"
 
 # bump to invalidate cached per-file facts when recognizers change
-FACTS_SCHEMA_VERSION = 1
+FACTS_SCHEMA_VERSION = 2
 
 _BACKEND_QUERIES = {"jax.devices", "jax.local_devices",
                     "jax.device_count", "jax.default_backend",
@@ -99,6 +102,21 @@ def classify_call(site: CallSite) -> Set[str]:
             facts.add(GUARDS)
         if last in _RETRY_NAMES:
             facts.add(RETRIES)
+        # the execution core (ISSUE 19): run(plan) executes every
+        # LaunchPlan under its declared resilience contract — the
+        # heartbeat guard AND the bounded flap retry both live inside
+        # exec/core.run, so a call site is as protected as a literal
+        # guard/retry spelling was
+        if last == "run" and ("exec_core" in name or "exec.core" in name):
+            facts |= {GUARDS, RETRIES}
+        # builder-side LaunchContext surface (exec/core.py): builders
+        # receive `ctx` by convention; ctx.guard/ctx.tick delegate to
+        # utils.heartbeat, ctx.call to utils.retry
+        if name.startswith("ctx."):
+            if last in ("tick", "guard"):
+                facts.add(GUARDS)
+            elif last == "call":
+                facts.add(RETRIES)
         if last in _STAGE_NAMES or \
                 any(m in name for m in _STAGE_MODULES):
             facts.add(STAGES)

@@ -202,6 +202,16 @@ ADOPT_EVENTS = ("adopt.begin", "adopt.replica", "adopt.done")
 COMPILE_EVENTS = ("compile.start", "compile.end", "warm.start",
                   "warm.surface", "warm.end")
 
+# the one-executor vocabulary (exec/core.py + exec/cost.py; ISSUE 19 —
+# docs/EXECUTOR.md): every device launch is an exec.plan (the frozen
+# LaunchPlan record: surface, kind, timing mode, resilience contract,
+# geometry) -> exec.launch -> exec.done (ok + dispatch-side wall
+# clock) bracket, and every cost-oracle pick is an exec.select row
+# carrying the full candidate table + evidence paths. Consumer:
+# obs/timeline.py's exec_summary (per-surface launch attribution +
+# the selection audit table)
+EXEC_EVENTS = ("exec.plan", "exec.select", "exec.launch", "exec.done")
+
 # every other typed event the python producers emit (the seam table in
 # docs/OBSERVABILITY.md) — registered HERE so the emitters and the
 # drift gate (tests/test_event_registry.py) share one vocabulary: an
@@ -241,7 +251,7 @@ REGISTERED_EVENTS = frozenset(CORE_EVENTS + SHELL_EVENTS + SCHED_EVENTS
                               + ROUTE_EVENTS + REPLICA_EVENTS
                               + RESHARD_EVENTS + AUTOSCALE_EVENTS
                               + DRAIN_EVENTS + JOURNAL_EVENTS
-                              + ADOPT_EVENTS)
+                              + ADOPT_EVENTS + EXEC_EVENTS)
 
 
 def event_registered(name: str) -> bool:

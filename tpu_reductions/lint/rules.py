@@ -85,6 +85,17 @@ RESHARD_PRIMS_WHITELIST = ("reshard/primitives.py",)
 RESHARD_PRIM_NAMES = ("ppermute", "all_gather", "all_to_all",
                       "psum_scatter", "dynamic_slice",
                       "dynamic_slice_in_dim", "dynamic_index_in_dim")
+# RED025: the resilience contract (heartbeat guards, device-retry
+# classification, compile spans) is DECLARED on a LaunchPlan and
+# EXECUTED by exec/core.run — the one place those seams compose in the
+# audited order (ISSUE 19; docs/EXECUTOR.md). The whitelist names the
+# core itself plus the three primitive homes it builds on; everywhere
+# else the spelling is a plan field (heartbeat_phase= / retry=) or a
+# ctx.guard / ctx.call / observe_compile call on the core's surface.
+EXEC_CORE_WHITELIST = ("exec/core.py", "utils/heartbeat.py",
+                       "utils/retry.py", "obs/compile.py")
+_EXEC_FENCED_NAMES = ("retry_device_call", "compile_span",
+                      "probe_lower_compile")
 
 # RED006 applies to the measured packages only: every public surface in
 # ops/ and bench/ must carry its reference citation (PARITY.md).
@@ -200,6 +211,7 @@ def check_python(rel_posix: str, source: str) -> List[RawFinding]:
     out += _red014(rel_posix, ctx)
     out += _red015(rel_posix, ctx)
     out += _red016(rel_posix, ctx)
+    out += _red025(rel_posix, ctx)
     # nested timing scopes can double-report the same call site
     return sorted(set(out), key=lambda f: (f.line, f.rule, f.message))
 
@@ -809,6 +821,64 @@ def _red016(rel: str, ctx: _FileContext) -> List[RawFinding]:
                    for name in RESHARD_PRIM_NAMES):
                 out.append(RawFinding(
                     "RED016", node.lineno, f"{chain}() {msg}"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# RED025 — bespoke resilience/compile wiring outside the execution core
+# (ISSUE 19; docs/EXECUTOR.md). A raw heartbeat.guard, a direct
+# retry_device_call, or an inline compile_span / probe_lower_compile
+# spelled at a call site is a device launch whose resilience contract
+# lives in control flow instead of data: the chaos suite cannot see its
+# phase, the ledger join cannot prove its exactly-once story, and the
+# next flap-handling fix has to find it by grep. The contract belongs
+# ON the LaunchPlan (heartbeat_phase= / retry= / staging_bound=) and
+# its execution IN exec/core.run — the one audited composition of
+# watchdog gate, guard, retry classification and exec.plan/launch/done
+# evidence. Builder code that needs a narrower scope uses the
+# LaunchContext surface (ctx.guard / ctx.call / ctx.tick), which this
+# rule deliberately does not match.
+# --------------------------------------------------------------------------
+
+
+def _red025(rel: str, ctx: _FileContext) -> List[RawFinding]:
+    if _suffix_match(rel, EXEC_CORE_WHITELIST):
+        return []
+    msg = ("outside exec/core.py — heartbeat guards, device-retry "
+           "classification and compile spans are LaunchPlan contract "
+           "fields executed by THE one core (exec.core.run); declare "
+           "the plan (heartbeat_phase= / retry= / observe_compile) or "
+           "use the builder's ctx.guard/ctx.call, or waive with the "
+           "reason this site cannot be a LaunchPlan")
+    out = []
+    guard_aliases = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            names = {n.name: (n.asname or n.name) for n in node.names}
+            if (mod.endswith("utils.heartbeat") or mod == "heartbeat") \
+                    and "guard" in names:
+                guard_aliases.add(names["guard"])
+                out.append(RawFinding(
+                    "RED025", node.lineno,
+                    f"import of heartbeat.guard {msg}"))
+            for fenced in _EXEC_FENCED_NAMES:
+                if fenced in names:
+                    out.append(RawFinding(
+                        "RED025", node.lineno,
+                        f"import of {fenced} {msg}"))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain.endswith("heartbeat.guard") or \
+                (isinstance(node.func, ast.Name)
+                 and node.func.id in guard_aliases):
+            out.append(RawFinding(
+                "RED025", node.lineno, f"{chain or 'guard'}() {msg}"))
+        elif chain and chain.rsplit(".", 1)[-1] in _EXEC_FENCED_NAMES:
+            out.append(RawFinding(
+                "RED025", node.lineno, f"{chain}() {msg}"))
     return out
 
 

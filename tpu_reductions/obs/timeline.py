@@ -602,6 +602,70 @@ def compile_summary(events: List[dict]) -> Optional[dict]:
     return out
 
 
+def exec_summary(events: List[dict]) -> Optional[dict]:
+    """Execution-core attribution from the exec.* typed events
+    (lint/grammar.py EXEC_EVENTS; exec/core.run + exec/cost.py —
+    ISSUE 19). Per surface: how many LaunchPlans were declared, how
+    many completed (the exec.plan vs exec.done join IS the
+    duplicate-launch audit the chaos suite runs), how many failed, and
+    the wall-clock the core attributed to each. Plus every cost-oracle
+    decision (exec.select) with its static baseline, so a regime flip
+    is visible in the window record, not just in exec_decisions.json.
+    None when no plan executed."""
+    plans = [e for e in events if e["ev"] == "exec.plan"]
+    selects = [e for e in events if e["ev"] == "exec.select"]
+    launches = sum(1 for e in events if e["ev"] == "exec.launch")
+    dones = [e for e in events if e["ev"] == "exec.done"]
+    if not plans and not selects and not launches and not dones:
+        return None
+    surfaces: dict = {}
+    order: List[str] = []
+    total_s = 0.0
+    failures = 0
+
+    def rec_for(e: dict) -> Optional[dict]:
+        s = e.get("surface")
+        if not isinstance(s, str):
+            return None
+        if s not in surfaces:
+            surfaces[s] = {"surface": s, "kind": e.get("kind"),
+                           "plans": 0, "done": 0, "failed": 0,
+                           "wall_s": 0.0}
+            order.append(s)
+        return surfaces[s]
+
+    for e in plans:
+        rec = rec_for(e)
+        if rec is not None:
+            rec["plans"] += 1
+            if rec["kind"] is None and isinstance(e.get("kind"), str):
+                rec["kind"] = e["kind"]
+    for e in dones:
+        rec = rec_for(e)
+        if rec is None:
+            continue
+        rec["done"] += 1
+        if e.get("ok") is False:
+            rec["failed"] += 1
+            failures += 1
+        d = e.get("wall_s")
+        if isinstance(d, (int, float)):
+            rec["wall_s"] += float(d)
+            total_s += float(d)
+    for rec in surfaces.values():
+        rec["wall_s"] = round(rec["wall_s"], 6)
+    sel_rows = [{"axis": e.get("axis"), "choice": e.get("choice"),
+                 "static_choice": e.get("static"),
+                 "flipped": bool(e.get("flipped",
+                                       e.get("choice") != e.get("static"))),
+                 "reason": e.get("reason")} for e in selects]
+    return {"plans": len(plans), "launches": launches,
+            "done": len(dones), "failures": failures,
+            "exec_s": round(total_s, 6),
+            "surfaces": [surfaces[s] for s in order],
+            "selects": sel_rows}
+
+
 def summarize(path, events: List[dict], torn: int) -> dict:
     """The machine-readable summary JSON (bench/regen collates it into
     report.md; chip_session.sh persists it as obs_timeline.json)."""
@@ -632,6 +696,9 @@ def summarize(path, events: List[dict], torn: int) -> dict:
     comp = compile_summary(events)
     if comp is not None:
         out["compile"] = comp
+    execu = exec_summary(events)
+    if execu is not None:
+        out["exec"] = execu
     from tpu_reductions.obs import critical_path as _cp
     cp = _cp.compute(events)
     if cp is not None:
@@ -967,6 +1034,40 @@ def summary_markdown(summary: dict) -> str:
                      f"{comp['compile_s']:.2f} s total{share}"
                      + (f"; {comp['warm_runs']} warming pass(es)"
                         if comp.get("warm_runs") else ""))
+    execu = summary.get("exec")
+    if execu:
+        # the execution core's record (ISSUE 19): per-surface plan/done
+        # counts (the duplicate-launch audit is this join) + the
+        # cost-oracle decisions with their static baselines
+        lines.append("")
+        lines.append("### execution core (per-surface LaunchPlan "
+                     "attribution)")
+        lines.append("")
+        lines.append("| surface | kind | plans | done | failed "
+                     "| wall s |")
+        lines.append("|---|---|---|---|---|---|")
+        for rec in execu["surfaces"]:
+            lines.append(
+                f"| {rec['surface']} | {rec.get('kind') or '?'} "
+                f"| {rec['plans']} | {rec['done']} | {rec['failed']} "
+                f"| {rec['wall_s']:.3f} |")
+        lines.append("")
+        lines.append(f"{execu['plans']} plan(s), {execu['launches']} "
+                     f"launch(es), {execu['done']} completed, "
+                     f"{execu['failures']} failure(s), "
+                     f"{execu['exec_s']:.2f} s in planned device work")
+        if execu["selects"]:
+            lines.append("")
+            lines.append("| decision axis | chosen | static pick "
+                         "| flipped | why |")
+            lines.append("|---|---|---|---|---|")
+            for sel in execu["selects"]:
+                lines.append(
+                    f"| {sel.get('axis') or '?'} "
+                    f"| {sel.get('choice') or '?'} "
+                    f"| {sel.get('static_choice') or '?'} "
+                    f"| {'YES' if sel.get('flipped') else 'no'} "
+                    f"| {sel.get('reason') or '-'} |")
     return "\n".join(lines)
 
 

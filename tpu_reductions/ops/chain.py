@@ -168,12 +168,13 @@ def make_chained_reduce(core: Callable, op: ReduceOpSpec,
     def chained_observed(x2d, k):
         if state["first"]:
             state["first"] = False
-            from tpu_reductions.obs.compile import compile_span
+            from tpu_reductions.exec import core as exec_core
             plane = x2d[0] if isinstance(x2d, tuple) else x2d
             shape = tuple(getattr(plane, "shape", ()) or ())
-            with compile_span(sid, op=op.name,
-                              rows=(shape[0] if shape else None),
-                              pair=isinstance(x2d, tuple)):
+            with exec_core.observe_compile(sid, op=op.name,
+                                           rows=(shape[0] if shape
+                                                 else None),
+                                           pair=isinstance(x2d, tuple)):
                 return jitted(x2d, k)
         return jitted(x2d, k)
 

@@ -292,11 +292,10 @@ class StreamReducer:
         assert self._acc is not None, "restore() before fold()"
         if not self._compile_observed:
             self._compile_observed = True
-            from tpu_reductions.obs.compile import compile_span
-            with compile_span("stream", op=self.method,
-                              dtype=self.plan.dtype,
-                              chunk_elems=self.plan.chunk_elems,
-                              pair=self.is_dd):
+            from tpu_reductions.exec import core as exec_core
+            with exec_core.observe_compile(
+                    "stream", op=self.method, dtype=self.plan.dtype,
+                    chunk_elems=self.plan.chunk_elems, pair=self.is_dd):
                 self._fold_one(staged)
             return
         self._fold_one(staged)
@@ -434,9 +433,10 @@ def run_stream(flat: np.ndarray, method: str, *,
     """
     import time
 
+    from tpu_reductions.exec import core as exec_core
+    from tpu_reductions.exec.plan import launch_plan
     from tpu_reductions.faults.inject import fault_point
     from tpu_reductions.obs import ledger, trace
-    from tpu_reductions.utils import heartbeat
 
     flat = np.ravel(flat)
     r = reducer or StreamReducer(method, str(flat.dtype), flat.size,
@@ -459,7 +459,12 @@ def run_stream(flat: np.ndarray, method: str, *,
         t0 = time.monotonic()
         partial = None
         syncs = 0
-        with heartbeat.guard("stream"):
+
+        def pipeline(ctx):
+            # the whole double-buffered loop is ONE plan: the executor
+            # holds the "stream" heartbeat phase around it (contract),
+            # the per-chunk forward-progress marks are ctx.tick()
+            nonlocal partial, syncs
             r.restore(init_partial)
             if start_chunk < plan.num_chunks:
                 inflight = r.stage(flat, start_chunk)
@@ -475,7 +480,7 @@ def run_stream(flat: np.ndarray, method: str, *,
                 r.fold(inflight)           # overlaps nxt's transfer
                 t_done = time.monotonic()
                 inflight = nxt
-                heartbeat.tick()
+                ctx.tick()
                 done = i + 1
                 # stage_s/fold_s are DISPATCH-side wall clock (the
                 # honest-timing doctrine: device completion is only
@@ -488,7 +493,7 @@ def run_stream(flat: np.ndarray, method: str, *,
                 if done % sync_every == 0 or done == plan.num_chunks:
                     partial = r.partial()  # honest materialization
                     syncs += 1
-                    heartbeat.tick()
+                    ctx.tick()
                     ledger.emit("stream.sync", chunks_done=done,
                                 total=plan.num_chunks,
                                 elapsed_s=round(
@@ -497,6 +502,13 @@ def run_stream(flat: np.ndarray, method: str, *,
                         on_sync(done, partial)
             if partial is None:        # resumed-at-end degenerate case
                 partial = r.partial()
+
+        exec_core.run(launch_plan(
+            "stream", "stream", pipeline, timing="stream",
+            heartbeat_phase="stream",
+            staging_bound=int(plan.chunk_bytes),
+            method=r.method, dtype=r.dtype, n=plan.n,
+            chunks=plan.num_chunks, start_chunk=start_chunk))
         wall = time.monotonic() - t0
         value = r.finish(partial)
         span = plan.chunk_span(start_chunk)[0] if start_chunk \

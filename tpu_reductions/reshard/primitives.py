@@ -332,8 +332,9 @@ def execute_plan(plan, carried: np.ndarray, mesh: Mesh, *,
     factor.
 
     No reference analog (TPU-native)."""
+    from tpu_reductions.exec import core as exec_core
+    from tpu_reductions.exec.plan import launch_plan
     from tpu_reductions.obs import ledger, trace
-    from tpu_reductions.utils import heartbeat
     from tpu_reductions.utils.timing import Stopwatch
 
     x_np = np.asarray(carried)
@@ -349,40 +350,51 @@ def execute_plan(plan, carried: np.ndarray, mesh: Mesh, *,
                     wire_bytes=int(plan.wire_bytes),
                     mem_factor=round(plan.mem_factor, 6),
                     ranks=mesh.shape[axis])
-        x = place_spec(x_np, plan.source, mesh, axis)
         step_rows = []
-        measured = _shard_fraction(x, g_bytes)
         total = 0.0
-        for step in plan.steps:
-            fn, aux = build_step(step, mesh, global_shape, dtype, axis)
-            watch = Stopwatch()
-            watch.start()
-            # the step's one blocking device region: dispatch + host
-            # materialization, heartbeat-guarded so a mid-plan relay
-            # stall trips exit 4 instead of hanging (RED019)
-            with heartbeat.guard("reshard.step"):
-                y = fn(x)
-                jax.device_get(y)
-            wall_s = watch.stop()
-            total += wall_s
-            in_b = _max_shard_bytes(x)
-            out_b = _max_shard_bytes(y)
-            aux_b = sum(b for _, b in aux)
-            step_bytes = in_b + out_b + aux_b
-            step_frac = step_bytes / g_bytes
-            measured = max(measured, step_frac)
-            step_rows.append({"primitive": step.primitive,
-                              "algorithm": step.algorithm,
-                              "wall_s": round(wall_s, 6),
-                              "buffer_bytes": int(step_bytes),
-                              "mem_factor": round(step_frac, 6)})
-            ledger.emit("reshard.step", primitive=step.primitive,
-                        algorithm=step.algorithm,
-                        wall_s=round(wall_s, 6),
-                        mem_factor=round(step_frac, 6),
-                        ranks=mesh.shape[axis])
-            x = y
-        shards = collect_shards(x, mesh, axis)
+
+        def program(ctx):
+            # the whole redistribution program is ONE plan: the
+            # contract declares no whole-plan phase, and every step's
+            # blocking device region — dispatch + host materialization
+            # — runs under its own ctx.guard so a mid-plan relay stall
+            # trips exit 4 instead of hanging (RED019)
+            nonlocal total
+            x = place_spec(x_np, plan.source, mesh, axis)
+            measured = _shard_fraction(x, g_bytes)
+            for step in plan.steps:
+                fn, aux = build_step(step, mesh, global_shape, dtype,
+                                     axis)
+                watch = Stopwatch()
+                watch.start()
+                with ctx.guard("reshard.step"):
+                    y = fn(x)
+                    jax.device_get(y)
+                wall_s = watch.stop()
+                total += wall_s
+                in_b = _max_shard_bytes(x)
+                out_b = _max_shard_bytes(y)
+                aux_b = sum(b for _, b in aux)
+                step_bytes = in_b + out_b + aux_b
+                step_frac = step_bytes / g_bytes
+                measured = max(measured, step_frac)
+                step_rows.append({"primitive": step.primitive,
+                                  "algorithm": step.algorithm,
+                                  "wall_s": round(wall_s, 6),
+                                  "buffer_bytes": int(step_bytes),
+                                  "mem_factor": round(step_frac, 6)})
+                ledger.emit("reshard.step", primitive=step.primitive,
+                            algorithm=step.algorithm,
+                            wall_s=round(wall_s, 6),
+                            mem_factor=round(step_frac, 6),
+                            ranks=mesh.shape[axis])
+                x = y
+            return collect_shards(x, mesh, axis), measured
+
+        shards, measured = exec_core.run(launch_plan(
+            "reshard", "reshard", program, timing="steps",
+            heartbeat_phase=None, ranks=int(mesh.shape[axis]),
+            steps=len(plan.steps)))
         ledger.emit("reshard.done", src=plan.source.describe(),
                     dst=plan.target.describe(), steps=len(plan.steps),
                     wall_s=round(total, 6),

@@ -133,9 +133,10 @@ class BatchExecutor:
         device failure after the retry wrapper's classification — the
         engine contains the crash to the batch (the crash_result
         discipline of bench/driver.py, response-shaped)."""
+        from tpu_reductions.exec import core as exec_core
+        from tpu_reductions.exec.plan import launch_plan
         from tpu_reductions.ops import oracle as oracle_mod
         from tpu_reductions.ops.registry import get_op
-        from tpu_reductions.utils.retry import retry_device_call
         from tpu_reductions.utils.rng import host_data
 
         # chaos hook: one coalesced launch = one interruptible unit,
@@ -167,21 +168,28 @@ class BatchExecutor:
             # stacked payload under the 512 MiB single-message bound)
             return np.asarray(jax.device_get(fn(stacked)))
 
-        # compile observatory (obs/compile.py): the first launch of a
-        # (method, dtype, n, bucket) key is the bucket's trace+compile
-        # point — engine.prewarm drives exactly these — so it runs
-        # inside a compile_span and lands in the ledger with its
-        # cold/warm cache verdict; steady-state launches pay one set
-        # lookup
+        # the bucket launch is ONE LaunchPlan (exec/core.py): the
+        # executor owns the retry classification + "serve" heartbeat
+        # guard the old inline retry_device_call spelled here
+        plan = launch_plan(f"serve-bucket/{method.lower()}", "serve",
+                           lambda ctx: launch(), timing="serve",
+                           heartbeat_phase="serve", retry=True,
+                           drain=True, method=method, dtype=dtype,
+                           n=n, batch=kb)
+        # compile observatory (exec_core.observe_compile): the first
+        # launch of a (method, dtype, n, bucket) key is the bucket's
+        # trace+compile point — engine.prewarm drives exactly these —
+        # so it runs inside a compile span and lands in the ledger with
+        # its cold/warm cache verdict; steady-state launches pay one
+        # set lookup
         bucket_key = (method, dtype, n, kb)
         if bucket_key not in _observed_buckets:
             _observed_buckets.add(bucket_key)
-            from tpu_reductions.obs.compile import compile_span
-            with compile_span(f"serve-bucket/{method.lower()}",
-                              dtype=dtype, n=n, batch=kb):
-                vals = retry_device_call(launch, phase="serve")[:k]
+            with exec_core.observe_compile(plan.surface, dtype=dtype,
+                                           n=n, batch=kb):
+                vals = exec_core.run(plan)[:k]
         else:
-            vals = retry_device_call(launch, phase="serve")[:k]
+            vals = exec_core.run(plan)[:k]
 
         out: List[Dict] = []
         for i, seed in enumerate(seeds):
@@ -208,10 +216,11 @@ class BatchExecutor:
         chunk-wise oracle (ops/oracle.IncrementalOracle), so the host
         side never needs a second full-payload pass either. Same retry
         classification and response shape as run_batch."""
+        from tpu_reductions.exec import core as exec_core
+        from tpu_reductions.exec.plan import launch_plan
         from tpu_reductions.ops import oracle as oracle_mod
         from tpu_reductions.ops.stream import (iter_chunks, plan_chunks,
                                                run_stream)
-        from tpu_reductions.utils.retry import retry_device_call
         from tpu_reductions.utils.rng import host_data
 
         fault_point("serve.batch")   # same interruptible-unit hook as
@@ -221,10 +230,12 @@ class BatchExecutor:
         if x is None:
             x = host_data(n, dtype, rank=0, seed=seed)
 
-        res = retry_device_call(
-            lambda: run_stream(x, method, chunk_bytes=chunk_bytes,
-                               sync_every=sync_every),
-            phase="serve")
+        res = exec_core.run(launch_plan(
+            f"serve-stream/{method.lower()}", "serve",
+            lambda ctx: run_stream(x, method, chunk_bytes=chunk_bytes,
+                                   sync_every=sync_every),
+            timing="stream", heartbeat_phase="serve", retry=True,
+            drain=True, method=method, dtype=dtype, n=n))
 
         oracle = oracle_mod.IncrementalOracle(method, dtype)
         for chunk in iter_chunks(x, plan_chunks(n, dtype, chunk_bytes)):
@@ -264,12 +275,13 @@ class BatchExecutor:
         from tpu_reductions.collectives.core import make_collective_reduce
         from tpu_reductions.collectives.quant import (
             make_quant_sum_all_reduce, quant_error_bound, quant_supported)
+        from tpu_reductions.exec import core as exec_core
+        from tpu_reductions.exec.plan import launch_plan
         from tpu_reductions.obs import ledger, trace
         from tpu_reductions.ops import oracle as oracle_mod
         from tpu_reductions.ops.registry import accum_dtype, get_op
         from tpu_reductions.ops.stream import (_BLOCK, _LANES, _SUBLANES,
                                                iter_chunks, plan_chunks)
-        from tpu_reductions.utils.retry import retry_device_call
         from tpu_reductions.utils.rng import host_data
 
         fault_point("serve.batch")
@@ -330,9 +342,17 @@ class BatchExecutor:
                 acc = fold(acc, staged)
             return acc
 
-        accs = [retry_device_call(lambda r=r, d=d: fold_shard(r, d),
+        # per-shard folds: one plan, k retried device units — the
+        # contract sets no whole-plan phase; each ctx.call carries the
+        # "serve" guard exactly where the old inline retries did
+        accs = exec_core.run(launch_plan(
+            f"serve-shard/{method.lower()}", "serve",
+            lambda ctx: [ctx.call(lambda r=r, d=d: fold_shard(r, d),
                                   phase="serve")
-                for r, d in enumerate(devs)]
+                         for r, d in enumerate(devs)],
+            timing="serve", heartbeat_phase=None, drain=True,
+            staging_bound=int(plan.chunk_bytes), method=method,
+            dtype=dtype, n=n, devices=k))
 
         # combine dtype: what the partials actually hold (bf16 SUM
         # accumulates f32 — ops/registry.accum_dtype)
@@ -364,8 +384,12 @@ class BatchExecutor:
                         dtype=combine_dtype, ranks=k, n=int(per_rank))
             import time as _time
             t0 = _time.perf_counter()
-            block = np.asarray(jax.device_get(
-                retry_device_call(lambda: coll(garr), phase="serve")))
+            block = np.asarray(jax.device_get(exec_core.run(launch_plan(
+                f"serve-combine/{selection.algorithm}", "collective",
+                lambda ctx: ctx.call(lambda: coll(garr), phase="serve"),
+                timing="serve", heartbeat_phase=None, drain=True,
+                method=method, dtype=combine_dtype, ranks=k,
+                quantized=use_quant))))
             ledger.emit("collective.done",
                         algorithm=selection.algorithm, method=method,
                         dtype=combine_dtype, ranks=k,
@@ -420,12 +444,17 @@ class BatchExecutor:
         device touch funnels through here so the rest of serve/ stays
         inside the RED014 fence. Returns execute_plan's result dict
         ({'shards', 'wall_s', 'steps', 'measured_mem_factor'})."""
+        from tpu_reductions.exec import core as exec_core
+        from tpu_reductions.exec.plan import launch_plan
         from tpu_reductions.reshard.primitives import (execute_plan,
                                                        make_mesh)
-        from tpu_reductions.utils.retry import retry_device_call
 
         fault_point("serve.batch")
 
         mesh = make_mesh(plan.source.num_ranks)
-        return retry_device_call(
-            lambda: execute_plan(plan, carried, mesh), phase="serve")
+        return exec_core.run(launch_plan(
+            "serve-reshard", "reshard",
+            lambda ctx: execute_plan(plan, carried, mesh),
+            timing="steps", heartbeat_phase="serve", retry=True,
+            drain=True, ranks=plan.source.num_ranks,
+            steps=len(plan.steps)))

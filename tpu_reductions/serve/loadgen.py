@@ -1489,7 +1489,7 @@ def main(argv=None) -> int:
     from tpu_reductions.obs.ledger import arm_session
     arm_session("serve.loadgen",
                 argv=list(argv) if argv else sys.argv[1:])
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
     maybe_arm_for_tpu()   # a loadgen hung on a dead relay reports nothing
 
     if ns.scale:

@@ -91,7 +91,7 @@ class RelayTransport:
             return 0.0
         t0 = time.monotonic()
         inconclusive = False
-        with heartbeat.guard("serve"):
+        with heartbeat.guard("serve"):  # redlint: disable=RED025 -- guards a raw TCP relay-port probe (no device work, pre-jax); there is no launch to plan, only a socket wait to watch
             for port in self._resolved_ports():
                 try:
                     with socket.create_connection(

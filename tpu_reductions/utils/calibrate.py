@@ -169,7 +169,7 @@ def calibrate(n: int = 1 << 24, dtype: str = "float32",
     # heartbeat (exit 4) instead of hanging with live ports
     # (redlint RED019); time_chained below keeps its own guard.
     from tpu_reductions.utils import heartbeat
-    with heartbeat.guard("calibrate"):
+    with heartbeat.guard("calibrate"):  # redlint: disable=RED025 -- the trust-verdict instrument: one guard entered once so the raw per-sync perf_counter windows inside stay undistorted; a plan-per-probe would add the overhead being measured
         op = get_op("SUM")
         tm, p, t = choose_tiling(n, dtype=dtype)
         x2d = jax.block_until_ready(
@@ -247,7 +247,7 @@ def main(argv=None) -> int:
     from tpu_reductions.obs.ledger import arm_session
     arm_session("utils.calibrate",
                 argv=list(argv) if argv else sys.argv[1:])
-    from tpu_reductions.utils.watchdog import maybe_arm_for_tpu
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
     maybe_arm_for_tpu()  # no-op off-TPU; exits 3 on a dead relay
     import jax
 

@@ -128,12 +128,12 @@ def time_fn(fn: Callable, *args, iterations: int = 100, warmup: int = 1,
     # legitimately block 20-40 s on a tunnel compile, so its heartbeat
     # phase is 'compile' (the long deadline); the timed loop below is
     # steady-state (utils/heartbeat.py)
-    with heartbeat.guard(heartbeat.PHASE_COMPILE):
+    with heartbeat.guard(heartbeat.PHASE_COMPILE):  # redlint: disable=RED025 -- time_fn is the reference-analog sync-mode instrument, not a LaunchPlan path; its guard edges ARE the measured contract
         for _ in range(warmup):
             result = jax.block_until_ready(fn(*args))
 
     if mode == "bulk":
-        with heartbeat.guard("bulk"):
+        with heartbeat.guard("bulk"):  # redlint: disable=RED025 -- reference-analog bulk span; the single sync at the edge is the instrument
             sw.start()
             for _ in range(iterations):
                 result = fn(*args)
@@ -147,7 +147,7 @@ def time_fn(fn: Callable, *args, iterations: int = 100, warmup: int = 1,
         sw.samples.pop()
         return result, sw
 
-    with heartbeat.guard(mode):
+    with heartbeat.guard(mode):  # redlint: disable=RED025 -- reference-analog periter/fetch loop; per-iteration sync edges are the measurement, not a launch plan
         for _ in range(iterations):
             sw.start()
             result = jax.block_until_ready(fn(*args))
@@ -192,22 +192,37 @@ def time_chained(chained_fn, x, k_lo: int, k_hi: int, reps: int = 5,
 
     trips = 0
 
+    surface = getattr(chained_fn, "surface", "chain")
+
     def run(k) -> float:
         # chaos hook: every chained sample blocks on a host
         # materialization through the tunnel — the exact wait a relay
         # flap strands forever (faults/inject.py scripts that death).
-        # Each trip is one heartbeat-guarded region (ops/chain.py trip
-        # boundaries surface HERE — the in-program fori_loop trips are
-        # invisible to the host, so the materialization that bounds
-        # them is the tickable boundary); the first trip compiles.
+        # Each trip is ONE LaunchPlan through the executor
+        # (exec/core.py): the heartbeat guard around the trip comes
+        # from the plan's contract (ops/chain.py trip boundaries
+        # surface HERE — the in-program fori_loop trips are invisible
+        # to the host, so the materialization that bounds them is the
+        # tickable boundary); the first trip compiles, so its plan
+        # declares the long-deadline compile phase.
         nonlocal trips
         fault_point("chain.step")
         phase = heartbeat.PHASE_COMPILE if trips == 0 else "chained"
         trips += 1
-        with heartbeat.guard(phase):
+
+        def trip(ctx) -> float:
+            # the perf_counter window stays INSIDE the builder: exec.*
+            # events bracket the plan outside it, so the measured
+            # region is exactly what it was pre-executor
             t0 = time.perf_counter()
             fetch(chained_fn(x, k))
-            dt = time.perf_counter() - t0
+            return time.perf_counter() - t0
+
+        from tpu_reductions.exec import core as exec_core
+        from tpu_reductions.exec.plan import launch_plan
+        dt = exec_core.run(launch_plan(
+            surface, "chain", trip, timing="chained",
+            heartbeat_phase=phase, k=int(k), trip=trips))
         # flight-recorder: emitted AFTER the perf_counter window closes
         # and after the guard exits — trip events must never sit inside
         # the measured region (docs/OBSERVABILITY.md); both trips of a
