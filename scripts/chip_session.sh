@@ -478,6 +478,16 @@ fallback_static_session() {
             examples/tpu_run/serving_elastic.json -- \
         bash scripts/run_serving_elastic.sh
 
+    # the reduction family's first on-chip rows (ISSUE 20;
+    # docs/FAMILY.md): SCAN racing mxu-scan vs xla-cumsum, segmented
+    # reduce, argmin/argmax — every cell chained + oracle-verified,
+    # plus the serving proof rows; the committed artifact is what
+    # exec/cost.pick_scan prices from (smoke lowered mxu-scan above)
+    # redlint: disable=RED013 -- no-scheduler fallback path: mirrors sched/tasks.py family_spot
+    step "reduction-family spot" 300 examples/tpu_run/family_spot.json -- \
+        python -m tpu_reductions.bench.family_spot --n=16777216 \
+            --out=examples/tpu_run/family_spot.json
+
     # off-chip by design: the crash-recovery instrument kills and
     # restarts a journaled router subprocess + the in-process
     # kill-replica/drain contrast pair on cpu, flap-time filler

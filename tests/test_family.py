@@ -1,0 +1,370 @@
+"""Reduction-family properties (ISSUE 20; docs/FAMILY.md): scan
+chunk-carry vs one-shot bit-identity, the MXU matmul trick vs the XLA
+cumsum, segmented reduce against per-segment numpy (ragged + empty
+segments), arg-reduce lowest-index ties on device AND oracle, the
+registry/oracle round-trips, the serving wire end-to-end, the spot
+instrument's grid + report fold, and the `family.cell` exit-3
+mid-artifact resume (docs/RESILIENCE.md fault-point table)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpu_reductions.config import FAMILY_METHODS, SERVED_METHODS
+from tpu_reductions.ops import family as fam
+from tpu_reductions.ops import oracle as oracle_mod
+from tpu_reductions.ops.registry import get_op, tolerance
+from tpu_reductions.utils.rng import host_data
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------------------ scan
+
+def test_scan_int32_chunk_carry_is_bit_identical_to_one_shot():
+    """Modular addition is associative: the StreamScanner's chunk-carry
+    recurrence must reproduce the one-shot cumsum EXACTLY for int32,
+    including across the wrap."""
+    n = 1 << 14
+    x = host_data(n, "int32", rank=0, seed=3)
+    # force the wrap into play: large magnitudes on top of the byte fill
+    x = (x.astype(np.int64) * 0x0FFFFFFF).astype(np.int32)
+    sc = fam.StreamScanner("int32", n, chunk_bytes=4096)
+    got = sc.scan(x)
+    assert sc.plan.num_chunks > 1   # the chunk boundary is exercised
+    want = fam.host_scan(x)
+    assert np.array_equal(got, want)
+    # the carry is the running total — the next chunk's additive offset
+    assert int(sc.carry) == int(want[-1])
+
+
+def test_scan_float_chunk_carry_within_sum_tolerance():
+    n = 1 << 14
+    x = host_data(n, "float32", rank=0, seed=1)
+    sc = fam.StreamScanner("float32", n, chunk_bytes=4096)
+    got = sc.scan(x)
+    want = fam.host_scan(x)
+    assert float(np.abs(got.astype(np.float64) - want).max()) \
+        <= tolerance("SUM", "float32", n)
+
+
+def test_mxu_scan_matches_cumsum_baseline():
+    """The paper's trick (x @ upper-triangular ones per 128-block plus
+    a carry level, arXiv:1811.09736) against jnp.cumsum — including a
+    non-multiple-of-128 length, which exercises the pad/slice edges."""
+    import jax
+
+    for n in (1 << 12, (1 << 12) + 37):
+        x = host_data(n, "float32", rank=0, seed=2)
+        zero = np.float32(0)
+        a = np.asarray(jax.device_get(
+            fam.scan_fn("mxu-scan", "float32")(x, zero)))
+        b = np.asarray(jax.device_get(
+            fam.scan_fn("xla-cumsum", "float32")(x, zero)))
+        want = fam.host_scan(x)
+        for got in (a, b):
+            assert got.shape == (n,)
+            assert float(np.abs(got.astype(np.float64) - want).max()) \
+                <= tolerance("SUM", "float32", n)
+
+
+def test_scan_impls_gates_mxu_to_floats():
+    assert fam.scan_impls("float32") == ("xla-cumsum", "mxu-scan")
+    assert fam.scan_impls("bfloat16") == ("xla-cumsum", "mxu-scan")
+    assert fam.scan_impls("int32") == ("xla-cumsum",)
+    with pytest.raises(ValueError, match="float-only"):
+        fam.scan_fn("mxu-scan", "int32")
+
+
+# ------------------------------------------------------- segmented reduce
+
+@pytest.mark.parametrize("method", ["SEGSUM", "SEGMIN", "SEGMAX"])
+@pytest.mark.parametrize("dtype", ["int32", "float32"])
+def test_segmented_reduce_matches_per_segment_numpy(method, dtype):
+    """Device segment reduce vs a literal per-segment numpy loop over
+    ragged random offsets — duplicate cuts guarantee EMPTY segments,
+    which must land the identity on both sides."""
+    import jax
+
+    n, segs = 256, 64   # 63 cuts in [0,256]: duplicate cuts (= empty
+    #                     segments) occur with near-certainty
+    x = host_data(n, dtype, rank=0, seed=5)
+    offsets = fam.random_offsets(n, segs, seed=7)
+    assert offsets[0] == 0 and offsets[-1] == n
+    widths = np.diff(offsets)
+    assert (widths == 0).any()      # ragged by construction
+    ids = fam.segment_ids_from_offsets(offsets)
+    got = np.asarray(jax.device_get(
+        fam.segment_reduce_fn(method, segs)(x, ids))).astype(np.float64)
+    want = fam.host_segment_reduce(x, offsets, method)
+    assert got.shape == want.shape == (segs,)
+    for s in range(segs):
+        seg = x[offsets[s]:offsets[s + 1]]
+        if seg.size == 0:
+            # identity agreement: device fill == host fill (+-inf for
+            # float MIN/MAX, iinfo extremes for int)
+            assert got[s] == want[s] or (np.isinf(got[s])
+                                         and got[s] == want[s])
+            continue
+        ref = {"SEGSUM": seg.astype(np.float64).sum(),
+               "SEGMIN": float(seg.min()),
+               "SEGMAX": float(seg.max())}[method]
+        tol = tolerance("SUM", dtype, int(seg.size)) \
+            if method == "SEGSUM" and dtype != "int32" else 0.0
+        assert abs(want[s] - ref) <= tol
+        assert abs(got[s] - ref) <= tol
+
+
+def test_segment_ids_round_trip_offsets():
+    offsets = np.array([0, 3, 3, 7, 10], dtype=np.int64)
+    ids = fam.segment_ids_from_offsets(offsets)
+    assert ids.tolist() == [0, 0, 0, 2, 2, 2, 2, 3, 3, 3]
+
+
+# ------------------------------------------------------------- arg reduce
+
+@pytest.mark.parametrize("method", ["ARGMIN", "ARGMAX"])
+@pytest.mark.parametrize("dtype", ["int32", "float32"])
+def test_arg_reduce_exact_with_lowest_index_tie(method, dtype):
+    import jax
+
+    n = 1 << 12
+    x = host_data(n, dtype, rank=0, seed=11)
+    # plant the extreme value at three positions: the LOWEST index wins
+    lo, mid, hi = 100, n // 2, n - 7
+    extreme = (np.dtype(dtype).type(300)
+               if method == "ARGMAX" else np.dtype(dtype).type(-5))
+    x = x.copy()
+    x[lo] = x[mid] = x[hi] = extreme
+    got = int(jax.device_get(fam.arg_reduce_fn(method, dtype)(x)))
+    assert got == lo
+    assert int(fam.host_arg_reduce(x, method)) == lo
+    # numpy's first-occurrence rule is the same contract
+    ref = int(np.argmax(x) if method == "ARGMAX" else np.argmin(x))
+    assert got == ref
+
+
+def test_arg_reduce_rows_batches_independently():
+    import jax
+
+    k, n = 4, 512
+    rows = np.stack([host_data(n, "float32", rank=r, seed=13)
+                     for r in range(k)])
+    got = np.asarray(jax.device_get(
+        fam.arg_reduce_rows_fn("ARGMIN", "float32")(rows)))
+    want = rows.argmin(axis=1)
+    assert np.array_equal(got, want)
+
+
+# ------------------------------------------- registry / oracle round-trip
+
+def test_family_methods_registered_and_served():
+    assert FAMILY_METHODS == ("SCAN", "SEGSUM", "SEGMIN", "SEGMAX",
+                              "ARGMIN", "ARGMAX")
+    for m in FAMILY_METHODS:
+        assert m in SERVED_METHODS
+        op = get_op(m)
+        assert op is not None
+        assert fam.is_family_method(m)
+    assert not fam.is_family_method("SUM")
+
+
+def test_family_surfaces_vocabulary():
+    assert fam.family_surface("SCAN", "mxu-scan") == "mxu-scan"
+    assert fam.family_surface("SCAN") == "xla-cumsum"
+    assert fam.family_surface("SEGSUM") == "seg/segsum"
+    assert fam.family_surface("ARGMAX") == "argk/argmax"
+    with pytest.raises(ValueError):
+        fam.family_surface("SUM")
+
+
+def test_family_tolerances_follow_registry_classes():
+    n = 1 << 20
+    assert tolerance("SCAN", "float32", n) == tolerance("SUM", "float32",
+                                                        n)
+    for m in ("SEGMIN", "SEGMAX", "ARGMIN", "ARGMAX"):
+        assert tolerance(m, "float32", n) == 0.0
+
+
+def test_incremental_oracle_scan_and_arg_resume_round_trip():
+    n = 1 << 12
+    x = host_data(n, "int32", rank=0, seed=17)
+    o = oracle_mod.IncrementalOracle("SCAN", "int32")
+    o.update(x[: n // 2])
+    o = oracle_mod.IncrementalOracle.from_state(
+        json.loads(json.dumps(o.state())))   # the stream resume path
+    o.update(x[n // 2:])
+    assert int(o.value()) == int(fam.host_scan(x)[-1])
+
+    a = oracle_mod.IncrementalOracle("ARGMIN", "int32")
+    y = x.copy()
+    y[10] = y[3000] = -9    # tie across the chunk boundary: index 10 wins
+    a.update(y[:2048])
+    a = oracle_mod.IncrementalOracle.from_state(a.state())
+    a.update(y[2048:])
+    assert int(a.value()) == 10
+
+
+# ------------------------------------------------------------ serving wire
+
+def _serve_payload(n, dtype, seed):
+    """The executor's own payload convention (serve/executor.py:
+    native MT19937 fill when the C oracle built, utils.rng fallback)."""
+    x = oracle_mod.native_fill(n, dtype, rank=0, seed=seed)
+    return x if x is not None else host_data(n, dtype, rank=0,
+                                             seed=seed)
+
+
+def test_serve_engine_resolves_family_requests_end_to_end():
+    """The ISSUE 20 serving acceptance, in-process: SCAN / SEGSUM /
+    ARGMAX requests through the real coalescing engine resolve `ok`
+    with results the host oracle agrees with."""
+    from tpu_reductions.serve.engine import ServeEngine
+    from tpu_reductions.serve.request import ReduceRequest
+
+    eng = ServeEngine(coalesce_window_s=0.0).start()
+    try:
+        pends = [eng.submit(ReduceRequest(method=m, dtype=d, n=4096,
+                                          seed=s))
+                 for s, (m, d) in enumerate([("SCAN", "float32"),
+                                             ("SEGSUM", "int32"),
+                                             ("ARGMAX", "float32")])]
+        resps = [p.result(timeout=60.0) for p in pends]
+    finally:
+        eng.stop()
+    assert [r.status for r in resps] == ["ok", "ok", "ok"]
+    # SCAN's scalar result is the last prefix == the full SUM digest
+    x = _serve_payload(4096, "float32", 0)
+    assert abs(resps[0].result - float(x.astype(np.float64).sum())) \
+        <= tolerance("SUM", "float32", 4096)
+    # ARGMAX returns the (exact) index as the scalar
+    x2 = _serve_payload(4096, "float32", 2)
+    assert int(resps[2].result) == int(np.argmax(x2))
+
+
+def test_serve_executor_guards_family_stream_and_sharded():
+    from tpu_reductions.serve.executor import BatchExecutor
+
+    ex = BatchExecutor()
+    with pytest.raises(ValueError, match="no streaming path"):
+        ex.run_stream("SEGSUM", "int32", 1 << 12, 0)
+    with pytest.raises(ValueError, match="no device-parallel path"):
+        ex.run_sharded("ARGMAX", "float32", 1 << 12, 0)
+
+
+def test_serve_stream_scan_chunk_carries():
+    from tpu_reductions.serve.executor import BatchExecutor
+
+    res = BatchExecutor().run_stream("SCAN", "int32", 1 << 12, 0,
+                                     chunk_bytes=4096)
+    assert res["ok"] is True and res["chunks"] > 1
+    x = _serve_payload(1 << 12, "int32", 0)
+    assert int(res["result"]) == int(fam.host_scan(x)[-1])
+
+
+# --------------------------------------------------- the spot instrument
+
+def test_family_spot_grid_covers_every_method_and_serving_row():
+    from tpu_reductions.bench.family_spot import (SERVE_CELLS,
+                                                  family_cells)
+
+    cells = family_cells()
+    methods = {m for kind, m, _, _ in cells if kind == "cell"}
+    assert methods == set(FAMILY_METHODS)
+    scan_impls = [(d, i) for kind, m, d, i in cells
+                  if kind == "cell" and m == "SCAN"]
+    assert ("float32", "mxu-scan") in scan_impls     # the race happens
+    assert ("int32", "mxu-scan") not in scan_impls   # float-only guard
+    assert [(m, d) for kind, m, d, _ in cells if kind == "serve"] \
+        == list(SERVE_CELLS)
+    assert len(cells) == len(set(cells))
+
+
+@pytest.mark.parametrize("method,dtype,impl", [
+    ("SCAN", "float32", "mxu-scan"),
+    ("SEGSUM", "int32", "seg"),
+    ("ARGMAX", "float32", "argk"),
+])
+def test_family_spot_cell_verifies_and_times(method, dtype, impl,
+                                             stable_chained_timing):
+    from tpu_reductions.bench.family_spot import measure_cell
+
+    row = measure_cell(method, dtype, impl, n=1 << 12, segments=16,
+                       seed=0, reps=1)
+    assert row["status"] == "PASSED"
+    assert row["gbps"] > 0
+    # the cost oracle consumes exactly these spellings (exec/cost.py
+    # scan_rates): a key rename here silently unprices the scan axis
+    assert {"method", "dtype", "impl", "gbps", "status"} <= set(row)
+
+
+def test_family_spot_markdown_folds_cells_and_serve_rows():
+    from tpu_reductions.bench.family_spot import family_spot_markdown
+
+    assert family_spot_markdown({"rows": []}) == ""
+    md = family_spot_markdown({"n": 4096, "rows": [
+        {"kind": "cell", "method": "SCAN", "dtype": "float32",
+         "impl": "mxu-scan", "n": 4096, "gbps": 1.25, "max_err": 0.0,
+         "status": "PASSED"},
+        {"kind": "serve", "method": "SEGSUM", "dtype": "int32",
+         "n": 512, "requests": 3, "ok_count": 3, "status": "PASSED"},
+    ]})
+    assert "| SCAN | float32 | mxu-scan | 1.250 |" in md
+    assert "| SEGSUM | int32 | 512 | 3/3 | PASSED |" in md
+    assert "pick_scan" in md
+
+
+# ---------------------------------------------------- chaos: exit-3 resume
+
+def _spot_cmd(out):
+    return [sys.executable, "-m", "tpu_reductions.bench.family_spot",
+            "--platform=cpu", "--n=16384", "--serve-n=2048",
+            "--segments=16", "--reps=1", f"--out={out}"]
+
+
+def _spot_env(faults=None):
+    env = {**os.environ}
+    env.pop("TPU_REDUCTIONS_LEDGER", None)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    if faults is None:
+        env.pop("TPU_REDUCTIONS_FAULTS", None)
+    else:
+        env["TPU_REDUCTIONS_FAULTS"] = json.dumps(faults)
+    return env
+
+
+def test_chaos_family_spot_exit3_midgrid_resumes_rows(tmp_path):
+    """The `family.cell` fault point fires before each cell's payload
+    exists; a scripted exit-3 after 3 cells is the relay death between
+    family cells. The interrupted artifact must hold exactly the
+    finished rows (`complete: false`), and the re-invocation must
+    resume them byte-identically (docs/RESILIENCE.md; bench/resume)."""
+    out = tmp_path / "family_spot.json"
+    p = subprocess.run(
+        _spot_cmd(out), cwd=str(REPO), capture_output=True, text=True,
+        timeout=300,
+        env=_spot_env(faults={"family.cell": {"after": 3,
+                                              "action": "exit",
+                                              "code": 3}}))
+    assert p.returncode == 3, p.stderr
+    interrupted = json.loads(out.read_text())
+    assert interrupted["complete"] is False
+    assert len(interrupted["rows"]) == 3
+    assert all(r["status"] == "PASSED" for r in interrupted["rows"])
+
+    p2 = subprocess.run(_spot_cmd(out), cwd=str(REPO),
+                        capture_output=True, text=True, timeout=600,
+                        env=_spot_env())
+    assert p2.returncode == 0, p2.stderr
+    resumed = json.loads(out.read_text())
+    assert resumed["complete"] is True
+    assert len(resumed["rows"]) == 16   # 13 cells + 3 serving rows
+    # banked rows reused byte-identically, never re-measured
+    assert resumed["rows"][:3] == interrupted["rows"]
+    assert all(r["status"] == "PASSED" for r in resumed["rows"])

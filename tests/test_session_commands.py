@@ -165,6 +165,12 @@ STEPS = [
      ["--platform=cpu", "--ranks=2", "--n=16384", "--rows=64",
       "--quant-bits=0", "--out=reshard_curve.json"],
      "reshard_curve.json"),
+    ("python -m tpu_reductions.bench.family_spot --n=16777216 "
+     "--out=examples/tpu_run/family_spot.json",
+     "tpu_reductions.bench.family_spot",
+     ["--n=16384", "--serve-n=2048", "--segments=16", "--reps=2",
+      "--out=family_spot.json"],
+     "family_spot.json"),
     # the window scheduler's shell interface (run_scheduled_session):
     # one pick + one outcome record per loop iteration
     # (docs/SCHEDULER.md); rehearsed against the real registry's cpu

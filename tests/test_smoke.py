@@ -4,21 +4,24 @@ seconds-cost line in the session log (round-3 verdict, weak #3)."""
 
 import json
 
-from tpu_reductions.bench.smoke import CASES, main, run_smoke
+from tpu_reductions.bench.smoke import (CASES, FAMILY_CASES, main,
+                                        run_smoke)
 
 
 def test_run_smoke_covers_every_never_lowered_surface():
     seen = []
     rows = run_smoke(on_result=lambda r: seen.append(r["name"]))
-    assert [r["name"] for r in rows] == [c[0] for c in CASES]
-    assert seen == [c[0] for c in CASES]        # fired per case, in order
+    want = [c[0] for c in CASES] + [c[0] for c in FAMILY_CASES]
+    assert [r["name"] for r in rows] == want
+    assert seen == want                         # fired per case, in order
     # on the virtual-CPU platform every surface lowers and verifies
     assert all(r["ok"] and r["status"] in ("PASSED", "WAIVED")
                for r in rows)
     # the k10 depth knob and both dd pair paths are distinct cases
     names = " ".join(seen)
     for frag in ("depth=2", "depth=4", "depth=8", "mxu f32", "mxu bf16",
-                 "big-tile", "sum pair-tree", "min key-pair"):
+                 "big-tile", "sum pair-tree", "min key-pair",
+                 "mxu-scan", "cumsum", "seg reduce", "argk"):
         assert frag in names
 
 
@@ -49,8 +52,10 @@ def test_smoke_cli_writes_manifest(tmp_path, capsys):
     assert main([f"--out={out}"]) == 0
     data = json.loads(out.read_text())
     assert data["complete"] is True
-    assert len(data["cases"]) == len(CASES)
-    assert "8/8 cases lowered and verified" in capsys.readouterr().out
+    total = len(CASES) + len(FAMILY_CASES)
+    assert len(data["cases"]) == total
+    assert (f"{total}/{total} cases lowered and verified"
+            in capsys.readouterr().out)
 
 
 def test_smoke_cli_rejects_too_small_n():

@@ -1,0 +1,388 @@
+"""L5: the reduction-family spot instrument — SCAN / SEG* / ARG*
+measured, oracle-verified, and served (ISSUE 20; docs/FAMILY.md).
+
+The reference benchmarks exactly three full reductions
+(reduction.h:15-25); the family around them (prefix scan, segmented
+reduce, argmin/argmax — ops/family/) lands here as one committed
+artifact with the same discipline every other instrument follows:
+
+  * every (method, dtype, impl) cell is CHAINED-timed (ops/chain.py —
+    the only honest per-iteration clock on the tunneled TPU) and
+    verified against the host oracle BEFORE its GB/s number counts:
+    SCAN element-wise against the float64/int64 prefix
+    (ops/family/scan.host_scan; int32 bit-exact under the mod-2^32
+    wrap), SEG* per-segment against host_segment_reduce (ragged
+    offsets with empty segments by construction), ARG* exact-index
+    against numpy's first-occurrence argmin/argmax;
+  * the SCAN cells race both implementations — the MXU matmul trick
+    (arXiv:1811.09736) vs the XLA cumsum baseline — and the committed
+    rates are exactly what `exec/cost.pick_scan` prices its candidate
+    axis from;
+  * three serving rows prove SCAN/SEGSUM/ARGMAX requests resolve `ok`
+    END-TO-END through the coalescing engine (serve/engine.py ->
+    serve/executor._run_family_batch) on the same platform — the wire
+    support is measured, not asserted.
+
+Every cell persists the moment it lands and resumes under the shared
+contract (bench/resume.Checkpoint, keyed (kind, method, dtype, impl));
+the `family.cell` fault point fires before each cell's payload exists,
+so a scripted mid-grid exit-3 rehearses the relay-death resume
+(tests/test_family.py). Rows print in the pinned
+`DATATYPE OP IMPL N GBPS STATUS` schema (lint/grammar.py); bench/regen
+folds the table into report.md.
+
+CLI:
+    python -m tpu_reductions.bench.family_spot [--platform=cpu] \
+        [--n=1048576 --serve-n=16384 --segments=64 --seed=0 --reps=5] \
+        --out=examples/tpu_run/family_spot.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from tpu_reductions.faults.inject import fault_point
+from tpu_reductions.lint.grammar import FAMILY_HEADER
+from tpu_reductions.obs import ledger
+from tpu_reductions.utils.logging import BenchLogger, family_row
+
+# the committed grid: every family method x dtype the matrix supports
+# (docs/FAMILY.md — no family f64; the dd pair planes stay with the
+# classic methods), SCAN racing both implementations where legal
+FAMILY_DTYPES = ("int32", "float32")
+SEG_METHODS = ("SEGSUM", "SEGMIN", "SEGMAX")
+ARG_METHODS = ("ARGMIN", "ARGMAX")
+# the end-to-end serving rows: one method per family group, resolved
+# `ok` through the real coalescing engine
+SERVE_CELLS = (("SCAN", "float32"), ("SEGSUM", "int32"),
+               ("ARGMAX", "float32"))
+
+
+def family_cells() -> List[tuple]:
+    """The (kind, method, dtype, impl) grid in artifact order — scan
+    first (its rows carry the cost-oracle story), then the segmented
+    group, then arg, then the serving proof rows.
+
+    No reference analog (TPU-native).
+    """
+    from tpu_reductions.ops.family import scan_impls
+    cells = []
+    for dtype in FAMILY_DTYPES:
+        for impl in scan_impls(dtype):
+            cells.append(("cell", "SCAN", dtype, impl))
+    for method in SEG_METHODS:
+        for dtype in FAMILY_DTYPES:
+            cells.append(("cell", method, dtype, "seg"))
+    for method in ARG_METHODS:
+        for dtype in FAMILY_DTYPES:
+            cells.append(("cell", method, dtype, "argk"))
+    for method, dtype in SERVE_CELLS:
+        cells.append(("serve", method, dtype, "serve"))
+    return cells
+
+
+def _verify(method: str, dtype: str, impl: str, x, got, segments,
+            offsets) -> tuple:
+    """(ok, max_err): the per-method oracle comparison (module
+    docstring). `got` is the full device result array/scalar from the
+    verification launch — never the chained digest, which exists for
+    timing only (ops/chain.py doctrine)."""
+    import numpy as np
+
+    from tpu_reductions.ops.family import (host_arg_reduce, host_scan,
+                                           host_segment_reduce)
+    from tpu_reductions.ops.registry import tolerance
+
+    if method == "SCAN":
+        want = host_scan(x)
+        if dtype == "int32":
+            return bool(np.array_equal(got, want)), float(
+                np.abs(got.astype(np.int64) - want.astype(np.int64))
+                .max())
+        err = float(np.abs(got.astype(np.float64) - want).max())
+        return err <= tolerance("SUM", dtype, x.size), err
+    if method in SEG_METHODS:
+        want = host_segment_reduce(x, offsets, method)
+        got64 = got.astype(np.float64)
+        if method == "SEGSUM" and dtype != "int32":
+            finite = np.isfinite(want)
+            err = float(np.abs(got64[finite] - want[finite]).max())
+            return err <= tolerance("SUM", dtype, x.size), err
+        # int32 (wrap-exact) and MIN/MAX (exact, +-inf identities on
+        # empty segments compare equal) are exact-match classes
+        eq = bool(np.array_equal(got64, want))
+        with np.errstate(invalid="ignore"):
+            err = float(np.nan_to_num(
+                np.abs(got64 - want), nan=0.0, posinf=0.0).max())
+        return eq, err
+    want = host_arg_reduce(x, method)
+    err = float(abs(int(got) - int(want)))
+    return int(got) == int(want), err
+
+
+def measure_cell(method: str, dtype: str, impl: str, n: int,
+                 segments: int, seed: int, reps: int) -> dict:
+    """One grid cell: a dedicated verification launch (full result
+    array against the host oracle), then the chained-slope timing of a
+    scalar digest core (make_chained_reduce — the digest's only job is
+    the data dependence; verification never reads it).
+
+    No reference analog (TPU-native).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_reductions.exec import core as exec_core
+    from tpu_reductions.exec.plan import device_task
+    from tpu_reductions.ops.chain import (auto_chain_span,
+                                          make_chained_reduce)
+    from tpu_reductions.ops.family import (arg_reduce_fn, family_surface,
+                                           random_offsets, scan_fn,
+                                           segment_ids_from_offsets,
+                                           segment_reduce_fn)
+    from tpu_reductions.ops.registry import get_op
+    from tpu_reductions.utils.rng import host_data
+    from tpu_reductions.utils.timing import Stopwatch, time_chained
+
+    # chaos hook: one cell = one interruptible unit (docs/RESILIENCE.md
+    # fault-point table; tests/test_family.py scripts an exit-3 here)
+    fault_point("family.cell")
+
+    x = host_data(n, dtype, rank=0, seed=seed)
+    x2d = x.reshape(-1, 128)
+    surface = family_surface(method, impl)
+    offsets = None
+    zero = np.dtype(dtype).type(0)
+
+    if method == "SCAN":
+        fn = scan_fn(impl, dtype)
+
+        def full(x1d):
+            return fn(x1d, zero)
+
+        def core(xx):
+            return fn(xx.reshape(-1), zero)[-1]
+    elif method in SEG_METHODS:
+        offsets = random_offsets(n, segments, seed)
+        ids = segment_ids_from_offsets(offsets)
+        mask = np.diff(offsets) > 0
+        sfn = segment_reduce_fn(method, segments)
+
+        def full(x1d):
+            return sfn(x1d, ids)
+
+        def core(xx):
+            # timing digest only: empty-segment identities (+-inf for
+            # float MIN/MAX) are masked so the scalar stays finite
+            segs = sfn(xx.reshape(-1), ids)
+            return jnp.where(mask, segs, zero).sum()
+    else:
+        fn = arg_reduce_fn(method, dtype)
+
+        def full(x1d):
+            return fn(x1d)
+
+        def core(xx):
+            return fn(xx.reshape(-1))
+
+    # verification launch: one retried, flap-classified unit through
+    # THE executor (exec/core.py) — full result materialized and
+    # compared before any timing number exists
+    got = np.asarray(exec_core.run(device_task(
+        surface, lambda: jax.device_get(full(x)),
+        method=method, dtype=dtype, n=n)))
+    ok, max_err = _verify(method, dtype, impl, x, got, segments, offsets)
+
+    chained = make_chained_reduce(core, get_op(method), surface=surface)
+    span = auto_chain_span(n, dtype)
+    watch = Stopwatch()
+    time_chained(chained, x2d, 1, 1 + span, reps=reps, stopwatch=watch)
+    per_iter = watch.median_s
+    nbytes = n * np.dtype(dtype).itemsize
+    gbps = (nbytes / per_iter / 1e9) if per_iter > 0 else 0.0
+
+    row = {"kind": "cell", "method": method, "dtype": dtype,
+           "impl": impl, "n": int(n), "segments": (segments if offsets
+                                                   is not None else None),
+           "span": span, "reps": reps, "per_iter_s": per_iter,
+           "gbps": round(gbps, 4), "max_err": max_err,
+           "status": "PASSED" if ok else "FAILED"}
+    ledger.emit("family.cell", method=method, dtype=dtype, impl=impl,
+                n=int(n), gbps=row["gbps"], status=row["status"])
+    return row
+
+
+def measure_serve(method: str, dtype: str, n: int, requests: int = 3
+                  ) -> dict:
+    """One serving proof row: `requests` real ReduceRequests submitted
+    to an in-process ServeEngine and required to resolve `ok` through
+    the coalescing path (serve/executor._run_family_batch emits the
+    family.serve ledger evidence). This is the acceptance row — the
+    family wire support measured end-to-end, not asserted.
+
+    No reference analog (TPU-native).
+    """
+    import time as _time
+
+    from tpu_reductions.serve.engine import ServeEngine
+    from tpu_reductions.serve.request import ReduceRequest
+
+    fault_point("family.cell")   # serving rows resume like any cell
+
+    eng = ServeEngine(coalesce_window_s=0.0).start()
+    try:
+        t0 = _time.perf_counter()
+        pends = [eng.submit(ReduceRequest(method=method, dtype=dtype,
+                                          n=n, seed=s))
+                 for s in range(requests)]
+        resps = [p.result(timeout=120.0) for p in pends]
+        wall = _time.perf_counter() - t0
+    finally:
+        eng.stop()
+    ok_n = sum(1 for r in resps if r.status == "ok")
+    row = {"kind": "serve", "method": method, "dtype": dtype,
+           "impl": "serve", "n": int(n), "requests": requests,
+           "ok_count": ok_n, "gbps": 0.0,
+           "latency_s": round(wall, 6),
+           "status": "PASSED" if ok_n == requests else "FAILED"}
+    ledger.emit("family.cell", method=method, dtype=dtype, impl="serve",
+                n=int(n), gbps=0.0, status=row["status"])
+    return row
+
+
+def run_family_spot(*, n: int, serve_n: int, segments: int, seed: int,
+                    reps: int, out: Optional[str] = None,
+                    logger: Optional[BenchLogger] = None) -> List[dict]:
+    """The full grid with per-cell persist/resume (bench/resume
+    .Checkpoint + run_checkpointed_cells — the shared loop of the
+    quant/reshard curves), serving rows included.
+
+    No reference analog (TPU-native).
+    """
+    from tpu_reductions.bench.resume import (Checkpoint,
+                                             run_checkpointed_cells)
+    logger = logger or BenchLogger(None, None)
+    ck = Checkpoint(out, {"n": n, "serve_n": serve_n,
+                          "segments": segments, "seed": seed,
+                          "reps": reps, "timing": "chained",
+                          "stat": "median"},
+                    key_fn=lambda r: (r.get("kind", "cell"),
+                                      r.get("method"), r.get("dtype"),
+                                      r.get("impl")))
+    logger.log(FAMILY_HEADER)
+
+    def measure(key):
+        kind, method, dtype, impl = key
+        if kind == "serve":
+            return measure_serve(method, dtype, serve_n)
+        return measure_cell(method, dtype, impl, n, segments, seed, reps)
+
+    def on_row(key, row):
+        logger.log(family_row(row["dtype"], row["method"], row["impl"],
+                              row["n"], row["gbps"], row["status"]))
+
+    return run_checkpointed_cells(ck, family_cells(), measure, on_row)
+
+
+def family_spot_markdown(data: dict) -> str:
+    """The report fold (bench/regen.py): the committed family grid as
+    one table — measured GB/s per (method, dtype, impl) with its
+    verification verdict — plus the serving proof rows. Empty string
+    when there are no rows (regen then skips the section).
+
+    No reference analog (TPU-native).
+    """
+    rows = [r for r in data.get("rows", []) if isinstance(r, dict)]
+    if not rows:
+        return ""
+    cells = [r for r in rows if r.get("kind") != "serve"]
+    serves = [r for r in rows if r.get("kind") == "serve"]
+    n_fail = sum(1 for r in rows if r.get("status") != "PASSED")
+    lines = [
+        "### Reduction family (SCAN / segmented / argmin-argmax)",
+        "",
+        f"{len(cells)} chained-verified cells at n={data.get('n')}"
+        + (f" — **{n_fail} FAILED**" if n_fail
+           else "; every cell oracle-verified")
+        + " (docs/FAMILY.md; `python -m tpu_reductions.bench."
+          "family_spot`). SCAN rates price `exec/cost.pick_scan`'s "
+          "mxu-scan vs xla-cumsum axis.",
+        "",
+        "| method | dtype | impl | GB/s | max err | status |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in cells:
+        lines.append(
+            f"| {r['method']} | {r['dtype']} | {r['impl']} "
+            f"| {r['gbps']:.3f} | {r.get('max_err', 0.0):.3e} "
+            f"| {r['status']} |")
+    if serves:
+        lines += ["",
+                  "| served method | dtype | n | requests ok | status |",
+                  "|---|---|---|---|---|"]
+        for r in serves:
+            lines.append(
+                f"| {r['method']} | {r['dtype']} | {r['n']} "
+                f"| {r['ok_count']}/{r['requests']} | {r['status']} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    """CLI: the family grid + serving proof, one committed JSON
+    artifact — the reference's per-op benchmark loop
+    (reduction.cpp:161-200) extended to the method family it never
+    had."""
+    p = argparse.ArgumentParser(
+        prog="tpu_reductions.bench.family_spot",
+        description="Reduction-family spot: SCAN (mxu-scan vs "
+                    "xla-cumsum), segmented reduce, argmin/argmax — "
+                    "chained-timed, oracle-verified, served end-to-end",
+    )
+    p.add_argument("--n", type=int, default=1 << 20,
+                   help="Cell payload elements (must divide by 128 for "
+                        "the chained 2-D view)")
+    p.add_argument("--serve-n", dest="serve_n", type=int,
+                   default=1 << 14,
+                   help="Per-request elements for the serving rows")
+    p.add_argument("--segments", type=int, default=64,
+                   help="Segment count for the SEG* cells (ragged "
+                        "random offsets; empty segments occur by "
+                        "construction)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--reps", type=int, default=5,
+                   help="Chained slope samples per cell (median wins)")
+    p.add_argument("--platform", type=str, default=None,
+                   choices=("cpu", "tpu"))
+    p.add_argument("--out", type=str, default=None)
+    ns = p.parse_args(argv)
+    if ns.n <= 0 or ns.n % 128:
+        p.error(f"--n must be a positive multiple of 128, got {ns.n}")
+    if ns.segments < 2 or ns.serve_n <= 0 or ns.reps < 1:
+        p.error("--segments >= 2, --serve-n > 0, --reps >= 1 required")
+    from tpu_reductions.config import _apply_platform
+    _apply_platform(ns)
+    # flight recorder + watchdog BEFORE the first device touch
+    # (docs/OBSERVABILITY.md; RED011)
+    from tpu_reductions.obs.ledger import arm_session
+    arm_session("bench.family_spot",
+                argv=list(argv) if argv else sys.argv[1:])
+    from tpu_reductions.exec.core import maybe_arm_for_tpu
+    maybe_arm_for_tpu()
+    logger = BenchLogger(None, None, console=sys.stdout)
+    rows = run_family_spot(n=ns.n, serve_n=ns.serve_n,
+                           segments=ns.segments, seed=ns.seed,
+                           reps=ns.reps, out=ns.out, logger=logger)
+    if ns.out:
+        print(f"wrote {ns.out}")
+    bad = [r for r in rows if r.get("status") != "PASSED"]
+    for r in bad:
+        print(f"FAILED: {r['method']} {r['dtype']} {r.get('impl')} "
+              f"(max_err {r.get('max_err')})", file=sys.stderr)
+    return 1 if bad or not rows else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

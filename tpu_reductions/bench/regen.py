@@ -412,6 +412,23 @@ def regenerate(out_dir: str | Path, device_kind: str | None = None,
                     "(exec_decisions.json)")
         except (OSError, ValueError, KeyError, TypeError) as e:
             log(f"regen: exec_decisions.json unusable ({e}); skipped")
+    # the reduction-family spot grid (ISSUE 20): SCAN / SEG* / ARG*
+    # chained-verified rates + the end-to-end serving proof rows —
+    # the same rows exec/cost.pick_scan prices its scan axis from
+    fs_file = out / "family_spot.json"
+    if fs_file.exists():
+        try:
+            from tpu_reductions.bench.family_spot import \
+                family_spot_markdown
+            fs = json.loads(fs_file.read_text())
+            md = family_spot_markdown(fs)
+            if md:
+                with open(paths["md"], "a") as f:
+                    f.write("\n" + md + "\n")
+                log("regen: appended reduction-family table "
+                    "(family_spot.json)")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log(f"regen: family_spot.json unusable ({e}); skipped")
     # the cross-round headline trajectory (ISSUE 12 satellite): the
     # committed BENCH_rNN.json round metrics collated into one table
     # so regressions across windows are visible in one place

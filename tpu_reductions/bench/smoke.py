@@ -12,7 +12,9 @@ mid-race burns its middle on 20-40 s tunnel compiles that were doomed
 
 This module front-loads that discovery: each case compiles and runs ONE
 verified reduction at tiny n (compile time dominates; execution is
-microseconds), and the manifest records pass/fail per case so the
+microseconds) — the kernel races' geometries plus the reduction-family
+executables (FAMILY_CASES: the MXU scan trick, the segmented reduce,
+the arg planes — ISSUE 20) — and the manifest records pass/fail per case so the
 session log shows in seconds which race rows are live before any race
 starts. Crashes are contained per case — the manifest is the product,
 and a FAILED case is exactly the information the step exists to buy.
@@ -57,6 +59,61 @@ CASES: Tuple[Tuple[str, str, str, Optional[int], int, int, str], ...] = (
     ("dd f64 sum pair-tree", "float64", "SUM", None, 256, 4, "dd"),
     ("dd f64 min key-pair", "float64", "MIN", None, 256, 4, "dd"),
 )
+
+# the reduction-family executables (ISSUE 20, ops/family/): the MXU
+# scan trick is exactly the kind of surface this gate exists for —
+# interpret-tested, never Mosaic-lowered — and the segmented/arg planes
+# ride along. (name, surface); surface ids shared with bench/warm.py
+# and ops/family.family_surface so the manifests and compile_ledger
+# join on one vocabulary.
+FAMILY_CASES: Tuple[Tuple[str, str], ...] = (
+    ("family mxu-scan f32", "mxu-scan"),
+    ("family cumsum i32", "xla-cumsum"),
+    ("family seg reduce", "seg/segsum"),
+    ("family argk", "argk/argmin"),
+)
+
+
+def _family_case(surface: str, n: int) -> bool:
+    """Compile+run one family executable at tiny n, verified against
+    the host oracle (ops/family/) — the family analog of the classic
+    cases' run_benchmark(verify=True). Returns ok.
+
+    No reference analog (TPU-native).
+    """
+    import jax
+    import numpy as np
+
+    from tpu_reductions.ops import family as fam
+    from tpu_reductions.ops.registry import tolerance
+    from tpu_reductions.utils.rng import host_data
+
+    if surface in ("mxu-scan", "xla-cumsum"):
+        dtype = "float32" if surface == "mxu-scan" else "int32"
+        x = host_data(n, dtype, rank=0, seed=0)
+        got = np.asarray(jax.device_get(
+            fam.scan_fn(surface, dtype)(x, np.dtype(dtype).type(0))))
+        want = fam.host_scan(x)
+        if dtype == "int32":
+            return bool(np.array_equal(got, want))
+        err = float(np.abs(got.astype(np.float64) - want).max())
+        return err <= tolerance("SUM", dtype, n)
+    if surface.startswith("seg/"):
+        x = host_data(n, "int32", rank=0, seed=0)
+        offsets = fam.random_offsets(n, 16, 0)
+        ids = fam.segment_ids_from_offsets(offsets)
+        got = np.asarray(jax.device_get(
+            fam.segment_reduce_fn("SEGSUM", 16)(x, ids)))
+        # byte-valued payloads at tiny n stay far below the int32 wrap,
+        # so the float64 host digest compares exactly
+        return bool(np.array_equal(got.astype(np.float64),
+                                   fam.host_segment_reduce(x, offsets,
+                                                           "SEGSUM")))
+    got = int(jax.device_get(
+        fam.arg_reduce_fn("ARGMIN", "float32")(
+            host_data(n, "float32", rank=0, seed=0))))
+    return got == int(fam.host_arg_reduce(
+        host_data(n, "float32", rank=0, seed=0), "ARGMIN"))
 
 
 def run_smoke(n: int = 1 << 20, logger: Optional[BenchLogger] = None,
@@ -105,6 +162,31 @@ def run_smoke(n: int = 1 << 20, logger: Optional[BenchLogger] = None,
             row = {"name": name, "surface": surface,
                    "status": res.status.name,
                    "ok": res.status.name in ("PASSED", "WAIVED"),
+                   "seconds": round(time.perf_counter() - t0, 2),
+                   "error": None}
+        except Exception as e:   # the manifest IS the product
+            row = {"name": name, "surface": surface, "status": "FAILED",
+                   "ok": False,
+                   "seconds": round(time.perf_counter() - t0, 2),
+                   "error": f"{type(e).__name__}: {e}"[:500]}
+        rows.append(row)
+        if on_result is not None:
+            on_result(row)
+    for name, surface in FAMILY_CASES:
+        prior = resume(name) if resume is not None else None
+        if prior is not None:
+            logger.log(f"smoke {name}: resumed from prior manifest")
+            rows.append(prior)
+            if on_result is not None:
+                on_result(prior)
+            continue
+        t0 = time.perf_counter()
+        try:
+            ok = exec_core.run(device_task(
+                surface, lambda s=surface: _family_case(s, n),
+                retry_log=logger.log, case=name))
+            row = {"name": name, "surface": surface,
+                   "status": "PASSED" if ok else "FAILED", "ok": ok,
                    "seconds": round(time.perf_counter() - t0, 2),
                    "error": None}
         except Exception as e:   # the manifest IS the product

@@ -142,6 +142,59 @@ def _serve_surface() -> Tuple[str, Callable]:
     return "serve-bucket/sum", build
 
 
+def _family_scan_surface(impl: str, dtype: str) -> Tuple[str, Callable]:
+    """One family SCAN executable (ops/family/scan.py — the MXU matmul
+    trick or the cumsum baseline), staged from shape specs alone.
+    Surface id == impl, shared with bench/smoke.py FAMILY_CASES and
+    ops/family.family_surface.
+
+    No reference analog (TPU-native).
+    """
+    def build(n: int):
+        import jax
+        import numpy as np
+
+        from tpu_reductions.ops.family import scan_fn
+        fn = scan_fn(impl, dtype)
+        return fn, (jax.ShapeDtypeStruct((n,), np.dtype(dtype)),
+                    jax.ShapeDtypeStruct((), np.dtype(dtype)))
+
+    return impl, build
+
+
+def _family_seg_surface() -> Tuple[str, Callable]:
+    """The segmented-reduce executable (ops/family/segmented.py).
+
+    No reference analog (TPU-native).
+    """
+    def build(n: int):
+        import jax
+        import numpy as np
+
+        from tpu_reductions.ops.family import segment_reduce_fn
+        fn = segment_reduce_fn("SEGSUM", 64)
+        return fn, (jax.ShapeDtypeStruct((n,), np.int32),
+                    jax.ShapeDtypeStruct((n,), np.int32))
+
+    return "seg/segsum", build
+
+
+def _family_arg_surface() -> Tuple[str, Callable]:
+    """The (key, index) arg-reduce executable (ops/family/argreduce.py).
+
+    No reference analog (TPU-native).
+    """
+    def build(n: int):
+        import jax
+        import numpy as np
+
+        from tpu_reductions.ops.family import arg_reduce_fn
+        fn = arg_reduce_fn("ARGMIN", "float32")
+        return fn, (jax.ShapeDtypeStruct((n,), np.float32),)
+
+    return "argk/argmin", build
+
+
 def surfaces() -> List[Tuple[str, Callable]]:
     """The warm registry: every surface the next window would
     otherwise compile cold, in smoke's canonical geometries
@@ -162,6 +215,13 @@ def surfaces() -> List[Tuple[str, Callable]]:
         _xla_surface(),
         _stream_surface(),
         _serve_surface(),
+        # the reduction family (ISSUE 20): mxu-scan is the one family
+        # surface with a genuinely novel lowering; the baselines ride
+        # along so a live window compiles none of them twice
+        _family_scan_surface("mxu-scan", "float32"),
+        _family_scan_surface("xla-cumsum", "int32"),
+        _family_seg_surface(),
+        _family_arg_surface(),
     ]
 
 

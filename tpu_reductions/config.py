@@ -56,6 +56,14 @@ DTYPE_ALIASES = {
 }
 
 METHODS = ("SUM", "MIN", "MAX")
+# the reduction family (ISSUE 20; docs/FAMILY.md): prefix scan,
+# segmented reductions, and index-carrying extremes. These are served
+# methods (serve/request.py validates against SERVED_METHODS) and
+# family-spot cells, NOT classic single-chip bench methods —
+# ReduceConfig stays METHODS-only.
+FAMILY_METHODS = ("SCAN", "SEGSUM", "SEGMIN", "SEGMAX",
+                  "ARGMIN", "ARGMAX")
+SERVED_METHODS = METHODS + FAMILY_METHODS
 BACKENDS = ("auto", "pallas", "xla")
 
 # ---------------------------------------------------------------------------

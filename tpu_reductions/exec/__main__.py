@@ -42,6 +42,11 @@ _WIRE_CELLS = [("SUM", "float32", 8, 1 << 24, None),
                ("SUM", "float32", 8, 1 << 24, 0.005),
                ("SUM", "bfloat16", 8, 1 << 24, 0.005),
                ("MIN", "float32", 8, 1 << 24, 0.005)]
+# the scan axis (ISSUE 20): an int cell pins the float-only guard, the
+# float cells span small/large payloads priced from the family-spot
+# rates (exec/cost.pick_scan)
+_SCAN_CELLS = [("int32", 1 << 24), ("float32", 1 << 20),
+               ("float32", 1 << 26)]
 
 
 def decision_rows(oracle: CostOracle) -> list:
@@ -62,6 +67,9 @@ def decision_rows(oracle: CostOracle) -> list:
         add(oracle.pick_wire(method, dtype, k, payload, slack),
             method=method, dtype=dtype, devices=k,
             payload_bytes=payload, slack_s=slack)
+    for dtype, n in _SCAN_CELLS:
+        add(oracle.pick_scan(dtype, n),
+            method="SCAN", dtype=dtype, n=n)
     return rows
 
 

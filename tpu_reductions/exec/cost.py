@@ -51,6 +51,10 @@ in the committed `examples/tpu_run/exec_decisions.json`):
              against the predicted exact time (the serving engine's
              formula, unchanged — serve/engine._quant_wire delegates
              here so the decision is ledger-auditable).
+  * scan     XLA cumsum vs the MXU matmul-scan trick (ISSUE 20;
+             ops/family/scan.py, arXiv:1811.09736) priced from the
+             committed family-spot rates — float payloads only; the
+             integer path always rides the cumsum baseline.
 
 Purely offline: reads JSON artifacts, touches no device; jax-bearing
 modules (collectives.algorithms) import lazily inside the pricing
@@ -96,6 +100,10 @@ _EVIDENCE = {
                             "scaling_shape.json"),
     "quant": os.path.join("examples", "rank_scaling",
                           "quant_curve.json"),
+    # the reduction-family spot instrument (ISSUE 20; docs/FAMILY.md):
+    # measured GB/s per (method, dtype, impl) cell — prices the
+    # mxu-scan vs xla-cumsum candidate axis (pick_scan)
+    "family": os.path.join("examples", "tpu_run", "family_spot.json"),
 }
 
 
@@ -106,7 +114,7 @@ class Decision:
     and the artifact paths the prediction consulted (empty tuple =
     fallback — the oracle had nothing to learn from)."""
 
-    axis: str                                   # kernel|topology|wire
+    axis: str                          # kernel|topology|wire|scan
     choice: str
     static_choice: str
     candidates: Tuple[Tuple[str, Optional[float]], ...]
@@ -238,7 +246,25 @@ class CostOracle:
                       and r.get("status") == "PASSED")
         return vals[len(vals) // 2] if vals else None
 
-    # -- the three axes --------------------------------------------------
+    def scan_rates(self, dtype: str) -> Optional[Dict[str, float]]:
+        """Best measured GB/s per scan implementation for `dtype` from
+        the committed family-spot artifact (bench/family_spot.py) —
+        pick_scan's evidence table."""
+        doc = self._load("family")
+        if not doc:
+            return None
+        rates: Dict[str, float] = {}
+        for row in doc.get("rows") or []:
+            if (row.get("method") != "SCAN"
+                    or row.get("dtype") != dtype
+                    or row.get("status") != "PASSED"):
+                continue
+            impl = str(row.get("impl"))
+            rates[impl] = max(rates.get(impl, 0.0),
+                              float(row.get("gbps") or 0.0))
+        return rates or None
+
+    # -- the four axes ---------------------------------------------------
 
     def pick_kernel(self, method: str, dtype: str, n: int) -> Decision:
         """k6 vs k10 by payload regime. Static default: kernel 6, the
@@ -359,6 +385,49 @@ class CostOracle:
             reason=(f"slack {slack_s:.4f}s "
                     f"{'<' if tight else '>='} {slack_factor:g} x "
                     f"est {est_s:.4f}s"))
+
+
+    def pick_scan(self, dtype: str, n: int) -> Decision:
+        """xla-cumsum vs mxu-scan for a SCAN launch (ISSUE 20;
+        ops/family/scan.py). Static default: xla-cumsum, the every-
+        dtype baseline. The MXU trick is only a candidate for float
+        payloads (an integer matmul would not ride the MXU —
+        scan_impls); with the committed family-spot rates in hand both
+        candidates are priced as payload/rate plus any cold-compile
+        penalty their surface still owes."""
+        payload = n * _ITEMSIZE.get(dtype, 4)
+        floating = dtype in ("float", "float32", "bfloat16",
+                             "double", "float64")
+        if not floating:
+            return Decision(
+                axis="scan", choice="xla-cumsum",
+                static_choice="xla-cumsum",
+                candidates=(("xla-cumsum", None),), evidence=(),
+                reason=(f"mxu-scan is float-only; {dtype} rides the "
+                        "XLA cumsum baseline"))
+        rates = self.scan_rates(dtype)
+        if (not rates or "mxu-scan" not in rates
+                or "xla-cumsum" not in rates):
+            return Decision(
+                axis="scan", choice="xla-cumsum",
+                static_choice="xla-cumsum",
+                candidates=(("mxu-scan", None), ("xla-cumsum", None)),
+                evidence=(),
+                reason="no family_spot evidence; static xla-cumsum")
+        cands = tuple(
+            (impl, payload / (rates[impl] * 1e9)
+             + self.compile_penalty(impl))
+            for impl in ("mxu-scan", "xla-cumsum"))
+        choice = min(cands, key=lambda c: c[1])[0]
+        evidence = [self._path("family")]
+        if self._load("compile"):
+            evidence.append(self._path("compile"))
+        return Decision(
+            axis="scan", choice=choice, static_choice="xla-cumsum",
+            candidates=cands, evidence=tuple(evidence),
+            reason=(f"measured {rates['mxu-scan']:.3f} GB/s mxu-scan "
+                    f"vs {rates['xla-cumsum']:.3f} GB/s xla-cumsum "
+                    f"at {payload} B"))
 
 
 def decisions_markdown(doc: dict) -> str:

@@ -70,7 +70,12 @@ i.e. after the plan is declared but before ANY device work — so a
 scripted `exit` there is the deterministic relay-death-mid-plan: the
 re-invoked entry point must re-enter through exec/core and the ledger
 join of exec.plan/exec.launch/exec.done rows must show zero duplicate
-launches, tests/test_exec_chaos.py).
+launches, tests/test_exec_chaos.py), and `family.cell` (fired once
+per family-spot cell just before its payload is generated,
+bench/family_spot.py — a scripted `exit` mid-grid rehearses a relay
+death between family cells, and the re-invoked spot must resume its
+persisted method x dtype x impl rows byte-identically,
+tests/test_family.py).
 docs/RESILIENCE.md keeps the list.
 
 Counters are process-global and monotonic; `reset()` re-arms them for

@@ -76,6 +76,24 @@ QUANT_CURVE_ROW_RE = re.compile(
     r"^[A-Z][A-Z0-9]* [A-Z]+ \d+ \d+ [0-9.]+ [0-9.e+-]+ [0-9.e+-]+$")
 
 # --------------------------------------------------------------------------
+# Family-spot row schema (bench/family_spot.py; ISSUE 20) — the
+# reduction-family instrument's stdout rows, one per (method, dtype,
+# impl) cell: the DATATYPE-row family extended with the implementation
+# column (mxu-scan vs xla-cumsum vs seg vs argk) and the oracle
+# verdict. Registered HERE like the collective/quant rows so the
+# producer (utils/logging.family_row) and any grep pipeline share one
+# byte-exact schema.
+# --------------------------------------------------------------------------
+
+FAMILY_COLUMNS = ("DATATYPE", "OP", "IMPL", "N", "GBPS", "STATUS")
+FAMILY_HEADER = " ".join(FAMILY_COLUMNS)
+
+FAMILY_ROW_TEMPLATE = "{dtype} {op} {impl} {n} {gbps:.3f} {status}"
+FAMILY_ROW_RE = re.compile(
+    r"^[A-Z][A-Z0-9]* [A-Z]+ [a-z][a-z0-9-]* \d+ [0-9.]+ "
+    r"(PASSED|FAILED)$")
+
+# --------------------------------------------------------------------------
 # Flight-recorder event rows (obs/ledger.py; docs/OBSERVABILITY.md).
 # One JSON object per line, leading keys fixed as {"t": ..., "ev": ...,
 # "pid": ...} so awk/grep postmortems can key on byte offsets the same
@@ -212,6 +230,15 @@ COMPILE_EVENTS = ("compile.start", "compile.end", "warm.start",
 # the selection audit table)
 EXEC_EVENTS = ("exec.plan", "exec.select", "exec.launch", "exec.done")
 
+# the reduction family's typed events (ops/family/ +
+# bench/family_spot.py; ISSUE 20 — docs/FAMILY.md): family.cell is one
+# spot cell (method x dtype x impl) with its chained-timing measurement
+# and oracle verdict; family.serve is one end-to-end serving probe (a
+# family-method ReduceRequest resolved through the coalescing engine).
+# Consumer: obs/timeline.py renders them in the generic event stream;
+# bench/regen folds the committed artifact's table into report.md
+FAMILY_EVENTS = ("family.cell", "family.serve")
+
 # every other typed event the python producers emit (the seam table in
 # docs/OBSERVABILITY.md) — registered HERE so the emitters and the
 # drift gate (tests/test_event_registry.py) share one vocabulary: an
@@ -251,7 +278,8 @@ REGISTERED_EVENTS = frozenset(CORE_EVENTS + SHELL_EVENTS + SCHED_EVENTS
                               + ROUTE_EVENTS + REPLICA_EVENTS
                               + RESHARD_EVENTS + AUTOSCALE_EVENTS
                               + DRAIN_EVENTS + JOURNAL_EVENTS
-                              + ADOPT_EVENTS + EXEC_EVENTS)
+                              + ADOPT_EVENTS + EXEC_EVENTS
+                              + FAMILY_EVENTS)
 
 
 def event_registered(name: str) -> bool:
@@ -354,12 +382,13 @@ def _check_line(line: str) -> str | None:
                         f"reduction.cpp:744-745 template "
                         f"'{THROUGHPUT_TEMPLATE}'")
     if ("DATATYPE" in s and s != COLLECTIVE_HEADER
-            and s != QUANT_CURVE_HEADER):
+            and s != QUANT_CURVE_HEADER and s != FAMILY_HEADER):
         # a literal mentioning the header's lead token must BE one of
         # the registered headers (the collective row schema or the
-        # quant-curve extension of it)
+        # quant-curve / family extensions of it)
         if s.startswith("DATATYPE "):
             return (f"collective header literal {line!r} != golden "
-                    f"'{COLLECTIVE_HEADER}' (reduce.c:67-69) or "
-                    f"'{QUANT_CURVE_HEADER}' (bench/quant_curve.py)")
+                    f"'{COLLECTIVE_HEADER}' (reduce.c:67-69), "
+                    f"'{QUANT_CURVE_HEADER}' (bench/quant_curve.py) or "
+                    f"'{FAMILY_HEADER}' (bench/family_spot.py)")
     return None

@@ -1,0 +1,81 @@
+"""The reduction family: SCAN, segmented reductions, ARGMIN/ARGMAX
+(ISSUE 20; docs/FAMILY.md).
+
+The reference benchmarks exactly three full reductions
+({SUM,MIN,MAX} — reduction.h:15-25, reduce.c:21-28); real traffic has
+the *family* around them. This package adds three method groups and
+threads them through every layer (registry, oracle, exec core,
+serving wire, spot/smoke/warm instruments):
+
+  SCAN            inclusive prefix sum — the MXU matmul trick of
+                  Carrasco et al. (arXiv:1811.09736: within-block
+                  scan = row-block @ upper-triangular ones matrix)
+                  next to the XLA `cumsum` baseline, with a
+                  chunk-carry so the streaming pipeline's 2-chunk
+                  bound (ops/stream.py) scans unbounded inputs
+  SEGSUM/MIN/MAX  segmented reductions over a segment-offset vector —
+                  the batched row-reduce shape serving traffic has;
+                  serve/executor's ragged-batch path launches ONE
+                  concatenated segment reduce instead of paying
+                  identity-padding to the bucket's power of two
+  ARGMIN/ARGMAX   index-carrying extremes via order-preserving
+                  (key, index) planes reusing ops/dd_reduce.py's
+                  key-encoding idiom — exact, lowest-index tie-break
+                  on both device and oracle
+
+Method vocabulary lives in config.FAMILY_METHODS / SERVED_METHODS;
+registry entries in ops/registry.FAMILY_OPS. Every device launch built
+here goes through the one executor (`exec.core.run` on a LaunchPlan —
+RED025: no raw guard/retry spellings in this package).
+"""
+
+from __future__ import annotations
+
+from tpu_reductions.config import FAMILY_METHODS, SERVED_METHODS
+from tpu_reductions.ops.family.argreduce import (arg_reduce_fn,
+                                                 arg_reduce_rows_fn,
+                                                 host_arg_reduce,
+                                                 order_key)
+from tpu_reductions.ops.family.scan import (SCAN_IMPLS, StreamScanner,
+                                            host_scan, scan_fn,
+                                            scan_impls, scan_rows_fn)
+from tpu_reductions.ops.family.segmented import (SEG_BASE,
+                                                 host_segment_reduce,
+                                                 random_offsets,
+                                                 segment_ids_from_offsets,
+                                                 segment_reduce_fn)
+
+__all__ = [
+    "FAMILY_METHODS", "SERVED_METHODS", "SCAN_IMPLS", "SEG_BASE",
+    "is_family_method", "family_surface",
+    "scan_fn", "scan_rows_fn", "scan_impls", "host_scan",
+    "StreamScanner",
+    "segment_reduce_fn", "host_segment_reduce",
+    "segment_ids_from_offsets", "random_offsets",
+    "arg_reduce_fn", "arg_reduce_rows_fn", "host_arg_reduce",
+    "order_key",
+]
+
+
+def is_family_method(name: str) -> bool:
+    """Whether `name` is a family method (SCAN/SEG*/ARG*) as opposed to
+    a classic full reduction (config.METHODS). No reference analog
+    (TPU-native)."""
+    return name.upper() in FAMILY_METHODS
+
+
+def family_surface(method: str, impl: str | None = None) -> str:
+    """Compile-observatory surface id for a family launch — the warm/
+    smoke manifest rows and the spot cells must agree on these
+    spellings (bench/warm.py: mxu-scan, seg, argk).
+
+    No reference analog (TPU-native).
+    """
+    m = method.upper()
+    if m == "SCAN":
+        return impl or "xla-cumsum"
+    if m in SEG_BASE:
+        return f"seg/{m.lower()}"
+    if m in ("ARGMIN", "ARGMAX"):
+        return f"argk/{m.lower()}"
+    raise ValueError(f"not a family method: {method!r}")

@@ -1,0 +1,127 @@
+"""ARGMIN / ARGMAX — index-carrying extremes via order-preserving
+key planes (ISSUE 20; docs/FAMILY.md).
+
+The encoding reuses ops/dd_reduce.py's idiom (host_key_encode: an
+order-preserving bitcast makes float order equal signed-integer
+order) at 32-bit width: for a float32 bit pattern b,
+
+    key = b ^ ((b >> 31) & 0x7FFFFFFF)
+
+keeps non-negatives fixed (sign bit clear -> XOR with 0) and flips the
+magnitude bits of negatives (sign bit set -> XOR with 0x7FFFFFFF), so
+signed int32 order of keys == float32 total order (NaN-free payloads,
+the benchmark fill contract reduction.cpp:698-705). int32 values are
+their own key. The reduction is then a lexicographic MIN over the
+(key, index) planes — ARGMAX over key's order-reversing complement
+~key — realized as key-extreme + masked index-min, which breaks every
+tie to the LOWEST index by construction; the host oracle
+(np.argmin/argmax, first occurrence) has the same tie rule, so parity
+is exact (ops/registry.tolerance: 0.0).
+
+No reference analog (the reference's min/max return values only,
+reduction.cpp:228-249).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+def order_key(x: np.ndarray) -> np.ndarray:
+    """Host-side order-preserving int32 key of an int32/float32 array
+    (module docstring) — the 32-bit sibling of
+    ops/dd_reduce.host_key_encode's 64-bit pair.
+
+    No reference analog (TPU-native).
+    """
+    x = np.ravel(np.asarray(x))
+    if x.dtype == np.int32:
+        return x
+    if x.dtype != np.float32:
+        raise ValueError(f"order_key supports int32/float32, got {x.dtype}")
+    b = x.view(np.int32)
+    return b ^ ((b >> np.int32(31)) & np.int32(0x7FFFFFFF))
+
+
+@functools.lru_cache(maxsize=None)
+def arg_reduce_fn(method: str, dtype: str):
+    """Jitted x -> int32 index of the extreme, lowest index on ties.
+
+    No reference analog (TPU-native).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    m = method.upper()
+    if m not in ("ARGMIN", "ARGMAX"):
+        raise ValueError(f"not an arg method: {method!r}")
+    floating = np.issubdtype(np.dtype(dtype), np.floating)
+
+    def argk(x):
+        n = x.shape[0]
+        if floating:
+            b = jax.lax.bitcast_convert_type(x, jnp.int32)
+            key = b ^ ((b >> 31) & jnp.int32(0x7FFFFFFF))
+        else:
+            key = x
+        if m == "ARGMAX":
+            # bitwise complement reverses int32 order exactly (no
+            # negation overflow at INT32_MIN), turning the lexicographic
+            # MIN machinery into ARGMAX
+            key = ~key
+        kmin = jnp.min(key)
+        idx = jnp.arange(n, dtype=jnp.int32)
+        # lexicographic (key, index) MIN: among the extreme's ties the
+        # smallest index wins; non-ties are masked to n (> any index)
+        return jnp.min(jnp.where(key == kmin, idx, jnp.int32(n)))
+
+    return jax.jit(argk)
+
+
+@functools.lru_cache(maxsize=None)
+def arg_reduce_rows_fn(method: str, dtype: str):
+    """Jitted (k, n) -> (k,) per-row extreme indices — the coalesced
+    serving shape (serve/executor.run_batch's family dispatch), same
+    lexicographic (key, index) machinery as arg_reduce_fn per row.
+
+    No reference analog (TPU-native).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    m = method.upper()
+    if m not in ("ARGMIN", "ARGMAX"):
+        raise ValueError(f"not an arg method: {method!r}")
+    floating = np.issubdtype(np.dtype(dtype), np.floating)
+
+    def rows(x):
+        n = x.shape[1]
+        if floating:
+            b = jax.lax.bitcast_convert_type(x, jnp.int32)
+            key = b ^ ((b >> 31) & jnp.int32(0x7FFFFFFF))
+        else:
+            key = x
+        if m == "ARGMAX":
+            key = ~key
+        kext = jnp.min(key, axis=1, keepdims=True)
+        idx = jnp.arange(n, dtype=jnp.int32)[None, :]
+        return jnp.min(jnp.where(key == kext, idx, jnp.int32(n)), axis=1)
+
+    return jax.jit(rows)
+
+
+def host_arg_reduce(x: np.ndarray, method: str) -> np.int64:
+    """Host oracle: numpy's first-occurrence argmin/argmax — the same
+    lowest-index tie rule the device lexicographic reduce has.
+
+    No reference analog (TPU-native).
+    """
+    m = method.upper()
+    x = np.ravel(np.asarray(x))
+    if m == "ARGMIN":
+        return np.int64(np.argmin(x))
+    if m == "ARGMAX":
+        return np.int64(np.argmax(x))
+    raise ValueError(f"not an arg method: {method!r}")

@@ -1,0 +1,198 @@
+"""SCAN — inclusive prefix sum, MXU matmul trick + XLA baseline.
+
+Carrasco et al. (arXiv:1811.09736) extend the tensor-core
+matmul-as-reduction idiom (the one kernel 9 uses for full SUM,
+following Navarro et al. arXiv:2001.05585) to *scan*: the inclusive
+prefix sum of a row block x of width B is
+
+    y = x @ U,   U[i, j] = 1  iff  i <= j     (upper triangular,
+                                               diagonal included)
+
+so within-block scans ride the MXU at matmul throughput. Blocks then
+need their predecessors' totals added — the hierarchical carry level
+of the paper's recursion; at our block counts that level is a single
+vector cumsum, so it stays on the VPU rather than paying a quadratic
+(nb x nb) ones matrix.
+
+Two implementations behind one `scan_fn(impl, dtype)` cache:
+
+  xla-cumsum   `jnp.cumsum` — the XLA baseline, every dtype; int32
+               wraps mod 2^32 (same accumulator-width contract as SUM,
+               reduction.cpp:748,776-777)
+  mxu-scan     the blocked matmul trick above — float dtypes only (an
+               integer matmul would not land on the MXU), highest
+               precision so the ones-matrix products are exact sums
+
+`StreamScanner` is the chunk-carry composition with the streaming
+pipeline's chunk plan (ops/stream.plan_chunks): per bounded chunk,
+y = scan(chunk) + carry and carry' = y[-1], so an arbitrarily large
+input scans under the <= 2-chunk device-residency bound and no message
+can exceed config.stage_chunk_bytes. For int32 the chunk-carry result
+is bit-identical to the one-shot cumsum (associativity of modular
+addition); floats reassociate across the chunk boundary within SUM's
+declared tolerance (ops/registry.tolerance).
+
+No reference analog (the reference has no scan at all).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+from tpu_reductions.ops.stream import iter_chunks, plan_chunks
+from tpu_reductions.utils import staging
+
+# MXU tile width (pallas_guide.md): the within-block scan width
+_MXU_B = 128
+
+SCAN_IMPLS = ("xla-cumsum", "mxu-scan")
+
+
+def scan_impls(dtype) -> tuple:
+    """The implementations legal for `dtype` — the exec/cost.py
+    candidate axis (pick_scan). mxu-scan is float-only: the trick is a
+    matmul, and an int32 matmul would not ride the MXU.
+
+    No reference analog (TPU-native).
+    """
+    if _is_float(dtype):
+        return SCAN_IMPLS
+    return ("xla-cumsum",)
+
+
+def _is_float(dtype) -> bool:
+    """bfloat16 is a float for the MXU's purposes but not a numpy
+    floating subtype (it lives in ml_dtypes), so the gate names it."""
+    return (str(np.dtype(dtype)) == "bfloat16"
+            or np.issubdtype(np.dtype(dtype), np.floating))
+
+
+def _core(impl: str, dtype: str):
+    """Traceable 1-D inclusive-prefix core for one implementation
+    (module docstring) — shared by the one-shot/carry jit (scan_fn)
+    and the row-batched serving jit (scan_rows_fn).
+
+    No reference analog (TPU-native).
+    """
+    import jax.numpy as jnp
+
+    if impl == "xla-cumsum":
+        def core(x):
+            return jnp.cumsum(x, dtype=x.dtype)
+    elif impl == "mxu-scan":
+        if not _is_float(dtype):
+            raise ValueError(f"mxu-scan is float-only, got {dtype}")
+
+        def core(x):
+            n = x.shape[0]
+            nb = -(-n // _MXU_B)
+            xp = jnp.pad(x, (0, nb * _MXU_B - n)).reshape(nb, _MXU_B)
+            u = jnp.triu(jnp.ones((_MXU_B, _MXU_B), dtype=x.dtype))
+            # within-block scan on the MXU (1811.09736); highest
+            # precision so each ones-column product is an exact sum
+            within = jnp.dot(xp, u, precision="highest")
+            # hierarchical carry level: exclusive prefix of block totals
+            totals = within[:, -1]
+            excl = jnp.cumsum(totals, dtype=x.dtype) - totals
+            return (within + excl[:, None]).reshape(-1)[:n]
+    else:
+        raise ValueError(f"unknown scan impl {impl!r}; one of {SCAN_IMPLS}")
+
+    return core
+
+
+@functools.lru_cache(maxsize=None)
+def scan_fn(impl: str, dtype: str):
+    """Jitted (chunk, carry) -> inclusive prefix array. `carry` is the
+    running total of everything before this chunk (0 for a one-shot
+    scan); adding it on device keeps the int32 wrap in the device's
+    own accumulator width.
+
+    No reference analog (TPU-native).
+    """
+    import jax
+
+    core = _core(impl, dtype)
+    return jax.jit(lambda x, carry: core(x) + carry)
+
+
+@functools.lru_cache(maxsize=None)
+def scan_rows_fn(impl: str, dtype: str):
+    """Jitted (k, n) -> (k, n) per-row inclusive prefixes — the
+    coalesced serving shape (serve/executor.run_batch's family
+    dispatch): k stacked SCAN requests pay one dispatch.
+
+    No reference analog (TPU-native).
+    """
+    import jax
+
+    return jax.jit(jax.vmap(_core(impl, dtype)))
+
+
+def host_scan(x: np.ndarray) -> np.ndarray:
+    """Host oracle: the full inclusive prefix in the device's
+    accumulator conventions — int32 wraps mod 2^32 (exact int64 cumsum
+    then truncate, same result class as a wrapping int32 accumulator),
+    floats accumulate in float64 (the Kahan-class reference precision,
+    reduction.cpp:214-227) for tolerance comparison.
+
+    No reference analog (TPU-native).
+    """
+    x = np.ravel(np.asarray(x))
+    if x.dtype == np.int32:
+        return np.cumsum(x.astype(np.int64)).astype(np.uint64).astype(
+            np.uint32).view(np.int32)
+    return np.cumsum(x.astype(np.float64))
+
+
+class StreamScanner:
+    """Chunk-carry prefix scan over the streaming chunk plan
+    (module docstring has the recurrence). Drive each device launch
+    through the executor: `scan(flat, call=ctx.call)` from inside a
+    LaunchPlan builder keeps the package RED025-clean.
+
+    No reference analog (TPU-native).
+    """
+
+    def __init__(self, dtype: str, n: int, *, impl: str = "xla-cumsum",
+                 chunk_bytes: Optional[int] = None) -> None:
+        self.dtype = str(dtype)
+        self.impl = impl
+        self.plan = plan_chunks(n, self.dtype, chunk_bytes)
+        self._fn = scan_fn(impl, self.dtype)
+        self._carry = np.dtype(self.dtype).type(0)
+
+    @property
+    def carry(self):
+        """Running total of every element scanned so far (the next
+        chunk's additive offset). No reference analog (TPU-native)."""
+        return self._carry
+
+    def scan(self, flat: np.ndarray, *, call=None) -> np.ndarray:
+        """Full inclusive prefix of `flat`, one bounded chunk at a
+        time (<= 2 chunks device-resident: the staged chunk plus its
+        in-flight result). `call` wraps each device unit — pass
+        `ctx.call` from a LaunchPlan builder.
+
+        No reference analog (TPU-native).
+        """
+        import jax
+
+        call = call or (lambda fn: fn())
+        flat = np.ravel(np.asarray(flat, dtype=self.dtype))
+        out = np.empty(flat.size, dtype=self.dtype)
+        pos = 0
+        for chunk in iter_chunks(flat, self.plan):
+            def unit(chunk=chunk):
+                d = staging.put_chunk_async(
+                    chunk, chunk_bytes=self.plan.chunk_bytes)
+                return np.asarray(jax.device_get(
+                    self._fn(d, self._carry)))
+            y = call(unit)
+            out[pos:pos + y.size] = y
+            self._carry = y[-1]
+            pos += y.size
+        return out

@@ -1,0 +1,110 @@
+"""Segmented reductions — SEGSUM / SEGMIN / SEGMAX over an offset
+vector (ISSUE 20; docs/FAMILY.md).
+
+A segmented reduce is the batched row-reduce shape serving traffic
+has: one flat payload, a vector of segment offsets (ragged — segments
+may be empty), one result per segment. serve/executor.run_batch's
+stacked bucket launch is this operation in disguise with every
+segment forced to the bucket's power-of-two length; the ragged path
+here launches ONE concatenated segment reduce and pays zero
+identity-padding (serve/executor.run_family_batch).
+
+Device side rides XLA's segment combiners (`jax.ops.segment_sum/
+min/max` — scatter-combine, not a redistribution primitive, so no
+RED016 fence applies); empty segments come back as the op's monoid
+identity, exactly the padding contract the classic path uses
+(ops/registry.ReduceOpSpec.identity — the guard the reference's
+non-pow2 min/max kernels lacked, reduction_kernel.cu:140,157).
+int32 SEGSUM wraps mod 2^32 per segment on both device and oracle
+(the reference's accumulator-width contract, reduction.cpp:748,776-777).
+
+No reference analog (the reference reduces whole arrays only).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from tpu_reductions.ops.registry import get_op
+
+# family method -> the classic op whose combine/identity/tolerance
+# rules each segment follows
+SEG_BASE = {"SEGSUM": "SUM", "SEGMIN": "MIN", "SEGMAX": "MAX"}
+
+
+@functools.lru_cache(maxsize=None)
+def segment_reduce_fn(method: str, num_segments: int):
+    """Jitted (x, segment_ids) -> per-segment results for one family
+    method at a static segment count (retrace per count, like every
+    other shape axis).
+
+    No reference analog (TPU-native).
+    """
+    import jax
+
+    m = method.upper()
+    combiner = {"SEGSUM": jax.ops.segment_sum,
+                "SEGMIN": jax.ops.segment_min,
+                "SEGMAX": jax.ops.segment_max}[m]
+
+    def seg(x, ids):
+        return combiner(x, ids, num_segments=num_segments)
+
+    return jax.jit(seg)
+
+
+def segment_ids_from_offsets(offsets: np.ndarray) -> np.ndarray:
+    """Expand an offset vector (length S+1, offsets[0]=0,
+    offsets[-1]=n, monotone; equal neighbors = empty segment) into the
+    per-element segment-id vector the device combiner consumes.
+
+    No reference analog (TPU-native).
+    """
+    offsets = np.asarray(offsets, dtype=np.int64)
+    lengths = np.diff(offsets)
+    if offsets[0] != 0 or (lengths < 0).any():
+        raise ValueError("offsets must start at 0 and be monotone")
+    return np.repeat(np.arange(lengths.size, dtype=np.int32), lengths)
+
+
+def random_offsets(n: int, num_segments: int, seed: int) -> np.ndarray:
+    """Deterministic ragged offsets for `n` elements: `num_segments`
+    segments with uniformly random cut points, duplicates included —
+    so empty segments occur by construction and the ragged path is
+    exercised, not just the uniform one.
+
+    No reference analog (TPU-native).
+    """
+    rng = np.random.default_rng(seed)
+    cuts = np.sort(rng.integers(0, n + 1, size=num_segments - 1))
+    return np.concatenate(([0], cuts, [n])).astype(np.int64)
+
+
+def host_segment_reduce(x: np.ndarray, offsets: np.ndarray,
+                        method: str) -> np.ndarray:
+    """Host oracle: per-segment numpy reduce in host_reduce's result
+    conventions — int32 SEGSUM wraps mod 2^32 per segment, float sums
+    accumulate in float64, MIN/MAX exact; an empty segment yields the
+    base op's monoid identity (the device combiner's fill value).
+    Returns float64 (every family digest comparison happens in the
+    float64 value domain; int32 values embed exactly).
+
+    No reference analog (TPU-native).
+    """
+    from tpu_reductions.ops.oracle import host_reduce
+
+    m = method.upper()
+    base = SEG_BASE[m]
+    op = get_op(base)
+    x = np.ravel(np.asarray(x))
+    offsets = np.asarray(offsets, dtype=np.int64)
+    out = np.empty(offsets.size - 1, dtype=np.float64)
+    for i in range(offsets.size - 1):
+        seg = x[offsets[i]:offsets[i + 1]]
+        if seg.size == 0:
+            out[i] = np.float64(op.identity(x.dtype))
+        else:
+            out[i] = np.float64(host_reduce(seg, base))
+    return out

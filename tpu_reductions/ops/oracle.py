@@ -149,6 +149,16 @@ def host_reduce(x: np.ndarray, method: str) -> np.ndarray:
             return x.dtype.type(fn(x, x.size))
         return get_op(method).np_reduce(x)
 
+    if method == "SCAN":
+        # a scan's scalar digest is its last prefix element == the full
+        # SUM (docs/FAMILY.md); the full-prefix oracle is
+        # ops/family/scan.host_scan
+        return host_reduce(x, "SUM")
+
+    if method in ("ARGMIN", "ARGMAX"):
+        from tpu_reductions.ops.family.argreduce import host_arg_reduce
+        return host_arg_reduce(x, method)
+
     raise ValueError(f"unknown method {method!r}")
 
 
@@ -190,7 +200,8 @@ class IncrementalOracle:
 
     def __init__(self, method: str, dtype: str) -> None:
         self.method = method.upper()
-        if self.method not in ("SUM", "MIN", "MAX"):
+        if self.method not in ("SUM", "MIN", "MAX", "SCAN",
+                               "ARGMIN", "ARGMAX"):
             raise ValueError(f"unknown method {method!r}")
         self.dtype = str(dtype)
         self.count = 0
@@ -198,6 +209,11 @@ class IncrementalOracle:
         self._sum = 0.0              # float SUM: Kahan pair
         self._comp = 0.0
         self._extreme: Optional[float] = None   # MIN/MAX running value
+        # ARGMIN/ARGMAX: global index of the running extreme — `count`
+        # at each update is the chunk's global offset, so indices stay
+        # global across chunk boundaries; a tie keeps the OLD index
+        # (earlier chunk == lower index, docs/FAMILY.md tie rule)
+        self._extreme_idx: Optional[int] = None
 
     def update(self, chunk: np.ndarray) -> None:
         """Fold one host chunk into the running oracle state (module
@@ -207,9 +223,22 @@ class IncrementalOracle:
         """
         if chunk.size == 0:
             return
-        h = host_reduce(np.asarray(chunk), self.method)
+        chunk = np.asarray(chunk)
+        if self.method in ("ARGMIN", "ARGMAX"):
+            li = int(np.argmin(chunk) if self.method == "ARGMIN"
+                     else np.argmax(chunk))
+            v = float(chunk[li])
+            better = (self._extreme is None
+                      or (v < self._extreme if self.method == "ARGMIN"
+                          else v > self._extreme))
+            if better:    # strict: a tie keeps the earlier (lower) index
+                self._extreme = v
+                self._extreme_idx = self.count + li
+            self.count += int(chunk.size)
+            return
+        h = host_reduce(chunk, self.method)
         self.count += int(chunk.size)
-        if self.method == "SUM":
+        if self.method in ("SUM", "SCAN"):
             if self.dtype == "int32":
                 # both addends already wrap mod 2^32; their wrapped sum
                 # equals the one-shot wrapped total (associativity of
@@ -240,10 +269,14 @@ class IncrementalOracle:
 
         No reference analog (TPU-native).
         """
-        if self.method == "SUM":
+        if self.method in ("SUM", "SCAN"):
             if self.dtype == "int32":
                 return np.int64(self._int_total).astype(np.int32)[()]
             return np.float64(self._sum)
+        if self.method in ("ARGMIN", "ARGMAX"):
+            if self._extreme_idx is None:
+                raise ValueError("oracle saw no data")
+            return np.int64(self._extreme_idx)
         if self._extreme is None:
             raise ValueError("oracle saw no data")
         return np.dtype(self.dtype).type(self._extreme)
@@ -254,7 +287,8 @@ class IncrementalOracle:
         return {"method": self.method, "dtype": self.dtype,
                 "count": self.count, "int_total": self._int_total,
                 "sum": self._sum, "comp": self._comp,
-                "extreme": self._extreme}
+                "extreme": self._extreme,
+                "extreme_idx": self._extreme_idx}
 
     @classmethod
     def from_state(cls, state: dict) -> "IncrementalOracle":
@@ -266,6 +300,8 @@ class IncrementalOracle:
         o._sum = float(state.get("sum", 0.0))
         o._comp = float(state.get("comp", 0.0))
         o._extreme = state.get("extreme")
+        idx = state.get("extreme_idx")
+        o._extreme_idx = None if idx is None else int(idx)
         return o
 
 

@@ -108,13 +108,88 @@ OPS = {
 }
 
 
+# --------------------------------------------------------------------------
+# The reduction family (ISSUE 20; docs/FAMILY.md; config.FAMILY_METHODS):
+# SCAN, segmented reductions, argmin/argmax as ReduceOpSpec-compatible
+# entries. The spec fields describe the COMBINE monoid each method's
+# partials obey — SCAN carries combine like SUM (a prefix's continuation
+# adds the running total), SEG* segments each follow their base op, and
+# ARG* combine in the order-preserving key domain (ops/family/argreduce)
+# where the extreme is a MIN/MAX — so chained timing (ops/chain.py needs
+# name + jnp_combine), padding (identity) and the collective spelling
+# all fall out of the same table the classic ops use. The family device
+# entry points live in ops/family/; these specs are the registry's view.
+# --------------------------------------------------------------------------
+
+def _scan_np_reduce(x, **kw):
+    # digest convention: a scan's scalar digest is its last prefix
+    # element == the full SUM (docs/FAMILY.md)
+    return np.sum(x, **kw)
+
+
+FAMILY_OPS = {
+    "SCAN": ReduceOpSpec(
+        name="SCAN",
+        jnp_reduce=_jnp_sum_same_dtype,
+        jnp_combine=jnp.add,
+        np_reduce=_scan_np_reduce,
+        lax_collective="psum",
+        monoid_identity=_sum_identity,
+    ),
+    "SEGSUM": ReduceOpSpec(
+        name="SEGSUM",
+        jnp_reduce=_jnp_sum_same_dtype,
+        jnp_combine=jnp.add,
+        np_reduce=np.sum,
+        lax_collective="psum",
+        monoid_identity=_sum_identity,
+    ),
+    "SEGMIN": ReduceOpSpec(
+        name="SEGMIN",
+        jnp_reduce=jnp.min,
+        jnp_combine=jnp.minimum,
+        np_reduce=np.min,
+        lax_collective="pmin",
+        monoid_identity=_min_identity,
+    ),
+    "SEGMAX": ReduceOpSpec(
+        name="SEGMAX",
+        jnp_reduce=jnp.max,
+        jnp_combine=jnp.maximum,
+        np_reduce=np.max,
+        lax_collective="pmax",
+        monoid_identity=_max_identity,
+    ),
+    "ARGMIN": ReduceOpSpec(
+        name="ARGMIN",
+        jnp_reduce=jnp.min,
+        jnp_combine=jnp.minimum,
+        np_reduce=np.min,
+        lax_collective="pmin",
+        monoid_identity=_min_identity,
+    ),
+    "ARGMAX": ReduceOpSpec(
+        name="ARGMAX",
+        jnp_reduce=jnp.max,
+        jnp_combine=jnp.maximum,
+        np_reduce=np.max,
+        lax_collective="pmax",
+        monoid_identity=_max_identity,
+    ),
+}
+
+
 def get_op(name: str) -> ReduceOpSpec:
-    """Lookup by the CLI spelling (SUM/MIN/MAX — the reference's
-    --method flag values, reduction.cpp:84-204)."""
-    try:
-        return OPS[name.upper()]
-    except KeyError:
-        raise ValueError(f"unknown reduction {name!r}; expected one of {list(OPS)}")
+    """Lookup by the CLI spelling: the reference's --method flag values
+    (SUM/MIN/MAX, reduction.cpp:84-204) plus the family methods
+    (config.FAMILY_METHODS; docs/FAMILY.md)."""
+    key = name.upper()
+    if key in OPS:
+        return OPS[key]
+    if key in FAMILY_OPS:
+        return FAMILY_OPS[key]
+    raise ValueError(f"unknown reduction {name!r}; expected one of "
+                     f"{list(OPS) + list(FAMILY_OPS)}")
 
 
 def tolerance(method: str, dtype: str, n: int) -> float:
@@ -125,7 +200,10 @@ def tolerance(method: str, dtype: str, n: int) -> float:
     """
     if dtype in ("int32", "int64"):
         return 0.0
-    if method.upper() in ("MIN", "MAX"):
+    if method.upper() in ("MIN", "MAX", "SEGMIN", "SEGMAX",
+                          "ARGMIN", "ARGMAX"):
+        # exact selections — the family extremes inherit the MIN/MAX
+        # rule, and arg indices are integers whatever the data dtype
         return 0.0
     if dtype == "float64":
         return 1e-12

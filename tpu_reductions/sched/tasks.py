@@ -296,6 +296,26 @@ SESSION_TASKS: Tuple[Task, ...] = (
                             "--out=serving_elastic.json"),
          artifacts=("examples/tpu_run/serving_elastic.json",),
          done_artifact="examples/tpu_run/serving_elastic.json"),
+    Task("family_spot", "reduction-family spot", value=130.0,
+         budget_s=300,
+         # the family grid (ISSUE 20; docs/FAMILY.md): SCAN racing the
+         # MXU matmul trick against the XLA cumsum, segmented reduce,
+         # argmin/argmax — every cell chained-timed and oracle-verified,
+         # plus the end-to-end serving proof rows. The committed
+         # artifact is what exec/cost.pick_scan prices from, and
+         # bench/regen folds family_spot_markdown into report.md; the
+         # smoke gate must have lowered mxu-scan first
+         command=("python -m tpu_reductions.bench.family_spot "
+                  "--n=16777216 "
+                  "--out=examples/tpu_run/family_spot.json"),
+         rehearsal_command=("python -m tpu_reductions.bench.family_spot "
+                            "--platform=cpu --n=131072 --serve-n=8192 "
+                            "--reps=2 --out=family_spot.json"),
+         artifacts=("examples/tpu_run/family_spot.json",),
+         done_artifact="examples/tpu_run/family_spot.json",
+         requires=("smoke",),
+         surfaces=("mxu-scan", "xla-cumsum", "seg/segsum",
+                   "argk/argmin")),
     Task("serving_recovery", "crash-recovery instrument", value=100.0,
          budget_s=420,
          # off-chip by design (ISSUE 18; docs/SERVING.md

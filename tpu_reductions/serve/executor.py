@@ -133,11 +133,19 @@ class BatchExecutor:
         device failure after the retry wrapper's classification — the
         engine contains the crash to the batch (the crash_result
         discipline of bench/driver.py, response-shaped)."""
+        from tpu_reductions.config import FAMILY_METHODS
         from tpu_reductions.exec import core as exec_core
         from tpu_reductions.exec.plan import launch_plan
         from tpu_reductions.ops import oracle as oracle_mod
         from tpu_reductions.ops.registry import get_op
         from tpu_reductions.utils.rng import host_data
+
+        method = method.upper()
+        # the reduction family (SCAN/SEG*/ARG* — ISSUE 20,
+        # docs/FAMILY.md) coalesces through the same engine but
+        # launches per method group, not as a padded row-reduce
+        if method in FAMILY_METHODS:
+            return self._run_family_batch(method, dtype, n, seeds)
 
         # chaos hook: one coalesced launch = one interruptible unit,
         # the serving analog of bench.run (faults/inject.py;
@@ -203,6 +211,151 @@ class BatchExecutor:
             })
         return out
 
+    # segments per served segmented request: small enough that the
+    # offset vector is wire-trivial, large enough to exercise ragged
+    # and (by the random-cut construction) occasionally empty segments
+    _SERVE_SEGMENTS = 8
+
+    def _run_family_batch(self, method: str, dtype: str, n: int,
+                          seeds: List[int]) -> List[Dict]:
+        """Coalesced launch for one family method group (ISSUE 20;
+        docs/FAMILY.md), same response shape as run_batch:
+
+          SCAN    k requests stack to (k, n); the impl (mxu-scan vs
+                  xla-cumsum) is a cost-oracle decision
+                  (exec/cost.pick_scan, exec.select-audited); the
+                  served scalar is the scan digest (last prefix =
+                  full SUM).
+          SEG*    the RAGGED path: k offset-vector payloads
+                  concatenate into ONE flat array with globally
+                  renumbered segment ids and launch a single
+                  segment reduce — no identity padding to the
+                  bucket's power of two, the whole point of
+                  segmented serving.
+          ARG*    k requests stack to (k, n); one lexicographic
+                  (key, index) row reduce returns all k extreme
+                  indices, exact with lowest-index ties
+                  (ops/family/argreduce.py).
+
+        Each launch is one LaunchPlan through exec.core.run (RED025)
+        and lands a `family.serve` ledger event."""
+        from tpu_reductions.exec import core as exec_core
+        from tpu_reductions.exec.cost import CostOracle, emit_select
+        from tpu_reductions.exec.plan import launch_plan
+        from tpu_reductions.obs import ledger
+        from tpu_reductions.ops import oracle as oracle_mod
+        from tpu_reductions.ops.family import (SEG_BASE,
+                                               arg_reduce_rows_fn,
+                                               host_segment_reduce,
+                                               random_offsets,
+                                               scan_rows_fn,
+                                               segment_ids_from_offsets,
+                                               segment_reduce_fn)
+        from tpu_reductions.utils.rng import host_data
+
+        fault_point("serve.batch")   # same interruptible-unit hook as
+        #                              a classic coalesced launch
+
+        payloads = []
+        for seed in seeds:
+            x = oracle_mod.native_fill(n, dtype, rank=0, seed=seed)
+            if x is None:
+                x = host_data(n, dtype, rank=0, seed=seed)
+            payloads.append(np.ravel(x))
+        k = len(payloads)
+
+        if method == "SCAN":
+            decision = CostOracle().pick_scan(dtype, n)
+            emit_select(decision, method=method, dtype=dtype, n=n,
+                        batch=k)
+            fn = scan_rows_fn(decision.choice, dtype)
+            stacked = np.stack(payloads)
+            surface = f"family-scan/{decision.choice}"
+
+            def launch():
+                import jax
+                # jit ingests the host stack directly — the same
+                # bounded-transfer argument as run_batch's launch
+                return np.asarray(jax.device_get(fn(stacked)))[:, -1]
+        elif method in SEG_BASE:
+            offsets = [random_offsets(n, self._SERVE_SEGMENTS, seed)
+                       for seed in seeds]
+            s = self._SERVE_SEGMENTS
+            flat = np.concatenate(payloads)
+            ids = np.concatenate(
+                [np.int32(i * s) + segment_ids_from_offsets(off)
+                 for i, off in enumerate(offsets)]).astype(np.int32)
+            # (k, s) mask of non-empty segments: empty segments come
+            # back as the op's monoid identity (+-inf for float
+            # MIN/MAX), which must not poison the digest sum — both
+            # sides drop them identically
+            nonempty = np.stack([np.diff(off) > 0 for off in offsets])
+            fn = segment_reduce_fn(method, k * s)
+            surface = f"family-seg/{method.lower()}"
+
+            def launch():
+                import jax
+                segs = np.asarray(jax.device_get(fn(flat, ids)))
+                # per-request digest: float64 sum of its non-empty
+                # per-segment results (per-segment values are the real
+                # payload; the digest is only the scalar the wire
+                # carries back)
+                segs = segs.astype(np.float64).reshape(k, s)
+                return np.where(nonempty, segs, 0.0).sum(axis=1)
+        else:   # ARGMIN / ARGMAX
+            fn = arg_reduce_rows_fn(method, dtype)
+            stacked = np.stack(payloads)
+            surface = f"family-argk/{method.lower()}"
+
+            def launch():
+                import jax
+                return np.asarray(jax.device_get(fn(stacked)))
+
+        plan = launch_plan(surface, "serve", lambda ctx: launch(),
+                           timing="serve", heartbeat_phase="serve",
+                           retry=True, drain=True, method=method,
+                           dtype=dtype, n=n, batch=k)
+        # first launch per (surface, dtype, n) is the group's
+        # trace+compile point — same observatory discipline as the
+        # classic bucket launch above
+        bucket_key = (surface, dtype, n, _bucket(k))
+        if bucket_key not in _observed_buckets:
+            _observed_buckets.add(bucket_key)
+            with exec_core.observe_compile(plan.surface, dtype=dtype,
+                                           n=n, batch=k):
+                vals = exec_core.run(plan)
+        else:
+            vals = exec_core.run(plan)
+
+        out: List[Dict] = []
+        ok_count = 0
+        for i in range(k):
+            if method in SEG_BASE:
+                segs_h = host_segment_reduce(payloads[i], offsets[i],
+                                             method)
+                host = float(segs_h[nonempty[i]].sum())
+                # the digest is a SUM of per-segment results, so it
+                # verifies under SUM's tolerance class (SEGMIN/SEGMAX
+                # per-segment values are exact, making the digest
+                # exact too)
+                ok, diff = oracle_mod.verify(vals[i], host, "SUM",
+                                             dtype, n)
+            else:
+                host = oracle_mod.host_reduce(payloads[i], method)
+                ok, diff = oracle_mod.verify(vals[i], host, method,
+                                             dtype, n)
+            ok_count += bool(ok)
+            out.append({
+                "result": float(np.asarray(vals[i], dtype=np.float64)),
+                "ok": bool(ok),
+                "host": float(np.asarray(host, dtype=np.float64)),
+                "diff": float(diff),
+            })
+        ledger.emit("family.serve", method=method, dtype=dtype, n=n,
+                    batch=k, surface=surface, ok=ok_count,
+                    failed=k - ok_count)
+        return out
+
     def run_stream(self, method: str, dtype: str, n: int, seed: int,
                    *, chunk_bytes: Optional[int] = None,
                    sync_every: int = 8) -> Dict:
@@ -216,12 +369,24 @@ class BatchExecutor:
         chunk-wise oracle (ops/oracle.IncrementalOracle), so the host
         side never needs a second full-payload pass either. Same retry
         classification and response shape as run_batch."""
+        from tpu_reductions.config import FAMILY_METHODS
         from tpu_reductions.exec import core as exec_core
         from tpu_reductions.exec.plan import launch_plan
         from tpu_reductions.ops import oracle as oracle_mod
         from tpu_reductions.ops.stream import (iter_chunks, plan_chunks,
                                                run_stream)
         from tpu_reductions.utils.rng import host_data
+
+        method = method.upper()
+        if method in FAMILY_METHODS and method != "SCAN":
+            # segmented/arg requests carry whole-payload structure the
+            # chunk fold cannot carry across a boundary yet — they stay
+            # under the coalesced-batch size cap (docs/FAMILY.md)
+            raise ValueError(f"{method} has no streaming path; only "
+                             "SCAN chunk-carries (ops/family/scan.py)")
+        if method == "SCAN":
+            return self._run_stream_scan(dtype, n, seed,
+                                         chunk_bytes=chunk_bytes)
 
         fault_point("serve.batch")   # same interruptible-unit hook as
         #                              a coalesced launch
@@ -249,6 +414,57 @@ class BatchExecutor:
             "diff": float(diff),
             "chunks": res.num_chunks,
             "gbps": round(res.gbps, 4),
+        }
+
+    def _run_stream_scan(self, dtype: str, n: int, seed: int, *,
+                         chunk_bytes: Optional[int] = None) -> Dict:
+        """Oversized SCAN through the chunk-carry scanner
+        (ops/family/scan.StreamScanner; docs/FAMILY.md): per bounded
+        chunk y = scan(chunk) + carry, carry' = y[-1], so an
+        arbitrarily large prefix sum serves under the <= 2-chunk
+        device-residency bound. The served scalar is the scan digest
+        (final carry = full SUM), verified against the incremental
+        oracle — same response shape as run_stream."""
+        import time as _time
+
+        from tpu_reductions.exec import core as exec_core
+        from tpu_reductions.exec.plan import launch_plan
+        from tpu_reductions.ops import oracle as oracle_mod
+        from tpu_reductions.ops.family.scan import StreamScanner
+        from tpu_reductions.ops.stream import iter_chunks, plan_chunks
+        from tpu_reductions.utils.rng import host_data
+
+        fault_point("serve.batch")
+
+        x = oracle_mod.native_fill(n, dtype, rank=0, seed=seed)
+        if x is None:
+            x = host_data(n, dtype, rank=0, seed=seed)
+        x = np.ravel(x)
+
+        sc = StreamScanner(dtype, n, chunk_bytes=chunk_bytes)
+        t0 = _time.perf_counter()
+        exec_core.run(launch_plan(
+            "serve-stream/scan", "serve",
+            lambda ctx: sc.scan(x, call=lambda fn: ctx.call(
+                fn, phase="serve")),
+            timing="stream", heartbeat_phase=None, retry=False,
+            drain=True, staging_bound=int(sc.plan.chunk_bytes),
+            method="SCAN", dtype=dtype, n=n))
+        wall = _time.perf_counter() - t0
+        digest = sc.carry
+
+        oracle = oracle_mod.IncrementalOracle("SCAN", dtype)
+        for chunk in iter_chunks(x, plan_chunks(n, dtype, chunk_bytes)):
+            oracle.update(chunk)
+        ok, diff = oracle_mod.verify(digest, oracle.value(),
+                                     "SCAN", dtype, n)
+        return {
+            "result": float(np.asarray(digest, dtype=np.float64)),
+            "ok": bool(ok),
+            "host": float(np.asarray(oracle.value(), dtype=np.float64)),
+            "diff": float(diff),
+            "chunks": sc.plan.num_chunks,
+            "gbps": round(x.nbytes / max(wall, 1e-9) / 1e9, 4),
         }
 
     def run_sharded(self, method: str, dtype: str, n: int, seed: int,
@@ -286,7 +502,18 @@ class BatchExecutor:
 
         fault_point("serve.batch")
 
+        from tpu_reductions.config import FAMILY_METHODS
+
         method = method.upper()
+        if method in FAMILY_METHODS:
+            if method == "SCAN":
+                # an oversized SCAN chunk-carries; the digest is the
+                # same scalar the sharded fold would produce
+                return self.run_stream(method, dtype, n, seed,
+                                       chunk_bytes=chunk_bytes)
+            raise ValueError(f"{method} has no device-parallel path; "
+                             "family methods serve via the coalesced "
+                             "batch (docs/FAMILY.md)")
         if dtype == "float64":
             raise ValueError("float64 shards through the dd stream "
                              "path, not run_sharded (serve/engine.py "

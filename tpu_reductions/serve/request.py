@@ -20,7 +20,7 @@ from typing import Optional
 
 import numpy as np
 
-from tpu_reductions.config import DTYPE_ALIASES, METHODS
+from tpu_reductions.config import DTYPE_ALIASES, SERVED_METHODS
 
 # terminal response statuses — the engine's whole vocabulary. Every
 # submitted request resolves to exactly one of these (the no-hang
@@ -75,8 +75,12 @@ class ReduceRequest:
 
     def __post_init__(self) -> None:
         self.method = self.method.upper()
-        if self.method not in METHODS:
-            raise ValueError(f"method must be one of {METHODS}, "
+        # the served vocabulary is the classic ops PLUS the reduction
+        # family (SCAN/SEG*/ARG* — ISSUE 20, docs/FAMILY.md); admission,
+        # coalescing and SLO handling are method-agnostic, only the
+        # executor dispatches per group
+        if self.method not in SERVED_METHODS:
+            raise ValueError(f"method must be one of {SERVED_METHODS}, "
                              f"got {self.method!r}")
         if self.dtype not in DTYPE_ALIASES:
             raise ValueError(f"unknown dtype {self.dtype!r}")

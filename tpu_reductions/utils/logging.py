@@ -19,6 +19,7 @@ from typing import Optional, TextIO
 
 from tpu_reductions.lint.grammar import (COLLECTIVE_HEADER,
                                          COLLECTIVE_ROW_TEMPLATE,
+                                         FAMILY_ROW_TEMPLATE,
                                          QUANT_CURVE_ROW_TEMPLATE,
                                          THROUGHPUT_TEMPLATE)
 
@@ -55,6 +56,20 @@ def quant_curve_row(dtype: str, op: str, bits: int, ranks: int,
     return QUANT_CURVE_ROW_TEMPLATE.format(
         dtype=names.get(dtype, dtype.upper()), op=op.upper(), bits=bits,
         ranks=ranks, wirex=wirex, max_err=max_err, bound=bound)
+
+
+def family_row(dtype: str, op: str, impl: str, n: int, gbps: float,
+               status: str) -> str:
+    """One reduction-family spot row (bench/family_spot.py):
+    `DATATYPE OP IMPL N GBPS STATUS` — the family extension of the
+    MPI rank-0 schema (reduce.c:81,95), same upper-cased dtype
+    spelling plus the implementation column and the oracle verdict,
+    template pinned in lint/grammar.py."""
+    names = {"int32": "INT", "float64": "DOUBLE", "float32": "FLOAT",
+             "bfloat16": "BF16"}
+    return FAMILY_ROW_TEMPLATE.format(
+        dtype=names.get(dtype, dtype.upper()), op=op.upper(), impl=impl,
+        n=n, gbps=gbps, status=status)
 
 
 # COLLECTIVE_HEADER (reduce.c:67-69) is imported from lint/grammar.py
